@@ -1,0 +1,28 @@
+"""Test config: force an 8-device virtual CPU platform.
+
+This is the TPU analog of the reference's IN_PROCESS endpoint trick
+(include/distributed/endpoint.hpp:210, communicator.hpp:51-60): distributed logic is
+tested in one process — here on a virtual 8-device mesh — without real hardware.
+
+The dev box exposes a real TPU through a sitecustomize that pre-imports jax, so env vars
+alone don't stick; jax.config.update after import is required. TNN_TEST_PLATFORM
+overrides for running the suite on hardware.
+"""
+import os
+
+_platform = os.environ.get("TNN_TEST_PLATFORM", "cpu")
+os.environ["JAX_PLATFORMS"] = _platform
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", _platform)
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rng():
+    return jax.random.PRNGKey(0)

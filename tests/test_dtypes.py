@@ -1,0 +1,42 @@
+"""Dtype system tests (parity: reference bf16_test.cpp / fp16_test.cpp intent —
+here bf16 is hardware-native so tests cover policy/cast semantics, not bit emulation)."""
+import jax.numpy as jnp
+import pytest
+
+from tnn_tpu.core import dtypes as dt
+
+
+def test_canonical_names():
+    assert dt.canonical_name("f32") == "float32"
+    assert dt.canonical_name("bf16") == "bfloat16"
+    assert dt.canonical_name(jnp.float32) == "float32"
+    assert dt.canonical_name(jnp.bfloat16) == "bfloat16"
+    with pytest.raises(ValueError):
+        dt.canonical_name("not_a_dtype")
+
+
+def test_sizes():
+    assert dt.size_of("float32") == 4
+    assert dt.size_of("bfloat16") == 2
+    assert dt.size_of("int8") == 1
+    assert dt.size_of("float64") == 8
+
+
+def test_policy_roundtrip():
+    p = dt.DTypePolicy(io="bf16", param="f32", compute="bf16")
+    cfg = p.to_config()
+    p2 = dt.DTypePolicy.from_config(cfg)
+    assert p == p2
+    assert p2.compute_dtype == jnp.bfloat16
+
+
+def test_policy_casts():
+    p = dt.MIXED_BF16
+    x = jnp.ones((4,), jnp.float32)
+    assert p.cast_in(x).dtype == jnp.bfloat16
+    ids = jnp.ones((4,), jnp.int32)
+    assert p.cast_in(ids).dtype == jnp.int32  # ints pass through
+
+
+def test_epsilon_ordering():
+    assert dt.epsilon("float64") < dt.epsilon("float32") < dt.epsilon("bfloat16")

@@ -1,0 +1,251 @@
+"""Loss/optimizer/scheduler/train-step tests.
+
+End-to-end convergence on a synthetic task is the analog of the reference's
+train-loop integration coverage (src/nn/train.cpp paths)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tnn_tpu import nn
+from tnn_tpu.core import dtypes as dt
+from tnn_tpu.nn import losses, optimizers, schedulers
+from tnn_tpu.train import create_train_state, make_eval_step, make_train_step
+
+F32 = dt.FP32
+
+
+# -- losses ------------------------------------------------------------------
+
+def test_softmax_cross_entropy_matches_numpy():
+    logits = np.random.RandomState(0).randn(8, 5).astype(np.float32)
+    labels = np.array([0, 1, 2, 3, 4, 0, 1, 2])
+    loss = losses.softmax_cross_entropy(jnp.asarray(logits), jnp.asarray(labels))
+    p = np.exp(logits - logits.max(-1, keepdims=True))
+    p /= p.sum(-1, keepdims=True)
+    ref = -np.mean(np.log(p[np.arange(8), labels]))
+    np.testing.assert_allclose(float(loss), ref, rtol=1e-5)
+
+
+def test_losses_basic():
+    a = jnp.asarray([[1.0, 2.0], [3.0, 4.0]])
+    b = jnp.asarray([[1.5, 2.0], [2.0, 4.0]])
+    np.testing.assert_allclose(float(losses.mse(a, b)), (0.25 + 1.0) / 4, rtol=1e-6)
+    np.testing.assert_allclose(float(losses.mae(a, b)), (0.5 + 1.0) / 4, rtol=1e-6)
+    h = float(losses.huber(a, b, delta=1.0))
+    np.testing.assert_allclose(h, (0.5 * 0.25 + 0.5) / 4, rtol=1e-6)
+
+
+def test_onehot_and_int_labels_agree():
+    logits = jnp.asarray(np.random.randn(4, 3), jnp.float32)
+    ints = jnp.asarray([0, 2, 1, 0], jnp.int32)
+    onehot = jax.nn.one_hot(ints, 3)
+    np.testing.assert_allclose(
+        float(losses.softmax_cross_entropy(logits, ints)),
+        float(losses.softmax_cross_entropy(logits, onehot)), rtol=1e-6)
+
+
+# -- optimizers --------------------------------------------------------------
+
+def _quad_params():
+    return {"w": jnp.asarray([5.0, -3.0], jnp.float32)}
+
+
+def _quad_grads(params):
+    return {"w": 2 * params["w"]}  # grad of ||w||^2
+
+
+@pytest.mark.parametrize("opt", [
+    optimizers.SGD(lr=0.1),
+    optimizers.SGD(lr=0.05, momentum=0.9),
+    optimizers.SGD(lr=0.05, momentum=0.9, nesterov=True),
+    optimizers.Adam(lr=0.3),
+    optimizers.Adam(lr=0.3, amsgrad=True),
+    optimizers.AdamW(lr=0.3, weight_decay=0.01),
+])
+def test_optimizers_minimize_quadratic(opt):
+    params = _quad_params()
+    state = opt.init(params)
+    for _ in range(150):
+        params, state = opt.update(_quad_grads(params), state, params)
+    assert float(jnp.abs(params["w"]).max()) < 0.1, f"{opt.opt_name} failed to converge"
+
+
+def test_sgd_matches_closed_form():
+    opt = optimizers.SGD(lr=0.1)
+    params = {"w": jnp.asarray([1.0])}
+    state = opt.init(params)
+    new_params, _ = opt.update({"w": jnp.asarray([0.5])}, state, params)
+    np.testing.assert_allclose(np.asarray(new_params["w"]), [0.95], rtol=1e-6)
+
+
+def test_grad_clipping():
+    opt = optimizers.SGD(lr=1.0, grad_clip_norm=1.0)
+    params = {"w": jnp.zeros((2,))}
+    state = opt.init(params)
+    new_params, _ = opt.update({"w": jnp.asarray([30.0, 40.0])}, state, params)
+    # clipped grad has norm 1 -> step of norm 1
+    np.testing.assert_allclose(float(jnp.linalg.norm(new_params["w"])), 1.0, rtol=1e-4)
+
+
+def test_optimizer_config_roundtrip():
+    opt = optimizers.Adam(lr=0.01, beta1=0.8, amsgrad=True, weight_decay=0.1)
+    cfg = opt.get_config()
+    opt2 = optimizers.from_config(cfg)
+    assert opt2.get_config() == cfg
+
+
+# -- schedulers --------------------------------------------------------------
+
+def test_step_lr():
+    s = schedulers.StepLR(step_size=10, gamma=0.1)
+    assert float(s.scale(0)) == pytest.approx(1.0)
+    assert float(s.scale(9)) == pytest.approx(1.0)
+    assert float(s.scale(10)) == pytest.approx(0.1)
+    assert float(s.scale(25)) == pytest.approx(0.01)
+
+
+def test_multistep_lr():
+    s = schedulers.MultiStepLR([5, 15], gamma=0.5)
+    assert float(s.scale(4)) == pytest.approx(1.0)
+    assert float(s.scale(5)) == pytest.approx(0.5)
+    assert float(s.scale(20)) == pytest.approx(0.25)
+
+
+def test_cosine():
+    s = schedulers.CosineAnnealingLR(t_max=100)
+    assert float(s.scale(0)) == pytest.approx(1.0)
+    assert float(s.scale(50)) == pytest.approx(0.5, abs=1e-6)
+    assert float(s.scale(100)) == pytest.approx(0.0, abs=1e-6)
+
+
+def test_warmup_cosine():
+    s = schedulers.WarmupCosineAnnealing(warmup=10, t_max=110)
+    assert float(s.scale(0)) == pytest.approx(0.0)
+    assert float(s.scale(5)) == pytest.approx(0.5)
+    assert float(s.scale(10)) == pytest.approx(1.0)
+    assert float(s.scale(110)) == pytest.approx(0.0, abs=1e-6)
+
+
+def test_cosine_restarts():
+    s = schedulers.CosineAnnealingWarmRestarts(t_0=10, t_mult=2)
+    assert float(s.scale(0)) == pytest.approx(1.0)
+    assert float(s.scale(10)) == pytest.approx(1.0)  # restart
+    assert float(s.scale(30)) == pytest.approx(1.0)  # second restart (10+20)
+
+
+def test_reduce_on_plateau():
+    s = schedulers.ReduceLROnPlateau(factor=0.5, patience=1)
+    assert s.observe(1.0) == 1.0
+    assert s.observe(0.5) == 1.0   # improved
+    assert s.observe(0.6) == 1.0   # bad 1
+    assert s.observe(0.6) == 0.5   # bad 2 > patience -> reduce
+    assert float(s.scale(0)) == 0.5
+
+
+def test_scheduler_config_roundtrip():
+    for s in [schedulers.StepLR(10), schedulers.MultiStepLR([1, 2]),
+              schedulers.ExponentialLR(0.9), schedulers.CosineAnnealingLR(50),
+              schedulers.WarmupCosineAnnealing(5, 50), schedulers.NoOp()]:
+        cfg = s.get_config()
+        assert schedulers.from_config(cfg).get_config() == cfg
+
+
+def test_scheduler_traces_in_jit():
+    s = schedulers.WarmupCosineAnnealing(warmup=10, t_max=100)
+
+    @jax.jit
+    def f(t):
+        return s.scale(t)
+
+    assert float(f(jnp.asarray(5))) == pytest.approx(0.5)
+
+
+# -- end-to-end train step ---------------------------------------------------
+
+def _spiral_data(n=256, seed=0):
+    """Two-class spiral — small but not linearly separable."""
+    rs = np.random.RandomState(seed)
+    n2 = n // 2
+    theta = np.linspace(0.5, 3 * np.pi, n2)
+    r = theta / (3 * np.pi)
+    x0 = np.stack([r * np.cos(theta), r * np.sin(theta)], -1)
+    x1 = -x0
+    x = np.concatenate([x0, x1]) + rs.randn(n, 2) * 0.02
+    y = np.concatenate([np.zeros(n2), np.ones(n2)]).astype(np.int32)
+    return x.astype(np.float32), y
+
+
+def test_train_step_learns_spiral(rng):
+    model = nn.Sequential([
+        nn.Dense(64, activation="tanh", policy=F32),
+        nn.Dense(64, activation="tanh", policy=F32),
+        nn.Dense(2, policy=F32),
+    ], policy=F32)
+    opt = nn.Adam(lr=1e-2)
+    state = create_train_state(model, opt, rng, (256, 2), input_dtype=jnp.float32)
+    step = make_train_step(model, opt)
+    x, y = _spiral_data()
+    data, labels = jnp.asarray(x), jnp.asarray(y)
+    for _ in range(150):
+        state, metrics = step(state, data, labels)
+    assert float(metrics["accuracy"]) > 0.95
+    assert float(metrics["loss"]) < 0.3
+
+
+def test_train_step_mixed_precision(rng):
+    """bf16 io/compute with f32 params — the TPU-native default policy."""
+    model = nn.Sequential([
+        nn.Dense(32, activation="relu"),
+        nn.Dense(2),
+    ])
+    opt = nn.SGD(lr=0.1, momentum=0.9)
+    state = create_train_state(model, opt, rng, (64, 2))
+    step = make_train_step(model, opt)
+    x, y = _spiral_data(64)
+    data = jnp.asarray(x, jnp.bfloat16)
+    labels = jnp.asarray(y)
+    for _ in range(30):
+        state, metrics = step(state, data, labels)
+    # params stay f32 master copies
+    assert state.params["00_dense"]["kernel"].dtype == jnp.float32
+    assert np.isfinite(float(metrics["loss"]))
+
+
+def test_eval_step_uses_running_stats(rng):
+    model = nn.Sequential([nn.Dense(16, policy=F32), nn.BatchNorm(policy=F32),
+                           nn.Dense(2, policy=F32)], policy=F32)
+    opt = nn.SGD(lr=0.01)
+    state = create_train_state(model, opt, rng, (32, 2), input_dtype=jnp.float32)
+    train_step = make_train_step(model, opt)
+    eval_step = make_eval_step(model)
+    x, y = _spiral_data(32)
+    state, _ = train_step(state, jnp.asarray(x), jnp.asarray(y))
+    m = eval_step(state, jnp.asarray(x), jnp.asarray(y))
+    assert "loss" in m and "corrects" in m
+
+
+def test_plateau_scheduler_affects_jitted_step(rng):
+    """Regression: host-driven scheduler factor must NOT constant-fold into the
+    compiled step — it is threaded in as a runtime operand."""
+    model = nn.Sequential([nn.Dense(2, policy=F32)], policy=F32)
+    opt = nn.SGD(lr=0.1)
+    sched = schedulers.ReduceLROnPlateau(factor=0.5, patience=0)
+    state = create_train_state(model, opt, rng, (4, 2), input_dtype=jnp.float32)
+    step = make_train_step(model, opt, scheduler=sched)
+    x = jnp.ones((4, 2), jnp.float32)
+    y = jnp.asarray([0, 1, 0, 1], jnp.int32)
+    state, m1 = step(state, x, y)
+    assert float(m1["lr_scale"]) == 1.0
+    sched.observe(1.0)
+    sched.observe(1.0)  # no improvement -> reduce
+    state, m2 = step(state, x, y)
+    assert float(m2["lr_scale"]) == 0.5
+
+
+def test_int8_labels_route_to_onehot():
+    logits = jnp.asarray(np.random.RandomState(0).randn(6, 3), jnp.float32)
+    l8 = jnp.asarray([0, 1, 2, 0, 1, 2], jnp.int8)
+    l32 = l8.astype(jnp.int32)
+    np.testing.assert_allclose(float(losses.softmax_cross_entropy(logits, l8)),
+                               float(losses.softmax_cross_entropy(logits, l32)), rtol=1e-6)

@@ -1,0 +1,35 @@
+"""tnn_tpu — a TPU-native deep-learning framework.
+
+Brand-new implementation with the capabilities of the reference C++/CUDA framework TNN
+(see SURVEY.md): tensor/device runtime, layer/block NN library with a builder DSL, model
+zoo (MNIST CNN -> ResNets -> WRN -> ViT -> GPT-2), losses/optimizers/schedulers, data
+loading/augmentation, profiling/logging/config, checkpointing, and a distributed runtime —
+redesigned TPU-first on JAX/XLA/Pallas: whole train steps compile to single XLA programs,
+bf16 is the native compute type, and parallelism is jax.sharding over device meshes with
+XLA collectives instead of hand-rolled TCP/RDMA byte transports.
+"""
+
+__version__ = "0.1.0"
+
+from . import nn  # noqa: F401  — importing registers every built-in layer type
+from .core import dtypes
+from .core.dtypes import DTypePolicy
+from .core.module import (
+    Module,
+    module_from_config,
+    param_bytes,
+    param_count,
+    register_module,
+)
+
+__all__ = [
+    "nn",
+    "dtypes",
+    "DTypePolicy",
+    "Module",
+    "module_from_config",
+    "param_count",
+    "param_bytes",
+    "register_module",
+    "__version__",
+]

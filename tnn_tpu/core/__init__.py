@@ -1,0 +1,3 @@
+from . import dtypes, module, rng
+
+__all__ = ["dtypes", "module", "rng"]

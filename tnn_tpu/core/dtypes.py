@@ -1,0 +1,171 @@
+"""Dtype system for the TPU-native framework.
+
+Capability parity with the reference's type layer (``include/type/type.hpp:76`` ``DType_t``,
+``TypeTraits`` at ``include/type/type.hpp:30-60``, dispatch macros at ``:226``/``:252``), but
+TPU-first: bf16 is the *native* compute type (the reference emulates it in software,
+``include/type/bf16.hpp``), and dispatch is by jnp dtype rather than C++ template expansion.
+
+The reference gives every layer three dtypes — io, param, compute
+(``include/nn/layer.hpp:117-119``). We keep that exact contract as :class:`DTypePolicy`.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax.numpy as jnp
+import numpy as np
+
+# Canonical name -> jnp dtype. Mirrors the reference's DType_t enum members
+# (include/type/type.hpp:76): f32, f64, f16, bf16, i8..i64, u8..u64, bool.
+_NAME_TO_DTYPE = {
+    "float32": jnp.float32,
+    "float64": jnp.float64,
+    "float16": jnp.float16,
+    "bfloat16": jnp.bfloat16,
+    "int8": jnp.int8,
+    "int16": jnp.int16,
+    "int32": jnp.int32,
+    "int64": jnp.int64,
+    "uint8": jnp.uint8,
+    "uint16": jnp.uint16,
+    "uint32": jnp.uint32,
+    "uint64": jnp.uint64,
+    "bool": jnp.bool_,
+}
+
+_ALIASES = {
+    "f32": "float32",
+    "f64": "float64",
+    "f16": "float16",
+    "bf16": "bfloat16",
+    "half": "float16",
+    "float": "float32",
+    "double": "float64",
+}
+
+
+def canonical_name(dtype: Any) -> str:
+    """Canonical string name for a dtype or dtype name."""
+    if isinstance(dtype, str):
+        name = _ALIASES.get(dtype, dtype)
+        if name not in _NAME_TO_DTYPE:
+            raise ValueError(f"unknown dtype name: {dtype!r}")
+        return name
+    name = jnp.dtype(dtype).name
+    if name not in _NAME_TO_DTYPE:
+        raise ValueError(f"unsupported dtype: {dtype!r}")
+    return name
+
+
+def resolve(dtype: Any):
+    """Resolve a dtype name/object to a jnp dtype (parity: dtype_of<T>, type.hpp:91)."""
+    return _NAME_TO_DTYPE[canonical_name(dtype)]
+
+
+def size_of(dtype: Any) -> int:
+    """Byte size of a dtype (parity: dtype size table, include/type/type.hpp)."""
+    return jnp.dtype(resolve(dtype)).itemsize
+
+
+def is_floating(dtype: Any) -> bool:
+    return jnp.issubdtype(resolve(dtype), jnp.floating)
+
+
+def epsilon(dtype: Any) -> float:
+    """Comparison tolerance per dtype (parity: TypeTraits::epsilon, type.hpp:30-60).
+
+    Used by the differential test harness; values are loose enough to absorb
+    XLA fusion reassociation.
+    """
+    name = canonical_name(dtype)
+    return {
+        "float64": 1e-12,
+        "float32": 1e-5,
+        "float16": 1e-2,
+        "bfloat16": 2e-2,
+    }.get(name, 0.0)
+
+
+@dataclasses.dataclass(frozen=True)
+class DTypePolicy:
+    """The reference's per-layer (io, param, compute) dtype triple
+    (include/nn/layer.hpp:117-119), as an immutable policy object.
+
+    On TPU the idiomatic mixed-precision recipe is bf16 io/compute with f32 params
+    (master weights) — matmuls hit the MXU in bf16 while optimizer state stays f32.
+    """
+
+    io: str = "bfloat16"
+    param: str = "float32"
+    compute: str = "bfloat16"
+
+    def __post_init__(self):
+        object.__setattr__(self, "io", canonical_name(self.io))
+        object.__setattr__(self, "param", canonical_name(self.param))
+        object.__setattr__(self, "compute", canonical_name(self.compute))
+
+    @property
+    def io_dtype(self):
+        return resolve(self.io)
+
+    @property
+    def param_dtype(self):
+        return resolve(self.param)
+
+    @property
+    def compute_dtype(self):
+        return resolve(self.compute)
+
+    def cast_in(self, x):
+        """Cast an input to the compute dtype (float inputs only)."""
+        if is_floating(x.dtype):
+            return x.astype(self.compute_dtype)
+        return x
+
+    def cast_param(self, p):
+        """Cast a parameter to the compute dtype for use inside a kernel."""
+        if is_floating(p.dtype):
+            return p.astype(self.compute_dtype)
+        return p
+
+    def cast_out(self, y):
+        if is_floating(y.dtype):
+            return y.astype(self.io_dtype)
+        return y
+
+    def to_config(self) -> dict:
+        return {"io": self.io, "param": self.param, "compute": self.compute}
+
+    @classmethod
+    def from_config(cls, cfg: dict | None) -> "DTypePolicy":
+        if cfg is None:
+            return cls()
+        return cls(**cfg)
+
+
+# Full-precision policy: everything f32 (the reference's default uniform-dtype mode).
+FP32 = DTypePolicy(io="float32", param="float32", compute="float32")
+# TPU-native default: bf16 io/compute, f32 master params.
+MIXED_BF16 = DTypePolicy(io="bfloat16", param="float32", compute="bfloat16")
+
+_default_policy = MIXED_BF16
+
+
+def default_policy() -> DTypePolicy:
+    return _default_policy
+
+
+def set_default_policy(policy: DTypePolicy) -> None:
+    global _default_policy
+    _default_policy = policy
+
+
+def finfo_max(dtype: Any) -> float:
+    return float(jnp.finfo(resolve(dtype)).max)
+
+
+def neg_inf(dtype: Any) -> float:
+    """Large negative value for masking, safe in reduced precision (softmax -> exact 0)."""
+    del dtype
+    return -1e9

@@ -1,0 +1,209 @@
+"""Functional module system — the TPU-native replacement for the reference's Layer contract.
+
+Reference capability being matched (not ported):
+  * ``Layer`` base class — ``include/nn/layer.hpp:44`` — with three dtypes
+    (``layer.hpp:117-119``), weight init (``init_impl``), forward/backward, and JSON config
+    round-trip via ``get_config()/create_from_config`` (how checkpointing *and* pipeline stage
+    shipping work in the reference).
+  * ``LayerFactory`` registry — ``include/nn/layers.hpp:96-164``.
+
+TPU-first redesign: layers are *static configuration* objects; parameters and mutable state
+live in pytrees owned by the caller. ``apply`` is pure, so an entire train step
+(forward + loss + backward + optimizer update) JITs into ONE XLA program — per-op eager
+dispatch (the reference's Task/Flow machinery, ``include/device/task.hpp:28``) is unnecessary
+because XLA schedules and fuses the whole program. Backward passes come from ``jax.grad``
+rather than hand-written ``backward_impl`` kernels.
+
+Variables layout (a plain dict pytree):
+  ``{"params": {...}, "state": {...}}``
+``state`` holds non-gradient mutable collections (BatchNorm running stats). Layers without
+state contribute empty dicts which are pruned.
+"""
+from __future__ import annotations
+
+import json
+from typing import Any, Callable, Dict, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from . import dtypes as dt
+
+# ---------------------------------------------------------------------------
+# Registry (parity: LayerFactory, include/nn/layers.hpp:96-164)
+# ---------------------------------------------------------------------------
+
+_REGISTRY: Dict[str, type] = {}
+
+
+def register_module(name: str):
+    """Class decorator: register under ``name`` for config round-trip."""
+
+    def wrap(cls):
+        if name in _REGISTRY and _REGISTRY[name] is not cls:
+            raise ValueError(f"module type {name!r} already registered")
+        _REGISTRY[name] = cls
+        cls.type_name = name
+        return cls
+
+    return wrap
+
+
+def registered_types() -> Sequence[str]:
+    return sorted(_REGISTRY)
+
+
+def module_from_config(cfg: Dict[str, Any]) -> "Module":
+    """Instantiate any registered module from its config dict
+    (parity: LayerFactory::create_from_config, include/nn/layers.hpp:125-164)."""
+    cfg = dict(cfg)
+    type_name = cfg.pop("type")
+    if type_name not in _REGISTRY:
+        raise KeyError(f"unknown module type {type_name!r}; known: {registered_types()}")
+    return _REGISTRY[type_name].from_config(cfg)
+
+
+# ---------------------------------------------------------------------------
+# Module base
+# ---------------------------------------------------------------------------
+
+
+class Module:
+    """Base class for all layers/blocks.
+
+    Subclasses define:
+      * ``_init(rng, *input_shapes) -> (params, state)`` — shape-inferring param creation
+        (parity: Layer::init_impl weight init, e.g. src/nn/layers_impl/dense_layer.cpp:46).
+      * ``_apply(params, state, *inputs, train, rng) -> (output, new_state)`` — pure forward.
+
+    ``name`` gives the parameter subtree key; anonymous modules get positional names from
+    their parent container.
+    """
+
+    type_name: str = "module"
+
+    def __init__(self, name: Optional[str] = None, policy: Optional[dt.DTypePolicy] = None):
+        self.name = name
+        self.policy = policy or dt.default_policy()
+
+    # -- shape/param plumbing ------------------------------------------------
+
+    def init(self, rng: jax.Array, *input_shapes) -> Dict[str, Any]:
+        """Create variables for the given input shapes (tuples of ints).
+
+        Returns ``{"params": ..., "state": ...}``.
+        """
+        input_shapes = tuple(_as_shape(s) for s in input_shapes)
+        params, state = self._init(rng, *input_shapes)
+        return {"params": params, "state": state}
+
+    def apply(
+        self,
+        variables: Dict[str, Any],
+        *inputs,
+        train: bool = False,
+        rng: Optional[jax.Array] = None,
+    ):
+        """Pure forward. Returns ``(output, new_state)``.
+
+        ``new_state`` echoes ``variables["state"]`` (updated when train=True for stateful
+        layers such as BatchNorm).
+        """
+        params = variables.get("params", {})
+        state = variables.get("state", {})
+        return self._apply(params, state, *inputs, train=train, rng=rng)
+
+    def __call__(self, variables, *inputs, train: bool = False, rng=None):
+        out, _ = self.apply(variables, *inputs, train=train, rng=rng)
+        return out
+
+    # -- to be overridden ----------------------------------------------------
+
+    def _init(self, rng, *input_shapes):
+        return {}, {}
+
+    def _apply(self, params, state, *inputs, train, rng):
+        raise NotImplementedError
+
+    def output_shape(self, *input_shapes) -> Tuple[int, ...]:
+        """Static shape inference — drives the builder DSL and the partitioner
+        (parity: LayerBuilder shape inference, include/nn/layer_builder.hpp:11)."""
+        raise NotImplementedError(f"{type(self).__name__} does not implement output_shape")
+
+    # -- config round-trip ---------------------------------------------------
+
+    def get_config(self) -> Dict[str, Any]:
+        """JSON-safe config (parity: Layer::get_config, include/nn/layer.hpp).
+
+        Subclasses extend via ``_config()``.
+        """
+        cfg: Dict[str, Any] = {"type": self.type_name}
+        if self.name is not None:
+            cfg["name"] = self.name
+        cfg["policy"] = self.policy.to_config()
+        cfg.update(self._config())
+        return cfg
+
+    def _config(self) -> Dict[str, Any]:
+        return {}
+
+    @classmethod
+    def from_config(cls, cfg: Dict[str, Any]) -> "Module":
+        cfg = dict(cfg)
+        cfg.pop("type", None)
+        policy = cfg.pop("policy", None)
+        return cls(**cfg, policy=dt.DTypePolicy.from_config(policy))
+
+    def to_json(self, **kw) -> str:
+        return json.dumps(self.get_config(), **kw)
+
+    def __repr__(self):
+        cfg = {k: v for k, v in self.get_config().items() if k not in ("policy",)}
+        args = ", ".join(f"{k}={v!r}" for k, v in cfg.items() if k != "type")
+        return f"{type(self).__name__}({args})"
+
+
+def _as_shape(s) -> Tuple[int, ...]:
+    if hasattr(s, "shape"):
+        return tuple(s.shape)
+    return tuple(int(d) for d in s)
+
+
+# ---------------------------------------------------------------------------
+# Param tree utilities (parity: GraphContext param slab bookkeeping,
+# include/nn/graph_context.hpp:37-89 — on TPU, XLA owns placement, we keep the census)
+# ---------------------------------------------------------------------------
+
+
+def param_count(tree) -> int:
+    leaves = jax.tree_util.tree_leaves(tree)
+    return int(sum(x.size for x in leaves))
+
+
+def param_bytes(tree) -> int:
+    leaves = jax.tree_util.tree_leaves(tree)
+    return int(sum(x.size * jnp.dtype(x.dtype).itemsize for x in leaves))
+
+
+def tree_paths(tree) -> Dict[str, Any]:
+    """Flatten a pytree into {'a/b/c': leaf} path dict (checkpoint naming)."""
+    out = {}
+    flat, _ = jax.tree_util.tree_flatten_with_path(tree)
+    for path, leaf in flat:
+        key = "/".join(_path_str(p) for p in path)
+        out[key] = leaf
+    return out
+
+
+def _path_str(p) -> str:
+    if isinstance(p, jax.tree_util.DictKey):
+        return str(p.key)
+    if isinstance(p, jax.tree_util.SequenceKey):
+        return str(p.idx)
+    if isinstance(p, jax.tree_util.GetAttrKey):
+        return str(p.name)
+    return str(p)
+
+
+def zeros_like_tree(tree):
+    return jax.tree_util.tree_map(jnp.zeros_like, tree)

@@ -1,0 +1,25 @@
+"""PRNG helpers.
+
+The reference seeds per-layer RNG via a `seed` member on Layer (include/nn/layer.hpp) and
+Philox-style CUDA kernels (src/ops/cuda/kernels.cu RNG). JAX's splittable threefry keys are
+the idiomatic equivalent; these helpers keep key plumbing terse inside containers.
+"""
+from __future__ import annotations
+
+from typing import Iterator, Optional
+
+import jax
+
+
+def split_for(rng: Optional[jax.Array], n: int):
+    """Split an optional key into n optional keys."""
+    if rng is None:
+        return [None] * n
+    return list(jax.random.split(rng, n))
+
+
+def key_stream(rng: jax.Array) -> Iterator[jax.Array]:
+    """Infinite stream of fresh keys."""
+    while True:
+        rng, sub = jax.random.split(rng)
+        yield sub

@@ -1,0 +1,49 @@
+"""NN library: layers, blocks, losses, optimizers, schedulers, metrics.
+
+Importing this package registers every built-in layer type with the module registry
+(parity: ExampleModels::register_defaults + LayerFactory::register_defaults,
+include/nn/layers.hpp:125).
+"""
+from . import activations, blocks, embedding, initializers, layers, losses, metrics, norms, optimizers, schedulers
+from .activations import Activation
+from .blocks import Parallel, Residual, Sequential
+from .embedding import ClassToken, Embedding, PositionalEmbedding
+from .layers import (
+    AvgPool2D,
+    Conv2D,
+    Dense,
+    Dropout,
+    Flatten,
+    GlobalAvgPool,
+    Identity,
+    MaxPool2D,
+    Reshape,
+    Slice,
+    Transpose,
+)
+from .norms import BatchNorm, GroupNorm, LayerNorm, RMSNorm
+from .optimizers import SGD, Adam, AdamW
+from .schedulers import (
+    CosineAnnealingLR,
+    CosineAnnealingWarmRestarts,
+    ExponentialLR,
+    LinearWarmup,
+    MultiStepLR,
+    NoOp,
+    ReduceLROnPlateau,
+    StepLR,
+    WarmupCosineAnnealing,
+)
+
+__all__ = [
+    "activations", "blocks", "embedding", "initializers", "layers", "losses", "metrics",
+    "norms", "optimizers", "schedulers",
+    "Activation", "Parallel", "Residual", "Sequential",
+    "ClassToken", "Embedding", "PositionalEmbedding",
+    "AvgPool2D", "Conv2D", "Dense", "Dropout", "Flatten", "GlobalAvgPool", "Identity",
+    "MaxPool2D", "Reshape", "Slice", "Transpose",
+    "BatchNorm", "GroupNorm", "LayerNorm", "RMSNorm",
+    "SGD", "Adam", "AdamW",
+    "CosineAnnealingLR", "CosineAnnealingWarmRestarts", "ExponentialLR", "LinearWarmup",
+    "MultiStepLR", "NoOp", "ReduceLROnPlateau", "StepLR", "WarmupCosineAnnealing",
+]

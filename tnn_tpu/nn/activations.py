@@ -1,0 +1,59 @@
+"""Activation functions + ActivationLayer.
+
+Parity: reference ActivationFunction/ActivationFactory (include/nn/activations.hpp,
+src/nn/activations_impl/, 2176 LoC of CPU+CUDA kernels). On TPU these are single XLA
+HLO ops that fuse into adjacent matmuls — no hand kernels needed.
+Set: relu, leaky_relu, elu, gelu, sigmoid, tanh, softmax, linear (same inventory),
+plus silu (modern addition).
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict
+
+import jax
+import jax.numpy as jnp
+
+from ..core.module import Module, register_module
+
+_ACTIVATIONS: Dict[str, Callable] = {
+    "linear": lambda x: x,
+    "relu": jax.nn.relu,
+    "leaky_relu": lambda x: jax.nn.leaky_relu(x, negative_slope=0.01),
+    "elu": jax.nn.elu,
+    "gelu": lambda x: jax.nn.gelu(x, approximate=True),
+    "gelu_exact": lambda x: jax.nn.gelu(x, approximate=False),
+    "sigmoid": jax.nn.sigmoid,
+    "tanh": jnp.tanh,
+    "silu": jax.nn.silu,
+    "softmax": lambda x: jax.nn.softmax(x, axis=-1),
+}
+
+
+def get(name: str) -> Callable:
+    """Activation lookup (parity: ActivationFactory, include/nn/activations.hpp)."""
+    if name not in _ACTIVATIONS:
+        raise KeyError(f"unknown activation {name!r}; known: {sorted(_ACTIVATIONS)}")
+    return _ACTIVATIONS[name]
+
+
+def names():
+    return sorted(_ACTIVATIONS)
+
+
+@register_module("activation")
+class Activation(Module):
+    """Stateless activation layer (parity: ActivationLayer wrapping ActivationFunction)."""
+
+    def __init__(self, fn: str = "relu", name=None, policy=None):
+        super().__init__(name=name, policy=policy)
+        self.fn = fn
+        self._impl = get(fn)
+
+    def _apply(self, params, state, x, *, train, rng):
+        return self._impl(x), state
+
+    def output_shape(self, input_shape):
+        return tuple(input_shape)
+
+    def _config(self):
+        return {"fn": self.fn}

@@ -1,0 +1,225 @@
+"""Container blocks: Sequential, Residual, Parallel.
+
+Parity:
+  * Sequential      — reference blocks_impl/sequential.hpp:21
+  * ResidualBlock   — blocks_impl/residual_block.hpp (main + shortcut paths)
+  * Parallel        — MSequential parallel-branches-plus-join
+    (blocks_impl/msequential.hpp:29-45). The reference hand-orders branch execution by a
+    peak-memory heuristic; under XLA the scheduler owns ordering/rematerialisation, so the
+    capability collapses to the dataflow itself.
+
+Shape inference during ``init`` runs through ``jax.eval_shape`` (zero FLOPs), so any child
+module works even without ``output_shape``.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from ..core import rng as rnglib
+from ..core.module import Module, module_from_config, register_module
+
+
+def _child_key(idx: int, child: Module) -> str:
+    return child.name or f"{idx:02d}_{child.type_name}"
+
+
+def _shape_of(variables, child, shape, dtype, train=False):
+    """Abstract-eval a child's output ShapeDtypeStruct."""
+    dummy = jax.ShapeDtypeStruct(tuple(shape), dtype)
+
+    def fwd(v, x):
+        out, _ = child.apply(v, x, train=False)
+        return out
+
+    return jax.eval_shape(fwd, variables, dummy)
+
+
+class _Container(Module):
+    """Shared child bookkeeping for blocks."""
+
+    def __init__(self, children: Sequence[Module], name=None, policy=None):
+        super().__init__(name=name, policy=policy)
+        self.children: List[Module] = list(children)
+
+    def child_keys(self) -> List[str]:
+        return [_child_key(i, c) for i, c in enumerate(self.children)]
+
+    def _config(self):
+        return {"children": [c.get_config() for c in self.children]}
+
+    @classmethod
+    def from_config(cls, cfg):
+        cfg = dict(cfg)
+        cfg.pop("type", None)
+        policy = cfg.pop("policy", None)
+        children = [module_from_config(c) for c in cfg.pop("children")]
+        from ..core.dtypes import DTypePolicy
+
+        return cls(children, **cfg, policy=DTypePolicy.from_config(policy))
+
+
+@register_module("sequential")
+class Sequential(_Container):
+    """Chain of modules; params nested under per-child keys."""
+
+    def __init__(self, children: Sequence[Module], name=None, policy=None):
+        super().__init__(children, name=name, policy=policy)
+
+    def _init(self, rng, input_shape, input_dtype=None):
+        dtype = input_dtype or self.policy.io_dtype
+        params: Dict[str, Any] = {}
+        state: Dict[str, Any] = {}
+        shape = tuple(input_shape)
+        keys = rnglib.split_for(rng, len(self.children))
+        for i, (child, k) in enumerate(zip(self.children, keys)):
+            v = child.init(k, shape)
+            key = _child_key(i, child)
+            if v["params"]:
+                params[key] = v["params"]
+            if v["state"]:
+                state[key] = v["state"]
+            out = _shape_of(v, child, shape, dtype)
+            shape, dtype = out.shape, out.dtype
+        return params, state
+
+    def init(self, rng, input_shape, input_dtype=None):
+        params, state = self._init(rng, tuple(input_shape), input_dtype=input_dtype)
+        return {"params": params, "state": state}
+
+    def _apply(self, params, state, x, *, train, rng):
+        new_state: Dict[str, Any] = {}
+        keys = rnglib.split_for(rng, len(self.children))
+        for i, (child, k) in enumerate(zip(self.children, keys)):
+            key = _child_key(i, child)
+            v = {"params": params.get(key, {}), "state": state.get(key, {})}
+            x, st = child.apply(v, x, train=train, rng=k)
+            if st:
+                new_state[key] = st
+        return x, new_state
+
+    def output_shape(self, input_shape):
+        shape = tuple(input_shape)
+        for child in self.children:
+            shape = child.output_shape(shape)
+        return shape
+
+
+@register_module("residual")
+class Residual(_Container):
+    """y = join(main(x), shortcut(x)); join is add then optional activation.
+
+    Parity: ResidualBlock main+shortcut (blocks_impl/residual_block.hpp). ``children`` is
+    [main] or [main, shortcut]; missing shortcut = identity.
+    """
+
+    def __init__(self, children: Sequence[Module], activation: Optional[str] = None,
+                 name=None, policy=None):
+        super().__init__(children, name=name, policy=policy)
+        if not 1 <= len(self.children) <= 2:
+            raise ValueError("Residual takes [main] or [main, shortcut]")
+        self.activation = activation
+
+    def _init(self, rng, input_shape):
+        params, state = {}, {}
+        keys = rnglib.split_for(rng, len(self.children))
+        for i, (child, k) in enumerate(zip(self.children, keys)):
+            v = child.init(k, tuple(input_shape))
+            key = _child_key(i, child)
+            if v["params"]:
+                params[key] = v["params"]
+            if v["state"]:
+                state[key] = v["state"]
+        return params, state
+
+    def _apply(self, params, state, x, *, train, rng):
+        keys = rnglib.split_for(rng, len(self.children))
+        new_state: Dict[str, Any] = {}
+
+        def run(i, child, inp):
+            key = _child_key(i, child)
+            v = {"params": params.get(key, {}), "state": state.get(key, {})}
+            out, st = child.apply(v, inp, train=train, rng=keys[i])
+            if st:
+                new_state[key] = st
+            return out
+
+        main = run(0, self.children[0], x)
+        short = run(1, self.children[1], x) if len(self.children) == 2 else x
+        y = main + short
+        if self.activation:
+            from . import activations
+
+            y = activations.get(self.activation)(y)
+        return y, new_state
+
+    def output_shape(self, input_shape):
+        return self.children[0].output_shape(tuple(input_shape))
+
+    def _config(self):
+        cfg = super()._config()
+        cfg["activation"] = self.activation
+        return cfg
+
+
+@register_module("parallel")
+class Parallel(_Container):
+    """Fan x out to every branch, join results (parity: MSequential, msequential.hpp:24).
+
+    join: 'add' | 'concat' (concat over last axis) | 'mul'.
+    """
+
+    def __init__(self, children: Sequence[Module], join: str = "add", name=None, policy=None):
+        super().__init__(children, name=name, policy=policy)
+        if join not in ("add", "concat", "mul"):
+            raise ValueError(f"unknown join {join!r}")
+        self.join = join
+
+    def _init(self, rng, input_shape):
+        params, state = {}, {}
+        keys = rnglib.split_for(rng, len(self.children))
+        for i, (child, k) in enumerate(zip(self.children, keys)):
+            v = child.init(k, tuple(input_shape))
+            key = _child_key(i, child)
+            if v["params"]:
+                params[key] = v["params"]
+            if v["state"]:
+                state[key] = v["state"]
+        return params, state
+
+    def _apply(self, params, state, x, *, train, rng):
+        keys = rnglib.split_for(rng, len(self.children))
+        new_state: Dict[str, Any] = {}
+        outs = []
+        for i, child in enumerate(self.children):
+            key = _child_key(i, child)
+            v = {"params": params.get(key, {}), "state": state.get(key, {})}
+            out, st = child.apply(v, x, train=train, rng=keys[i])
+            if st:
+                new_state[key] = st
+            outs.append(out)
+        if self.join == "add":
+            y = outs[0]
+            for o in outs[1:]:
+                y = y + o
+        elif self.join == "mul":
+            y = outs[0]
+            for o in outs[1:]:
+                y = y * o
+        else:
+            y = jnp.concatenate(outs, axis=-1)
+        return y, new_state
+
+    def output_shape(self, input_shape):
+        shapes = [c.output_shape(tuple(input_shape)) for c in self.children]
+        if self.join in ("add", "mul"):
+            return shapes[0]
+        last = sum(s[-1] for s in shapes)
+        return tuple(shapes[0][:-1]) + (last,)
+
+    def _config(self):
+        cfg = super()._config()
+        cfg["join"] = self.join
+        return cfg

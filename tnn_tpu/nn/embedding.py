@@ -1,0 +1,95 @@
+"""Embedding / positional-embedding / class-token layers.
+
+Parity: EmbeddingLayer, PositionalEmbeddingLayer (learned), ClassTokenLayer (ViT) —
+reference layers_impl/embedding*, positional_embedding*, class_token* (~1200 LoC of
+CPU+CUDA gather/scatter kernels). On TPU, embedding lookup is a one-hot matmul or gather
+that XLA lowers natively.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.module import Module, register_module
+from . import initializers
+
+
+@register_module("embedding")
+class Embedding(Module):
+    """Token embedding: int ids (..., S) -> (..., S, dim)."""
+
+    def __init__(self, vocab_size: int, dim: int, kernel_init: str = "normal",
+                 name=None, policy=None):
+        super().__init__(name=name, policy=policy)
+        self.vocab_size = int(vocab_size)
+        self.dim = int(dim)
+        self.kernel_init = kernel_init
+
+    def _init(self, rng, input_shape):
+        init = initializers.get(self.kernel_init)
+        return {"table": init(rng, (self.vocab_size, self.dim), self.policy.param_dtype)}, {}
+
+    def _apply(self, params, state, ids, *, train, rng):
+        table = self.policy.cast_param(params["table"])
+        return jnp.take(table, ids, axis=0), state
+
+    def attend(self, params, x):
+        """Tied-softmax logits: x @ table.T (used by GPT-2 output head)."""
+        table = self.policy.cast_param(params["table"])
+        return jax.lax.dot_general(
+            x, table, (((x.ndim - 1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    def output_shape(self, input_shape):
+        return tuple(input_shape) + (self.dim,)
+
+    def _config(self):
+        return {"vocab_size": self.vocab_size, "dim": self.dim,
+                "kernel_init": initializers.name_of(self.kernel_init)}
+
+
+@register_module("positional_embedding")
+class PositionalEmbedding(Module):
+    """Learned positional embedding added to (N, S, D) activations.
+
+    Parity: PositionalEmbeddingLayer (learned) in the reference.
+    """
+
+    def __init__(self, max_len: int, kernel_init: str = "normal", name=None, policy=None):
+        super().__init__(name=name, policy=policy)
+        self.max_len = int(max_len)
+        self.kernel_init = kernel_init
+
+    def _init(self, rng, input_shape):
+        d = input_shape[-1]
+        init = initializers.get(self.kernel_init)
+        return {"pos": init(rng, (self.max_len, d), self.policy.param_dtype)}, {}
+
+    def _apply(self, params, state, x, *, train, rng, offset: int = 0):
+        s = x.shape[-2]
+        pos = jax.lax.dynamic_slice_in_dim(params["pos"], offset, s, axis=0)
+        return x + self.policy.cast_param(pos), state
+
+    def output_shape(self, input_shape):
+        return tuple(input_shape)
+
+    def _config(self):
+        return {"max_len": self.max_len, "kernel_init": initializers.name_of(self.kernel_init)}
+
+
+@register_module("class_token")
+class ClassToken(Module):
+    """Prepend a learned [CLS] token: (N, S, D) -> (N, S+1, D). Parity: ClassTokenLayer (ViT)."""
+
+    def _init(self, rng, input_shape):
+        d = input_shape[-1]
+        return {"token": jnp.zeros((1, 1, d), self.policy.param_dtype)}, {}
+
+    def _apply(self, params, state, x, *, train, rng):
+        tok = jnp.broadcast_to(
+            self.policy.cast_param(params["token"]).astype(x.dtype), (x.shape[0], 1, x.shape[-1]))
+        return jnp.concatenate([tok, x], axis=1), state
+
+    def output_shape(self, input_shape):
+        n, s, d = input_shape
+        return (n, s + 1, d)

@@ -1,0 +1,104 @@
+"""Weight initializers.
+
+Parity with the reference's per-layer init (e.g. DenseLayer He-style init at
+src/nn/layers_impl/dense_layer.cpp:46; fill_random_{uniform,normal} ops at
+include/ops/ops.hpp). Implemented as (rng, shape, dtype) -> array callables with a
+string registry so layer configs serialize.
+"""
+from __future__ import annotations
+
+import math
+from typing import Callable, Dict
+
+import jax
+import jax.numpy as jnp
+
+Initializer = Callable[[jax.Array, tuple, jnp.dtype], jax.Array]
+
+_REGISTRY: Dict[str, Initializer] = {}
+
+
+def register(name: str):
+    def wrap(fn):
+        _REGISTRY[name] = fn
+        fn.init_name = name
+        return fn
+
+    return wrap
+
+
+def get(name_or_fn) -> Initializer:
+    if callable(name_or_fn):
+        return name_or_fn
+    if name_or_fn not in _REGISTRY:
+        raise KeyError(f"unknown initializer {name_or_fn!r}; known: {sorted(_REGISTRY)}")
+    return _REGISTRY[name_or_fn]
+
+
+def name_of(fn) -> str:
+    return getattr(fn, "init_name", "he_normal")
+
+
+def _fans(shape):
+    """fan_in/fan_out. Dense: (in, out). Conv HWIO: (h, w, cin, cout)."""
+    if len(shape) < 1:
+        return 1, 1
+    if len(shape) == 1:
+        return shape[0], shape[0]
+    receptive = math.prod(shape[:-2]) if len(shape) > 2 else 1
+    fan_in = shape[-2] * receptive
+    fan_out = shape[-1] * receptive
+    return fan_in, fan_out
+
+
+@register("zeros")
+def zeros(rng, shape, dtype=jnp.float32):
+    del rng
+    return jnp.zeros(shape, dtype)
+
+
+@register("ones")
+def ones(rng, shape, dtype=jnp.float32):
+    del rng
+    return jnp.ones(shape, dtype)
+
+
+@register("he_normal")
+def he_normal(rng, shape, dtype=jnp.float32):
+    fan_in, _ = _fans(shape)
+    std = math.sqrt(2.0 / max(1, fan_in))
+    return (jax.random.normal(rng, shape, jnp.float32) * std).astype(dtype)
+
+
+@register("he_uniform")
+def he_uniform(rng, shape, dtype=jnp.float32):
+    fan_in, _ = _fans(shape)
+    limit = math.sqrt(6.0 / max(1, fan_in))
+    return jax.random.uniform(rng, shape, jnp.float32, -limit, limit).astype(dtype)
+
+
+@register("xavier_normal")
+def xavier_normal(rng, shape, dtype=jnp.float32):
+    fan_in, fan_out = _fans(shape)
+    std = math.sqrt(2.0 / max(1, fan_in + fan_out))
+    return (jax.random.normal(rng, shape, jnp.float32) * std).astype(dtype)
+
+
+@register("xavier_uniform")
+def xavier_uniform(rng, shape, dtype=jnp.float32):
+    fan_in, fan_out = _fans(shape)
+    limit = math.sqrt(6.0 / max(1, fan_in + fan_out))
+    return jax.random.uniform(rng, shape, jnp.float32, -limit, limit).astype(dtype)
+
+
+@register("normal")
+def normal(rng, shape, dtype=jnp.float32):
+    return (jax.random.normal(rng, shape, jnp.float32) * 0.02).astype(dtype)
+
+
+def scaled_normal(std: float) -> Initializer:
+    def fn(rng, shape, dtype=jnp.float32):
+        return (jax.random.normal(rng, shape, jnp.float32) * std).astype(dtype)
+
+    fn.init_name = "normal"
+    return fn

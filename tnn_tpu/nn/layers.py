@@ -1,0 +1,404 @@
+"""Core layers: Dense, Conv2D, pooling, shape utilities, Dropout.
+
+Reference parity map (capabilities, not design):
+  * DenseLayer           — include/nn/layers_impl/dense_layer.hpp:21 (CPU gemm path
+    src/nn/layers_impl/dense_layer.cpp:114-177, cuDNN graph path :180+)
+  * Conv2DLayer          — im2col+GEMM NCHW in the reference
+    (src/nn/layers_impl/cpu/conv2d_nchw_ops.cpp:20-65); here XLA's native conv,
+    which tiles directly onto the MXU — no im2col materialisation.
+  * Max/AvgPool2D        — layers_impl/{max,avg}pool* (NCHW+NHWC variants)
+  * Flatten/Slice/Transpose/Identity/Dropout — layers_impl shape/util layers
+
+TPU-first choices: NHWC layout (lane dimension = channels, the TPU-native conv layout;
+the reference is NCHW), bf16 compute via DTypePolicy, backward passes from jax.grad.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.module import Module, register_module
+from . import activations, initializers
+
+PaddingLike = Union[str, int, Tuple[int, int], Sequence[Tuple[int, int]]]
+
+
+def _norm_pair(v) -> Tuple[int, int]:
+    if isinstance(v, int):
+        return (v, v)
+    a, b = v
+    return (int(a), int(b))
+
+
+def _conv_padding(padding: PaddingLike):
+    if isinstance(padding, str):
+        return padding.upper()
+    if isinstance(padding, int):
+        return [(padding, padding), (padding, padding)]
+    padding = list(padding)
+    if len(padding) == 2 and all(isinstance(p, int) for p in padding):
+        return [(padding[0], padding[0]), (padding[1], padding[1])]
+    return [tuple(p) for p in padding]
+
+
+@register_module("dense")
+class Dense(Module):
+    """Fully-connected layer: y = act(x @ W + b).
+
+    Parity: DenseLayer (include/nn/layers_impl/dense_layer.hpp:21). The reference runs
+    blocked AVX2 sgemm (src/math/cpu/sgemm.cpp:489) or cuBLAS; here the matmul is a single
+    dot_general in the compute dtype (bf16 -> MXU) with f32 accumulation.
+    """
+
+    def __init__(
+        self,
+        units: int,
+        use_bias: bool = True,
+        activation: Optional[str] = None,
+        kernel_init: str = "he_normal",
+        name=None,
+        policy=None,
+    ):
+        super().__init__(name=name, policy=policy)
+        self.units = int(units)
+        self.use_bias = bool(use_bias)
+        self.activation = activation
+        self.kernel_init = kernel_init
+
+    def _init(self, rng, input_shape):
+        in_features = input_shape[-1]
+        k_rng, _ = jax.random.split(rng)
+        init = initializers.get(self.kernel_init)
+        params = {"kernel": init(k_rng, (in_features, self.units), self.policy.param_dtype)}
+        if self.use_bias:
+            params["bias"] = jnp.zeros((self.units,), self.policy.param_dtype)
+        return params, {}
+
+    def _apply(self, params, state, x, *, train, rng):
+        x = self.policy.cast_in(x)
+        kernel = self.policy.cast_param(params["kernel"])
+        # f32 accumulation on the MXU even in bf16 (preferred_element_type).
+        y = lax.dot_general(
+            x,
+            kernel,
+            (((x.ndim - 1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        if self.use_bias:
+            y = y + params["bias"].astype(jnp.float32)
+        if self.activation:
+            y = activations.get(self.activation)(y)
+        return self.policy.cast_out(y), state
+
+    def output_shape(self, input_shape):
+        return tuple(input_shape[:-1]) + (self.units,)
+
+    def _config(self):
+        return {
+            "units": self.units,
+            "use_bias": self.use_bias,
+            "activation": self.activation,
+            "kernel_init": initializers.name_of(self.kernel_init),
+        }
+
+
+@register_module("conv2d")
+class Conv2D(Module):
+    """2-D convolution, NHWC, HWIO kernel.
+
+    Parity: Conv2DLayer (reference im2col+GEMM, src/nn/layers_impl/cpu/conv2d_nchw_ops.cpp:20-25).
+    XLA lowers conv_general_dilated straight onto the MXU; NHWC keeps channels in the lane
+    dimension, the TPU-preferred layout (reference is NCHW, a GPU-era choice).
+    """
+
+    def __init__(
+        self,
+        filters: int,
+        kernel_size=3,
+        strides=1,
+        padding: PaddingLike = "same",
+        use_bias: bool = True,
+        dilation=1,
+        groups: int = 1,
+        activation: Optional[str] = None,
+        kernel_init: str = "he_normal",
+        name=None,
+        policy=None,
+    ):
+        super().__init__(name=name, policy=policy)
+        self.filters = int(filters)
+        self.kernel_size = _norm_pair(kernel_size)
+        self.strides = _norm_pair(strides)
+        self.padding = padding
+        self.use_bias = bool(use_bias)
+        self.dilation = _norm_pair(dilation)
+        self.groups = int(groups)
+        self.activation = activation
+        self.kernel_init = kernel_init
+
+    def _init(self, rng, input_shape):
+        cin = input_shape[-1]
+        kh, kw = self.kernel_size
+        init = initializers.get(self.kernel_init)
+        params = {
+            "kernel": init(rng, (kh, kw, cin // self.groups, self.filters), self.policy.param_dtype)
+        }
+        if self.use_bias:
+            params["bias"] = jnp.zeros((self.filters,), self.policy.param_dtype)
+        return params, {}
+
+    def _apply(self, params, state, x, *, train, rng):
+        x = self.policy.cast_in(x)
+        kernel = self.policy.cast_param(params["kernel"])
+        # No preferred_element_type here: the conv VJP would feed an f32 cotangent into a
+        # bf16 transposed conv and conv_general_dilated requires uniform dtypes. The TPU
+        # MXU accumulates bf16 convs in f32 internally regardless.
+        y = lax.conv_general_dilated(
+            x,
+            kernel,
+            window_strides=self.strides,
+            padding=_conv_padding(self.padding),
+            rhs_dilation=self.dilation,
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+            feature_group_count=self.groups,
+        )
+        y = y.astype(jnp.float32)
+        if self.use_bias:
+            y = y + params["bias"].astype(jnp.float32)
+        if self.activation:
+            y = activations.get(self.activation)(y)
+        return self.policy.cast_out(y), state
+
+    def output_shape(self, input_shape):
+        n, h, w, _ = input_shape
+        kh, kw = self.kernel_size
+        sh, sw = self.strides
+        dh, dw = self.dilation
+        ekh, ekw = (kh - 1) * dh + 1, (kw - 1) * dw + 1
+        pad = _conv_padding(self.padding)
+        if pad == "SAME":
+            oh, ow = -(-h // sh), -(-w // sw)
+        elif pad == "VALID":
+            oh, ow = (h - ekh) // sh + 1, (w - ekw) // sw + 1
+        else:
+            (pt, pb), (pl, pr) = pad
+            oh = (h + pt + pb - ekh) // sh + 1
+            ow = (w + pl + pr - ekw) // sw + 1
+        return (n, oh, ow, self.filters)
+
+    def _config(self):
+        if isinstance(self.padding, (str, int)):
+            pad = self.padding
+        else:
+            pad = [list(p) if not isinstance(p, int) else p for p in self.padding]
+        return {
+            "filters": self.filters,
+            "kernel_size": list(self.kernel_size),
+            "strides": list(self.strides),
+            "padding": pad,
+            "use_bias": self.use_bias,
+            "dilation": list(self.dilation),
+            "groups": self.groups,
+            "activation": self.activation,
+            "kernel_init": initializers.name_of(self.kernel_init),
+        }
+
+
+class _Pool2D(Module):
+    def __init__(self, pool_size=2, strides=None, padding="valid", name=None, policy=None):
+        super().__init__(name=name, policy=policy)
+        self.pool_size = _norm_pair(pool_size)
+        self.strides = _norm_pair(strides) if strides is not None else self.pool_size
+        self.padding = padding
+
+    def _window(self):
+        return (1,) + self.pool_size + (1,)
+
+    def _strides(self):
+        return (1,) + self.strides + (1,)
+
+    def _pad(self):
+        if isinstance(self.padding, str):
+            return self.padding.upper()
+        (ph, pw) = _norm_pair(self.padding)
+        return [(0, 0), (ph, ph), (pw, pw), (0, 0)]
+
+    def output_shape(self, input_shape):
+        n, h, w, c = input_shape
+        kh, kw = self.pool_size
+        sh, sw = self.strides
+        pad = self._pad()
+        if pad == "SAME":
+            oh, ow = -(-h // sh), -(-w // sw)
+        elif pad == "VALID":
+            oh, ow = (h - kh) // sh + 1, (w - kw) // sw + 1
+        else:
+            oh = (h + pad[1][0] + pad[1][1] - kh) // sh + 1
+            ow = (w + pad[2][0] + pad[2][1] - kw) // sw + 1
+        return (n, oh, ow, c)
+
+    def _config(self):
+        return {
+            "pool_size": list(self.pool_size),
+            "strides": list(self.strides),
+            "padding": self.padding if isinstance(self.padding, (str, int)) else list(self.padding),
+        }
+
+
+@register_module("maxpool2d")
+class MaxPool2D(_Pool2D):
+    """Parity: MaxPool2DLayer (layers_impl/maxpool*, CPU+CUDA). reduce_window(max)."""
+
+    def _apply(self, params, state, x, *, train, rng):
+        y = lax.reduce_window(
+            x, -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min,
+            lax.max, self._window(), self._strides(), self._pad(),
+        )
+        return y, state
+
+
+@register_module("avgpool2d")
+class AvgPool2D(_Pool2D):
+    """Parity: AvgPool2DLayer (layers_impl/avgpool*). reduce_window(add)/count."""
+
+    def _apply(self, params, state, x, *, train, rng):
+        pad = self._pad()
+        xf = x.astype(jnp.float32)
+        s = lax.reduce_window(xf, 0.0, lax.add, self._window(), self._strides(), pad)
+        if pad == "VALID":
+            denom = self.pool_size[0] * self.pool_size[1]
+            y = s / denom
+        else:
+            ones = jnp.ones(x.shape[1:3] + (1,), jnp.float32)[None]
+            cnt = lax.reduce_window(ones, 0.0, lax.add, self._window(), self._strides(), pad)
+            y = s / cnt
+        return y.astype(x.dtype), state
+
+
+@register_module("global_avgpool")
+class GlobalAvgPool(Module):
+    """Spatial mean over H,W (NHWC) -> (N, C)."""
+
+    def _apply(self, params, state, x, *, train, rng):
+        return jnp.mean(x.astype(jnp.float32), axis=(1, 2)).astype(x.dtype), state
+
+    def output_shape(self, input_shape):
+        n, _, _, c = input_shape
+        return (n, c)
+
+
+@register_module("flatten")
+class Flatten(Module):
+    """Parity: FlattenLayer. Collapses all non-batch dims."""
+
+    def _apply(self, params, state, x, *, train, rng):
+        return x.reshape(x.shape[0], -1), state
+
+    def output_shape(self, input_shape):
+        n = input_shape[0]
+        size = 1
+        for d in input_shape[1:]:
+            size *= d
+        return (n, size)
+
+
+@register_module("reshape")
+class Reshape(Module):
+    def __init__(self, shape, name=None, policy=None):
+        super().__init__(name=name, policy=policy)
+        self.shape = tuple(int(d) for d in shape)
+
+    def _apply(self, params, state, x, *, train, rng):
+        return x.reshape((x.shape[0],) + self.shape), state
+
+    def output_shape(self, input_shape):
+        return (input_shape[0],) + self.shape
+
+    def _config(self):
+        return {"shape": list(self.shape)}
+
+
+@register_module("transpose")
+class Transpose(Module):
+    """Parity: TransposeLayer (layers_impl). Permutation excludes batch dim."""
+
+    def __init__(self, perm, name=None, policy=None):
+        super().__init__(name=name, policy=policy)
+        self.perm = tuple(int(p) for p in perm)
+
+    def _apply(self, params, state, x, *, train, rng):
+        full = (0,) + tuple(p + 1 for p in self.perm)
+        return jnp.transpose(x, full), state
+
+    def output_shape(self, input_shape):
+        rest = input_shape[1:]
+        return (input_shape[0],) + tuple(rest[p] for p in self.perm)
+
+    def _config(self):
+        return {"perm": list(self.perm)}
+
+
+@register_module("identity")
+class Identity(Module):
+    """Parity: IdentityLayer."""
+
+    def _apply(self, params, state, x, *, train, rng):
+        return x, state
+
+    def output_shape(self, input_shape):
+        return tuple(input_shape)
+
+
+@register_module("slice")
+class Slice(Module):
+    """Static slice along one non-batch axis (parity: SliceLayer, layers_impl)."""
+
+    def __init__(self, axis: int, start: int, length: int, name=None, policy=None):
+        super().__init__(name=name, policy=policy)
+        self.axis = int(axis)
+        self.start = int(start)
+        self.length = int(length)
+
+    def _apply(self, params, state, x, *, train, rng):
+        axis = self.axis + 1  # axis is relative to non-batch dims
+        idx = [slice(None)] * x.ndim
+        idx[axis] = slice(self.start, self.start + self.length)
+        return x[tuple(idx)], state
+
+    def output_shape(self, input_shape):
+        shape = list(input_shape)
+        shape[self.axis + 1] = self.length
+        return tuple(shape)
+
+    def _config(self):
+        return {"axis": self.axis, "start": self.start, "length": self.length}
+
+
+@register_module("dropout")
+class Dropout(Module):
+    """Parity: DropoutLayer (CPU+CUDA RNG kernels in the reference; threefry here).
+
+    Identity when train=False or rate == 0.
+    """
+
+    def __init__(self, rate: float = 0.5, name=None, policy=None):
+        super().__init__(name=name, policy=policy)
+        self.rate = float(rate)
+
+    def _apply(self, params, state, x, *, train, rng):
+        if not train or self.rate <= 0.0:
+            return x, state
+        if rng is None:
+            raise ValueError("Dropout needs an rng key when train=True")
+        keep = 1.0 - self.rate
+        mask = jax.random.bernoulli(rng, keep, x.shape)
+        return jnp.where(mask, x / keep, 0).astype(x.dtype), state
+
+    def output_shape(self, input_shape):
+        return tuple(input_shape)
+
+    def _config(self):
+        return {"rate": self.rate}

@@ -1,0 +1,87 @@
+"""Loss functions.
+
+Parity: reference Loss hierarchy (include/nn/loss.hpp:24) — CrossEntropyLoss (logits or
+probs mode, :68), MSELoss (:197), MAELoss (:283), HuberLoss (:369), LossFactory (:464,
+``create_logsoftmax_crossentropy``). The reference ships CPU+CUDA kernels for loss and
+loss-gradient (loss_impl/{cpu,cuda}/loss_ops); here gradients come from jax.grad so only
+the scalar forward is defined. All reductions in f32.
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+_REGISTRY: Dict[str, Callable] = {}
+
+
+def register(name: str):
+    def wrap(fn):
+        _REGISTRY[name] = fn
+        fn.loss_name = name
+        return fn
+
+    return wrap
+
+
+def get(name: str) -> Callable:
+    """Parity: LossFactory (include/nn/loss.hpp:464)."""
+    if name not in _REGISTRY:
+        raise KeyError(f"unknown loss {name!r}; known: {sorted(_REGISTRY)}")
+    return _REGISTRY[name]
+
+
+def names():
+    return sorted(_REGISTRY)
+
+
+def _to_onehot(labels, num_classes):
+    if jnp.issubdtype(labels.dtype, jnp.integer) or jnp.issubdtype(labels.dtype, jnp.bool_):
+        return jax.nn.one_hot(labels, num_classes, dtype=jnp.float32)
+    return labels.astype(jnp.float32)
+
+
+@register("softmax_cross_entropy")
+def softmax_cross_entropy(logits, labels, weight: Optional[jax.Array] = None):
+    """Fused log-softmax + NLL on logits (parity: create_logsoftmax_crossentropy,
+    loss.hpp:464 — the numerically-stable mode). ``labels``: int class ids or one-hot/soft.
+    """
+    logits = logits.astype(jnp.float32)
+    onehot = _to_onehot(labels, logits.shape[-1])
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.sum(onehot * logp, axis=-1)
+    if weight is not None:
+        nll = nll * weight
+    return jnp.mean(nll)
+
+
+@register("cross_entropy")
+def cross_entropy(probs, labels, eps: float = 1e-7):
+    """CE on probabilities (parity: CrossEntropyLoss probs mode, loss.hpp:68)."""
+    probs = probs.astype(jnp.float32)
+    onehot = _to_onehot(labels, probs.shape[-1])
+    return jnp.mean(-jnp.sum(onehot * jnp.log(jnp.clip(probs, eps, 1.0)), axis=-1))
+
+
+@register("mse")
+def mse(pred, target):
+    """Parity: MSELoss (loss.hpp:197)."""
+    d = pred.astype(jnp.float32) - target.astype(jnp.float32)
+    return jnp.mean(d * d)
+
+
+@register("mae")
+def mae(pred, target):
+    """Parity: MAELoss (loss.hpp:283)."""
+    return jnp.mean(jnp.abs(pred.astype(jnp.float32) - target.astype(jnp.float32)))
+
+
+@register("huber")
+def huber(pred, target, delta: float = 1.0):
+    """Parity: HuberLoss (loss.hpp:369)."""
+    d = pred.astype(jnp.float32) - target.astype(jnp.float32)
+    a = jnp.abs(d)
+    quad = 0.5 * d * d
+    lin = delta * (a - 0.5 * delta)
+    return jnp.mean(jnp.where(a <= delta, quad, lin))

@@ -1,0 +1,35 @@
+"""Metrics.
+
+Parity: reference accuracy (compute_class_corrects argmax-match, include/nn/accuracy.hpp:14-38,
+CPU+CUDA kernels in accuracy_impl/). Pure jnp; composes into the jit'd eval step.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def class_corrects(logits, labels) -> jnp.ndarray:
+    """Number of argmax matches (parity: compute_class_corrects, accuracy.hpp:14)."""
+    pred = jnp.argmax(logits, axis=-1)
+    if labels.ndim == pred.ndim + 1:
+        labels = jnp.argmax(labels, axis=-1)
+    return jnp.sum((pred == labels).astype(jnp.int32))
+
+
+def accuracy(logits, labels) -> jnp.ndarray:
+    pred = jnp.argmax(logits, axis=-1)
+    if labels.ndim == pred.ndim + 1:
+        labels = jnp.argmax(labels, axis=-1)
+    return jnp.mean((pred == labels).astype(jnp.float32))
+
+
+def topk_accuracy(logits, labels, k: int = 5) -> jnp.ndarray:
+    if labels.ndim == logits.ndim:
+        labels = jnp.argmax(labels, axis=-1)
+    topk = jnp.argsort(logits, axis=-1)[..., -k:]
+    hit = jnp.any(topk == labels[..., None], axis=-1)
+    return jnp.mean(hit.astype(jnp.float32))
+
+
+def perplexity(mean_nll) -> jnp.ndarray:
+    return jnp.exp(mean_nll)

@@ -1,0 +1,160 @@
+"""Normalization layers: BatchNorm, LayerNorm, GroupNorm, RMSNorm.
+
+Parity: reference norm family (~2500 LoC of NCHW/NHWC CPU+CUDA+cuDNN kernels,
+layers_impl/*norm*). On TPU each is a handful of fused HLO ops; stats are computed in f32
+regardless of io dtype. BatchNorm running stats live in the ``state`` collection — the
+functional replacement for the reference's mutable layer members.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.module import Module, register_module
+
+
+@register_module("batchnorm")
+class BatchNorm(Module):
+    """Batch normalization over all axes except the last (channels-last).
+
+    Works for (N, C) and (N, H, W, C). Parity: BatchNormLayer (NCHW+NHWC CPU, CUDA,
+    cuDNN variants in the reference).
+    """
+
+    def __init__(self, momentum: float = 0.9, eps: float = 1e-5, affine: bool = True,
+                 name=None, policy=None):
+        super().__init__(name=name, policy=policy)
+        self.momentum = float(momentum)
+        self.eps = float(eps)
+        self.affine = bool(affine)
+
+    def _init(self, rng, input_shape):
+        c = input_shape[-1]
+        params = {}
+        if self.affine:
+            params = {"scale": jnp.ones((c,), self.policy.param_dtype),
+                      "bias": jnp.zeros((c,), self.policy.param_dtype)}
+        state = {"mean": jnp.zeros((c,), jnp.float32),
+                 "var": jnp.ones((c,), jnp.float32)}
+        return params, state
+
+    def _apply(self, params, state, x, *, train, rng):
+        reduce_axes = tuple(range(x.ndim - 1))
+        xf = x.astype(jnp.float32)
+        if train:
+            mean = jnp.mean(xf, axis=reduce_axes)
+            var = jnp.var(xf, axis=reduce_axes)
+            m = self.momentum
+            new_state = {"mean": m * state["mean"] + (1 - m) * mean,
+                         "var": m * state["var"] + (1 - m) * var}
+        else:
+            mean, var = state["mean"], state["var"]
+            new_state = state
+        inv = jnp.reciprocal(jnp.sqrt(var + self.eps))
+        y = (xf - mean) * inv
+        if self.affine:
+            y = y * params["scale"].astype(jnp.float32) + params["bias"].astype(jnp.float32)
+        return y.astype(x.dtype), new_state
+
+    def output_shape(self, input_shape):
+        return tuple(input_shape)
+
+    def _config(self):
+        return {"momentum": self.momentum, "eps": self.eps, "affine": self.affine}
+
+
+@register_module("layernorm")
+class LayerNorm(Module):
+    """Layer norm over the last dim. Parity: LayerNormLayer (CPU/CUDA/cuDNN)."""
+
+    def __init__(self, eps: float = 1e-5, affine: bool = True, name=None, policy=None):
+        super().__init__(name=name, policy=policy)
+        self.eps = float(eps)
+        self.affine = bool(affine)
+
+    def _init(self, rng, input_shape):
+        c = input_shape[-1]
+        params = {}
+        if self.affine:
+            params = {"scale": jnp.ones((c,), self.policy.param_dtype),
+                      "bias": jnp.zeros((c,), self.policy.param_dtype)}
+        return params, {}
+
+    def _apply(self, params, state, x, *, train, rng):
+        xf = x.astype(jnp.float32)
+        mean = jnp.mean(xf, axis=-1, keepdims=True)
+        var = jnp.var(xf, axis=-1, keepdims=True)
+        y = (xf - mean) * jnp.reciprocal(jnp.sqrt(var + self.eps))
+        if self.affine:
+            y = y * params["scale"].astype(jnp.float32) + params["bias"].astype(jnp.float32)
+        return y.astype(x.dtype), state
+
+    def output_shape(self, input_shape):
+        return tuple(input_shape)
+
+    def _config(self):
+        return {"eps": self.eps, "affine": self.affine}
+
+
+@register_module("groupnorm")
+class GroupNorm(Module):
+    """Group norm over channel groups (channels-last). Parity: GroupNormLayer (CPU/CUDA)."""
+
+    def __init__(self, groups: int = 32, eps: float = 1e-5, affine: bool = True,
+                 name=None, policy=None):
+        super().__init__(name=name, policy=policy)
+        self.groups = int(groups)
+        self.eps = float(eps)
+        self.affine = bool(affine)
+
+    def _init(self, rng, input_shape):
+        c = input_shape[-1]
+        if c % self.groups:
+            raise ValueError(f"channels {c} not divisible by groups {self.groups}")
+        params = {}
+        if self.affine:
+            params = {"scale": jnp.ones((c,), self.policy.param_dtype),
+                      "bias": jnp.zeros((c,), self.policy.param_dtype)}
+        return params, {}
+
+    def _apply(self, params, state, x, *, train, rng):
+        c = x.shape[-1]
+        g = self.groups
+        xf = x.astype(jnp.float32).reshape(x.shape[:-1] + (g, c // g))
+        axes = tuple(range(1, xf.ndim - 2)) + (xf.ndim - 1,)
+        mean = jnp.mean(xf, axis=axes, keepdims=True)
+        var = jnp.var(xf, axis=axes, keepdims=True)
+        y = ((xf - mean) * jnp.reciprocal(jnp.sqrt(var + self.eps))).reshape(x.shape)
+        if self.affine:
+            y = y * params["scale"].astype(jnp.float32) + params["bias"].astype(jnp.float32)
+        return y.astype(x.dtype), state
+
+    def output_shape(self, input_shape):
+        return tuple(input_shape)
+
+    def _config(self):
+        return {"groups": self.groups, "eps": self.eps, "affine": self.affine}
+
+
+@register_module("rmsnorm")
+class RMSNorm(Module):
+    """RMS norm (no reference equivalent — modern LLM addition beyond parity)."""
+
+    def __init__(self, eps: float = 1e-6, name=None, policy=None):
+        super().__init__(name=name, policy=policy)
+        self.eps = float(eps)
+
+    def _init(self, rng, input_shape):
+        c = input_shape[-1]
+        return {"scale": jnp.ones((c,), self.policy.param_dtype)}, {}
+
+    def _apply(self, params, state, x, *, train, rng):
+        xf = x.astype(jnp.float32)
+        ms = jnp.mean(xf * xf, axis=-1, keepdims=True)
+        y = xf * jnp.reciprocal(jnp.sqrt(ms + self.eps)) * params["scale"].astype(jnp.float32)
+        return y.astype(x.dtype), state
+
+    def output_shape(self, input_shape):
+        return tuple(input_shape)
+
+    def _config(self):
+        return {"eps": self.eps}

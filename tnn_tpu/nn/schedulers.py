@@ -1,0 +1,253 @@
+"""LR schedulers — full parity set with the reference (include/nn/schedulers.hpp):
+
+NoOp (:85), StepLR (:112), MultiStepLR (:149), ExponentialLR (:195),
+CosineAnnealingLR (:227), CosineAnnealingWarmRestarts (:265), LinearWarmup (:320),
+WarmupCosineAnnealing (:363), ReduceLROnPlateau (:424), SchedulerFactory (:619).
+
+Design: a scheduler maps an (epoch or step) counter to a multiplicative *scale* on the
+optimizer's base lr. ``scale(t)`` is pure jnp math so it can be traced inside the jit'd
+train step (t as a traced scalar). ReduceLROnPlateau is inherently host-driven (depends on
+val metrics), so it exposes a stateful host API like the reference.
+All are config round-trippable.
+"""
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, Sequence
+
+import jax.numpy as jnp
+
+_REGISTRY: Dict[str, type] = {}
+
+
+def register(name: str):
+    def wrap(cls):
+        _REGISTRY[name] = cls
+        cls.sched_name = name
+        return cls
+
+    return wrap
+
+
+def from_config(cfg: Dict[str, Any]) -> "Scheduler":
+    """Parity: SchedulerFactory (schedulers.hpp:619)."""
+    cfg = dict(cfg)
+    name = cfg.pop("type")
+    if name not in _REGISTRY:
+        raise KeyError(f"unknown scheduler {name!r}; known: {sorted(_REGISTRY)}")
+    return _REGISTRY[name](**cfg)
+
+
+class Scheduler:
+    sched_name = "base"
+    #: True for schedulers whose scale depends on host-side observations (e.g. val
+    #: metrics). Their scale CANNOT be traced into a jitted step — make_train_step
+    #: threads it in as a runtime operand instead.
+    host_driven = False
+
+    def scale(self, t):
+        """Multiplier on base lr at counter t (jnp scalar or python int)."""
+        raise NotImplementedError
+
+    def get_config(self) -> Dict[str, Any]:
+        cfg = {"type": self.sched_name}
+        cfg.update(self._config())
+        return cfg
+
+    def _config(self):
+        return {}
+
+
+@register("noop")
+class NoOp(Scheduler):
+    """Parity: NoOpScheduler (schedulers.hpp:85)."""
+
+    def scale(self, t):
+        return jnp.ones_like(jnp.asarray(t, jnp.float32))
+
+
+@register("step")
+class StepLR(Scheduler):
+    """lr *= gamma every ``step_size`` counters (parity: StepLR, schedulers.hpp:112)."""
+
+    def __init__(self, step_size: int, gamma: float = 0.1):
+        self.step_size = int(step_size)
+        self.gamma = float(gamma)
+
+    def scale(self, t):
+        k = jnp.asarray(t, jnp.float32) // self.step_size
+        return jnp.power(self.gamma, k)
+
+    def _config(self):
+        return {"step_size": self.step_size, "gamma": self.gamma}
+
+
+@register("multistep")
+class MultiStepLR(Scheduler):
+    """lr *= gamma at each milestone (parity: MultiStepLR, schedulers.hpp:149)."""
+
+    def __init__(self, milestones: Sequence[int], gamma: float = 0.1):
+        self.milestones = sorted(int(m) for m in milestones)
+        self.gamma = float(gamma)
+
+    def scale(self, t):
+        tf = jnp.asarray(t, jnp.float32)
+        count = sum(jnp.where(tf >= m, 1.0, 0.0) for m in self.milestones)
+        return jnp.power(self.gamma, count)
+
+    def _config(self):
+        return {"milestones": list(self.milestones), "gamma": self.gamma}
+
+
+@register("exponential")
+class ExponentialLR(Scheduler):
+    """lr *= gamma^t (parity: ExponentialLR, schedulers.hpp:195)."""
+
+    def __init__(self, gamma: float = 0.95):
+        self.gamma = float(gamma)
+
+    def scale(self, t):
+        return jnp.power(self.gamma, jnp.asarray(t, jnp.float32))
+
+    def _config(self):
+        return {"gamma": self.gamma}
+
+
+@register("cosine")
+class CosineAnnealingLR(Scheduler):
+    """Cosine decay to eta_min over T_max (parity: CosineAnnealingLR, schedulers.hpp:227).
+
+    ``eta_min_scale`` is relative to base lr.
+    """
+
+    def __init__(self, t_max: int, eta_min_scale: float = 0.0):
+        self.t_max = int(t_max)
+        self.eta_min_scale = float(eta_min_scale)
+
+    def scale(self, t):
+        tf = jnp.minimum(jnp.asarray(t, jnp.float32), self.t_max)
+        cos = 0.5 * (1 + jnp.cos(math.pi * tf / self.t_max))
+        return self.eta_min_scale + (1 - self.eta_min_scale) * cos
+
+    def _config(self):
+        return {"t_max": self.t_max, "eta_min_scale": self.eta_min_scale}
+
+
+@register("cosine_restarts")
+class CosineAnnealingWarmRestarts(Scheduler):
+    """SGDR restarts (parity: CosineAnnealingWarmRestarts, schedulers.hpp:265).
+
+    T_mult must be 1 or 2 for closed-form traced math; the common cases.
+    """
+
+    def __init__(self, t_0: int, t_mult: int = 1, eta_min_scale: float = 0.0):
+        self.t_0 = int(t_0)
+        self.t_mult = int(t_mult)
+        if self.t_mult not in (1, 2):
+            raise ValueError("t_mult must be 1 or 2")
+        self.eta_min_scale = float(eta_min_scale)
+
+    def scale(self, t):
+        tf = jnp.asarray(t, jnp.float32)
+        if self.t_mult == 1:
+            tcur = jnp.mod(tf, self.t_0)
+            ti = float(self.t_0)
+        else:
+            # cycle i has length T0*2^i; cumulative = T0*(2^(i+1)-1)
+            i = jnp.floor(jnp.log2(tf / self.t_0 + 1.0))
+            start = self.t_0 * (jnp.power(2.0, i) - 1.0)
+            ti = self.t_0 * jnp.power(2.0, i)
+            tcur = tf - start
+        cos = 0.5 * (1 + jnp.cos(math.pi * tcur / ti))
+        return self.eta_min_scale + (1 - self.eta_min_scale) * cos
+
+    def _config(self):
+        return {"t_0": self.t_0, "t_mult": self.t_mult, "eta_min_scale": self.eta_min_scale}
+
+
+@register("linear_warmup")
+class LinearWarmup(Scheduler):
+    """Ramp 0 -> 1 over ``warmup`` counters (parity: LinearWarmup, schedulers.hpp:320)."""
+
+    def __init__(self, warmup: int, start_scale: float = 0.0):
+        self.warmup = int(warmup)
+        self.start_scale = float(start_scale)
+
+    def scale(self, t):
+        tf = jnp.asarray(t, jnp.float32)
+        frac = jnp.clip(tf / max(1, self.warmup), 0.0, 1.0)
+        return self.start_scale + (1 - self.start_scale) * frac
+
+    def _config(self):
+        return {"warmup": self.warmup, "start_scale": self.start_scale}
+
+
+@register("warmup_cosine")
+class WarmupCosineAnnealing(Scheduler):
+    """Linear warmup then cosine decay (parity: WarmupCosineAnnealing, schedulers.hpp:363)."""
+
+    def __init__(self, warmup: int, t_max: int, eta_min_scale: float = 0.0):
+        self.warmup = int(warmup)
+        self.t_max = int(t_max)
+        self.eta_min_scale = float(eta_min_scale)
+
+    def scale(self, t):
+        tf = jnp.asarray(t, jnp.float32)
+        warm = tf / max(1, self.warmup)
+        span = max(1, self.t_max - self.warmup)
+        tcos = jnp.clip((tf - self.warmup) / span, 0.0, 1.0)
+        cos = self.eta_min_scale + (1 - self.eta_min_scale) * 0.5 * (1 + jnp.cos(math.pi * tcos))
+        return jnp.where(tf < self.warmup, warm, cos)
+
+    def _config(self):
+        return {"warmup": self.warmup, "t_max": self.t_max, "eta_min_scale": self.eta_min_scale}
+
+
+@register("reduce_on_plateau")
+class ReduceLROnPlateau(Scheduler):
+    """Host-driven plateau scheduler (parity: ReduceLROnPlateau, schedulers.hpp:424).
+
+    Call ``observe(metric)`` each validation; ``current_scale()`` returns the current
+    factor to feed into the train step as a runtime operand (it must NOT be traced into
+    the compiled program — it would constant-fold).
+    """
+
+    host_driven = True
+
+    def __init__(self, factor: float = 0.1, patience: int = 10, mode: str = "min",
+                 min_scale: float = 1e-4, threshold: float = 1e-4):
+        self.factor = float(factor)
+        self.patience = int(patience)
+        self.mode = mode
+        self.min_scale = float(min_scale)
+        self.threshold = float(threshold)
+        self._best = None
+        self._bad = 0
+        self._scale = 1.0
+
+    def observe(self, metric: float):
+        better = (
+            self._best is None
+            or (self.mode == "min" and metric < self._best - self.threshold)
+            or (self.mode == "max" and metric > self._best + self.threshold)
+        )
+        if better:
+            self._best = metric
+            self._bad = 0
+        else:
+            self._bad += 1
+            if self._bad > self.patience:
+                self._scale = max(self.min_scale, self._scale * self.factor)
+                self._bad = 0
+        return self._scale
+
+    def current_scale(self) -> float:
+        return self._scale
+
+    def scale(self, t):
+        del t
+        return jnp.asarray(self._scale, jnp.float32)
+
+    def _config(self):
+        return {"factor": self.factor, "patience": self.patience, "mode": self.mode,
+                "min_scale": self.min_scale, "threshold": self.threshold}

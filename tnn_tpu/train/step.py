@@ -1,0 +1,131 @@
+"""Compiled train/eval steps.
+
+This is the TPU-first replacement for the reference's eager per-batch hot loop
+(src/nn/train.cpp:150-206: forward -> loss -> gradient -> backward -> optimizer step ->
+flow sync). Here the ENTIRE step — forward, loss, backward (jax.grad), optimizer update,
+metric — is one XLA program, compiled once and cached, with buffer donation so params and
+optimizer state update in place on device (the reference's GraphContext slab residency,
+include/nn/graph_context.hpp:37-89, maps to donated device buffers).
+
+TrainState is the step carry: params + optimizer state + mutable net state (BatchNorm
+stats) + step counter + rng key.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Dict, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..nn import losses as losses_lib
+from ..nn import metrics as metrics_lib
+from ..nn.optimizers import Optimizer
+from ..nn.schedulers import Scheduler, NoOp
+
+
+class TrainState(NamedTuple):
+    params: Any
+    opt_state: Any
+    net_state: Any
+    step: jax.Array
+    rng: jax.Array
+
+
+def create_train_state(model, optimizer: Optimizer, rng: jax.Array, input_shape,
+                       input_dtype=None) -> TrainState:
+    init_rng, step_rng = jax.random.split(rng)
+    if input_dtype is not None:
+        variables = model.init(init_rng, input_shape, input_dtype=input_dtype)
+    else:
+        variables = model.init(init_rng, input_shape)
+    return TrainState(
+        params=variables["params"],
+        opt_state=optimizer.init(variables["params"]),
+        net_state=variables["state"],
+        step=jnp.zeros((), jnp.int32),
+        rng=step_rng,
+    )
+
+
+def make_train_step(
+    model,
+    optimizer: Optimizer,
+    loss_fn: Callable | str = "softmax_cross_entropy",
+    scheduler: Optional[Scheduler] = None,
+    compute_accuracy: bool = True,
+    donate: bool = True,
+) -> Callable[[TrainState, jax.Array, jax.Array], Tuple[TrainState, Dict[str, jax.Array]]]:
+    """Build a jitted (state, data, labels) -> (state, metrics) step.
+
+    The scheduler's scale is traced from the step counter, so LR schedules do not
+    retrigger compilation.
+    """
+    if isinstance(loss_fn, str):
+        loss_fn = losses_lib.get(loss_fn)
+    scheduler = scheduler or NoOp()
+    host_driven = getattr(scheduler, "host_driven", False)
+
+    def step(state: TrainState, data, labels, lr_scale):
+        rng, sub = jax.random.split(state.rng)
+
+        def compute_loss(params):
+            out, new_net_state = model.apply(
+                {"params": params, "state": state.net_state}, data, train=True, rng=sub)
+            loss = loss_fn(out, labels)
+            return loss, (out, new_net_state)
+
+        (loss, (out, new_net_state)), grads = jax.value_and_grad(
+            compute_loss, has_aux=True)(state.params)
+        if not host_driven:
+            lr_scale = scheduler.scale(state.step)
+        new_params, new_opt_state = optimizer.update(
+            grads, state.opt_state, state.params, lr_scale=lr_scale)
+        metrics = {"loss": loss, "lr_scale": lr_scale}
+        if compute_accuracy:
+            metrics["accuracy"] = metrics_lib.accuracy(out, labels)
+        new_state = TrainState(new_params, new_opt_state, new_net_state, state.step + 1, rng)
+        return new_state, metrics
+
+    donate_argnums = (0,) if donate else ()
+    jitted = jax.jit(step, donate_argnums=donate_argnums)
+
+    if host_driven:
+        # Host-driven schedulers (ReduceLROnPlateau) feed their factor in as a runtime
+        # operand — tracing scheduler.scale() would constant-fold it into the program.
+        def wrapped(state, data, labels):
+            return jitted(state, data, labels,
+                          jnp.asarray(scheduler.current_scale(), jnp.float32))
+    else:
+        def wrapped(state, data, labels):
+            return jitted(state, data, labels, jnp.ones((), jnp.float32))
+
+    return wrapped
+
+
+def make_eval_step(model, loss_fn: Callable | str = "softmax_cross_entropy",
+                   compute_accuracy: bool = True):
+    """Jitted (state, data, labels) -> metrics (no state mutation; BN uses running stats)."""
+    if isinstance(loss_fn, str):
+        loss_fn = losses_lib.get(loss_fn)
+
+    @jax.jit
+    def step(state: TrainState, data, labels):
+        out, _ = model.apply({"params": state.params, "state": state.net_state},
+                             data, train=False)
+        metrics = {"loss": loss_fn(out, labels)}
+        if compute_accuracy:
+            metrics["corrects"] = metrics_lib.class_corrects(out, labels)
+        return metrics
+
+    return step
+
+
+def make_predict(model):
+    @jax.jit
+    def predict(state: TrainState, data):
+        out, _ = model.apply({"params": state.params, "state": state.net_state},
+                             data, train=False)
+        return out
+
+    return predict
