@@ -1,0 +1,72 @@
+"""Headline benchmark: WRN-16-8 CIFAR-100 training throughput on one TPU chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Baseline (BASELINE.md): the reference's flagship run is CIFAR-100 WRN-16-8 at
+~102-110 ms/batch for bs=256 over a 2-machine RoCE pipeline => ~2.4k img/s
+(sample_logs/cifar100_wrn16_8:348-368). vs_baseline = our img/s per chip / 2400.
+
+Timing note: on this box's tunneled `axon` TPU platform, jax.block_until_ready does NOT
+actually wait; the only true sync is a value fetch (~90ms round trip). So we time many
+steps and subtract the separately-measured fetch latency.
+"""
+import json
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+BATCH = 256
+BASELINE_IMG_S = 2400.0
+WARMUP_STEPS = 8
+MEASURE_STEPS = 100
+
+
+def _sync(x) -> float:
+    """True device sync: fetch one scalar (block_until_ready lies on axon relay)."""
+    return float(jnp.ravel(x)[0].astype(jnp.float32))
+
+
+def main():
+    from tnn_tpu import models, nn
+    from tnn_tpu.train import create_train_state, make_train_step
+
+    rng = jax.random.PRNGKey(0)
+    model = models.create("cifar100_wrn16_8")  # bf16 compute, f32 master params
+    opt = nn.SGD(lr=0.1, momentum=0.9, weight_decay=5e-4)
+    sched = nn.WarmupCosineAnnealing(warmup=200, t_max=20000)
+    state = create_train_state(model, opt, rng, (BATCH, 32, 32, 3))
+    step = make_train_step(model, opt, scheduler=sched)
+
+    rs = np.random.RandomState(0)
+    data = jnp.asarray(rs.randn(BATCH, 32, 32, 3), jnp.bfloat16)
+    labels = jnp.asarray(rs.randint(0, 100, BATCH), jnp.int32)
+
+    for _ in range(WARMUP_STEPS):
+        state, m = step(state, data, labels)
+    _sync(m["loss"])
+
+    # fetch round-trip latency (amortised out below)
+    t0 = time.perf_counter()
+    _sync(m["loss"])
+    fetch_latency = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    for _ in range(MEASURE_STEPS):
+        state, m = step(state, data, labels)
+    _sync(m["loss"])
+    dt = (time.perf_counter() - t0 - fetch_latency) / MEASURE_STEPS
+
+    img_s = BATCH / dt
+    print(json.dumps({
+        "metric": "wrn16_8_cifar100_train_img_per_sec_per_chip",
+        "value": round(img_s, 1),
+        "unit": "img/s",
+        "vs_baseline": round(img_s / BASELINE_IMG_S, 3),
+    }))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

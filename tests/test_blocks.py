@@ -91,3 +91,46 @@ def test_stateful_sequential_updates_bn(rng):
     x = jnp.asarray(np.random.RandomState(0).randn(4, 4), jnp.float32)
     _, new_state = model.apply(v, x, train=True)
     assert "01_batchnorm" in new_state
+
+
+def test_layer_builder_dsl(rng):
+    """Parity: LayerBuilder chained shape-inferring DSL (layer_builder.hpp:11-624)."""
+    import jax.numpy as jnp
+    from tnn_tpu.nn.builder import LayerBuilder
+
+    model = (LayerBuilder((32, 32, 3), policy=F32)
+             .conv2d(32, 3, activation="relu")
+             .batchnorm()
+             .maxpool(2)
+             .basic_residual_block(64, strides=2)
+             .global_avgpool()
+             .dense(10)
+             .build(name="builder_cnn"))
+    v = model.init(rng, (2, 32, 32, 3), input_dtype=jnp.float32)
+    y = model(v, jnp.zeros((2, 32, 32, 3), jnp.float32))
+    assert y.shape == (2, 10)
+
+
+def test_layer_builder_shape_tracking():
+    from tnn_tpu.nn.builder import LayerBuilder
+
+    b = LayerBuilder((32, 32, 3), policy=F32).conv2d(16, 3, strides=2).maxpool(2)
+    assert b.shape == (8, 8, 16)
+    b = b.flatten()
+    assert b.shape == (8 * 8 * 16,)
+
+
+def test_layer_builder_transformer(rng):
+    import jax.numpy as jnp
+    from tnn_tpu.nn.builder import LayerBuilder
+
+    model = (LayerBuilder((16,), policy=F32)
+             .embedding(100, 32)
+             .positional_embedding()
+             .gpt_block(4)
+             .layernorm()
+             .dense(100)
+             .build())
+    v = model.init(rng, (2, 16), input_dtype=jnp.int32)
+    y = model(v, jnp.zeros((2, 16), jnp.int32))
+    assert y.shape == (2, 16, 100)

@@ -103,18 +103,20 @@ class Module:
         *inputs,
         train: bool = False,
         rng: Optional[jax.Array] = None,
+        **kwargs,
     ):
         """Pure forward. Returns ``(output, new_state)``.
 
         ``new_state`` echoes ``variables["state"]`` (updated when train=True for stateful
-        layers such as BatchNorm).
+        layers such as BatchNorm). Extra kwargs pass through to ``_apply`` for layers
+        with additional knobs (e.g. PositionalEmbedding's ``offset``).
         """
         params = variables.get("params", {})
         state = variables.get("state", {})
-        return self._apply(params, state, *inputs, train=train, rng=rng)
+        return self._apply(params, state, *inputs, train=train, rng=rng, **kwargs)
 
-    def __call__(self, variables, *inputs, train: bool = False, rng=None):
-        out, _ = self.apply(variables, *inputs, train=train, rng=rng)
+    def __call__(self, variables, *inputs, train: bool = False, rng=None, **kwargs):
+        out, _ = self.apply(variables, *inputs, train=train, rng=rng, **kwargs)
         return out
 
     # -- to be overridden ----------------------------------------------------

@@ -1,0 +1,6 @@
+from . import gpt2, resnet, vit, zoo
+from .gpt2 import GPT2, generate
+from .vit import ViT
+from .zoo import create, names
+
+__all__ = ["gpt2", "resnet", "vit", "zoo", "GPT2", "generate", "ViT", "create", "names"]

@@ -1,0 +1,189 @@
+"""GPT-2 model family.
+
+Parity: reference gpt2_{small,medium,large} builders (src/nn/example_models.cpp:384-504;
+small = 12L/768d/12h/1024ctx/50257vocab at :385-391) and the gpt_block DSL entry
+(include/nn/layer_builder.hpp:531-570). "flash" variants map to backend="pallas".
+
+Exceeds the reference: KV-cache greedy/sampled generation (the reference recomputes the
+full 1024-token sequence per generated token, examples/gpt2_inference.cpp:71-91) and
+weight tying between token embedding and output head.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..core import rng as rnglib
+from ..core.module import Module, register_module
+from ..nn.embedding import Embedding, PositionalEmbedding
+from ..nn.layers import Dense, Dropout
+from ..nn.norms import LayerNorm
+from ..nn.transformer import GPTBlock
+
+
+@register_module("gpt2")
+class GPT2(Module):
+    """Decoder-only LM: wte + wpe -> n_layer x GPTBlock -> ln_f -> logits (tied head)."""
+
+    def __init__(self, vocab_size: int = 50257, max_len: int = 1024, num_layers: int = 12,
+                 d_model: int = 768, num_heads: int = 12, dropout: float = 0.0,
+                 backend: str = "xla", tie_embeddings: bool = True,
+                 name=None, policy=None):
+        super().__init__(name=name, policy=policy)
+        self.vocab_size = int(vocab_size)
+        self.max_len = int(max_len)
+        self.num_layers = int(num_layers)
+        self.d_model = int(d_model)
+        self.num_heads = int(num_heads)
+        self.dropout = float(dropout)
+        self.backend = backend
+        self.tie_embeddings = bool(tie_embeddings)
+        p = self.policy
+        self.wte = Embedding(vocab_size, d_model, policy=p)
+        self.wpe = PositionalEmbedding(max_len, policy=p)
+        self.drop = Dropout(dropout, policy=p)
+        self.blocks = [GPTBlock(num_heads, dropout=dropout, backend=backend, policy=p)
+                       for _ in range(num_layers)]
+        self.ln_f = LayerNorm(policy=p)
+
+    def _init(self, rng, input_shape):
+        n, s = input_shape[:2]
+        keys = jax.random.split(rng, self.num_layers + 3)
+        emb_shape = (n, s, self.d_model)
+        params = {
+            "wte": self.wte.init(keys[0], input_shape)["params"],
+            "wpe": self.wpe.init(keys[1], emb_shape)["params"],
+            "ln_f": self.ln_f.init(keys[2], emb_shape)["params"],
+        }
+        for i, block in enumerate(self.blocks):
+            params[f"h{i}"] = block.init(keys[3 + i], emb_shape)["params"]
+        if not self.tie_embeddings:
+            head = Dense(self.vocab_size, use_bias=False, policy=self.policy)
+            params["head"] = head.init(keys[2], emb_shape)["params"]
+        return params, {}
+
+    def _trunk(self, params, ids, train, rng, offset=0):
+        keys = rnglib.split_for(rng, self.num_layers + 1)
+        x, _ = self.wte.apply({"params": params["wte"], "state": {}}, ids)
+        x, _ = self.wpe.apply({"params": params["wpe"], "state": {}}, x, offset=offset)
+        x, _ = self.drop.apply({}, x, train=train, rng=keys[-1])
+        return x, keys
+
+    def _head(self, params, x):
+        if self.tie_embeddings:
+            logits = self.wte.attend(params["wte"], x)
+        else:
+            w = self.policy.cast_param(params["head"]["kernel"])
+            logits = jax.lax.dot_general(x, w, (((x.ndim - 1,), (0,)), ((), ())),
+                                         preferred_element_type=jnp.float32)
+        return logits  # f32 logits for a stable softmax/loss
+
+    def _apply(self, params, state, ids, *, train, rng):
+        x, keys = self._trunk(params, ids, train, rng)
+        for i, block in enumerate(self.blocks):
+            x, _ = block.apply({"params": params[f"h{i}"], "state": {}}, x,
+                               train=train, rng=keys[i])
+        x, _ = self.ln_f.apply({"params": params["ln_f"], "state": {}}, x)
+        return self._head(params, x), state
+
+    def output_shape(self, input_shape):
+        return tuple(input_shape[:2]) + (self.vocab_size,)
+
+    # -- KV-cache decode ------------------------------------------------------
+
+    def init_cache(self, batch: int, max_len: Optional[int] = None):
+        max_len = max_len or self.max_len
+        return [b.init_cache(batch, max_len, self.d_model) for b in self.blocks]
+
+    def apply_cached(self, params, ids, caches, offset):
+        """Forward ids (N, S_new) given caches covering [0, offset).
+
+        Returns (logits for the new positions, new caches).
+        """
+        x, _ = self._trunk(params, ids, False, None, offset=offset)
+        new_caches = []
+        for i, block in enumerate(self.blocks):
+            x, c = block.apply_cached(params[f"h{i}"], x, caches[i], offset)
+            new_caches.append(c)
+        x, _ = self.ln_f.apply({"params": params["ln_f"], "state": {}}, x)
+        return self._head(params, x), new_caches
+
+    def _config(self):
+        return {"vocab_size": self.vocab_size, "max_len": self.max_len,
+                "num_layers": self.num_layers, "d_model": self.d_model,
+                "num_heads": self.num_heads, "dropout": self.dropout,
+                "backend": self.backend, "tie_embeddings": self.tie_embeddings}
+
+
+def generate(model: GPT2, params, prompt_ids, max_new_tokens: int,
+             temperature: float = 0.0, rng: Optional[jax.Array] = None,
+             max_len: Optional[int] = None):
+    """Autoregressive generation with a KV cache, fully jit-compiled.
+
+    Prefill processes the whole prompt in one pass; decode generates one token per step
+    with lax.scan (static shapes — no per-token recompilation). temperature<=0 = greedy.
+    Exceeds the reference inference loop (full recompute per token,
+    examples/gpt2_inference.cpp:71-122).
+    """
+    prompt_ids = jnp.asarray(prompt_ids)
+    if prompt_ids.ndim == 1:
+        prompt_ids = prompt_ids[None]
+    batch, prompt_len = prompt_ids.shape
+    max_len = max_len or model.max_len
+    if prompt_len + max_new_tokens > max_len:
+        raise ValueError(f"prompt_len {prompt_len} + max_new_tokens {max_new_tokens} "
+                         f"exceeds max_len {max_len}")
+    if rng is None:
+        rng = jax.random.PRNGKey(0)
+
+    # jit cache lives on the model instance — repeat calls with the same geometry reuse
+    # the compiled prefill+scan program instead of retracing.
+    cache_key = (batch, prompt_len, max_new_tokens, float(temperature), max_len)
+    jit_cache = getattr(model, "_generate_jit_cache", None)
+    if jit_cache is None:
+        jit_cache = model._generate_jit_cache = {}
+    run = jit_cache.get(cache_key)
+    if run is None:
+
+        @jax.jit
+        def run(params, prompt_ids, rng):
+            caches = model.init_cache(batch, max_len)
+            logits, caches = model.apply_cached(params, prompt_ids, caches, 0)
+            last_logits = logits[:, -1]
+
+            def sample(logits, key):
+                if temperature > 0.0:
+                    return jax.random.categorical(key, logits / temperature, axis=-1)
+                return jnp.argmax(logits, axis=-1)
+
+            def step(carry, key):
+                caches, last_logits, offset = carry
+                tok = sample(last_logits, key)
+                logits, caches = model.apply_cached(params, tok[:, None], caches, offset)
+                return (caches, logits[:, -1], offset + 1), tok
+
+            keys = jax.random.split(rng, max_new_tokens)
+            (_, _, _), toks = jax.lax.scan(
+                step, (caches, last_logits, jnp.asarray(prompt_len, jnp.int32)), keys)
+            return toks.T  # (batch, max_new_tokens)
+
+        jit_cache[cache_key] = run
+
+    return run(params, prompt_ids, rng)
+
+
+def gpt2_small(**kw):
+    """12L/768d/12h (parity: example_models.cpp:384-391)."""
+    return GPT2(num_layers=12, d_model=768, num_heads=12, **kw)
+
+
+def gpt2_medium(**kw):
+    """24L/1024d/16h (parity: example_models.cpp:432)."""
+    return GPT2(num_layers=24, d_model=1024, num_heads=16, **kw)
+
+
+def gpt2_large(**kw):
+    """36L/1280d/20h (parity: example_models.cpp:480)."""
+    return GPT2(num_layers=36, d_model=1280, num_heads=20, **kw)

@@ -1,0 +1,134 @@
+"""ResNet / WideResNet / VGG model builders, composed from the container blocks.
+
+Parity: reference model zoo creators (src/nn/example_models.cpp): cifar10_vgg (:39),
+cifar10_resnet9 (:74), cifar100_resnet18 (:104), cifar100_wrn16_8 (:130),
+tiny_imagenet_{resnet18:161, wrn16_8:187, resnet50:218}, resnet50_imagenet (:252) —
+and the basic/wide/bottleneck residual-block DSL entries (include/nn/layer_builder.hpp).
+
+All NHWC, bf16-compute by default.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+from ..core import dtypes as dt
+from ..nn.activations import Activation
+from ..nn.blocks import Sequential
+from ..nn.conv_blocks import basic_block, bottleneck_block, conv_bn, wide_basic_block
+from ..nn.layers import Conv2D, Dense, Dropout, Flatten, GlobalAvgPool, MaxPool2D
+from ..nn.norms import BatchNorm
+
+
+# ---------------------------------------------------------------------------
+# Whole models
+# ---------------------------------------------------------------------------
+
+
+def mnist_cnn(num_classes: int = 10, policy=None):
+    """Small conv net (parity: mnist_cnn, example_models.cpp:21)."""
+    p = policy
+    return Sequential(
+        conv_bn(32, 3, 1, "relu", p) + [MaxPool2D(2, policy=p)]
+        + conv_bn(64, 3, 1, "relu", p) + [MaxPool2D(2, policy=p)]
+        + [Flatten(policy=p), Dense(128, activation="relu", policy=p),
+           Dropout(0.25, policy=p), Dense(num_classes, policy=p)],
+        name="mnist_cnn", policy=p)
+
+
+def vgg11(num_classes: int = 10, policy=None):
+    """VGG-style stack (parity: cifar10_vgg, example_models.cpp:39)."""
+    p = policy
+    cfg = [64, "M", 128, "M", 256, 256, "M", 512, 512, "M"]
+    layers = []
+    for v in cfg:
+        if v == "M":
+            layers.append(MaxPool2D(2, policy=p))
+        else:
+            layers += conv_bn(v, 3, 1, "relu", p)
+    layers += [Flatten(policy=p), Dense(512, activation="relu", policy=p),
+               Dropout(0.5, policy=p), Dense(num_classes, policy=p)]
+    return Sequential(layers, name="vgg11", policy=p)
+
+
+def resnet9(num_classes: int = 10, policy=None):
+    """CIFAR ResNet-9 (parity: cifar10_resnet9, example_models.cpp:74)."""
+    p = policy
+    return Sequential(
+        conv_bn(64, 3, 1, "relu", p)
+        + conv_bn(128, 3, 1, "relu", p) + [MaxPool2D(2, policy=p)]
+        + [basic_block(128, policy=p)]
+        + conv_bn(256, 3, 1, "relu", p) + [MaxPool2D(2, policy=p)]
+        + conv_bn(512, 3, 1, "relu", p) + [MaxPool2D(2, policy=p)]
+        + [basic_block(512, policy=p)]
+        + [MaxPool2D(4, policy=p), Flatten(policy=p), Dense(num_classes, policy=p)],
+        name="resnet9", policy=p)
+
+
+def resnet18(num_classes: int = 100, small_input: bool = True, policy=None):
+    """ResNet-18 (parity: cifar100_resnet18 :104 / tiny_imagenet_resnet18 :161).
+
+    small_input: CIFAR-style 3x3 stem (no 7x7/stride-2, no stem maxpool).
+    """
+    p = policy
+    layers = []
+    if small_input:
+        layers += conv_bn(64, 3, 1, "relu", p)
+    else:
+        layers += conv_bn(64, 7, 2, "relu", p) + [MaxPool2D(3, 2, padding="same", policy=p)]
+    widths = [64, 128, 256, 512]
+    in_f = 64
+    for gi, w in enumerate(widths):
+        for bi in range(2):
+            strides = 2 if (gi > 0 and bi == 0) else 1
+            layers.append(basic_block(w, strides, in_filters=in_f, policy=p))
+            in_f = w
+    layers += [GlobalAvgPool(policy=p), Dense(num_classes, policy=p)]
+    return Sequential(layers, name="resnet18", policy=p)
+
+
+def resnet50(num_classes: int = 1000, small_input: bool = False, policy=None):
+    """ResNet-50 (parity: resnet50_imagenet :252 / tiny_imagenet_resnet50 :218)."""
+    p = policy
+    layers = []
+    if small_input:
+        layers += conv_bn(64, 3, 1, "relu", p)
+    else:
+        layers += conv_bn(64, 7, 2, "relu", p) + [MaxPool2D(3, 2, padding="same", policy=p)]
+    blocks_per = [3, 4, 6, 3]
+    widths = [64, 128, 256, 512]
+    in_f = 64
+    for gi, (w, n) in enumerate(zip(widths, blocks_per)):
+        for bi in range(n):
+            strides = 2 if (gi > 0 and bi == 0) else 1
+            layers.append(bottleneck_block(w, strides, in_filters=in_f, policy=p))
+            in_f = w * 4
+    layers += [GlobalAvgPool(policy=p), Dense(num_classes, policy=p)]
+    return Sequential(layers, name="resnet50", policy=p)
+
+
+def wrn16_8(num_classes: int = 100, dropout: float = 0.0, policy=None):
+    """WideResNet-16-8 (parity: cifar100_wrn16_8, example_models.cpp:130).
+
+    depth 16 -> (16-4)/6 = 2 blocks per group; widths 16k = [128, 256, 512] for k=8.
+    ~11M params — the reference's flagship training benchmark model.
+    """
+    return wrn(depth=16, widen=8, num_classes=num_classes, dropout=dropout, policy=policy)
+
+
+def wrn(depth: int = 16, widen: int = 8, num_classes: int = 100, dropout: float = 0.0,
+        policy=None):
+    p = policy
+    assert (depth - 4) % 6 == 0, "WRN depth must be 6n+4"
+    n = (depth - 4) // 6
+    widths = [16 * widen, 32 * widen, 64 * widen]
+    layers = [Conv2D(16, 3, padding="same", use_bias=False, policy=p)]
+    in_f = 16
+    for gi, w in enumerate(widths):
+        for bi in range(n):
+            strides = 2 if (gi > 0 and bi == 0) else 1
+            layers.append(wide_basic_block(w, strides, in_filters=in_f,
+                                           dropout=dropout, policy=p))
+            in_f = w
+    layers += [BatchNorm(policy=p), Activation("relu", policy=p),
+               GlobalAvgPool(policy=p), Dense(num_classes, policy=p)]
+    return Sequential(layers, name=f"wrn{depth}_{widen}", policy=p)

@@ -1,0 +1,88 @@
+"""Vision Transformer.
+
+Parity: reference tiny_imagenet_vit (src/nn/example_models.cpp:286) and flash_vit (:335):
+patchify -> class token -> learned positional embedding -> encoder blocks -> LN -> head
+(the reference builds this from class_token/positional_embedding/attention DSL entries,
+include/nn/layer_builder.hpp). "flash" maps to backend="pallas".
+"""
+from __future__ import annotations
+
+import jax
+
+from ..core.module import Module, register_module
+from ..core import rng as rnglib
+from ..nn.embedding import ClassToken, PositionalEmbedding
+from ..nn.layers import Conv2D, Dense, Dropout
+from ..nn.norms import LayerNorm
+from ..nn.transformer import EncoderBlock
+
+
+@register_module("vit")
+class ViT(Module):
+    def __init__(self, num_classes: int = 200, patch_size: int = 8, d_model: int = 384,
+                 num_layers: int = 6, num_heads: int = 6, mlp_ratio: int = 4,
+                 dropout: float = 0.0, backend: str = "xla", name=None, policy=None):
+        super().__init__(name=name, policy=policy)
+        self.num_classes = int(num_classes)
+        self.patch_size = int(patch_size)
+        self.d_model = int(d_model)
+        self.num_layers = int(num_layers)
+        self.num_heads = int(num_heads)
+        self.mlp_ratio = int(mlp_ratio)
+        self.dropout = float(dropout)
+        self.backend = backend
+        p = self.policy
+        self.patch = Conv2D(d_model, patch_size, strides=patch_size, padding="valid", policy=p)
+        self.cls = ClassToken(policy=p)
+        self.pos = PositionalEmbedding(policy=p)  # sized from input at init
+        self.drop = Dropout(dropout, policy=p)
+        self.blocks = [EncoderBlock(num_heads, mlp_ratio=mlp_ratio, dropout=dropout,
+                                    backend=backend, policy=p)
+                       for _ in range(num_layers)]
+        self.ln = LayerNorm(policy=p)
+        self.head = Dense(num_classes, policy=p)
+
+    def _seq_len(self, input_shape):
+        _, h, w, _ = input_shape
+        return (h // self.patch_size) * (w // self.patch_size) + 1
+
+    def _init(self, rng, input_shape):
+        n = input_shape[0]
+        s = self._seq_len(input_shape)
+        keys = jax.random.split(rng, self.num_layers + 5)
+        tok_shape = (n, s, self.d_model)
+        params = {
+            "patch": self.patch.init(keys[0], input_shape)["params"],
+            "cls": self.cls.init(keys[1], (n, s - 1, self.d_model))["params"],
+            "pos": self.pos.init(keys[2], tok_shape)["params"],
+            "ln": self.ln.init(keys[3], tok_shape)["params"],
+            "head": self.head.init(keys[4], (n, self.d_model))["params"],
+        }
+        for i, b in enumerate(self.blocks):
+            params[f"h{i}"] = b.init(keys[5 + i], tok_shape)["params"]
+        return params, {}
+
+    def _apply(self, params, state, x, *, train, rng):
+        keys = rnglib.split_for(rng, self.num_layers + 1)
+        x, _ = self.patch.apply({"params": params["patch"], "state": {}}, x)
+        n, h, w, d = x.shape
+        x = x.reshape(n, h * w, d)
+        x, _ = self.cls.apply({"params": params["cls"], "state": {}}, x)
+        x, _ = self.pos.apply({"params": params["pos"], "state": {}}, x)
+        x, _ = self.drop.apply({}, x, train=train, rng=keys[-1])
+        for i, b in enumerate(self.blocks):
+            x, _ = b.apply({"params": params[f"h{i}"], "state": {}}, x,
+                           train=train, rng=keys[i])
+        x, _ = self.ln.apply({"params": params["ln"], "state": {}}, x)
+        cls_tok = x[:, 0]
+        logits, _ = self.head.apply({"params": params["head"], "state": {}}, cls_tok)
+        return logits, state
+
+    def output_shape(self, input_shape):
+        return (input_shape[0], self.num_classes)
+
+    def _config(self):
+        return {"num_classes": self.num_classes, "patch_size": self.patch_size,
+                "d_model": self.d_model, "num_layers": self.num_layers,
+                "num_heads": self.num_heads, "mlp_ratio": self.mlp_ratio,
+                "dropout": self.dropout, "backend": self.backend}

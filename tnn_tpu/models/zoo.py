@@ -1,0 +1,63 @@
+"""Model zoo registry.
+
+Parity: ExampleModels registry (include/nn/example_models.hpp:19-46,
+``load_or_create_model`` :49; creators registered in src/nn/example_models.cpp:531-558).
+Same inventory, same names; "flash" variants select the pallas attention backend.
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, Optional, Sequence
+
+from . import gpt2 as gpt2_lib
+from . import resnet, vit
+
+_REGISTRY: Dict[str, Callable] = {}
+
+
+def register(name: str):
+    def wrap(fn):
+        _REGISTRY[name] = fn
+        return fn
+
+    return wrap
+
+
+def create(name: str, **kw):
+    """Instantiate a zoo model by name (parity: ExampleModels::create)."""
+    if name not in _REGISTRY:
+        raise KeyError(f"unknown model {name!r}; known: {sorted(_REGISTRY)}")
+    return _REGISTRY[name](**kw)
+
+
+def names() -> Sequence[str]:
+    return sorted(_REGISTRY)
+
+
+# -- vision (parity: example_models.cpp:21-335) ------------------------------
+
+register("mnist_cnn")(lambda **kw: resnet.mnist_cnn(num_classes=10, **kw))
+register("cifar10_vgg")(lambda **kw: resnet.vgg11(num_classes=10, **kw))
+register("cifar10_resnet9")(lambda **kw: resnet.resnet9(num_classes=10, **kw))
+register("cifar100_resnet18")(lambda **kw: resnet.resnet18(num_classes=100, **kw))
+register("cifar100_wrn16_8")(lambda **kw: resnet.wrn16_8(num_classes=100, **kw))
+register("tiny_imagenet_resnet18")(
+    lambda **kw: resnet.resnet18(num_classes=200, **kw))
+register("tiny_imagenet_wrn16_8")(
+    lambda **kw: resnet.wrn16_8(num_classes=200, **kw))
+register("tiny_imagenet_resnet50")(
+    lambda **kw: resnet.resnet50(num_classes=200, small_input=True, **kw))
+register("resnet50_imagenet")(
+    lambda **kw: resnet.resnet50(num_classes=1000, small_input=False, **kw))
+register("tiny_imagenet_vit")(
+    lambda **kw: vit.ViT(num_classes=200, patch_size=8, **kw))
+register("flash_vit")(
+    lambda **kw: vit.ViT(num_classes=200, patch_size=8, backend="pallas", **kw))
+
+# -- language (parity: example_models.cpp:384-504) ---------------------------
+
+register("gpt2_small")(lambda **kw: gpt2_lib.gpt2_small(**kw))
+register("gpt2_medium")(lambda **kw: gpt2_lib.gpt2_medium(**kw))
+register("gpt2_large")(lambda **kw: gpt2_lib.gpt2_large(**kw))
+register("flash_gpt2_small")(lambda **kw: gpt2_lib.gpt2_small(backend="pallas", **kw))
+register("flash_gpt2_medium")(lambda **kw: gpt2_lib.gpt2_medium(backend="pallas", **kw))
+register("flash_gpt2_large")(lambda **kw: gpt2_lib.gpt2_large(backend="pallas", **kw))

@@ -4,8 +4,23 @@ Importing this package registers every built-in layer type with the module regis
 (parity: ExampleModels::register_defaults + LayerFactory::register_defaults,
 include/nn/layers.hpp:125).
 """
-from . import activations, blocks, embedding, initializers, layers, losses, metrics, norms, optimizers, schedulers
+from . import (
+    activations,
+    attention,
+    blocks,
+    embedding,
+    initializers,
+    layers,
+    losses,
+    metrics,
+    norms,
+    optimizers,
+    schedulers,
+    transformer,
+)
 from .activations import Activation
+from .attention import MultiHeadAttention, sdpa
+from .transformer import EncoderBlock, GPTBlock
 from .blocks import Parallel, Residual, Sequential
 from .embedding import ClassToken, Embedding, PositionalEmbedding
 from .layers import (
@@ -36,8 +51,9 @@ from .schedulers import (
 )
 
 __all__ = [
-    "activations", "blocks", "embedding", "initializers", "layers", "losses", "metrics",
-    "norms", "optimizers", "schedulers",
+    "activations", "attention", "blocks", "embedding", "initializers", "layers", "losses",
+    "metrics", "norms", "optimizers", "schedulers", "transformer",
+    "MultiHeadAttention", "sdpa", "EncoderBlock", "GPTBlock",
     "Activation", "Parallel", "Residual", "Sequential",
     "ClassToken", "Embedding", "PositionalEmbedding",
     "AvgPool2D", "Conv2D", "Dense", "Dropout", "Flatten", "GlobalAvgPool", "Identity",

@@ -1,0 +1,165 @@
+"""Attention: fused scaled-dot-product attention + multi-head attention layer.
+
+Reference parity:
+  * AttentionBlock — include/nn/blocks_impl/attention_block.hpp:21 — q/k/v/out Dense
+    projections + batched QK^T -> causal mask -> softmax -> xV via cuBLAS strided-batch
+    (src/nn/blocks_impl/attention_block.cpp:109-315; CPU path throws).
+  * FlashAttentionBlock — cuDNN-frontend fused SDPA (src/nn/blocks_impl/flash_attention_block.cpp:74-338).
+  * SDPALayer — layers_impl/sdpa_layer.hpp:23.
+
+TPU-first: one SDPA implementation with pluggable backends — "xla" (lax ops XLA fuses
+well, works everywhere) and "pallas" (blockwise online-softmax flash kernel for long
+sequences, tnn_tpu/ops/pallas/flash_attention.py). Both are O(S^2) FLOPs but pallas is
+O(block) memory like the reference's flash path. Unlike the reference, attention runs on
+every backend (the reference throws on CPU).
+
+KV-cache decode support (``apply_cached``) exceeds the reference, which recomputes the
+full sequence per generated token (examples/gpt2_inference.cpp:71-91).
+"""
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..core import dtypes as dt
+from ..core.module import Module, register_module
+from . import initializers
+
+
+def sdpa(q, k, v, *, causal: bool = False, mask: Optional[jax.Array] = None,
+         scale: Optional[float] = None, backend: str = "xla",
+         kv_offset: Optional[jax.Array] = None):
+    """Scaled dot-product attention over (B, H, S, Dh) tensors.
+
+    ``kv_offset``: during cached decode, absolute position of q[0] within the kv
+    sequence — builds the correct causal mask for S_q != S_kv.
+    """
+    if backend == "pallas":
+        if mask is not None or kv_offset is not None:
+            raise NotImplementedError(
+                "backend='pallas' does not support mask/kv_offset yet; use backend='xla'")
+        from ..ops.pallas.flash_attention import flash_attention
+
+        return flash_attention(q, k, v, causal=causal, scale=scale)
+    sq, skv = q.shape[-2], k.shape[-2]
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    # QK^T with f32 accumulation on the MXU.
+    logits = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                        preferred_element_type=jnp.float32) * scale
+    if causal:
+        qpos = jnp.arange(sq)[:, None]
+        if kv_offset is not None:
+            qpos = qpos + kv_offset
+        kpos = jnp.arange(skv)[None, :]
+        causal_mask = qpos >= kpos
+        logits = jnp.where(causal_mask, logits, dt.neg_inf(logits.dtype))
+    if mask is not None:
+        logits = jnp.where(mask, logits, dt.neg_inf(logits.dtype))
+    probs = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("bhqk,bhkd->bhqd", probs.astype(v.dtype), v,
+                     preferred_element_type=jnp.float32)
+    return out.astype(v.dtype)
+
+
+@register_module("multihead_attention")
+class MultiHeadAttention(Module):
+    """Multi-head self-attention over (N, S, D).
+
+    Parity: AttentionBlock (4 Dense projections q/k/v/out + batched SDPA,
+    blocks_impl/attention_block.cpp:109-315). Fused qkv projection (one matmul instead of
+    three — better MXU utilisation).
+    """
+
+    def __init__(self, num_heads: int, causal: bool = False, dropout: float = 0.0,
+                 backend: str = "xla", kernel_init: str = "xavier_uniform",
+                 name=None, policy=None):
+        super().__init__(name=name, policy=policy)
+        self.num_heads = int(num_heads)
+        self.causal = bool(causal)
+        self.dropout = float(dropout)
+        self.backend = backend
+        self.kernel_init = kernel_init
+        from .layers import Dropout  # local import: layers has no dep on attention
+
+        self._drop = Dropout(self.dropout, policy=self.policy)
+
+    def _init(self, rng, input_shape):
+        d = input_shape[-1]
+        if d % self.num_heads:
+            raise ValueError(f"model dim {d} not divisible by num_heads {self.num_heads}")
+        init = initializers.get(self.kernel_init)
+        k1, k2 = jax.random.split(rng)
+        pd = self.policy.param_dtype
+        params = {
+            "qkv_kernel": init(k1, (d, 3 * d), pd),
+            "qkv_bias": jnp.zeros((3 * d,), pd),
+            "out_kernel": init(k2, (d, d), pd),
+            "out_bias": jnp.zeros((d,), pd),
+        }
+        return params, {}
+
+    def _split_heads(self, x):
+        n, s, d = x.shape
+        h = self.num_heads
+        return x.reshape(n, s, h, d // h).transpose(0, 2, 1, 3)
+
+    def _merge_heads(self, x):
+        n, h, s, dh = x.shape
+        return x.transpose(0, 2, 1, 3).reshape(n, s, h * dh)
+
+    def _project_qkv(self, params, x):
+        x = self.policy.cast_in(x)
+        w = self.policy.cast_param(params["qkv_kernel"])
+        qkv = (x @ w + params["qkv_bias"].astype(x.dtype))
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        return self._split_heads(q), self._split_heads(k), self._split_heads(v)
+
+    def _project_out(self, params, attn, train, rng):
+        y = self._merge_heads(attn)
+        w = self.policy.cast_param(params["out_kernel"])
+        y = y @ w + params["out_bias"].astype(y.dtype)
+        y, _ = self._drop.apply({}, y, train=train, rng=rng)
+        return self.policy.cast_out(y)
+
+    def _apply(self, params, state, x, *, train, rng):
+        q, k, v = self._project_qkv(params, x)
+        attn = sdpa(q, k, v, causal=self.causal, backend=self.backend)
+        return self._project_out(params, attn, train, rng), state
+
+    # -- cached autoregressive decode (exceeds reference) ----------------------
+
+    def init_cache(self, batch: int, max_len: int, d_model: int):
+        """Allocate a (k, v) ring cache for decode."""
+        h = self.num_heads
+        dh = d_model // h
+        dtype = self.policy.compute_dtype
+        return {
+            "k": jnp.zeros((batch, h, max_len, dh), dtype),
+            "v": jnp.zeros((batch, h, max_len, dh), dtype),
+        }
+
+    def apply_cached(self, variables, x, cache, offset):
+        """Decode step: x is (N, S_new, D); cache holds keys/values for [0, offset).
+
+        Returns (out, new_cache). The full cache buffer participates in attention with a
+        position mask, keeping shapes static for jit.
+        """
+        params = variables["params"]
+        q, k_new, v_new = self._project_qkv(params, x)
+        k = jax.lax.dynamic_update_slice_in_dim(cache["k"], k_new, offset, axis=2)
+        v = jax.lax.dynamic_update_slice_in_dim(cache["v"], v_new, offset, axis=2)
+        out = sdpa(q, k, v, causal=True, kv_offset=offset)
+        y = self._project_out(params, out, False, None)
+        return y, {"k": k, "v": v}
+
+    def output_shape(self, input_shape):
+        return tuple(input_shape)
+
+    def _config(self):
+        return {"num_heads": self.num_heads, "causal": self.causal,
+                "dropout": self.dropout, "backend": self.backend,
+                "kernel_init": initializers.name_of(self.kernel_init)}

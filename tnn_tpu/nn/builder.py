@@ -1,0 +1,151 @@
+"""LayerBuilder — the chained, shape-inferring model-building DSL.
+
+Parity: reference ``LayerBuilder`` (include/nn/layer_builder.hpp:11-624) with the same
+method inventory: dense, conv2d, batchnorm/layernorm/groupnorm, max/avg pool, activation,
+dropout, flatten, class_token, positional_embedding, slice, attention, flash_attention,
+embedding, transpose, residual/basic/wide/bottleneck blocks, gpt_block (:531-570),
+flash_gpt_block (:575 — whose flash line the reference left commented out; here it works).
+
+The builder tracks the running output shape so blocks that need the incoming channel
+count (residual projections) get it automatically.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+from ..core import dtypes as dt
+from . import layers as L
+from .activations import Activation
+from .attention import MultiHeadAttention
+from .blocks import Residual, Sequential
+from .embedding import ClassToken, Embedding, PositionalEmbedding
+from .norms import BatchNorm, GroupNorm, LayerNorm, RMSNorm
+from .conv_blocks import basic_block, bottleneck_block, wide_basic_block
+from .transformer import EncoderBlock, GPTBlock
+
+
+class LayerBuilder:
+    """Chained builder; ``input_shape`` excludes batch (like the reference DSL)."""
+
+    def __init__(self, input_shape: Sequence[int], policy: Optional[dt.DTypePolicy] = None):
+        self.policy = policy or dt.default_policy()
+        self._shape: Tuple[int, ...] = (1,) + tuple(int(d) for d in input_shape)
+        self._layers = []
+
+    # -- bookkeeping ---------------------------------------------------------
+
+    def _add(self, layer):
+        self._layers.append(layer)
+        self._shape = layer.output_shape(self._shape)
+        return self
+
+    @property
+    def shape(self) -> Tuple[int, ...]:
+        """Current output shape (excluding batch)."""
+        return self._shape[1:]
+
+    def build(self, name: Optional[str] = None) -> Sequential:
+        return Sequential(self._layers, name=name, policy=self.policy)
+
+    # -- layers (same inventory as layer_builder.hpp) ------------------------
+
+    def dense(self, units, activation=None, use_bias=True):
+        return self._add(L.Dense(units, use_bias=use_bias, activation=activation,
+                                 policy=self.policy))
+
+    def conv2d(self, filters, kernel_size=3, strides=1, padding="same", use_bias=True,
+               activation=None, groups=1):
+        return self._add(L.Conv2D(filters, kernel_size, strides=strides, padding=padding,
+                                  use_bias=use_bias, activation=activation, groups=groups,
+                                  policy=self.policy))
+
+    def batchnorm(self, momentum=0.9, eps=1e-5):
+        return self._add(BatchNorm(momentum=momentum, eps=eps, policy=self.policy))
+
+    def layernorm(self, eps=1e-5):
+        return self._add(LayerNorm(eps=eps, policy=self.policy))
+
+    def groupnorm(self, groups=32, eps=1e-5):
+        return self._add(GroupNorm(groups=groups, eps=eps, policy=self.policy))
+
+    def rmsnorm(self, eps=1e-6):
+        return self._add(RMSNorm(eps=eps, policy=self.policy))
+
+    def maxpool(self, pool_size=2, strides=None, padding="valid"):
+        return self._add(L.MaxPool2D(pool_size, strides, padding, policy=self.policy))
+
+    def avgpool(self, pool_size=2, strides=None, padding="valid"):
+        return self._add(L.AvgPool2D(pool_size, strides, padding, policy=self.policy))
+
+    def global_avgpool(self):
+        return self._add(L.GlobalAvgPool(policy=self.policy))
+
+    def activation(self, fn="relu"):
+        return self._add(Activation(fn, policy=self.policy))
+
+    def dropout(self, rate=0.5):
+        return self._add(L.Dropout(rate, policy=self.policy))
+
+    def flatten(self):
+        return self._add(L.Flatten(policy=self.policy))
+
+    def reshape(self, shape):
+        return self._add(L.Reshape(shape, policy=self.policy))
+
+    def transpose(self, perm):
+        return self._add(L.Transpose(perm, policy=self.policy))
+
+    def slice(self, axis, start, length):
+        return self._add(L.Slice(axis, start, length, policy=self.policy))
+
+    def embedding(self, vocab_size, dim):
+        return self._add(Embedding(vocab_size, dim, policy=self.policy))
+
+    def positional_embedding(self, max_len=None):
+        max_len = max_len or self._shape[-2]
+        return self._add(PositionalEmbedding(max_len, policy=self.policy))
+
+    def class_token(self):
+        return self._add(ClassToken(policy=self.policy))
+
+    def attention(self, num_heads, causal=False, dropout=0.0):
+        """Parity: attention DSL entry -> full SDPA (XLA backend)."""
+        return self._add(MultiHeadAttention(num_heads, causal=causal, dropout=dropout,
+                                            policy=self.policy))
+
+    def flash_attention(self, num_heads, causal=False, dropout=0.0):
+        """Parity: flash_attention DSL entry -> pallas blockwise kernel."""
+        return self._add(MultiHeadAttention(num_heads, causal=causal, dropout=dropout,
+                                            backend="pallas", policy=self.policy))
+
+    def residual(self, main: Sequence, shortcut: Optional[Sequence] = None,
+                 activation=None):
+        children = [Sequential(list(main), policy=self.policy)]
+        if shortcut:
+            children.append(Sequential(list(shortcut), policy=self.policy))
+        return self._add(Residual(children, activation=activation, policy=self.policy))
+
+    def basic_residual_block(self, filters, strides=1):
+        return self._add(basic_block(filters, strides, in_filters=self._shape[-1],
+                                     policy=self.policy))
+
+    def wide_residual_block(self, filters, strides=1, dropout=0.0):
+        return self._add(wide_basic_block(filters, strides, in_filters=self._shape[-1],
+                                          dropout=dropout, policy=self.policy))
+
+    def bottleneck_residual_block(self, filters, strides=1):
+        return self._add(bottleneck_block(filters, strides, in_filters=self._shape[-1],
+                                          policy=self.policy))
+
+    def gpt_block(self, num_heads, mlp_ratio=4, dropout=0.0):
+        return self._add(GPTBlock(num_heads, mlp_ratio=mlp_ratio, dropout=dropout,
+                                  policy=self.policy))
+
+    def flash_gpt_block(self, num_heads, mlp_ratio=4, dropout=0.0):
+        """Parity: flash_gpt_block (layer_builder.hpp:575) — functional here."""
+        return self._add(GPTBlock(num_heads, mlp_ratio=mlp_ratio, dropout=dropout,
+                                  backend="pallas", policy=self.policy))
+
+    def encoder_block(self, num_heads, mlp_ratio=4, dropout=0.0):
+        return self._add(EncoderBlock(num_heads, mlp_ratio=mlp_ratio, dropout=dropout,
+                                      policy=self.policy))

@@ -55,15 +55,17 @@ class PositionalEmbedding(Module):
     Parity: PositionalEmbeddingLayer (learned) in the reference.
     """
 
-    def __init__(self, max_len: int, kernel_init: str = "normal", name=None, policy=None):
+    def __init__(self, max_len=None, kernel_init: str = "normal", name=None, policy=None):
         super().__init__(name=name, policy=policy)
-        self.max_len = int(max_len)
+        #: None = size the table from the input sequence length at init time.
+        self.max_len = None if max_len is None else int(max_len)
         self.kernel_init = kernel_init
 
     def _init(self, rng, input_shape):
         d = input_shape[-1]
+        max_len = self.max_len if self.max_len is not None else input_shape[-2]
         init = initializers.get(self.kernel_init)
-        return {"pos": init(rng, (self.max_len, d), self.policy.param_dtype)}, {}
+        return {"pos": init(rng, (max_len, d), self.policy.param_dtype)}, {}
 
     def _apply(self, params, state, x, *, train, rng, offset: int = 0):
         s = x.shape[-2]

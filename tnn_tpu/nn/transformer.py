@@ -1,0 +1,120 @@
+"""Transformer blocks: GPT block (pre-LN) and encoder (ViT) block.
+
+Parity: reference ``gpt_block`` builder (include/nn/layer_builder.hpp:531-570):
+ResidualBlock(LayerNorm -> AttentionBlock -> Dropout) then
+ResidualBlock(LayerNorm -> Dense(4E) GELU -> Dense(E) -> Dropout); ``flash_gpt_block``
+(:575) maps to backend="pallas". ViT encoder block shares the structure.
+
+Implemented as a dedicated Module (not the generic containers) so the KV-cache decode
+path (``apply_cached``) can thread per-layer caches — the functional analog of the
+reference's per-microbatch activation caches (include/nn/layer.hpp:113-114).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..core import rng as rnglib
+from ..core.module import Module, register_module
+from . import initializers
+from .attention import MultiHeadAttention
+from .layers import Dense, Dropout
+from .norms import LayerNorm
+
+
+@register_module("gpt_block")
+class GPTBlock(Module):
+    """Pre-LN transformer decoder block (parity: gpt_block, layer_builder.hpp:531)."""
+
+    def __init__(self, num_heads: int, mlp_ratio: int = 4, dropout: float = 0.0,
+                 causal: bool = True, backend: str = "xla", activation: str = "gelu",
+                 name=None, policy=None):
+        super().__init__(name=name, policy=policy)
+        self.num_heads = int(num_heads)
+        self.mlp_ratio = int(mlp_ratio)
+        self.dropout = float(dropout)
+        self.causal = bool(causal)
+        self.backend = backend
+        self.activation = activation
+        p = self.policy
+        self.ln1 = LayerNorm(policy=p)
+        self.attn = MultiHeadAttention(num_heads, causal=causal, dropout=dropout,
+                                       backend=backend, policy=p)
+        self.ln2 = LayerNorm(policy=p)
+        self.drop = Dropout(dropout, policy=p)
+
+    def _mlp_layers(self, d):
+        p = self.policy
+        return (Dense(self.mlp_ratio * d, activation=self.activation, policy=p),
+                Dense(d, policy=p))
+
+    def _init(self, rng, input_shape):
+        d = input_shape[-1]
+        k1, k2, k3, k4, k5 = jax.random.split(rng, 5)
+        fc, proj = self._mlp_layers(d)
+        mlp_shape = tuple(input_shape[:-1]) + (self.mlp_ratio * d,)
+        params = {
+            "ln1": self.ln1.init(k1, input_shape)["params"],
+            "attn": self.attn.init(k2, input_shape)["params"],
+            "ln2": self.ln2.init(k3, input_shape)["params"],
+            "fc": fc.init(k4, input_shape)["params"],
+            "proj": proj.init(k5, mlp_shape)["params"],
+        }
+        return params, {}
+
+    def _mlp(self, params, h, train, rng):
+        d = h.shape[-1]
+        fc, proj = self._mlp_layers(d)
+        h, _ = fc.apply({"params": params["fc"], "state": {}}, h, train=train)
+        h, _ = proj.apply({"params": params["proj"], "state": {}}, h, train=train)
+        return h
+
+    def _apply(self, params, state, x, *, train, rng):
+        k1, k2, k3 = rnglib.split_for(rng, 3)
+        h, _ = self.ln1.apply({"params": params["ln1"], "state": {}}, x)
+        h, _ = self.attn.apply({"params": params["attn"], "state": {}}, h,
+                               train=train, rng=k1)
+        h, _ = self.drop.apply({}, h, train=train, rng=k2)
+        x = x + h
+        h, _ = self.ln2.apply({"params": params["ln2"], "state": {}}, x)
+        h = self._mlp(params, h, train, rng)
+        h, _ = self.drop.apply({}, h, train=train, rng=k3)
+        return x + h, state
+
+    # -- cached decode --------------------------------------------------------
+
+    def init_cache(self, batch: int, max_len: int, d_model: int):
+        return self.attn.init_cache(batch, max_len, d_model)
+
+    def apply_cached(self, params, x, cache, offset):
+        h, _ = self.ln1.apply({"params": params["ln1"], "state": {}}, x)
+        h, new_cache = self.attn.apply_cached({"params": params["attn"]}, h, cache, offset)
+        x = x + h
+        h, _ = self.ln2.apply({"params": params["ln2"], "state": {}}, x)
+        h = self._mlp(params, h, False, None)
+        return x + h, new_cache
+
+    def output_shape(self, input_shape):
+        return tuple(input_shape)
+
+    def _config(self):
+        return {"num_heads": self.num_heads, "mlp_ratio": self.mlp_ratio,
+                "dropout": self.dropout, "causal": self.causal,
+                "backend": self.backend, "activation": self.activation}
+
+
+@register_module("encoder_block")
+class EncoderBlock(GPTBlock):
+    """Non-causal pre-LN encoder block (ViT). Same structure, causal=False default."""
+
+    def __init__(self, num_heads: int, mlp_ratio: int = 4, dropout: float = 0.0,
+                 backend: str = "xla", activation: str = "gelu", name=None, policy=None):
+        super().__init__(num_heads, mlp_ratio=mlp_ratio, dropout=dropout, causal=False,
+                         backend=backend, activation=activation, name=name, policy=policy)
+
+    def _config(self):
+        cfg = super()._config()
+        cfg.pop("causal")
+        return cfg

@@ -1,0 +1,3 @@
+from . import pallas  # noqa: F401
+
+__all__ = ["pallas"]
