@@ -1,0 +1,25 @@
+"""Parallelism: mesh, data/tensor/pipeline/sequence parallel, partitioner.
+
+Inventory vs the reference (SURVEY.md §2.4): TNN has microbatch pipeline parallelism,
+coordinator-mediated data parallelism (without gradient all-reduce — a bug-class we fix
+by construction), and intra-op threading. This package adds correct DP, FSDP, tensor
+parallelism, and ring-attention sequence parallelism on top — all as sharding
+annotations + XLA collectives over ICI, replacing ~4.4k LoC of TCP/RoCE runtime.
+"""
+from . import data_parallel, mesh, partitioner, pipeline, ring_attention, tensor_parallel
+from .data_parallel import make_dp_train_step, shard_params_fsdp
+from .mesh import batch_sharding, data_mesh, make_mesh, replicated
+from .partitioner import SeqPartition, balanced_partitions, partition_model, split
+from .pipeline import StagePipeline, spmd_pipeline, stack_stage_params
+from .ring_attention import ring_attention
+from .tensor_parallel import DEFAULT_TP_RULES, shard_params_tp, spec_tree
+
+__all__ = [
+    "data_parallel", "mesh", "partitioner", "pipeline", "ring_attention", "tensor_parallel",
+    "make_dp_train_step", "shard_params_fsdp",
+    "batch_sharding", "data_mesh", "make_mesh", "replicated",
+    "SeqPartition", "balanced_partitions", "partition_model", "split",
+    "StagePipeline", "spmd_pipeline", "stack_stage_params",
+    "ring_attention",
+    "DEFAULT_TP_RULES", "shard_params_tp", "spec_tree",
+]

@@ -1,0 +1,131 @@
+"""Model partitioner: split a Sequential into pipeline stages.
+
+Parity: reference Partitioner (include/partitioner/partitioner.hpp:50-65,
+``SeqPartition{start,length}`` :8, ``split()`` re-instantiating layers per stage via
+config round-trip :26-48) and NaivePipelinePartitioner (naive_partitioner.hpp:19-56).
+The reference's FLOPs-weighted partitioners were left unfinished
+(``FTDPartitioner::partition_model`` undefined, WeightedPipelinePartitioner commented
+out — SURVEY.md §1 caveats); the cost-balanced partitioner here finishes that idea.
+
+Stages are rebuilt from layer configs — the same mechanism the reference uses to ship
+stages to workers (CONFIG_TRANSFER), reused here for mesh placement.
+"""
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import List, Optional, Sequence, Tuple
+
+from ..core.module import Module, module_from_config
+from ..nn.blocks import Sequential
+
+
+@dataclasses.dataclass(frozen=True)
+class SeqPartition:
+    """Parity: SeqPartition{start_index, length} (partitioner.hpp:8)."""
+
+    start: int
+    length: int
+
+
+def split(model: Sequential, partitions: Sequence[SeqPartition]) -> List[Sequential]:
+    """Clone layer ranges into fresh stage modules via config round-trip
+    (parity: Partitioner::split, partitioner.hpp:26-48)."""
+    stages = []
+    for i, part in enumerate(partitions):
+        children = model.children[part.start:part.start + part.length]
+        cloned = [module_from_config(c.get_config()) for c in children]
+        stages.append(Sequential(cloned, name=f"stage{i}", policy=model.policy))
+    return stages
+
+
+def proportional_partitions(num_layers: int, proportions: Sequence[float]) -> List[SeqPartition]:
+    """Parity: NaivePipelinePartitioner proportion-based split (naive_partitioner.hpp:19-56)."""
+    if num_layers < len(proportions):
+        raise ValueError(f"cannot split {num_layers} layers into {len(proportions)} stages")
+    total = sum(proportions)
+    counts = [max(1, round(num_layers * p / total)) for p in proportions]
+    # fix rounding drift
+    while sum(counts) > num_layers:
+        counts[counts.index(max(counts))] -= 1
+    while sum(counts) < num_layers:
+        counts[counts.index(min(counts))] += 1
+    parts, start = [], 0
+    for c in counts:
+        parts.append(SeqPartition(start, c))
+        start += c
+    return parts
+
+
+def layer_flops(layer: Module, input_shape: Tuple[int, ...]) -> float:
+    """Rough forward FLOPs estimate per layer (drives cost-balanced splitting —
+    the finished version of the reference's FTD/Weighted partitioner idea)."""
+    out_shape = layer.output_shape(tuple(input_shape))
+    t = layer.type_name
+    if t == "dense":
+        return 2.0 * math.prod(input_shape) * out_shape[-1]
+    if t == "conv2d":
+        kh, kw = layer.kernel_size
+        cin = input_shape[-1] // layer.groups
+        return 2.0 * math.prod(out_shape) * kh * kw * cin
+    if t in ("multihead_attention", "gpt_block", "encoder_block"):
+        n, s, d = input_shape[0], input_shape[-2], input_shape[-1]
+        proj = 8.0 * n * s * d * d  # qkv+out
+        attn = 4.0 * n * s * s * d
+        mlp = 0.0
+        if t in ("gpt_block", "encoder_block"):
+            mlp = 4.0 * n * s * d * d * layer.mlp_ratio
+        return proj + attn + mlp
+    if t in ("sequential", "residual", "parallel"):
+        total, shape = 0.0, tuple(input_shape)
+        children = layer.children
+        for child in children:
+            total += layer_flops(child, shape)
+            if t == "sequential":
+                shape = child.output_shape(shape)
+        return total
+    # elementwise-ish layers: one pass over the data
+    return float(math.prod(out_shape))
+
+
+def balanced_partitions(model: Sequential, num_stages: int,
+                        input_shape: Tuple[int, ...],
+                        weights: Optional[Sequence[float]] = None) -> List[SeqPartition]:
+    """FLOPs-balanced contiguous split into ``num_stages`` (exceeds the reference's
+    unfinished FTDPartitioner). ``weights`` optionally scales per-stage capacity."""
+    costs = []
+    shape = tuple(input_shape)
+    for child in model.children:
+        costs.append(layer_flops(child, shape))
+        shape = child.output_shape(shape)
+    n = len(costs)
+    if num_stages > n:
+        raise ValueError(f"cannot split {n} layers into {num_stages} stages")
+    weights = list(weights) if weights else [1.0] * num_stages
+    total = sum(costs)
+    wsum = sum(weights)
+    # greedy: cut when the running stage cost passes its proportional share
+    parts: List[SeqPartition] = []
+    start, acc, stage = 0, 0.0, 0
+    for i, c in enumerate(costs):
+        acc += c
+        remaining_layers = n - i - 1
+        remaining_stages = num_stages - stage - 1
+        share = total * weights[stage] / wsum
+        if stage < num_stages - 1 and (acc >= share or remaining_layers == remaining_stages):
+            parts.append(SeqPartition(start, i - start + 1))
+            start, acc, stage = i + 1, 0.0, stage + 1
+    parts.append(SeqPartition(start, n - start))
+    return parts
+
+
+def partition_model(model: Sequential, num_stages: int, input_shape: Tuple[int, ...],
+                    strategy: str = "balanced") -> List[Sequential]:
+    """One-call API (parity: Partitioner::partition_model, partitioner.hpp:50-65)."""
+    if strategy == "balanced":
+        parts = balanced_partitions(model, num_stages, input_shape)
+    elif strategy == "uniform":
+        parts = proportional_partitions(len(model.children), [1.0] * num_stages)
+    else:
+        raise ValueError(f"unknown strategy {strategy!r}")
+    return split(model, parts)

@@ -1,0 +1,225 @@
+"""Pipeline parallelism.
+
+Parity-and-beyond with the reference's microbatch pipeline runtime
+(docs/pipeline_architecture.md; Coordinator chain wiring coordinator.hpp:418-433; Worker
+FORWARD_JOB/BACKWARD_JOB loop worker.hpp:145-193; Job{tensor, mb_id} job.hpp:93-129).
+
+Two TPU-native implementations:
+
+1. ``spmd_pipeline`` — the performance path. Stages are a stacked pytree of
+   identical-structure block params sharded over the "pipe" mesh axis; the GPipe
+   fill/drain schedule is a lax.scan over ticks inside shard_map, activations hop
+   stages via collective-permute over ICI. jax.grad straight through it yields the
+   backward pipeline automatically (ppermute transposes to the reverse hop) — no
+   hand-written BACKWARD_JOB protocol. One compiled XLA program, zero host round trips
+   per microbatch (the reference serializes every hop through TCP/RDMA).
+
+2. ``StagePipeline`` — the generality path, mirroring the reference's
+   coordinator/worker shape for heterogeneous stages: each stage is a separate jitted
+   program placed on its own device; microbatches flow via device-to-device transfers;
+   JAX's async dispatch overlaps stages like the reference's semi-async schedule.
+   Activation residuals are held by jax.vjp closures — the analog of the reference's
+   per-mb layer caches (include/nn/layer.hpp:113-114).
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from . import mesh as mesh_lib
+
+
+# ---------------------------------------------------------------------------
+# 1. Compiled SPMD pipeline (shard_map + ppermute + scan)
+# ---------------------------------------------------------------------------
+
+
+def spmd_pipeline(block_fn: Callable, stacked_params, x_microbatches, mesh: Mesh,
+                  axis: str = "pipe"):
+    """Run microbatches through a chain of identical-structure stages.
+
+    Args:
+      block_fn: (stage_params, activation) -> activation. stage_params is one slice of
+        ``stacked_params`` along its leading axis (a stage may hold several layers —
+        stack them inside and scan in block_fn).
+      stacked_params: pytree; every leaf has leading dim == mesh pipe size.
+      x_microbatches: (num_mb, mb_size, ...) inputs to stage 0.
+      mesh: mesh containing ``axis``.
+
+    Returns: (num_mb, mb_size, ...) outputs of the last stage.
+    Differentiable end-to-end.
+    """
+    pp = mesh_lib.axis_size(mesh, axis)
+    num_mb = x_microbatches.shape[0]
+    if num_mb < 1:
+        raise ValueError("need at least one microbatch")
+    # activation dtype/shape between stages = block output (stages are homogeneous)
+    stage0 = jax.tree_util.tree_map(lambda a: a[0], stacked_params)
+    act = jax.eval_shape(block_fn, stage0, jax.ShapeDtypeStruct(
+        x_microbatches.shape[1:], x_microbatches.dtype))
+    if act.shape != x_microbatches.shape[1:]:
+        raise ValueError(f"pipeline stages must preserve activation shape, got "
+                         f"{x_microbatches.shape[1:]} -> {act.shape}")
+
+    def per_device(params, xs):
+        # shard_map keeps the sharded leading dim at local size 1 — drop it
+        params = jax.tree_util.tree_map(lambda a: a[0], params)
+        # xs: full microbatch queue (replicated)
+        stage = jax.lax.axis_index(axis)
+        mb_shape = xs.shape[1:]
+        zero = jnp.zeros(mb_shape, act.dtype)
+        outputs0 = jnp.zeros((num_mb,) + mb_shape, act.dtype)
+
+        def tick(carry, t):
+            recv, outputs = carry
+            inject = xs[jnp.minimum(t, num_mb - 1)].astype(act.dtype)
+            inp = jnp.where(stage == 0, inject, recv)
+            out = block_fn(params, inp).astype(act.dtype)
+            # last stage: record mb (t - (pp-1)) when valid
+            out_idx = t - (pp - 1)
+            valid = jnp.logical_and(stage == pp - 1,
+                                    jnp.logical_and(out_idx >= 0, out_idx < num_mb))
+            idx = jnp.clip(out_idx, 0, num_mb - 1)
+            cur = jax.lax.dynamic_index_in_dim(outputs, idx, 0, keepdims=False)
+            upd = jnp.where(valid, out, cur)
+            outputs = jax.lax.dynamic_update_index_in_dim(outputs, upd, idx, 0)
+            # hop to the next stage over ICI
+            perm = [(i, (i + 1) % pp) for i in range(pp)]
+            recv = jax.lax.ppermute(out, axis, perm)
+            return (recv, outputs), None
+
+        (recv, outputs), _ = jax.lax.scan(
+            tick, (zero, outputs0), jnp.arange(num_mb + pp - 1))
+        return outputs[None]  # re-add pipe dim for out_specs
+
+    in_specs = (jax.tree_util.tree_map(lambda _: P(axis), stacked_params), P())
+    out_specs = P(axis)
+    fn = jax.shard_map(per_device, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                       check_vma=False)
+    stacked_out = fn(stacked_params, x_microbatches)  # (pp, num_mb, ...)
+    return stacked_out[-1]
+
+
+def stack_stage_params(per_stage_params: Sequence) -> Any:
+    """Stack a list of identical-structure stage params into one pytree with a leading
+    stage axis (the SPMD pipeline's input layout)."""
+    return jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *per_stage_params)
+
+
+# ---------------------------------------------------------------------------
+# 2. Host-orchestrated heterogeneous-stage pipeline
+# ---------------------------------------------------------------------------
+
+
+class StagePipeline:
+    """Generic pipeline over heterogeneous stage modules, one device each.
+
+    The TPU-native analog of the reference's coordinator+workers (SURVEY.md §3.2):
+    CONFIG_TRANSFER -> constructor; FORWARD_JOB/BACKWARD_JOB -> jitted per-stage
+    programs + async dispatch; TCP/RoCE hops -> jax.device_put over ICI.
+    """
+
+    def __init__(self, stages: Sequence, optimizer, loss_fn, devices=None,
+                 train: bool = False):
+        self.stages = list(stages)
+        self.optimizer = optimizer
+        self.loss_fn = loss_fn
+        devices = list(devices) if devices is not None else jax.devices()
+        if len(devices) < len(self.stages):
+            raise ValueError(f"{len(self.stages)} stages need as many devices, "
+                             f"have {len(devices)}")
+        self.devices = devices[:len(self.stages)]
+        self.variables: List[Any] = []
+        self.opt_states: List[Any] = []
+        self._fwd = []
+        for i, stage in enumerate(self.stages):
+            # pure apply for vjp; BatchNorm runs in inference mode inside the pipeline.
+            # net state is a real argument (closing over it would bake it into the
+            # compiled program and ignore later updates).
+            def apply_fn(params, net_state, x, stage=stage):
+                out, _ = stage.apply({"params": params, "state": net_state},
+                                     x, train=False)
+                return out
+
+            # params are committed to the stage's device, so the jitted program runs there
+            self._fwd.append(jax.jit(apply_fn))
+
+    def init(self, rng, input_shape, input_dtype=None):
+        """Initialize every stage, placing its params on its device
+        (parity: deploy_stages, coordinator.hpp:368)."""
+        shape = tuple(input_shape)
+        dtype = input_dtype
+        self.variables, self.opt_states = [], []
+        keys = jax.random.split(rng, len(self.stages))
+        for i, stage in enumerate(self.stages):
+            if dtype is not None:
+                v = stage.init(keys[i], shape, input_dtype=dtype)
+            else:
+                v = stage.init(keys[i], shape)
+            v = jax.device_put(v, self.devices[i])
+            self.variables.append(v)
+            self.opt_states.append(
+                jax.device_put(self.optimizer.init(v["params"]), self.devices[i]))
+            dummy = jax.ShapeDtypeStruct(tuple(shape), dtype or jnp.float32)
+            out = jax.eval_shape(self._fwd[i], v["params"], v["state"], dummy)
+            shape, dtype = out.shape, out.dtype
+        return self
+
+    def forward(self, x):
+        """Inference pass: microbatch-free, stage hop = device transfer."""
+        for i in range(len(self.stages)):
+            x = jax.device_put(x, self.devices[i])
+            x = self._fwd[i](self.variables[i]["params"], self.variables[i]["state"], x)
+        return x
+
+    def train_batch(self, data, labels, num_microbatches: int = 4):
+        """One training step: GPipe fill/drain with gradient accumulation
+        (parity: async_train_batch, coordinator.hpp:165-223 + distributed/train.hpp:19-79).
+
+        Async dispatch overlaps stage work across microbatches without explicit
+        scheduling — the queueing the reference does by hand.
+        """
+        n = len(self.stages)
+        mbs = jnp.split(data, num_microbatches)
+        lbs = jnp.split(labels, num_microbatches)
+        grads = [None] * n
+
+        # fill: forward all microbatches, keeping vjp closures (activation residuals)
+        vjps = []  # [mb][stage]
+        outs = []
+        for mb in mbs:
+            stage_vjps = []
+            x = mb
+            for i in range(n):
+                x = jax.device_put(x, self.devices[i])
+                fwd, st = self._fwd[i], self.variables[i]["state"]
+                x, vjp = jax.vjp(lambda p, xx, fwd=fwd, st=st: fwd(p, st, xx),
+                                 self.variables[i]["params"], x)
+                stage_vjps.append(vjp)
+            vjps.append(stage_vjps)
+            outs.append(x)
+
+        # drain: loss grad per microbatch, backward through stages in reverse
+        scale = 1.0 / num_microbatches
+        losses = []
+        for out, lb, stage_vjps in zip(outs, lbs, vjps):
+            lb = jax.device_put(lb, self.devices[-1])
+            loss, loss_vjp = jax.vjp(lambda o: self.loss_fn(o, lb), out)
+            losses.append(loss)  # keep on device — a float() here would stall the pipeline
+            (g,) = loss_vjp(jnp.asarray(scale, jnp.float32))
+            for i in reversed(range(n)):
+                g = jax.device_put(g, self.devices[i])
+                gp, g = stage_vjps[i](g)
+                grads[i] = gp if grads[i] is None else jax.tree_util.tree_map(
+                    jnp.add, grads[i], gp)
+
+        # optimizer step per stage (parity: UPDATE_PARAMETERS, worker.hpp:194-207)
+        for i in range(n):
+            new_params, self.opt_states[i] = self.optimizer.update(
+                grads[i], self.opt_states[i], self.variables[i]["params"])
+            self.variables[i] = {"params": new_params, "state": self.variables[i]["state"]}
+        return float(sum(float(l) for l in losses) * scale)

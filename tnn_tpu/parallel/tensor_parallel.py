@@ -1,0 +1,69 @@
+"""Tensor (model) parallelism via GSPMD sharding rules.
+
+Beyond the reference: TNN has no tensor parallelism (SURVEY.md preamble). On TPU,
+Megatron-style TP is expressed as sharding annotations over the "model" mesh axis —
+column-parallel for qkv/fc-in kernels, row-parallel for out/fc-proj kernels — and GSPMD
+inserts the all-reduces over ICI. No custom kernels or communication code.
+
+Rules are (regex on the param path) -> PartitionSpec, applied to any model's param
+pytree — the same mechanism t5x/maxtext use, fitted to this framework's param naming.
+"""
+from __future__ import annotations
+
+import re
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..core.module import _path_str, tree_paths
+
+# Default rules for this framework's layer naming (ordered; first match wins).
+# Transformer blocks: qkv/fc column-parallel (shard output dim), out/proj row-parallel
+# (shard input dim). Embedding table sharded over vocab (output head all-reduces).
+DEFAULT_TP_RULES: List[Tuple[str, P]] = [
+    (r".*attn/qkv_kernel$", P(None, "model")),
+    (r".*attn/qkv_bias$", P("model")),
+    (r".*attn/out_kernel$", P("model", None)),
+    (r".*attn/out_bias$", P()),
+    (r".*fc/kernel$", P(None, "model")),
+    (r".*fc/bias$", P("model")),
+    (r".*proj/kernel$", P("model", None)),
+    (r".*proj/bias$", P()),
+    (r".*wte/table$", P("model", None)),
+    (r".*embedding/table$", P("model", None)),
+]
+
+
+def spec_tree(params, rules: Optional[Sequence[Tuple[str, P]]] = None):
+    """Map a param pytree to a pytree of PartitionSpecs via path-regex rules."""
+    rules = list(rules) if rules is not None else DEFAULT_TP_RULES
+    compiled = [(re.compile(pat), spec) for pat, spec in rules]
+    paths = tree_paths(params)
+    specs: Dict[str, P] = {}
+    for path in paths:
+        for pat, spec in compiled:
+            if pat.match(path):
+                specs[path] = spec
+                break
+        else:
+            specs[path] = P()
+    # rebuild as pytree in params' structure (same key derivation as tree_paths)
+    flat, treedef = jax.tree_util.tree_flatten_with_path(params)
+    out = []
+    for path, _ in flat:
+        key = "/".join(_path_str(p) for p in path)
+        out.append(specs[key])
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+def shard_params_tp(params, mesh: Mesh, rules=None):
+    """Place params per the TP rules; un-matched params replicate."""
+    specs = spec_tree(params, rules)
+    return jax.tree_util.tree_map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), params, specs)
+
+
+def logical_constraint(x, mesh: Mesh, spec: P):
+    """Mid-computation sharding hint (activation annotations)."""
+    return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
