@@ -1,0 +1,429 @@
+"""Data subsystem tests: loaders, formats, augmentation, tokenizer.
+
+Mirrors the reference's loader/augmentation coverage (SURVEY.md §4) but with generated
+fixtures — binary files are written in the reference's on-disk formats and read back, so
+format compatibility is what's actually tested.
+"""
+import os
+import struct
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tnn_tpu import data as tdata
+
+
+# -- loader contract ----------------------------------------------------------
+
+
+def test_array_loader_epoch_and_shuffle():
+    x = np.arange(40, dtype=np.float32).reshape(10, 4)
+    y = np.arange(10, dtype=np.int32)
+    dl = tdata.ArrayDataLoader(x, y, seed=0)
+    assert len(dl) == 10 and dl.data_shape == (4,)
+
+    batches = list(dl.batches(4))
+    assert len(batches) == 2  # remainder dropped
+    got = np.concatenate([b[1] for b in batches])
+    assert np.array_equal(got, np.arange(8))
+
+    dl.shuffle()
+    order1 = np.concatenate([b[1] for b in dl.batches(5)])
+    order2 = np.concatenate([b[1] for b in dl.batches(5)])
+    assert not np.array_equal(order1, np.arange(10)) or not np.array_equal(order2, np.arange(10))
+    assert sorted(order1) == list(range(10))
+
+
+def test_loader_tail_batch():
+    dl = tdata.SyntheticDataLoader(10, (3,), 2)
+    batches = list(dl.batches(4, drop_remainder=False))
+    assert [len(b[0]) for b in batches] == [4, 4, 2]
+
+
+def test_split_microbatches():
+    x = np.zeros((8, 3)); y = np.zeros(8)
+    mbs = tdata.split_microbatches(x, y, 4)
+    assert len(mbs) == 4 and mbs[0][0].shape == (2, 3)
+    with pytest.raises(ValueError):
+        tdata.split_microbatches(x, y, 3)
+
+
+def test_prefetch_matches_direct():
+    dl = tdata.SyntheticDataLoader(16, (2,), 4)
+    direct = [b[1].tolist() for b in dl.batches(4)]
+    fetched = [np.asarray(b[1]).tolist() for b in tdata.prefetch(dl.batches(4))]
+    assert direct == fetched
+
+
+def test_prefetch_abandoned_early_stops_producer():
+    import threading
+
+    before = threading.active_count()
+    dl = tdata.SyntheticDataLoader(64, (2,), 4)
+    it = tdata.prefetch(dl.batches(4), to_device=False)
+    next(it)
+    it.close()  # early stop: producer must shut down, not leak
+    for _ in range(50):
+        if threading.active_count() <= before:
+            break
+        import time
+        time.sleep(0.05)
+    assert threading.active_count() <= before
+
+
+def test_prefetch_propagates_errors():
+    def bad():
+        yield np.zeros(2), np.zeros(2)
+        raise RuntimeError("boom")
+
+    it = tdata.prefetch(bad(), to_device=False)
+    next(it)
+    with pytest.raises(RuntimeError, match="boom"):
+        list(it)
+
+
+# -- on-disk format compatibility --------------------------------------------
+
+
+def test_mnist_csv_roundtrip(tmp_path):
+    rows = []
+    rs = np.random.RandomState(0)
+    labels = rs.randint(0, 10, 5)
+    pixels = rs.randint(0, 256, (5, 784))
+    for lab, px in zip(labels, pixels):
+        rows.append(",".join([str(lab)] + [str(p) for p in px]))
+    p = tmp_path / "mnist_train.csv"
+    p.write_text("label," + ",".join(f"p{i}" for i in range(784)) + "\n" + "\n".join(rows))
+
+    dl = tdata.MNISTDataLoader(str(tmp_path), train=True)
+    assert dl.data_shape == (28, 28, 1)
+    d, l = dl.get_batch(5)
+    assert np.array_equal(l, labels)
+    assert np.allclose(d.reshape(5, -1), pixels / 255.0, atol=1e-6)
+
+
+def test_cifar10_bin_format(tmp_path):
+    rs = np.random.RandomState(1)
+    n = 7
+    recs = np.empty((n, 1 + 3072), np.uint8)
+    recs[:, 0] = rs.randint(0, 10, n)
+    recs[:, 1:] = rs.randint(0, 256, (n, 3072))
+    (tmp_path / "data_batch_1.bin").write_bytes(recs.tobytes())
+
+    dl = tdata.CIFAR10DataLoader(str(tmp_path), train=True)
+    d, l = dl.get_batch(n)
+    assert d.shape == (n, 32, 32, 3)
+    assert np.array_equal(l, recs[:, 0])
+    # CHW on disk -> NHWC in memory: red plane first on disk = channel 0
+    assert np.allclose(d[0, :, :, 0].ravel() * 255, recs[0, 1:1025])
+
+
+def test_cifar100_bin_format(tmp_path):
+    rs = np.random.RandomState(2)
+    n = 4
+    recs = np.empty((n, 2 + 3072), np.uint8)
+    recs[:, 0] = rs.randint(0, 20, n)   # coarse
+    recs[:, 1] = rs.randint(0, 100, n)  # fine
+    recs[:, 2:] = rs.randint(0, 256, (n, 3072))
+    (tmp_path / "train.bin").write_bytes(recs.tobytes())
+
+    dl = tdata.CIFAR100DataLoader(str(tmp_path), train=True)
+    _, l = dl.get_batch(n)
+    assert np.array_equal(l, recs[:, 1])
+
+
+def test_image_folder_npy(tmp_path):
+    for ci, cname in enumerate(["class_a", "class_b"]):
+        d = tmp_path / cname
+        d.mkdir()
+        np.save(d / "images.npy",
+                np.full((3, 8, 8, 3), ci * 100, np.uint8))
+    dl = tdata.ImageFolderDataLoader(str(tmp_path), image_size=(8, 8))
+    assert len(dl) == 6 and dl.class_names == ["class_a", "class_b"]
+    d, l = dl.get_batch(6)
+    assert np.array_equal(np.sort(l), [0, 0, 0, 1, 1, 1])
+
+
+def test_image_folder_tinyimagenet_layout(tmp_path):
+    # TinyImageNet layout: <class>/images/<name>.JPEG — decoded lazily per batch
+    pytest.importorskip("PIL")
+    from PIL import Image
+    for ci, cname in enumerate(["n01443537", "n01629819"]):
+        d = tmp_path / cname / "images"
+        d.mkdir(parents=True)
+        (tmp_path / cname / f"{cname}_boxes.txt").write_text("x")
+        for i in range(2):
+            Image.fromarray(np.full((64, 64, 3), ci * 100 + i, np.uint8)).save(
+                d / f"{cname}_{i}.JPEG")
+    dl = tdata.ImageFolderDataLoader(str(tmp_path), image_size=(64, 64))
+    assert len(dl) == 4
+    d_, l_ = dl.get_batch(4)
+    assert d_.shape == (4, 64, 64, 3) and sorted(l_) == [0, 0, 1, 1]
+
+
+def test_image_folder_class_names_order_preserved(tmp_path):
+    for cname in ["dog", "cat"]:
+        d = tmp_path / cname
+        d.mkdir()
+        np.save(d / "images.npy", np.zeros((1, 8, 8, 3), np.uint8))
+    dl = tdata.ImageFolderDataLoader(str(tmp_path), image_size=(8, 8),
+                                     class_names=["dog", "cat"])
+    assert dl.class_names == ["dog", "cat"]  # user order pinned, not re-sorted
+    _, l_ = dl.get_batch(2)
+    assert set(l_) == {0, 1}
+
+
+def test_token_stream_last_window_usable(tmp_path):
+    toks = np.arange(17, dtype=np.uint16)  # exactly S+1 tokens -> one valid window
+    p = tmp_path / "t.bin"
+    toks.tofile(p)
+    dl = tdata.OpenWebTextDataLoader(str(p), context_length=16)
+    assert len(dl) == 1
+    d, l = dl.random_windows(2)
+    assert np.array_equal(d[0], np.arange(16)) and l[0][-1] == 16
+
+
+def test_tokenizer_reload_clears_specials(tmp_path):
+    base = [bytes([i]) for i in range(256)]
+    p1, p2 = tmp_path / "v1.bin", tmp_path / "v2.bin"
+    _write_vocab(p1, base + [b"<|endoftext|>"])
+    _write_vocab(p2, base)
+    tok = tdata.Tokenizer().load(str(p1))
+    assert tok.eot_token == 256
+    tok.load(str(p2))
+    assert tok.eot_token is None
+
+
+def test_token_stream(tmp_path):
+    toks = np.arange(1000, dtype=np.uint16)
+    p = tmp_path / "tokens.bin"
+    toks.tofile(p)
+    dl = tdata.OpenWebTextDataLoader(str(p), context_length=16)
+    d, l = dl.get_batch(2)
+    assert d.shape == (2, 16) and l.shape == (2, 16)
+    # labels are inputs shifted by one (next-token prediction)
+    assert np.array_equal(l[0], d[0] + 1)
+    assert np.array_equal(d[1], np.arange(1, 17))
+
+    d2, _ = dl.random_windows(3)
+    assert d2.shape == (3, 16)
+
+
+def test_factory():
+    assert "cifar100" in tdata.available()
+    dl = tdata.create("synthetic_cifar", num_samples=64)
+    assert dl.data_shape == (32, 32, 3)
+    with pytest.raises(KeyError):
+        tdata.create("nope")
+
+
+# -- augmentation -------------------------------------------------------------
+
+
+@pytest.fixture
+def batch():
+    rs = np.random.RandomState(0)
+    return jnp.asarray(rs.rand(4, 16, 16, 3).astype(np.float32))
+
+
+def test_normalization(batch):
+    aug = tdata.Normalization(mean=(0.5, 0.5, 0.5), std=(0.25, 0.25, 0.25))
+    out = aug.apply(jax.random.PRNGKey(0), batch)
+    assert np.allclose(out, (np.asarray(batch) - 0.5) / 0.25, atol=1e-6)
+
+
+def test_horizontal_flip_deterministic(batch):
+    aug = tdata.HorizontalFlip(p=1.0)
+    out = aug.apply(jax.random.PRNGKey(0), batch)
+    assert np.allclose(out, np.asarray(batch)[:, :, ::-1, :])
+    noop = tdata.HorizontalFlip(p=0.0).apply(jax.random.PRNGKey(0), batch)
+    assert np.allclose(noop, batch)
+
+
+def test_vertical_flip(batch):
+    out = tdata.VerticalFlip(p=1.0).apply(jax.random.PRNGKey(0), batch)
+    assert np.allclose(out, np.asarray(batch)[:, ::-1, :, :])
+
+
+def test_random_crop_shape_preserved(batch):
+    out = tdata.RandomCrop(padding=2).apply(jax.random.PRNGKey(1), batch)
+    assert out.shape == batch.shape
+    assert not np.allclose(out, batch)  # virtually certain some sample shifted
+
+
+def test_cutout_zeroes_square(batch):
+    out = tdata.Cutout(size=6, p=1.0).apply(jax.random.PRNGKey(2), batch)
+    assert out.shape == batch.shape
+    # every sample must have at least one zeroed pixel (center always inside)
+    zeroed = (np.asarray(out) == 0).any(axis=(1, 2, 3))
+    assert zeroed.all()
+    # zeroed region is at most size x size pixels (exactly size^2 when fully inside)
+    per_sample = (np.asarray(out)[..., 0] == 0).sum(axis=(1, 2))
+    assert (per_sample <= 36).all()
+    big = np.ones((1, 32, 32, 3), np.float32)
+    outb = np.asarray(tdata.Cutout(size=4, p=1.0).apply(jax.random.PRNGKey(0),
+                                                        jnp.asarray(big)))
+    counts = (outb[0, :, :, 0] == 0).sum()
+    assert counts <= 16
+
+
+def test_brightness_contrast_noise_bounded(batch):
+    for aug in [tdata.Brightness(0.3, p=1.0), tdata.Contrast(0.5, 1.5, p=1.0),
+                tdata.GaussianNoise(0.1, p=1.0)]:
+        out = np.asarray(aug.apply(jax.random.PRNGKey(3), batch))
+        assert out.min() >= 0.0 and out.max() <= 1.0
+        assert not np.allclose(out, batch)
+
+
+def test_rotation_identity_at_zero(batch):
+    out = tdata.Rotation(max_degrees=0.0, p=1.0).apply(jax.random.PRNGKey(4), batch)
+    assert np.allclose(out, batch, atol=1e-5)
+    rot = tdata.Rotation(max_degrees=30.0, p=1.0).apply(jax.random.PRNGKey(5), batch)
+    assert rot.shape == batch.shape and not np.allclose(rot, batch)
+
+
+def test_pipeline_builder_and_config(batch):
+    pipe = (tdata.AugmentationBuilder()
+            .random_crop(2).horizontal_flip(0.5).cutout(4, 0.5)
+            .normalization((0.5,) * 3, (0.25,) * 3).build())
+    out = pipe(jax.random.PRNGKey(0), batch)
+    assert out.shape == batch.shape
+
+    cfg = pipe.get_config()
+    assert [c["type"] for c in cfg] == ["random_crop", "horizontal_flip", "cutout",
+                                        "normalization"]
+    pipe2 = tdata.AugmentationPipeline.from_config(cfg)
+    out2 = pipe2(jax.random.PRNGKey(0), batch)
+    assert np.allclose(out, out2, atol=1e-6)
+
+
+def test_pipeline_is_jittable(batch):
+    pipe = tdata.cifar_train_pipeline()
+    out = jax.jit(pipe._apply)(jax.random.PRNGKey(0), batch)
+    assert out.shape == batch.shape
+
+
+# -- tokenizer ----------------------------------------------------------------
+
+
+def _write_vocab(path, tokens):
+    with open(path, "wb") as f:
+        f.write(struct.pack("<I", len(tokens)))
+        for t in tokens:
+            f.write(struct.pack("<I", len(t)))
+            f.write(t)
+
+
+def test_tokenizer_vocab_bin_roundtrip(tmp_path):
+    # byte-level base vocab + merges appended in merge order (GPT-2 layout)
+    base = [bytes([i]) for i in range(256)]
+    merges = [b"he", b"ll", b"hell", b"o ", b"hello "]
+    vocab = base + merges + [b"<|endoftext|>"]
+    p = tmp_path / "vocab.bin"
+    _write_vocab(p, vocab)
+
+    tok = tdata.Tokenizer().load(str(p))
+    assert tok.vocab_size == len(vocab)
+    assert tok.decode([256 + 4]) == "hello "
+    assert tok.decode_token(10 ** 6) == b"<unk>"
+
+    # save() writes the identical format back
+    p2 = tmp_path / "vocab2.bin"
+    tok.save(str(p2))
+    assert p.read_bytes() == p2.read_bytes()
+
+
+def test_tokenizer_encode_respects_merge_order(tmp_path):
+    base = [bytes([i]) for i in range(256)]
+    merges = [b"he", b"ll", b"hell", b"hello"]
+    p = tmp_path / "vocab.bin"
+    _write_vocab(p, base + merges)
+    tok = tdata.Tokenizer().load(str(p))
+
+    ids = tok.encode("hello")
+    assert tok.decode(ids) == "hello"
+    # lowest-id (earliest merge) pairs first: he+ll -> hell, then hell+o -> hello
+    assert ids == [256 + 3]
+
+    # unknown text falls back to raw bytes
+    ids = tok.encode("xyz")
+    assert ids == [ord("x"), ord("y"), ord("z")]
+    assert tok.decode(ids) == "xyz"
+
+
+def test_tokenizer_unicode_pretokenization(tmp_path):
+    base = [bytes([i]) for i in range(256)]
+    p = tmp_path / "vocab.bin"
+    _write_vocab(p, base)
+    tok = tdata.Tokenizer().load(str(p))
+    # accented letters stay in one letter-run (GPT-2 \p{L} semantics), so the
+    # UTF-8 bytes of " café" come out contiguously and round-trip
+    ids = tok.encode(" café!")
+    assert tok.decode(ids) == " café!"
+    assert ids == list(" café!".encode("utf-8"))
+
+
+def test_image_folder_npy_resizes_to_image_size(tmp_path):
+    d = tmp_path / "class_a"
+    d.mkdir()
+    np.save(d / "images.npy", np.full((2, 64, 64, 3), 128, np.uint8))
+    dl = tdata.ImageFolderDataLoader(str(tmp_path), image_size=(32, 32))
+    assert dl.data_shape == (32, 32, 3)
+
+
+def test_tokenizer_eot(tmp_path):
+    base = [bytes([i]) for i in range(256)]
+    p = tmp_path / "vocab.bin"
+    _write_vocab(p, base + [b"<|endoftext|>"])
+    tok = tdata.Tokenizer().load(str(p))
+    assert tok.eot_token == 256
+    ids = tok.encode("a<|endoftext|>b")
+    assert ids == [ord("a"), 256, ord("b")]
+
+
+def test_masked_label_loss():
+    from tnn_tpu.nn import losses
+    logits = jnp.asarray(np.random.RandomState(0).randn(4, 8).astype(np.float32))
+    labels = jnp.asarray([1, 2, -1, -1], jnp.int32)
+    full = losses.softmax_cross_entropy(logits[:2], labels[:2])
+    masked = losses.softmax_cross_entropy(logits, labels)
+    assert np.allclose(full, masked, atol=1e-6)
+
+
+def test_masked_label_metrics():
+    from tnn_tpu.nn import metrics
+    logits = jnp.eye(4, dtype=jnp.float32)  # pred = [0,1,2,3]
+    labels = jnp.asarray([0, 1, -1, -1], jnp.int32)
+    # ignored positions excluded from numerator AND denominator
+    assert float(metrics.accuracy(logits, labels)) == 1.0
+    assert int(metrics.class_corrects(logits, labels)) == 2
+    assert float(metrics.topk_accuracy(logits, labels, k=2)) == 1.0
+
+
+def test_synthetic_loader_shuffle_reorders_not_resamples():
+    dl = tdata.SyntheticDataLoader(16, (2,), 4, seed=0)
+    plain = np.sort(np.concatenate([b[0].ravel() for b in dl.batches(4)]))
+    dl.shuffle()
+    shuffled = np.sort(np.concatenate([b[0].ravel() for b in dl.batches(4)]))
+    assert np.allclose(plain, shuffled)
+    dl2 = tdata.SyntheticDataLoader(16, (2,), 4, seed=123)
+    assert not np.allclose(dl.data, dl2.data)
+
+
+def test_factory_image_size_override(tmp_path):
+    d = tmp_path / "c0"
+    d.mkdir()
+    np.save(d / "images.npy", np.zeros((2, 64, 64, 3), np.uint8))
+    dl = tdata.create("tiny_imagenet", str(tmp_path), image_size=(32, 32))
+    assert dl.data_shape == (32, 32, 3)
+
+
+def test_token_stream_too_short_clear_error(tmp_path):
+    p = tmp_path / "t.bin"
+    np.arange(10, dtype=np.uint16).tofile(p)
+    dl = tdata.OpenWebTextDataLoader(str(p), context_length=16)
+    assert dl.get_batch(1) is None
+    with pytest.raises(ValueError, match="too short"):
+        dl.random_windows(1)

@@ -1,0 +1,201 @@
+"""Dataset readers: MNIST CSV, CIFAR-10/100 binary, image folders.
+
+Reference capability being matched (not ported):
+  * MNIST CSV mmap loader — include/data_loading/mnist_data_loader.hpp (28x28x1 NHWC,
+    /255 normalization).
+  * CIFAR-10/100 binary loaders — include/data_loading/cifar10_data_loader.hpp,
+    cifar100_data_loader.hpp (stored CHW per record; label byte(s) first).
+  * TinyImageNet / ImageNet100 stb_image folder loaders —
+    include/data_loading/image_data_loader.hpp, src/data_loading/stb_image_impl.cpp.
+
+All readers produce NHWC float32 in [0,1] (mean/std normalization happens on device,
+tnn_tpu/data/augmentation.py) and int32 class labels — not one-hot; the loss takes
+integer labels directly, which is cheaper on TPU than shipping one-hot floats.
+"""
+from __future__ import annotations
+
+import os
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+from .loader import ArrayDataLoader, DataLoader
+
+# -- MNIST (CSV: label,p0,...,p783 per row) ----------------------------------
+
+
+def load_mnist_csv(path: str) -> Tuple[np.ndarray, np.ndarray]:
+    """Parse an MNIST CSV file into (N,28,28,1) float32 [0,1] + (N,) int32 labels."""
+    raw = np.loadtxt(path, delimiter=",", skiprows=_has_header(path), dtype=np.float32)
+    labels = raw[:, 0].astype(np.int32)
+    data = (raw[:, 1:] / 255.0).reshape(-1, 28, 28, 1).astype(np.float32)
+    return data, labels
+
+
+def _has_header(path: str) -> int:
+    with open(path, "r") as f:
+        first = f.readline()
+    return 0 if first.split(",")[0].strip().isdigit() else 1
+
+
+class MNISTDataLoader(ArrayDataLoader):
+    """MNIST from CSV (parity: MNISTDataLoader, include/data_loading/mnist_data_loader.hpp)."""
+
+    def __init__(self, path: str, train: bool = True, seed: int = 0):
+        name = "mnist_train.csv" if train else "mnist_test.csv"
+        full = path if path.endswith(".csv") else os.path.join(path, name)
+        data, labels = load_mnist_csv(full)
+        super().__init__(data, labels, seed)
+
+
+# -- CIFAR-10 / CIFAR-100 binary ---------------------------------------------
+
+_CIFAR_HW = 32
+_CIFAR_PIXELS = 3 * _CIFAR_HW * _CIFAR_HW  # 3072, stored CHW
+
+
+def load_cifar10_bin(files: Sequence[str]) -> Tuple[np.ndarray, np.ndarray]:
+    """CIFAR-10 binary batches: each record is 1 label byte + 3072 CHW pixel bytes."""
+    datas, labels = [], []
+    for f in files:
+        raw = np.fromfile(f, dtype=np.uint8).reshape(-1, 1 + _CIFAR_PIXELS)
+        labels.append(raw[:, 0].astype(np.int32))
+        datas.append(_chw_bytes_to_nhwc(raw[:, 1:]))
+    return np.concatenate(datas), np.concatenate(labels)
+
+
+def load_cifar100_bin(file: str, fine_labels: bool = True) -> Tuple[np.ndarray, np.ndarray]:
+    """CIFAR-100 binary: each record is coarse byte + fine byte + 3072 CHW pixel bytes."""
+    raw = np.fromfile(file, dtype=np.uint8).reshape(-1, 2 + _CIFAR_PIXELS)
+    labels = raw[:, 1 if fine_labels else 0].astype(np.int32)
+    return _chw_bytes_to_nhwc(raw[:, 2:]), labels
+
+
+def _chw_bytes_to_nhwc(flat: np.ndarray) -> np.ndarray:
+    n = flat.shape[0]
+    chw = flat.reshape(n, 3, _CIFAR_HW, _CIFAR_HW)
+    return (chw.transpose(0, 2, 3, 1).astype(np.float32) / 255.0)
+
+
+class CIFAR10DataLoader(ArrayDataLoader):
+    """CIFAR-10 from the standard binary distribution directory."""
+
+    def __init__(self, path: str, train: bool = True, seed: int = 0):
+        if train:
+            files = [os.path.join(path, f"data_batch_{i}.bin") for i in range(1, 6)]
+            files = [f for f in files if os.path.exists(f)]
+            if not files:
+                raise FileNotFoundError(f"no CIFAR-10 data_batch_*.bin under {path}")
+        else:
+            files = [os.path.join(path, "test_batch.bin")]
+        data, labels = load_cifar10_bin(files)
+        super().__init__(data, labels, seed)
+
+
+class CIFAR100DataLoader(ArrayDataLoader):
+    """CIFAR-100 from train.bin/test.bin (fine labels, 100 classes)."""
+
+    def __init__(self, path: str, train: bool = True, fine_labels: bool = True,
+                 seed: int = 0):
+        f = os.path.join(path, "train.bin" if train else "test.bin")
+        data, labels = load_cifar100_bin(f, fine_labels)
+        super().__init__(data, labels, seed)
+
+
+# -- Image folders (TinyImageNet layout) -------------------------------------
+
+
+_IMG_EXTS = (".png", ".jpg", ".jpeg", ".bmp")
+
+
+class ImageFolderDataLoader(DataLoader):
+    """class-per-directory image tree → NHWC float32 batches
+    (parity: ImageDataLoader + stb_image, src/data_loading/stb_image_impl.cpp).
+
+    Layouts handled: ``<class>/img.png``, TinyImageNet's nested
+    ``<class>/images/img.JPEG``, and raw ``<class>/images.npy`` arrays (works without
+    PIL). Like the reference (which lazily indexes paths because decoded sets do not
+    fit in RAM — tiny_imagenet_data_loader.hpp:45-46), only the (path, label) index is
+    built eagerly; pixels are decoded per batch. ``eager=True`` caches decoded uint8 in
+    memory for small sets. Conversion to float32/255 happens at batch time either way.
+    """
+
+    def __init__(self, path: str, image_size: Tuple[int, int] = (64, 64), seed: int = 0,
+                 class_names: Optional[Sequence[str]] = None, eager: bool = False):
+        super().__init__(seed)
+        # user-pinned class order is preserved (it fixes the label mapping);
+        # discovered classes are sorted for determinism
+        if class_names is not None:
+            names = list(class_names)
+        else:
+            names = sorted(d for d in os.listdir(path)
+                           if os.path.isdir(os.path.join(path, d)))
+        self.class_names = names
+        self.image_size = tuple(image_size)
+        self._items: list = []  # (kind, payload) per sample
+        labels = []
+        self._npy_cache: dict = {}
+        for ci, cname in enumerate(names):
+            cdir = os.path.join(path, cname)
+            nested = os.path.join(cdir, "images")
+            imgdir = nested if os.path.isdir(nested) else cdir
+            npy = os.path.join(cdir, "images.npy")
+            if os.path.exists(npy):
+                n = len(np.load(npy, mmap_mode="r"))
+                self._items += [("npy", (npy, i)) for i in range(n)]
+                labels += [ci] * n
+            else:
+                files = sorted(f for f in os.listdir(imgdir)
+                               if f.lower().endswith(_IMG_EXTS))
+                if not files:
+                    raise FileNotFoundError(
+                        f"class dir {cdir} has no {_IMG_EXTS} images or images.npy")
+                self._items += [("img", os.path.join(imgdir, f)) for f in files]
+                labels += [ci] * len(files)
+        self._labels = np.asarray(labels, np.int32)
+        self._num_samples = len(self._items)
+        self._data_shape = self.image_size + (3,)
+        self._label_shape = ()
+        self._eager_cache: Optional[np.ndarray] = None
+        if eager:
+            self._eager_cache = np.stack(
+                [self._decode(i) for i in range(self._num_samples)])
+
+    def _decode(self, i: int) -> np.ndarray:
+        """One sample as uint8 HWC at image_size."""
+        kind, payload = self._items[i]
+        if kind == "npy":
+            path, row = payload
+            if path not in self._npy_cache:
+                self._npy_cache[path] = np.load(path, mmap_mode="r")
+            arr = np.asarray(self._npy_cache[path][row])
+            if arr.dtype != np.uint8:
+                arr = np.clip(arr * 255.0, 0, 255).astype(np.uint8)
+            if arr.shape[:2] != self.image_size:
+                arr = _resize_nearest(arr[None], self.image_size)[0]
+            return arr
+        return _decode_image_pil(payload, self.image_size)
+
+    def _get(self, indices):
+        if self._eager_cache is not None:
+            batch = self._eager_cache[indices]
+        else:
+            batch = np.stack([self._decode(int(i)) for i in indices])
+        return batch.astype(np.float32) / 255.0, self._labels[indices]
+
+
+def _resize_nearest(imgs: np.ndarray, image_size) -> np.ndarray:
+    H, W = image_size
+    yi = (np.arange(H) * imgs.shape[1] // H)
+    xi = (np.arange(W) * imgs.shape[2] // W)
+    return imgs[:, yi[:, None], xi[None, :], :]
+
+
+def _decode_image_pil(path: str, image_size) -> np.ndarray:
+    try:
+        from PIL import Image  # noqa: deferred optional dep
+    except ImportError as e:
+        raise ImportError(
+            f"PIL unavailable to decode {path}; provide images.npy instead") from e
+    img = Image.open(path).convert("RGB").resize((image_size[1], image_size[0]))
+    return np.asarray(img, np.uint8)

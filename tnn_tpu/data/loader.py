@@ -1,0 +1,202 @@
+"""Data loaders: host-side batch producers feeding the compiled TPU train step.
+
+Reference capability being matched (not ported):
+  * ``BaseDataLoader`` — include/data_loading/data_loader.hpp:25-116 — get_batch /
+    shuffle / reset / size / data_shape contract.
+  * Batch splitting into microbatches — include/tensor/tensor_ops.hpp:240-268.
+
+TPU-first redesign: loaders produce **numpy host batches** (NHWC float32 or int token
+ids); normalization/augmentation runs ON DEVICE as part of the jitted step
+(tnn_tpu/data/augmentation.py), so the host side stays a cheap byte shuffler.
+``prefetch`` overlaps host batch assembly + H2D transfer with device compute —
+the TPU analog of the reference's async Task/Flow pipelining
+(include/device/task.hpp:28).
+"""
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Iterator, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+
+
+class DataLoader:
+    """Base contract (parity: BaseDataLoader, include/data_loading/data_loader.hpp:25).
+
+    Subclasses implement ``_get(indices) -> (data, labels)`` over sample indices and
+    set ``_num_samples`` / ``_data_shape`` / ``_label_shape``.
+    """
+
+    def __init__(self, seed: int = 0):
+        self._num_samples = 0
+        self._data_shape: Tuple[int, ...] = ()
+        self._label_shape: Tuple[int, ...] = ()
+        self._rng = np.random.default_rng(seed)
+        self._order: Optional[np.ndarray] = None
+        self._cursor = 0
+        self._shuffled = False
+
+    # -- contract ------------------------------------------------------------
+
+    def __len__(self) -> int:
+        return self._num_samples
+
+    @property
+    def num_samples(self) -> int:
+        return self._num_samples
+
+    @property
+    def data_shape(self) -> Tuple[int, ...]:
+        """Per-sample shape (parity: data_loader.hpp data_shape())."""
+        return self._data_shape
+
+    @property
+    def label_shape(self) -> Tuple[int, ...]:
+        return self._label_shape
+
+    def shuffle(self) -> None:
+        self._shuffled = True
+        self._order = self._rng.permutation(self._num_samples)
+
+    def reset(self) -> None:
+        self._cursor = 0
+        if self._shuffled:
+            self._order = self._rng.permutation(self._num_samples)
+
+    def get_batch(self, batch_size: int) -> Optional[Tuple[np.ndarray, np.ndarray]]:
+        """Next (data, labels) batch or None at epoch end (parity: get_batch returning
+        false, data_loader.hpp)."""
+        if self._cursor + batch_size > self._num_samples:
+            return None
+        idx = np.arange(self._cursor, self._cursor + batch_size)
+        if self._order is not None:
+            idx = self._order[idx]
+        self._cursor += batch_size
+        return self._get(idx)
+
+    def _get(self, indices: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+        raise NotImplementedError
+
+    # -- iteration -----------------------------------------------------------
+
+    def batches(self, batch_size: int,
+                drop_remainder: bool = True) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
+        """One epoch of batches. Remainder batches are dropped by default: variable
+        tail shapes would recompile the jitted step (SURVEY.md §7 hard part 3)."""
+        self.reset()
+        while True:
+            b = self.get_batch(batch_size)
+            if b is None:
+                if not drop_remainder:
+                    tail = self._num_samples - self._cursor
+                    if tail > 0:
+                        idx = np.arange(self._cursor, self._num_samples)
+                        if self._order is not None:
+                            idx = self._order[idx]
+                        self._cursor = self._num_samples
+                        yield self._get(idx)
+                return
+            yield b
+
+    def steps_per_epoch(self, batch_size: int) -> int:
+        return self._num_samples // batch_size
+
+
+class ArrayDataLoader(DataLoader):
+    """In-memory (data, labels) arrays — the workhorse for MNIST/CIFAR-scale sets."""
+
+    def __init__(self, data: np.ndarray, labels: np.ndarray, seed: int = 0):
+        super().__init__(seed)
+        if len(data) != len(labels):
+            raise ValueError(f"data/labels length mismatch: {len(data)} vs {len(labels)}")
+        self.data = data
+        self.labels = labels
+        self._num_samples = len(data)
+        self._data_shape = tuple(data.shape[1:])
+        self._label_shape = tuple(labels.shape[1:])
+
+    def _get(self, indices):
+        return self.data[indices], self.labels[indices]
+
+
+class SyntheticDataLoader(ArrayDataLoader):
+    """Random but fixed data — for benchmarks and tests (no fixtures on disk).
+
+    Samples are generated once from ``seed`` at construction, so shuffle() reorders the
+    same dataset (the DataLoader contract) rather than resampling it.
+    """
+
+    def __init__(self, num_samples: int, data_shape: Sequence[int], num_classes: int,
+                 seed: int = 0, dtype=np.float32):
+        gen = np.random.default_rng(seed)
+        data = gen.standard_normal((num_samples,) + tuple(data_shape)).astype(dtype)
+        labels = gen.integers(0, num_classes, num_samples).astype(np.int32)
+        self.num_classes = num_classes
+        super().__init__(data, labels, seed)
+
+
+def split_microbatches(data: np.ndarray, labels: np.ndarray,
+                       num_microbatches: int) -> Sequence[Tuple[np.ndarray, np.ndarray]]:
+    """Split a batch into microbatches along axis 0 (parity: ops::split batch →
+    microbatch use in distributed/train.hpp:37-41)."""
+    if len(data) % num_microbatches:
+        raise ValueError(
+            f"batch {len(data)} not divisible by num_microbatches {num_microbatches}")
+    return list(zip(np.split(data, num_microbatches), np.split(labels, num_microbatches)))
+
+
+def prefetch(iterator: Iterator, size: int = 2, to_device: bool = True) -> Iterator:
+    """Background-thread prefetch with optional H2D staging.
+
+    Overlaps host batch assembly and host→device transfer with device compute —
+    the TPU replacement for the reference's async stream pipeline (CUDAFlow/Task,
+    include/device/flow.hpp:28). ``jax.device_put`` is async: the transfer rides
+    ahead while the previous step executes.
+    """
+    q: "queue.Queue" = queue.Queue(maxsize=size)
+    sentinel = object()
+    stop = threading.Event()
+    err: list = []
+
+    def producer():
+        try:
+            for item in iterator:
+                if to_device:
+                    item = jax.device_put(item)
+                while not stop.is_set():
+                    try:
+                        q.put(item, timeout=0.1)
+                        break
+                    except queue.Full:
+                        continue
+                if stop.is_set():
+                    return
+        except Exception as e:  # surfaced in the consumer
+            err.append(e)
+        finally:
+            q.put(sentinel)
+
+    t = threading.Thread(target=producer, daemon=True)
+    t.start()
+    try:
+        while True:
+            item = q.get()
+            if item is sentinel:
+                if err:
+                    raise err[0]
+                return
+            yield item
+    finally:
+        # Abandoned mid-epoch (early stopping, max_steps): unblock and stop the
+        # producer so queued device batches are released.
+        stop.set()
+        while True:
+            try:
+                if q.get_nowait() is sentinel:
+                    break
+            except queue.Empty:
+                if not t.is_alive():
+                    break
+                t.join(timeout=0.05)

@@ -1,0 +1,145 @@
+"""GPT-2 tokenizer: vocab.bin decode (reference-compatible) + BPE encode (exceeds it).
+
+Reference capability being matched (not ported):
+  * Tokenizer — include/tokenizer/tokenizer.hpp:11-68 — DECODE-ONLY over a vocab.bin of
+    ``<u32 count, then per token: <u32 len + raw bytes`` (written by
+    python/export_vocab.py from tiktoken's gpt2 encoding).
+
+This implementation reads/writes the same vocab.bin format, and adds what the reference
+lacks: an ``encode`` path. Exact GPT-2 byte-pair-merge encoding needs the merge ranks;
+when only vocab.bin is available we recover ranks from token ids (GPT-2 merged tokens
+were appended to the vocab in merge order, so id order IS rank order for ids >= 256),
+which reproduces tiktoken's output for ordinary text.
+"""
+from __future__ import annotations
+
+import re
+import struct
+from typing import Dict, List, Optional, Sequence
+
+# GPT-2's pretokenization pattern (the public BPE spec uses \p{L}/\p{N}); stdlib `re`
+# has no \p classes, so letters are matched as [^\W\d_] (unicode L*) and the
+# punctuation run as "not whitespace, not letter, not digit".
+_PRETOKEN_RE = re.compile(
+    r"'s|'t|'re|'ve|'m|'ll|'d| ?[^\W\d_]+| ?\d+| ?(?:(?![^\W\d_])[^\s\d])+|\s+(?!\S)|\s+")
+
+_END_OF_TEXT = "<|endoftext|>"
+
+
+class Tokenizer:
+    """Byte-level BPE tokenizer over a reference-format vocab.bin."""
+
+    def __init__(self):
+        self._vocab: List[bytes] = []
+        self._encoder: Dict[bytes, int] = {}
+        self._special: Dict[str, int] = {}
+
+    # -- loading --------------------------------------------------------------
+
+    def load(self, vocab_path: str) -> "Tokenizer":
+        """Read the reference vocab.bin format (tokenizer.hpp:15-38)."""
+        with open(vocab_path, "rb") as f:
+            (count,) = struct.unpack("<I", f.read(4))
+            self._vocab = []
+            for _ in range(count):
+                (n,) = struct.unpack("<I", f.read(4))
+                self._vocab.append(f.read(n) if n else b"")
+        self._build_encoder()
+        return self
+
+    def save(self, vocab_path: str) -> None:
+        """Write vocab.bin in the same format (python/export_vocab.py layout)."""
+        with open(vocab_path, "wb") as f:
+            f.write(struct.pack("<I", len(self._vocab)))
+            for tok in self._vocab:
+                f.write(struct.pack("<I", len(tok)))
+                f.write(tok)
+
+    @classmethod
+    def from_tiktoken(cls, encoding_name: str = "gpt2") -> "Tokenizer":
+        """Build directly from tiktoken when it is installed (corpus-prep parity with
+        python/openwebtext.py)."""
+        import tiktoken  # optional dep, matches reference tooling
+
+        enc = tiktoken.get_encoding(encoding_name)
+        tok = cls()
+        tok._vocab = [enc.decode_bytes([i]) for i in range(enc.n_vocab)]
+        tok._build_encoder()
+        return tok
+
+    def _build_encoder(self):
+        self._encoder = {}
+        self._special = {}
+        for i, b in enumerate(self._vocab):
+            if b not in self._encoder:  # first id wins (specials may duplicate bytes)
+                self._encoder[b] = i
+        if _END_OF_TEXT.encode() in self._encoder:
+            self._special[_END_OF_TEXT] = self._encoder[_END_OF_TEXT.encode()]
+
+    # -- decode (reference parity) -------------------------------------------
+
+    @property
+    def vocab_size(self) -> int:
+        return len(self._vocab)
+
+    @property
+    def eot_token(self) -> Optional[int]:
+        return self._special.get(_END_OF_TEXT)
+
+    def decode_token(self, token_id: int) -> bytes:
+        if 0 <= token_id < len(self._vocab):
+            return self._vocab[token_id]
+        return b"<unk>"  # same out-of-range behavior as tokenizer.hpp:40-44
+
+    def decode(self, ids: Sequence[int]) -> str:
+        return b"".join(self.decode_token(int(i)) for i in ids).decode(
+            "utf-8", errors="replace")
+
+    # -- encode (exceeds reference) ------------------------------------------
+
+    def encode(self, text: str, allowed_special: bool = True) -> List[int]:
+        if not self._vocab:
+            raise RuntimeError("tokenizer not loaded")
+        out: List[int] = []
+        pieces = [text]
+        if allowed_special and _END_OF_TEXT in self._special and _END_OF_TEXT in text:
+            pieces = _split_keep(text, _END_OF_TEXT)
+        for piece in pieces:
+            if piece == _END_OF_TEXT:
+                out.append(self._special[_END_OF_TEXT])
+                continue
+            for word in _PRETOKEN_RE.findall(piece):
+                out.extend(self._bpe(word.encode("utf-8")))
+        return out
+
+    def _bpe(self, word: bytes) -> List[int]:
+        """Greedy lowest-id pair merging. For a vocab built in merge order (GPT-2's is),
+        token id order equals merge rank order, so this reproduces true BPE."""
+        parts: List[bytes] = [bytes([b]) for b in word]
+        while len(parts) > 1:
+            best_rank, best_i = None, None
+            for i in range(len(parts) - 1):
+                cand = parts[i] + parts[i + 1]
+                rank = self._encoder.get(cand)
+                if rank is not None and (best_rank is None or rank < best_rank):
+                    best_rank, best_i = rank, i
+            if best_i is None:
+                break
+            parts[best_i:best_i + 2] = [parts[best_i] + parts[best_i + 1]]
+        out = []
+        for p in parts:
+            if p in self._encoder:
+                out.append(self._encoder[p])
+            else:  # unmergeable raw byte — fall back to its byte token
+                out.extend(self._encoder[bytes([b])] for b in p)
+        return out
+
+
+def _split_keep(text: str, sep: str) -> List[str]:
+    out: List[str] = []
+    for i, piece in enumerate(text.split(sep)):
+        if i:
+            out.append(sep)
+        if piece:
+            out.append(piece)
+    return out

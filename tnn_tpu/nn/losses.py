@@ -46,13 +46,22 @@ def _to_onehot(labels, num_classes):
 def softmax_cross_entropy(logits, labels, weight: Optional[jax.Array] = None):
     """Fused log-softmax + NLL on logits (parity: create_logsoftmax_crossentropy,
     loss.hpp:464 — the numerically-stable mode). ``labels``: int class ids or one-hot/soft.
+    Integer labels < 0 are ignored (masked out of the mean) — used by the token-stream
+    loader to mask padding, vs the reference's zeroed one-hot rows
+    (open_webtext_data_loader.hpp:41-44).
     """
     logits = logits.astype(jnp.float32)
+    mask = None
+    if jnp.issubdtype(labels.dtype, jnp.integer):
+        mask = (labels >= 0).astype(jnp.float32)
+        labels = jnp.maximum(labels, 0)
     onehot = _to_onehot(labels, logits.shape[-1])
     logp = jax.nn.log_softmax(logits, axis=-1)
     nll = -jnp.sum(onehot * logp, axis=-1)
     if weight is not None:
         nll = nll * weight
+    if mask is not None:
+        return jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
     return jnp.mean(nll)
 
 

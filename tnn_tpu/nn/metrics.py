@@ -2,33 +2,47 @@
 
 Parity: reference accuracy (compute_class_corrects argmax-match, include/nn/accuracy.hpp:14-38,
 CPU+CUDA kernels in accuracy_impl/). Pure jnp; composes into the jit'd eval step.
+
+Integer labels < 0 mark ignored positions (padding) and are excluded from both the
+numerator and denominator — consistent with losses.softmax_cross_entropy's mask.
 """
 from __future__ import annotations
 
 import jax.numpy as jnp
 
 
+def _labels_mask(labels, class_ndim):
+    """Collapse one-hot labels and derive the ignore mask (integer labels < 0)."""
+    if labels.ndim == class_ndim + 1:
+        labels = jnp.argmax(labels, axis=-1)
+        mask = jnp.ones(labels.shape, jnp.bool_)
+    elif jnp.issubdtype(labels.dtype, jnp.integer):
+        mask = labels >= 0
+    else:
+        mask = jnp.ones(labels.shape, jnp.bool_)
+    return labels, mask
+
+
 def class_corrects(logits, labels) -> jnp.ndarray:
     """Number of argmax matches (parity: compute_class_corrects, accuracy.hpp:14)."""
     pred = jnp.argmax(logits, axis=-1)
-    if labels.ndim == pred.ndim + 1:
-        labels = jnp.argmax(labels, axis=-1)
-    return jnp.sum((pred == labels).astype(jnp.int32))
+    labels, mask = _labels_mask(labels, pred.ndim)
+    return jnp.sum((pred == labels) & mask, dtype=jnp.int32)
 
 
 def accuracy(logits, labels) -> jnp.ndarray:
     pred = jnp.argmax(logits, axis=-1)
-    if labels.ndim == pred.ndim + 1:
-        labels = jnp.argmax(labels, axis=-1)
-    return jnp.mean((pred == labels).astype(jnp.float32))
+    labels, mask = _labels_mask(labels, pred.ndim)
+    return jnp.sum((pred == labels) & mask, dtype=jnp.float32) / jnp.maximum(
+        jnp.sum(mask, dtype=jnp.float32), 1.0)
 
 
 def topk_accuracy(logits, labels, k: int = 5) -> jnp.ndarray:
-    if labels.ndim == logits.ndim:
-        labels = jnp.argmax(labels, axis=-1)
+    labels, mask = _labels_mask(labels, logits.ndim - 1)
     topk = jnp.argsort(logits, axis=-1)[..., -k:]
     hit = jnp.any(topk == labels[..., None], axis=-1)
-    return jnp.mean(hit.astype(jnp.float32))
+    return jnp.sum(hit & mask, dtype=jnp.float32) / jnp.maximum(
+        jnp.sum(mask, dtype=jnp.float32), 1.0)
 
 
 def perplexity(mean_nll) -> jnp.ndarray:

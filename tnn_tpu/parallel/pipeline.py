@@ -23,7 +23,6 @@ Two TPU-native implementations:
 """
 from __future__ import annotations
 
-import functools
 from typing import Any, Callable, List, Optional, Sequence, Tuple
 
 import jax

@@ -16,7 +16,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ..core.module import _path_str, tree_paths
+from ..core.module import _path_str
 
 # Default rules for this framework's layer naming (ordered; first match wins).
 # Transformer blocks: qkv/fc column-parallel (shard output dim), out/proj row-parallel
@@ -39,21 +39,11 @@ def spec_tree(params, rules: Optional[Sequence[Tuple[str, P]]] = None):
     """Map a param pytree to a pytree of PartitionSpecs via path-regex rules."""
     rules = list(rules) if rules is not None else DEFAULT_TP_RULES
     compiled = [(re.compile(pat), spec) for pat, spec in rules]
-    paths = tree_paths(params)
-    specs: Dict[str, P] = {}
-    for path in paths:
-        for pat, spec in compiled:
-            if pat.match(path):
-                specs[path] = spec
-                break
-        else:
-            specs[path] = P()
-    # rebuild as pytree in params' structure (same key derivation as tree_paths)
     flat, treedef = jax.tree_util.tree_flatten_with_path(params)
     out = []
     for path, _ in flat:
         key = "/".join(_path_str(p) for p in path)
-        out.append(specs[key])
+        out.append(next((spec for pat, spec in compiled if pat.match(key)), P()))
     return jax.tree_util.tree_unflatten(treedef, out)
 
 
