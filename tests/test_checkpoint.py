@@ -1,0 +1,130 @@
+"""Tests: tensor-file round trip, model save/load, full training-state checkpoints."""
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tnn_tpu import checkpoint as ckpt_lib
+from tnn_tpu import models, nn
+from tnn_tpu.data import SyntheticDataLoader
+from tnn_tpu.train import TrainState, create_train_state, make_train_step
+
+
+def small_model():
+    return models.create("mnist_cnn")
+
+
+class TestTensorFile:
+    def test_round_trip_dtypes(self, tmp_path):
+        trees = {
+            "a": {"x": jnp.arange(6, dtype=jnp.float32).reshape(2, 3),
+                  "y": {"z": jnp.ones((4,), jnp.bfloat16)}},
+            "b": jnp.asarray(3, jnp.int32),
+        }
+        path = str(tmp_path / "t.tnn")
+        ckpt_lib.save_tensors(path, trees, meta={"k": 1})
+        flat, meta = ckpt_lib.read_tensor_file(path)
+        assert meta == {"k": 1}
+        assert set(flat) == {"a/x", "a/y/z", "b"}
+        loaded, _ = ckpt_lib.load_tensors(path, {
+            "a": jax.tree_util.tree_map(jnp.zeros_like, trees["a"]),
+            "b": jnp.zeros((), jnp.int32)})
+        np.testing.assert_array_equal(np.asarray(loaded["a"]["x"]),
+                                      np.asarray(trees["a"]["x"]))
+        assert str(np.asarray(loaded["a"]["y"]["z"]).dtype) == "bfloat16"
+        assert int(loaded["b"]) == 3
+
+    def test_structure_mismatch_raises(self, tmp_path):
+        path = str(tmp_path / "t.tnn")
+        ckpt_lib.save_tensors(path, {"a": {"x": jnp.zeros((2,))}})
+        with pytest.raises(KeyError):
+            ckpt_lib.load_tensors(path, {"a": {"x": jnp.zeros((2,)),
+                                               "extra": jnp.zeros((1,))}})
+        with pytest.raises(ValueError):
+            ckpt_lib.load_tensors(path, {"a": {"x": jnp.zeros((3,))}})
+
+
+class TestModelSaveLoad:
+    def test_model_round_trip(self, tmp_path):
+        model = small_model()
+        variables = model.init(jax.random.PRNGKey(0), (2, 28, 28, 1))
+        path = str(tmp_path / "model.tnn")
+        ckpt_lib.save_model(path, model, variables["params"], variables["state"])
+
+        model2, vars2 = ckpt_lib.load_model(path, input_shape=(2, 28, 28, 1))
+        assert model2.get_config() == model.get_config()
+        x = jnp.ones((2, 28, 28, 1), jnp.bfloat16)
+        y1 = model(variables, x)
+        y2 = model2(vars2, x)
+        np.testing.assert_allclose(np.asarray(y1, np.float32),
+                                   np.asarray(y2, np.float32), atol=1e-5)
+
+    def test_model_load_without_template(self, tmp_path):
+        model = small_model()
+        variables = model.init(jax.random.PRNGKey(0), (2, 28, 28, 1))
+        path = str(tmp_path / "model.tnn")
+        ckpt_lib.save_model(path, model, variables["params"])
+        model2, vars2 = ckpt_lib.load_model(path)
+        flat1 = jax.tree_util.tree_leaves(variables["params"])
+        flat2 = jax.tree_util.tree_leaves(vars2["params"])
+        assert sum(x.size for x in flat1) == sum(x.size for x in flat2)
+
+
+class TestFullCheckpoint:
+    def _state_and_step(self):
+        model = small_model()
+        opt = nn.SGD(lr=0.05, momentum=0.9)
+        state = create_train_state(model, opt, jax.random.PRNGKey(0), (8, 28, 28, 1))
+        step = make_train_step(model, opt, donate=False)
+        return model, opt, state, step
+
+    def test_save_restore_exact_resume(self, tmp_path):
+        model, opt, state, step = self._state_and_step()
+        rs = np.random.RandomState(0)
+        data = jnp.asarray(rs.randn(8, 28, 28, 1), jnp.bfloat16)
+        labels = jnp.asarray(rs.randint(0, 10, 8), jnp.int32)
+
+        state, _ = step(state, data, labels)
+        ckpt = ckpt_lib.Checkpoint(str(tmp_path / "ck"))
+        sched = nn.ReduceLROnPlateau(patience=0)
+        sched.observe(1.0)
+        sched.observe(2.0)  # triggers a cut -> non-default state
+        loader = SyntheticDataLoader(32, (28, 28, 1), 10)
+        loader.shuffle()
+        loader.get_batch(8)
+        ckpt.save(state, model=model, scheduler=sched, loader=loader,
+                  extra={"note": "e2e"})
+
+        # continue the "original" run one more step
+        state_cont, m_cont = step(state, data, labels)
+
+        # restore into a FRESH state and take the same step -> identical result
+        model2, opt2, fresh, step2 = self._state_and_step()
+        sched2 = nn.ReduceLROnPlateau(patience=0)
+        loader2 = SyntheticDataLoader(32, (28, 28, 1), 10)
+        restored, meta = ckpt.restore(fresh, scheduler=sched2, loader=loader2)
+        assert int(restored.step) == int(state.step)
+        assert sched2.current_scale() == sched.current_scale()
+        assert loader2.state_dict() == loader.state_dict()
+        assert meta["extra"]["note"] == "e2e"
+
+        state_re, m_re = step2(restored, data, labels)
+        np.testing.assert_allclose(float(m_re["loss"]), float(m_cont["loss"]),
+                                   rtol=1e-6)
+        for a, b in zip(jax.tree_util.tree_leaves(state_re.params),
+                        jax.tree_util.tree_leaves(state_cont.params)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_retention_and_best(self, tmp_path):
+        model, opt, state, step = self._state_and_step()
+        ckpt = ckpt_lib.Checkpoint(str(tmp_path / "ck"), keep=2)
+        for i in range(4):
+            state = state._replace(step=jnp.asarray(i, jnp.int32))
+            ckpt.save(state, model=model)
+        steps = sorted(ckpt._step_dirs())
+        assert steps == [2, 3]
+        ckpt.save(state, model=model, best=True)
+        assert os.path.isdir(os.path.join(str(tmp_path / "ck"), "best"))
+        assert ckpt.latest_path().endswith("step_3")

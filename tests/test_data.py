@@ -301,7 +301,7 @@ def test_pipeline_builder_and_config(batch):
 
 def test_pipeline_is_jittable(batch):
     pipe = tdata.cifar_train_pipeline()
-    out = jax.jit(pipe._apply)(jax.random.PRNGKey(0), batch)
+    out = jax.jit(pipe.apply)(jax.random.PRNGKey(0), batch)
     assert out.shape == batch.shape
 
 

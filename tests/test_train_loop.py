@@ -1,0 +1,158 @@
+"""Tests: full training loop (epochs, validation, checkpointing), grad accumulation,
+in-step augmentation, evaluate."""
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tnn_tpu import models, nn
+from tnn_tpu.data import SyntheticDataLoader, cifar_train_pipeline
+from tnn_tpu.train import (
+    create_train_state,
+    evaluate,
+    make_eval_step,
+    make_train_step,
+    train_model,
+)
+from tnn_tpu.utils import TrainingConfig
+
+
+def tiny_config(tmp_path, **kw):
+    base = dict(
+        epochs=2, batch_size=16, progress_print_interval=2,
+        model_name="mnist_cnn", snapshot_dir=str(tmp_path / "snaps"),
+        optimizer={"type": "sgd", "lr": 0.05, "momentum": 0.9},
+        io_dtype="float32")
+    base.update(kw)
+    return TrainingConfig().update(base)
+
+
+class TestTrainModel:
+    def test_loss_decreases_and_checkpoints(self, tmp_path):
+        cfg = tiny_config(tmp_path)
+        model = models.create(cfg.model_name)
+        train = SyntheticDataLoader(64, (28, 28, 1), 10, seed=0)
+        val = SyntheticDataLoader(32, (28, 28, 1), 10, seed=1)
+        state, history = train_model(model, cfg, train, val_loader=val)
+        assert len(history) == 2
+        assert int(state.step) == 2 * (64 // 16)
+        assert history[-1]["val_accuracy"] >= 0
+        # per-epoch + best checkpoints exist
+        assert os.path.isdir(os.path.join(cfg.snapshot_dir, "best"))
+        assert any(d.startswith("step_") for d in os.listdir(cfg.snapshot_dir))
+
+    def test_resume_continues_step_count(self, tmp_path):
+        cfg = tiny_config(tmp_path, epochs=1)
+        model = models.create(cfg.model_name)
+        train = SyntheticDataLoader(64, (28, 28, 1), 10, seed=0)
+        state1, _ = train_model(model, cfg, train)
+
+        cfg2 = tiny_config(tmp_path, epochs=1, resume=cfg.snapshot_dir)
+        state2, _ = train_model(model, cfg2, train)
+        assert int(state2.step) == int(state1.step) + 64 // 16
+
+    def test_mid_epoch_resume_continues_cursor(self, tmp_path):
+        # max_steps cuts epoch 0 after 2 of 4 batches; the checkpoint stores the
+        # mid-epoch cursor, and a resumed run continues from it without reshuffling.
+        cfg = tiny_config(tmp_path, epochs=1, max_steps=2)
+        model = models.create(cfg.model_name)
+        train = SyntheticDataLoader(64, (28, 28, 1), 10, seed=0)
+        train_model(model, cfg, train)
+        saved = train.state_dict()
+        assert saved["cursor"] == 2 * 16
+
+        train2 = SyntheticDataLoader(64, (28, 28, 1), 10, seed=0)
+        cfg2 = tiny_config(tmp_path, epochs=1, resume=cfg.snapshot_dir)
+        state2, history2 = train_model(model, cfg2, train2)
+        # continued epoch ran only the remaining 2 batches
+        assert history2[0]["batches"] == 2
+        assert int(state2.step) == 4
+
+    def test_loader_state_reproduces_order_without_storing_it(self):
+        a = SyntheticDataLoader(64, (4,), 10, seed=3)
+        a.shuffle()
+        a.get_batch(8)
+        sd = a.state_dict()
+        assert "order" not in sd  # permutation is NOT serialized
+        b = SyntheticDataLoader(64, (4,), 10, seed=3)
+        b._rng.standard_normal(5)  # desync the rng; load must restore it
+        b.load_state_dict(sd)
+        np.testing.assert_array_equal(a._order, b._order)
+        da, la = a.get_batch(8)
+        db, lb = b.get_batch(8)
+        np.testing.assert_array_equal(da, db)
+
+    def test_max_steps(self, tmp_path):
+        cfg = tiny_config(tmp_path, epochs=1, max_steps=2)
+        model = models.create(cfg.model_name)
+        train = SyntheticDataLoader(64, (28, 28, 1), 10, seed=0)
+        state, history = train_model(model, cfg, train)
+        assert int(state.step) == 2
+        assert history[0]["batches"] == 2
+
+    def test_plateau_scheduler_observes(self, tmp_path):
+        cfg = tiny_config(tmp_path, epochs=3,
+                          scheduler={"type": "reduce_on_plateau", "patience": 0,
+                                     "factor": 0.5})
+        model = models.create(cfg.model_name)
+        sched = cfg.make_scheduler()
+        train = SyntheticDataLoader(32, (28, 28, 1), 10, seed=0)
+        val = SyntheticDataLoader(32, (28, 28, 1), 10, seed=1)
+        train_model(model, cfg, train, val_loader=val, scheduler=sched)
+        # after 3 epochs of noisy val loss the plateau scheduler has state
+        assert sched.current_scale() <= 1.0
+
+
+class TestGradAccum:
+    def test_grad_accum_matches_full_batch_linear(self):
+        # On a pure-linear model (no BN), accumulating grads over microbatches
+        # must equal the full-batch gradient step. f32 policy: in bf16 one big
+        # matmul and four small ones round differently.
+        from tnn_tpu.core.dtypes import DTypePolicy
+
+        model = nn.Dense(4, activation=None,
+                         policy=DTypePolicy(io="float32", param="float32",
+                                            compute="float32"))
+        opt = nn.SGD(lr=0.1)
+        rng = jax.random.PRNGKey(0)
+        data = jax.random.normal(rng, (8, 6), jnp.float32)
+        labels = jax.random.randint(rng, (8,), 0, 4)
+
+        s1 = create_train_state(model, opt, rng, (8, 6))
+        s2 = create_train_state(model, opt, rng, (8, 6))
+        step_full = make_train_step(model, opt, donate=False)
+        step_accum = make_train_step(model, opt, donate=False, grad_accum=4)
+        s1, m1 = step_full(s1, data, labels)
+        s2, m2 = step_accum(s2, data, labels)
+        np.testing.assert_allclose(float(m1["loss"]), float(m2["loss"]), rtol=1e-5)
+        for a, b in zip(jax.tree_util.tree_leaves(s1.params),
+                        jax.tree_util.tree_leaves(s2.params)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-5, atol=1e-6)
+
+    def test_augment_in_step(self):
+        model = models.create("cifar10_resnet9")
+        opt = nn.SGD(lr=0.01)
+        rng = jax.random.PRNGKey(0)
+        pipe = cifar_train_pipeline()
+        step = make_train_step(model, opt, donate=False, augment=pipe.apply)
+        state = create_train_state(model, opt, rng, (4, 32, 32, 3))
+        data = jax.random.normal(rng, (4, 32, 32, 3), jnp.float32)
+        labels = jax.random.randint(rng, (4,), 0, 10)
+        state, m = step(state, data, labels)
+        assert np.isfinite(float(m["loss"]))
+
+
+class TestEvaluate:
+    def test_evaluate_aggregates(self):
+        model = models.create("mnist_cnn")
+        opt = nn.SGD(lr=0.01)
+        state = create_train_state(model, opt, jax.random.PRNGKey(0), (16, 28, 28, 1))
+        eval_fn = make_eval_step(model)
+        loader = SyntheticDataLoader(48, (28, 28, 1), 10, seed=2)
+        out = evaluate(eval_fn, state, loader, 16,
+                       TrainingConfig(io_dtype="float32"))
+        assert 0.0 <= out["accuracy"] <= 1.0
+        assert np.isfinite(out["loss"])

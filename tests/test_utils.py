@@ -1,0 +1,98 @@
+"""Tests: env loader, TrainingConfig, logger, hardware introspection."""
+import json
+import os
+
+import pytest
+
+from tnn_tpu.utils import (
+    Env,
+    TrainingConfig,
+    device_info,
+    get_logger,
+    load_env_file,
+    memory_usage_kb,
+)
+
+
+class TestEnv:
+    def test_env_file_parsing(self, tmp_path, monkeypatch):
+        envf = tmp_path / ".env"
+        envf.write_text(
+            "# comment\n"
+            "EPOCHS=5\n"
+            "NAME = hello world  # inline comment\n"
+            'QUOTED="keep # this"\n'
+            "BAD KEY=skip\n"
+            "\n"
+            "FLOATY=0.25\n")
+        parsed = load_env_file(str(envf), export=False)
+        assert parsed == {"EPOCHS": "5", "NAME": "hello world",
+                          "QUOTED": "keep # this", "FLOATY": "0.25"}
+
+    def test_env_file_exports(self, tmp_path, monkeypatch):
+        envf = tmp_path / ".env"
+        envf.write_text("TNN_TEST_EXPORT_KEY=42\n")
+        monkeypatch.delenv("TNN_TEST_EXPORT_KEY", raising=False)
+        load_env_file(str(envf))
+        assert os.environ["TNN_TEST_EXPORT_KEY"] == "42"
+        monkeypatch.delenv("TNN_TEST_EXPORT_KEY")
+
+    def test_missing_file_is_empty(self, tmp_path):
+        assert load_env_file(str(tmp_path / "nope.env")) == {}
+
+    def test_typed_get(self, monkeypatch):
+        monkeypatch.setenv("TNN_T_INT", "7")
+        monkeypatch.setenv("TNN_T_BOOL", "true")
+        monkeypatch.setenv("TNN_T_BAD", "xyz")
+        assert Env.get("TNN_T_INT", 1) == 7
+        assert Env.get("TNN_T_BOOL", False) is True
+        assert Env.get("TNN_T_BAD", 3) == 3  # unparseable -> default
+        assert Env.get("TNN_T_UNSET", "d") == "d"
+
+
+class TestTrainingConfig:
+    def test_defaults_and_env_overlay(self, monkeypatch):
+        monkeypatch.setenv("EPOCHS", "3")
+        monkeypatch.setenv("BATCH_SIZE", "64")
+        monkeypatch.setenv("MODEL_NAME", "mnist_cnn")
+        cfg = TrainingConfig().load_from_env()
+        assert cfg.epochs == 3 and cfg.batch_size == 64
+        assert cfg.model_name == "mnist_cnn"
+
+    def test_json_overlay_and_unknown_key(self, tmp_path):
+        p = tmp_path / "cfg.json"
+        p.write_text(json.dumps({"epochs": 2, "optimizer": {"type": "adam", "lr": 0.01}}))
+        cfg = TrainingConfig().load_from_json(str(p))
+        assert cfg.epochs == 2
+        opt = cfg.make_optimizer()
+        assert opt.opt_name == "adam" and opt.lr == 0.01
+
+        p.write_text(json.dumps({"eppochs": 2}))
+        with pytest.raises(KeyError):
+            TrainingConfig().load_from_json(str(p))
+
+    def test_factories(self):
+        cfg = TrainingConfig(optimizer={"type": "sgd", "lr": 0.1, "momentum": 0.9},
+                             scheduler={"type": "cosine", "t_max": 100})
+        assert cfg.make_optimizer().momentum == 0.9
+        assert cfg.make_scheduler().sched_name == "cosine"
+        assert cfg.make_scheduler().get_config()["t_max"] == 100
+        assert TrainingConfig().make_scheduler().sched_name == "noop"
+
+    def test_round_trip(self):
+        cfg = TrainingConfig(epochs=7)
+        cfg2 = TrainingConfig().update(json.loads(cfg.to_json()))
+        assert cfg2.epochs == 7
+
+
+class TestLoggerHardware:
+    def test_logger_file_sink(self, tmp_path):
+        log = get_logger("tnn.test_sink", log_file=str(tmp_path / "x.log"))
+        log.info("hello %d", 42)
+        text = (tmp_path / "x.log").read_text()
+        assert "hello 42" in text
+
+    def test_memory_and_devices(self):
+        assert memory_usage_kb() > 0
+        info = device_info()
+        assert info and "platform" in info[0]

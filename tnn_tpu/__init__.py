@@ -12,6 +12,7 @@ XLA collectives instead of hand-rolled TCP/RDMA byte transports.
 __version__ = "0.1.0"
 
 from . import nn  # noqa: F401  — importing registers every built-in layer type
+from . import checkpoint, utils  # noqa: F401
 from .core import dtypes
 from .core.dtypes import DTypePolicy
 from .core.module import (

@@ -1,0 +1,269 @@
+"""Checkpointing: model save/load and full training-state snapshots.
+
+Parity-and-beyond with the reference's checkpoint path (SURVEY.md §3.5):
+  * reference ``Graph::save_state`` writes architecture JSON + raw param blobs
+    (include/nn/graph.hpp:119-126, include/tensor/tensor.hpp:585-606); ``load_state``
+    rebuilds via the LayerFactory then loads blobs (graph.hpp:172-183). ``save_model``/
+    ``load_model`` here are the equivalent single-file format: JSON header (module
+    config via the registry round-trip) + named raw tensors.
+  * the reference does NOT checkpoint optimizer state or dataloader position
+    (SURVEY.md §5); ``Checkpoint.save``/``resume`` snapshots params + optimizer
+    moments + net state (BatchNorm stats) + step + rng + scheduler + loader cursor,
+    so resume is bit-exact, not approximate.
+
+Binary layout of a ``.tnn`` tensor file:
+  magic ``TNNTPU1\\n`` | u64 header_len | header JSON | concatenated raw tensor bytes.
+  Header: {"tensors": [{"key", "dtype", "shape", "offset", "nbytes"}...], "meta": {...}}.
+  Tensors are keyed by pytree path, so loading is template-shaped: the caller supplies a
+  tree of the right structure (fresh ``init``) and leaves are replaced by key.
+"""
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import struct
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import numpy as np
+
+_MAGIC = b"TNNTPU1\n"
+
+
+def _np_dtype(name: str):
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes
+
+        return np.dtype(getattr(ml_dtypes, name))
+
+
+def _flatten_with_keys(tree) -> Dict[str, Any]:
+    from .core.module import tree_paths
+
+    return tree_paths(tree)
+
+
+# ---------------------------------------------------------------------------
+# Tensor-file primitives
+# ---------------------------------------------------------------------------
+
+
+def save_tensors(path: str, trees: Dict[str, Any], meta: Optional[Dict] = None) -> None:
+    """Write named pytrees of arrays to one binary file. ``trees`` maps a section name
+    ("params", "opt_state", ...) to a pytree; keys become "section/leaf/path"."""
+    entries = []
+    blobs = []
+    offset = 0
+    for section, tree in trees.items():
+        for key, leaf in _flatten_with_keys(tree).items():
+            arr = np.asarray(leaf)
+            raw = arr.tobytes()
+            full_key = f"{section}/{key}" if key else section
+            entries.append({"key": full_key, "dtype": str(arr.dtype),
+                            "shape": list(arr.shape), "offset": offset,
+                            "nbytes": len(raw)})
+            blobs.append(raw)
+            offset += len(raw)
+    header = json.dumps({"tensors": entries, "meta": meta or {}}).encode()
+    tmp = path + ".tmp"
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    with open(tmp, "wb") as f:
+        f.write(_MAGIC)
+        f.write(struct.pack("<Q", len(header)))
+        f.write(header)
+        for raw in blobs:
+            f.write(raw)
+    os.replace(tmp, path)  # atomic: no torn checkpoints on crash
+
+
+def read_tensor_file(path: str) -> Tuple[Dict[str, np.ndarray], Dict]:
+    """Read back {full_key: array}, meta."""
+    with open(path, "rb") as f:
+        if f.read(len(_MAGIC)) != _MAGIC:
+            raise ValueError(f"{path}: not a TNNTPU tensor file")
+        (hlen,) = struct.unpack("<Q", f.read(8))
+        header = json.loads(f.read(hlen))
+        base = f.tell()
+        out = {}
+        for e in header["tensors"]:
+            f.seek(base + e["offset"])
+            raw = f.read(e["nbytes"])
+            arr = np.frombuffer(raw, dtype=_np_dtype(e["dtype"])).reshape(e["shape"])
+            out[e["key"]] = arr
+    return out, header.get("meta", {})
+
+
+def load_tensors(path: str, templates: Dict[str, Any]) -> Tuple[Dict[str, Any], Dict]:
+    """Load sections into template-shaped pytrees (keys must match exactly —
+    a structural mismatch is an error, not a silent partial load)."""
+    flat, meta = read_tensor_file(path)
+    out = {}
+    for section, template in templates.items():
+        leaves_with_path, treedef = jax.tree_util.tree_flatten_with_path(template)
+        tmpl_keys = _flatten_with_keys(template)
+        want = {f"{section}/{k}" if k else section for k in tmpl_keys}
+        have = {k for k in flat if k == section or k.startswith(section + "/")}
+        if want != have:
+            missing, surplus = sorted(want - have), sorted(have - want)
+            raise KeyError(f"checkpoint section {section!r} mismatch: "
+                           f"missing={missing[:5]} surplus={surplus[:5]}")
+        new_leaves = []
+        for (pathk, leaf), key in zip(leaves_with_path, tmpl_keys):
+            full = f"{section}/{key}" if key else section
+            arr = flat[full]
+            if tuple(arr.shape) != tuple(np.shape(leaf)):
+                raise ValueError(f"{full}: shape {arr.shape} != template {np.shape(leaf)}")
+            new_leaves.append(arr)
+        out[section] = jax.tree_util.tree_unflatten(treedef, new_leaves)
+    return out, meta
+
+
+# ---------------------------------------------------------------------------
+# Model save/load (parity: Graph::save_state / load_state)
+# ---------------------------------------------------------------------------
+
+
+def save_model(path: str, model, params, net_state=None) -> None:
+    """Single-file model snapshot: module config + params (+ BatchNorm stats)."""
+    trees = {"params": params}
+    if net_state:
+        trees["net_state"] = net_state
+    save_tensors(path, trees, meta={"model_config": model.get_config()})
+
+
+def load_model(path: str, rng: Optional[jax.Array] = None,
+               input_shape=None) -> Tuple[Any, Dict[str, Any]]:
+    """Rebuild the module from its stored config (registry round-trip, parity:
+    Graph::create_from_config) and return ``(model, variables)``.
+
+    The stored arrays are loaded positionally-by-path into a template built from a
+    fresh ``model.init`` when ``input_shape`` is given; otherwise arrays are returned
+    in a path-keyed dict nested by '/' (no template needed).
+    """
+    from .core.module import module_from_config
+
+    flat, meta = read_tensor_file(path)
+    model = module_from_config(meta["model_config"])
+    if input_shape is not None:
+        variables = model.init(rng if rng is not None else jax.random.PRNGKey(0),
+                               input_shape)
+        templates = {"params": variables["params"]}
+        if any(k.startswith("net_state/") for k in flat):
+            templates["net_state"] = variables["state"]
+        loaded, _ = load_tensors(path, templates)
+        return model, {"params": loaded["params"],
+                       "state": loaded.get("net_state", variables["state"])}
+    # no template: reconstruct nested dicts from the path keys
+    nested: Dict[str, Any] = {}
+    for key, arr in flat.items():
+        parts = key.split("/")
+        d = nested
+        for p in parts[:-1]:
+            d = d.setdefault(p, {})
+        d[parts[-1]] = arr
+    return model, {"params": nested.get("params", {}),
+                   "state": nested.get("net_state", {})}
+
+
+# ---------------------------------------------------------------------------
+# Full training-state checkpoints (exceeds reference)
+# ---------------------------------------------------------------------------
+
+
+class Checkpoint:
+    """Directory checkpoints of the FULL training state with retention.
+
+    Layout: ``<dir>/step_<N>/state.tnn`` + ``meta.json``; ``<dir>/best/`` mirrors the
+    best-validation snapshot (parity: best-val save in src/nn/train.cpp:242-255).
+    """
+
+    def __init__(self, directory: str, keep: int = 3):
+        self.directory = directory
+        self.keep = int(keep)
+
+    # -- write ---------------------------------------------------------------
+
+    def save(self, train_state, model=None, scheduler=None, loader=None,
+             extra: Optional[Dict] = None, best: bool = False) -> str:
+        from .train.step import TrainState
+
+        assert isinstance(train_state, TrainState)
+        step = int(train_state.step)
+        name = "best" if best else f"step_{step}"
+        target = os.path.join(self.directory, name)
+        meta: Dict[str, Any] = {"step": step, "extra": extra or {}}
+        if model is not None:
+            meta["model_config"] = model.get_config()
+        if scheduler is not None:
+            meta["scheduler"] = {"config": scheduler.get_config(),
+                                 "state": getattr(scheduler, "state_dict", dict)()}
+        if loader is not None:
+            meta["loader"] = loader.state_dict()
+        os.makedirs(target, exist_ok=True)
+        save_tensors(os.path.join(target, "state.tnn"), {
+            "params": train_state.params,
+            "opt_state": train_state.opt_state,
+            "net_state": train_state.net_state,
+            "step": train_state.step,
+            "rng": train_state.rng,
+        }, meta=meta)
+        with open(os.path.join(target, "meta.json"), "w") as f:
+            json.dump(meta, f, indent=2, default=str)
+        if not best:
+            self._gc()
+        return target
+
+    def _gc(self):
+        steps = sorted(self._step_dirs())
+        for s in steps[: max(0, len(steps) - self.keep)]:
+            shutil.rmtree(os.path.join(self.directory, f"step_{s}"), ignore_errors=True)
+
+    def _step_dirs(self):
+        if not os.path.isdir(self.directory):
+            return []
+        out = []
+        for d in os.listdir(self.directory):
+            if d.startswith("step_"):
+                try:
+                    out.append(int(d[5:]))
+                except ValueError:
+                    pass
+        return out
+
+    # -- read ----------------------------------------------------------------
+
+    def latest_path(self) -> Optional[str]:
+        steps = self._step_dirs()
+        if not steps:
+            return None
+        return os.path.join(self.directory, f"step_{max(steps)}")
+
+    def restore(self, train_state, path: Optional[str] = None,
+                scheduler=None, loader=None):
+        """Restore into a template TrainState (fresh ``create_train_state``). Returns
+        ``(train_state, meta)``; also rehydrates scheduler/loader in place."""
+        path = path or self.latest_path()
+        if path is None:
+            raise FileNotFoundError(f"no checkpoints under {self.directory}")
+        loaded, meta = load_tensors(os.path.join(path, "state.tnn"), {
+            "params": train_state.params,
+            "opt_state": train_state.opt_state,
+            "net_state": train_state.net_state,
+            "step": train_state.step,
+            "rng": train_state.rng,
+        })
+        new_state = train_state._replace(
+            params=loaded["params"], opt_state=loaded["opt_state"],
+            net_state=loaded["net_state"],
+            step=jax.numpy.asarray(loaded["step"]),
+            rng=jax.numpy.asarray(loaded["rng"]))
+        if scheduler is not None and "scheduler" in meta:
+            sd = meta["scheduler"].get("state") or {}
+            if hasattr(scheduler, "load_state_dict"):
+                scheduler.load_state_dict(sd)
+        if loader is not None and "loader" in meta:
+            loader.load_state_dict(meta["loader"])
+        return new_state, meta

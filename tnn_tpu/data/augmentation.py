@@ -241,9 +241,11 @@ class AugmentationPipeline:
 
     def __init__(self, ops: Sequence[Augmentation]):
         self.ops = list(ops)
-        self._jitted = jax.jit(self._apply)
+        self._jitted = jax.jit(self.apply)
 
-    def _apply(self, rng: Array, batch: Array) -> Array:
+    def apply(self, rng: Array, batch: Array) -> Array:
+        """Unjitted transform — pass this to make_train_step(augment=...) so the
+        augmentation fuses into the compiled train step."""
         keys = jax.random.split(rng, max(len(self.ops), 1))
         for op, k in zip(self.ops, keys):
             batch = op.apply(k, batch)

@@ -58,12 +58,18 @@ class DataLoader:
 
     def shuffle(self) -> None:
         self._shuffled = True
-        self._order = self._rng.permutation(self._num_samples)
+        self._draw_order()
 
     def reset(self) -> None:
         self._cursor = 0
         if self._shuffled:
-            self._order = self._rng.permutation(self._num_samples)
+            self._draw_order()
+
+    def _draw_order(self) -> None:
+        # remember the rng state the permutation was drawn from, so state_dict can
+        # reproduce the order without serializing the whole permutation
+        self._pre_draw_rng = self._rng.bit_generator.state
+        self._order = self._rng.permutation(self._num_samples)
 
     def get_batch(self, batch_size: int) -> Optional[Tuple[np.ndarray, np.ndarray]]:
         """Next (data, labels) batch or None at epoch end (parity: get_batch returning
@@ -81,11 +87,13 @@ class DataLoader:
 
     # -- iteration -----------------------------------------------------------
 
-    def batches(self, batch_size: int,
-                drop_remainder: bool = True) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
+    def batches(self, batch_size: int, drop_remainder: bool = True,
+                reset: bool = True) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
         """One epoch of batches. Remainder batches are dropped by default: variable
-        tail shapes would recompile the jitted step (SURVEY.md §7 hard part 3)."""
-        self.reset()
+        tail shapes would recompile the jitted step (SURVEY.md §7 hard part 3).
+        ``reset=False`` continues from the current cursor (checkpoint resume)."""
+        if reset:
+            self.reset()
         while True:
             b = self.get_batch(batch_size)
             if b is None:
@@ -102,6 +110,34 @@ class DataLoader:
 
     def steps_per_epoch(self, batch_size: int) -> int:
         return self._num_samples // batch_size
+
+    def remaining_batches(self, batch_size: int) -> int:
+        """Complete batches left before the cursor exhausts the epoch."""
+        return max(0, (self._num_samples - self._cursor)) // batch_size
+
+    # -- checkpointable iteration state (exceeds reference: resume restarts the
+    # reference's loaders from scratch; here dataset position survives restarts) ----
+
+    def state_dict(self) -> dict:
+        # The permutation itself is not serialized (it can be millions of ints);
+        # instead we save the rng state it was drawn from and redraw on load.
+        return {
+            "cursor": int(self._cursor),
+            "shuffled": bool(self._shuffled),
+            "has_order": self._order is not None,
+            "pre_draw_rng": getattr(self, "_pre_draw_rng", None),
+            "rng": self._rng.bit_generator.state,
+        }
+
+    def load_state_dict(self, state: dict) -> None:
+        self._shuffled = bool(state["shuffled"])
+        if state.get("has_order") and state.get("pre_draw_rng") is not None:
+            self._rng.bit_generator.state = state["pre_draw_rng"]
+            self._draw_order()  # advances rng to exactly the saved "rng" state
+        else:
+            self._order = None
+        self._rng.bit_generator.state = state["rng"]
+        self._cursor = int(state["cursor"])
 
 
 class ArrayDataLoader(DataLoader):

@@ -57,6 +57,14 @@ class Scheduler:
     def _config(self):
         return {}
 
+    # -- checkpointable host-side state (stateless schedulers: empty) ---------
+
+    def state_dict(self) -> Dict[str, Any]:
+        return {}
+
+    def load_state_dict(self, state: Dict[str, Any]) -> None:
+        del state
+
 
 @register("noop")
 class NoOp(Scheduler):
@@ -251,3 +259,11 @@ class ReduceLROnPlateau(Scheduler):
     def _config(self):
         return {"factor": self.factor, "patience": self.patience, "mode": self.mode,
                 "min_scale": self.min_scale, "threshold": self.threshold}
+
+    def state_dict(self):
+        return {"best": self._best, "bad": self._bad, "scale": self._scale}
+
+    def load_state_dict(self, state):
+        self._best = state.get("best")
+        self._bad = int(state.get("bad", 0))
+        self._scale = float(state.get("scale", 1.0))

@@ -1,3 +1,5 @@
+from .loop import evaluate, train_model
 from .step import TrainState, create_train_state, make_eval_step, make_predict, make_train_step
 
-__all__ = ["TrainState", "create_train_state", "make_eval_step", "make_predict", "make_train_step"]
+__all__ = ["TrainState", "create_train_state", "make_eval_step", "make_predict",
+           "make_train_step", "train_model", "evaluate"]

@@ -1,0 +1,189 @@
+"""The full training loop: epochs, validation, checkpointing, metrics.
+
+Parity: reference ``train_model`` (src/nn/train.cpp:367) -> ``train_val`` (:219) /
+``train_step`` (:274) -> ``train_epoch`` (:129): per-batch forward/loss/backward/update,
+progress prints every N batches with loss/accuracy/ms-per-batch, per-epoch validation
+(``validate_model`` :388), best-validation checkpointing to ``model_snapshots/``
+(:242-255), RSS memory prints (:269).
+
+TPU-first differences: the per-batch body is ONE compiled XLA program (make_train_step);
+batches stream through a background prefetcher that overlaps host assembly + H2D with
+device compute; checkpoints capture optimizer/scheduler/loader state so resume is exact
+(the reference restarts moments and data order — SURVEY.md §5).
+"""
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import jax
+import numpy as np
+
+from ..checkpoint import Checkpoint
+from ..data.loader import DataLoader, prefetch
+from ..utils.config import TrainingConfig
+from ..utils.hardware import memory_usage_kb
+from ..utils.logging import get_logger
+from .step import TrainState, create_train_state, make_eval_step, make_train_step
+
+
+def _staged_batches(loader: DataLoader, batch_size: int, config: TrainingConfig,
+                    reset: bool = True, limit: int = -1):
+    """io-dtype cast on the producer thread + async device_put, so both the cast and
+    the H2D transfer overlap device compute (prefetch's to_device staging).
+
+    ``limit`` bounds the number of batches at the SOURCE (not a consumer-side break):
+    the prefetch producer must not advance the loader cursor past what the step loop
+    consumes, or mid-epoch checkpoints would record an overshot dataset position.
+    """
+    import itertools
+
+    import jax.numpy as jnp
+
+    io_dtype = jnp.dtype(config.io_dtype)
+
+    def gen():
+        it = loader.batches(batch_size, reset=reset)
+        if limit >= 0:
+            it = itertools.islice(it, limit)
+        for data, labels in it:
+            if np.issubdtype(data.dtype, np.floating):
+                data = data.astype(io_dtype)
+            yield data, labels
+
+    return prefetch(gen(), to_device=True)
+
+
+def evaluate(eval_step, state: TrainState, loader: DataLoader, batch_size: int,
+             config: Optional[TrainingConfig] = None) -> Dict[str, float]:
+    """Full-dataset validation (parity: validate_model, src/nn/train.cpp:388) —
+    aggregates corrects/loss over all complete batches."""
+    total, corrects, loss_sum, batches = 0, 0.0, 0.0, 0
+    cfg = config or TrainingConfig()
+    for data, labels in _staged_batches(loader, batch_size, cfg):
+        m = eval_step(state, data, labels)
+        loss_sum += float(m["loss"])
+        if "corrects" in m:
+            corrects += float(m["corrects"])
+        total += len(labels)
+        batches += 1
+    out = {"loss": loss_sum / max(batches, 1)}
+    if total:
+        out["accuracy"] = corrects / total
+    return out
+
+
+def train_model(
+    model,
+    config: TrainingConfig,
+    train_loader: DataLoader,
+    val_loader: Optional[DataLoader] = None,
+    optimizer=None,
+    scheduler=None,
+    augment: Optional[Callable] = None,
+    state: Optional[TrainState] = None,
+    metric_hook: Optional[Callable[[int, Dict[str, Any]], None]] = None,
+) -> Tuple[TrainState, List[Dict[str, Any]]]:
+    """Train ``model`` per ``config``; returns (final_state, per-epoch history).
+
+    The reference equivalent is train_model (src/nn/train.cpp:367) driving
+    train_epoch/validate_model with best-val snapshots.
+    """
+    log = get_logger("tnn.train", log_file=config.log_file or None)
+    optimizer = optimizer or config.make_optimizer()
+    scheduler = scheduler or config.make_scheduler()
+    plateau = getattr(scheduler, "host_driven", False)
+
+    batch_size = int(config.batch_size)
+    sample_shape = tuple(train_loader.data_shape)
+    input_shape = (batch_size,) + sample_shape
+    rng = jax.random.PRNGKey(config.seed)
+    if state is None:
+        state = create_train_state(model, optimizer, rng, input_shape)
+
+    ckpt = Checkpoint(config.snapshot_dir)
+    best_val = -float("inf")
+    resumed = False
+    if config.resume:
+        state, meta = Checkpoint(config.resume).restore(
+            state, scheduler=scheduler, loader=train_loader)
+        best_val = float(meta.get("extra", {}).get("best_val", -float("inf")))
+        resumed = True
+        log.info("resumed from %s at step %d", config.resume, int(state.step))
+
+    step_fn = make_train_step(
+        model, optimizer, loss_fn=config.loss, scheduler=scheduler,
+        grad_accum=config.gradient_accumulation_steps, augment=augment)
+    eval_fn = make_eval_step(model, loss_fn=config.loss)
+
+    history: List[Dict[str, Any]] = []
+    if config.shuffle and not resumed:
+        train_loader.shuffle()
+
+    for epoch in range(int(config.epochs)):
+        t_epoch = time.perf_counter()
+        window_t0 = time.perf_counter()
+        n_batches = 0
+        m: Dict[str, Any] = {}
+
+        # a resumed first epoch continues mid-epoch from the restored cursor/order
+        # (an end-of-epoch checkpoint has no batches left -> start a fresh epoch)
+        continue_epoch = (resumed and epoch == 0
+                          and train_loader.remaining_batches(batch_size) > 0)
+        for data, labels in _staged_batches(train_loader, batch_size, config,
+                                            reset=not continue_epoch,
+                                            limit=config.max_steps):
+            state, m = step_fn(state, data, labels)
+            n_batches += 1
+            # async: pull metrics only at print interval so the device never waits
+            if n_batches % max(1, config.progress_print_interval) == 0:
+                loss = float(m["loss"])
+                acc = float(m.get("accuracy", 0.0))
+                dt_batch = (time.perf_counter() - window_t0) * 1e3 / max(
+                    1, config.progress_print_interval)
+                window_t0 = time.perf_counter()
+                log.info(
+                    "epoch %d batch %d: loss=%.4f acc=%.4f %.1f ms/batch (%.0f samples/s)",
+                    epoch, n_batches, loss, acc, dt_batch,
+                    batch_size * 1e3 / max(dt_batch, 1e-9))
+                if config.print_memory_usage:
+                    log.info("host RSS: %.1f MiB", memory_usage_kb() / 1024)
+                if metric_hook:
+                    metric_hook(int(state.step),
+                                {"loss": loss, "accuracy": acc, "epoch": epoch})
+
+        # final metric of the epoch (forces one sync)
+        epoch_metrics: Dict[str, Any] = {
+            "epoch": epoch,
+            "train_loss": float(m["loss"]) if n_batches else float("nan"),
+            "train_accuracy": float(m.get("accuracy", 0.0)) if n_batches else 0.0,
+            "batches": n_batches,
+            "epoch_seconds": time.perf_counter() - t_epoch,
+        }
+
+        if val_loader is not None:
+            val = evaluate(eval_fn, state, val_loader, batch_size, config)
+            epoch_metrics["val_loss"] = val["loss"]
+            epoch_metrics["val_accuracy"] = val.get("accuracy", 0.0)
+            if plateau:
+                scheduler.observe(val["loss"])
+            score = val.get("accuracy", -val["loss"])
+            if score > best_val:
+                best_val = score
+                path = ckpt.save(state, model=model, scheduler=scheduler,
+                                 loader=train_loader,
+                                 extra={"epoch": epoch, **val}, best=True)
+                log.info("new best val %.4f -> %s", score, path)
+
+        ckpt.save(state, model=model, scheduler=scheduler, loader=train_loader,
+                  extra={**epoch_metrics, "best_val": best_val})
+        log.info(
+            "epoch %d done in %.1fs: train loss=%.4f acc=%.4f%s", epoch,
+            epoch_metrics["epoch_seconds"], epoch_metrics["train_loss"],
+            epoch_metrics["train_accuracy"],
+            (f" | val loss={epoch_metrics['val_loss']:.4f} "
+             f"acc={epoch_metrics.get('val_accuracy', 0):.4f}")
+            if val_loader is not None else "")
+        history.append(epoch_metrics)
+
+    return state, history

@@ -55,35 +55,83 @@ def make_train_step(
     scheduler: Optional[Scheduler] = None,
     compute_accuracy: bool = True,
     donate: bool = True,
+    grad_accum: int = 1,
+    augment: Optional[Callable] = None,
 ) -> Callable[[TrainState, jax.Array, jax.Array], Tuple[TrainState, Dict[str, jax.Array]]]:
     """Build a jitted (state, data, labels) -> (state, metrics) step.
 
     The scheduler's scale is traced from the step counter, so LR schedules do not
     retrigger compilation.
+
+    ``grad_accum`` > 1 splits the batch into that many microbatches inside the compiled
+    program (lax.scan), averaging grads before ONE optimizer update — the single-process
+    analog of the reference's microbatch gradient accumulation
+    (gradient_accumulation_steps, src/nn/train.cpp:176-199), with peak activation
+    memory divided by the accumulation factor.
+
+    ``augment`` is an on-device ``(rng, data) -> data`` transform (an
+    AugmentationPipeline.apply); fusing it into the step keeps augmentation off the
+    host (the reference runs augmentation on CPU inside the loader).
     """
     if isinstance(loss_fn, str):
         loss_fn = losses_lib.get(loss_fn)
     scheduler = scheduler or NoOp()
     host_driven = getattr(scheduler, "host_driven", False)
+    grad_accum = int(grad_accum)
+
+    def compute_loss(params, net_state, data, labels, sub):
+        out, new_net_state = model.apply(
+            {"params": params, "state": net_state}, data, train=True, rng=sub)
+        loss = loss_fn(out, labels)
+        return loss, (out, new_net_state)
 
     def step(state: TrainState, data, labels, lr_scale):
-        rng, sub = jax.random.split(state.rng)
+        rng, aug_rng, sub = jax.random.split(state.rng, 3)
+        if augment is not None:
+            data = augment(aug_rng, data)
 
-        def compute_loss(params):
-            out, new_net_state = model.apply(
-                {"params": params, "state": state.net_state}, data, train=True, rng=sub)
-            loss = loss_fn(out, labels)
-            return loss, (out, new_net_state)
+        grad_fn = jax.value_and_grad(compute_loss, has_aux=True)
+        if grad_accum == 1:
+            (loss, (out, new_net_state)), grads = grad_fn(
+                state.params, state.net_state, data, labels, sub)
+            acc = metrics_lib.accuracy(out, labels) if compute_accuracy else None
+        else:
+            if data.shape[0] % grad_accum:
+                raise ValueError(
+                    f"batch size {data.shape[0]} not divisible by "
+                    f"grad_accum {grad_accum}")
+            n = data.shape[0] // grad_accum
+            mb_data = data.reshape((grad_accum, n) + data.shape[1:])
+            mb_labels = labels.reshape((grad_accum, n) + labels.shape[1:])
+            subkeys = jax.random.split(sub, grad_accum)
 
-        (loss, (out, new_net_state)), grads = jax.value_and_grad(
-            compute_loss, has_aux=True)(state.params)
+            def mb_step(carry, mb):
+                grads_acc, net_state, loss_acc, acc_acc = carry
+                d, l, k = mb
+                (loss, (out, net_state)), grads = grad_fn(
+                    state.params, net_state, d, l, k)
+                grads_acc = jax.tree_util.tree_map(jnp.add, grads_acc, grads)
+                acc_inc = (metrics_lib.accuracy(out, l)
+                           if compute_accuracy else jnp.zeros((), jnp.float32))
+                return (grads_acc, net_state, loss_acc + loss, acc_acc + acc_inc), None
+
+            zeros = jax.tree_util.tree_map(
+                lambda p: jnp.zeros(p.shape, jnp.float32), state.params)
+            init = (zeros, state.net_state, jnp.zeros((), jnp.float32),
+                    jnp.zeros((), jnp.float32))
+            (grads, new_net_state, loss, acc), _ = jax.lax.scan(
+                mb_step, init, (mb_data, mb_labels, subkeys))
+            inv = 1.0 / grad_accum
+            grads = jax.tree_util.tree_map(lambda g: g * inv, grads)
+            loss, acc = loss * inv, acc * inv
+
         if not host_driven:
             lr_scale = scheduler.scale(state.step)
         new_params, new_opt_state = optimizer.update(
             grads, state.opt_state, state.params, lr_scale=lr_scale)
         metrics = {"loss": loss, "lr_scale": lr_scale}
         if compute_accuracy:
-            metrics["accuracy"] = metrics_lib.accuracy(out, labels)
+            metrics["accuracy"] = acc
         new_state = TrainState(new_params, new_opt_state, new_net_state, state.step + 1, rng)
         return new_state, metrics
 

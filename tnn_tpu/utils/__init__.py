@@ -1,0 +1,22 @@
+"""Utilities: env/config loading, logging, hardware introspection.
+
+Parity targets (capabilities, not designs): ``Env`` typed getter + ``.env`` loader
+(include/utils/env.hpp:14), ``TrainingConfig`` (include/nn/train.hpp:45-73),
+spdlog ``Logger`` (include/logging/logger.hpp:16), ``HardwareInfo``
+(include/utils/hardware_info.hpp:126) and RSS query (include/utils/memory.hpp).
+"""
+from .env import Env, load_env_file
+from .config import TrainingConfig
+from .logging import Logger, get_logger
+from .hardware import device_info, hbm_stats, memory_usage_kb
+
+__all__ = [
+    "Env",
+    "load_env_file",
+    "TrainingConfig",
+    "Logger",
+    "get_logger",
+    "device_info",
+    "hbm_stats",
+    "memory_usage_kb",
+]

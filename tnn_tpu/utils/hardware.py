@@ -1,0 +1,66 @@
+"""Hardware introspection: host memory, accelerator inventory, HBM stats.
+
+Parity: ``HardwareInfo`` CPU topology (include/utils/hardware_info.hpp:126) and
+``get_memory_usage_kb`` RSS query (include/utils/memory.hpp, used src/nn/train.cpp:269).
+On TPU the interesting inventory is the device list + per-device HBM, which PJRT
+exposes via ``device.memory_stats()``.
+"""
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, List
+
+
+def memory_usage_kb() -> int:
+    """Current process RSS in KiB (parity: get_memory_usage_kb)."""
+    try:
+        with open("/proc/self/status", "r") as f:
+            for line in f:
+                if line.startswith("VmRSS:"):
+                    return int(line.split()[1])
+    except OSError:
+        pass
+    return 0
+
+
+def cpu_info() -> Dict[str, Any]:
+    """Host CPU summary (capability parity with HardwareInfo's topology report)."""
+    info: Dict[str, Any] = {"logical_cores": os.cpu_count() or 0}
+    try:
+        with open("/proc/cpuinfo", "r") as f:
+            for line in f:
+                if line.startswith("model name"):
+                    info["model"] = line.partition(":")[2].strip()
+                    break
+    except OSError:
+        pass
+    return info
+
+
+def device_info() -> List[Dict[str, Any]]:
+    """Accelerator inventory (parity: DeviceManager discovery,
+    include/device/device_manager.hpp:16)."""
+    import jax
+
+    out = []
+    for d in jax.devices():
+        out.append({
+            "id": d.id,
+            "platform": d.platform,
+            "kind": getattr(d, "device_kind", "unknown"),
+            "process_index": d.process_index,
+        })
+    return out
+
+
+def hbm_stats(device=None) -> Dict[str, int]:
+    """Per-device HBM usage in bytes, when the PJRT backend reports it."""
+    import jax
+
+    d = device or jax.devices()[0]
+    try:
+        stats = d.memory_stats() or {}
+    except Exception:
+        return {}
+    return {k: int(v) for k, v in stats.items()
+            if k in ("bytes_in_use", "peak_bytes_in_use", "bytes_limit")}

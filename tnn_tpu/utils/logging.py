@@ -1,0 +1,80 @@
+"""Console + file logger.
+
+Parity: the reference's spdlog wrapper ``Logger`` (include/logging/logger.hpp:16) —
+console and file sinks, level filtering, a process-global instance, and named
+sub-loggers (the profiler writes to ``logs/profiler.log`` via the same facility).
+Built on stdlib logging so it composes with absl/jax logging.
+"""
+from __future__ import annotations
+
+import logging
+import os
+import sys
+import time
+from typing import Dict, Optional
+
+_FORMAT = "%(asctime)s.%(msecs)03d [%(levelname)s] %(name)s: %(message)s"
+_DATEFMT = "%H:%M:%S"
+
+_loggers: Dict[str, "Logger"] = {}
+
+
+class Logger:
+    """Thin veneer over ``logging.Logger`` adding a file-sink helper and timers."""
+
+    def __init__(self, name: str = "tnn", level: str = "info",
+                 log_file: Optional[str] = None):
+        self._log = logging.getLogger(name)
+        self._log.propagate = False
+        self.set_level(level)
+        if not self._log.handlers:
+            console = logging.StreamHandler(sys.stdout)
+            console.setFormatter(logging.Formatter(_FORMAT, _DATEFMT))
+            self._log.addHandler(console)
+        if log_file:
+            self.add_file_sink(log_file)
+
+    def add_file_sink(self, path: str) -> None:
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        fh = logging.FileHandler(path)
+        fh.setFormatter(logging.Formatter(_FORMAT, _DATEFMT))
+        self._log.addHandler(fh)
+
+    def set_level(self, level: str) -> None:
+        self._log.setLevel(getattr(logging, level.upper()))
+
+    def debug(self, msg, *a):
+        self._log.debug(msg, *a)
+
+    def info(self, msg, *a):
+        self._log.info(msg, *a)
+
+    def warning(self, msg, *a):
+        self._log.warning(msg, *a)
+
+    def error(self, msg, *a):
+        self._log.error(msg, *a)
+
+    class _Timer:
+        def __init__(self, logger: "Logger", label: str):
+            self._logger, self._label = logger, label
+
+        def __enter__(self):
+            self._t0 = time.perf_counter()
+            return self
+
+        def __exit__(self, *exc):
+            self._logger.info("%s took %.1f ms", self._label,
+                              (time.perf_counter() - self._t0) * 1e3)
+
+    def timed(self, label: str) -> "_Timer":
+        """``with log.timed("epoch"):`` — logs elapsed wall time at exit."""
+        return self._Timer(self, label)
+
+
+def get_logger(name: str = "tnn", level: str = "info",
+               log_file: Optional[str] = None) -> Logger:
+    """Process-global named loggers (parity: Logger singleton use in the reference)."""
+    if name not in _loggers:
+        _loggers[name] = Logger(name, level, log_file)
+    return _loggers[name]
