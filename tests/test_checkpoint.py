@@ -128,3 +128,13 @@ class TestFullCheckpoint:
         ckpt.save(state, model=model, best=True)
         assert os.path.isdir(os.path.join(str(tmp_path / "ck"), "best"))
         assert ckpt.latest_path().endswith("step_3")
+
+    def test_restore_from_concrete_dir(self, tmp_path):
+        """resume='.../best' (or a step_N dir) resolves directly, not via step_* scan."""
+        model, opt, state, step = self._state_and_step()
+        ckpt = ckpt_lib.Checkpoint(str(tmp_path / "ck"))
+        ckpt.save(state, model=model, best=True)
+        _, _, fresh, _ = self._state_and_step()
+        restored, _ = ckpt_lib.Checkpoint(
+            str(tmp_path / "ck" / "best")).restore(fresh)
+        assert int(restored.step) == int(state.step)

@@ -1,0 +1,134 @@
+"""Profiler subsystem tests (reference: include/profiling/, merge semantics
+profiler.hpp:52-63, communicator counters communicator.hpp:157-184)."""
+import json
+import threading
+import time
+
+import pytest
+
+from tnn_tpu.profiling import Event, EventType, Profiler, profiled
+from tnn_tpu.profiling import profiler as prof_mod
+
+
+def test_scope_records_event():
+    p = Profiler(source="t")
+    with p.scope("work", EventType.COMPUTE):
+        time.sleep(0.01)
+    evs = p.events
+    assert len(evs) == 1
+    assert evs[0].name == "work"
+    assert evs[0].type is EventType.COMPUTE
+    assert evs[0].source == "t"
+    assert evs[0].duration >= 0.009
+
+
+def test_counters_accumulate():
+    p = Profiler()
+    p.tick("send", 0.5)
+    p.tick("send", 0.25)
+    p.tick("recv", 1.0)
+    assert p.counters == {"send": 0.75, "recv": 1.0}
+
+
+def test_thread_safety():
+    p = Profiler()
+
+    def worker(i):
+        for _ in range(100):
+            p.add_event(EventType.OTHER, 0.0, 1.0, f"w{i}")
+            p.tick("n", 1.0)
+
+    threads = [threading.Thread(target=worker, args=(i,)) for i in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert len(p.events) == 800
+    assert p.counters["n"] == 800.0
+
+
+def test_merge_rebases_timeline():
+    a = Profiler(source="coord")
+    b = Profiler(source="worker1")
+    # simulate b's clock starting at a different origin
+    b._origin = a._origin - 100.0
+    b.add_event(EventType.COMPUTE, b._origin + 1.0, b._origin + 2.0, "fwd")
+    a.add_event(EventType.COMPUTE, a._origin + 1.0, a._origin + 2.0, "loss")
+    a.merge(b)
+    evs = {e.name: e for e in a.events}
+    # after rebase both events sit at origin+1..origin+2 on a's clock
+    assert evs["fwd"].start == pytest.approx(evs["loss"].start)
+    assert evs["fwd"].source == "worker1"
+
+
+def test_merge_accumulates_counters():
+    a, b = Profiler(), Profiler()
+    a.tick("bytes", 1.0)
+    b.tick("bytes", 2.0)
+    a.merge(b)
+    assert a.counters["bytes"] == 3.0
+
+
+def test_dict_roundtrip():
+    p = Profiler(source="w0")
+    with p.scope("step", EventType.COMMUNICATION):
+        pass
+    p.tick("k", 0.125)
+    q = Profiler.from_dict(json.loads(json.dumps(p.to_dict())))
+    assert q.source == "w0"
+    assert len(q.events) == 1
+    assert q.events[0].type is EventType.COMMUNICATION
+    assert q.counters == {"k": 0.125}
+    assert q._origin == p._origin
+
+
+def test_summary():
+    p = Profiler()
+    p.add_event(EventType.COMPUTE, 0.0, 1.0, "step")
+    p.add_event(EventType.COMPUTE, 1.0, 3.0, "step")
+    s = p.summary()
+    assert s["step"]["count"] == 2
+    assert s["step"]["total_s"] == pytest.approx(3.0)
+    assert s["step"]["mean_s"] == pytest.approx(1.5)
+
+
+def test_chrome_trace_export(tmp_path):
+    p = Profiler(source="host0")
+    p.add_event(EventType.COMPUTE, 0.0, 0.5, "fwd", source="stage0")
+    p.add_event(EventType.COMMUNICATION, 0.5, 0.6, "sendrecv", source="stage1")
+    path = tmp_path / "trace.json"
+    trace = p.to_chrome_trace(str(path))
+    loaded = json.loads(path.read_text())["traceEvents"]
+    assert loaded == trace
+    rows = [t for t in trace if t.get("ph") == "X"]
+    assert {r["cat"] for r in rows} == {"compute", "communication"}
+    # distinct sources land on distinct tids (one Gantt row per source)
+    assert len({r["tid"] for r in rows}) == 2
+
+
+def test_profiled_noop_when_disabled():
+    prof_mod.enable(False)
+    before = len(prof_mod.GlobalProfiler.events)
+    with profiled("x"):
+        pass
+    assert len(prof_mod.GlobalProfiler.events) == before
+
+
+def test_profiled_records_when_enabled():
+    prof_mod.enable(True)
+    try:
+        before = len(prof_mod.GlobalProfiler.events)
+        with profiled("y"):
+            pass
+        assert len(prof_mod.GlobalProfiler.events) == before + 1
+    finally:
+        prof_mod.enable(False)
+        prof_mod.GlobalProfiler.clear()
+
+
+def test_explicit_profiler_ignores_enable_flag():
+    prof_mod.enable(False)
+    p = Profiler()
+    with profiled("z", profiler=p):
+        pass
+    assert len(p.events) == 1

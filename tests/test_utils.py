@@ -29,6 +29,13 @@ class TestEnv:
         assert parsed == {"EPOCHS": "5", "NAME": "hello world",
                           "QUOTED": "keep # this", "FLOATY": "0.25"}
 
+    def test_inline_comment_after_quoted_value(self, tmp_path):
+        envf = tmp_path / ".env"
+        envf.write_text('MODEL_PATH="snap/model.tnn" # prod checkpoint\n'
+                        "PLAIN='x y' # trailing\n")
+        parsed = load_env_file(str(envf), export=False)
+        assert parsed == {"MODEL_PATH": "snap/model.tnn", "PLAIN": "x y"}
+
     def test_env_file_exports(self, tmp_path, monkeypatch):
         envf = tmp_path / ".env"
         envf.write_text("TNN_TEST_EXPORT_KEY=42\n")
@@ -91,6 +98,18 @@ class TestLoggerHardware:
         log.info("hello %d", 42)
         text = (tmp_path / "x.log").read_text()
         assert "hello 42" in text
+
+    def test_cached_logger_picks_up_new_file_sink(self, tmp_path):
+        log = get_logger("tnn.test_sink_pickup")
+        late = tmp_path / "late.log"
+        log2 = get_logger("tnn.test_sink_pickup", log_file=str(late))
+        assert log2 is log
+        log2.info("hello late sink")
+        assert "hello late sink" in late.read_text()
+        # requesting the same file again must not duplicate the handler
+        get_logger("tnn.test_sink_pickup", log_file=str(late))
+        log2.info("once")
+        assert late.read_text().count("once") == 1
 
     def test_memory_and_devices(self):
         assert memory_usage_kb() > 0

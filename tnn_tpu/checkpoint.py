@@ -55,18 +55,19 @@ def save_tensors(path: str, trees: Dict[str, Any], meta: Optional[Dict] = None) 
     """Write named pytrees of arrays to one binary file. ``trees`` maps a section name
     ("params", "opt_state", ...) to a pytree; keys become "section/leaf/path"."""
     entries = []
-    blobs = []
+    arrays = []
     offset = 0
     for section, tree in trees.items():
         for key, leaf in _flatten_with_keys(tree).items():
             arr = np.asarray(leaf)
-            raw = arr.tobytes()
+            if not arr.flags["C_CONTIGUOUS"]:  # ascontiguousarray would 1-d-ify 0-d
+                arr = np.ascontiguousarray(arr)
             full_key = f"{section}/{key}" if key else section
             entries.append({"key": full_key, "dtype": str(arr.dtype),
                             "shape": list(arr.shape), "offset": offset,
-                            "nbytes": len(raw)})
-            blobs.append(raw)
-            offset += len(raw)
+                            "nbytes": arr.nbytes})
+            arrays.append(arr)
+            offset += arr.nbytes
     header = json.dumps({"tensors": entries, "meta": meta or {}}).encode()
     tmp = path + ".tmp"
     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
@@ -74,8 +75,11 @@ def save_tensors(path: str, trees: Dict[str, Any], meta: Optional[Dict] = None) 
         f.write(_MAGIC)
         f.write(struct.pack("<Q", len(header)))
         f.write(header)
-        for raw in blobs:
-            f.write(raw)
+        # stream each array's buffer directly — no serialized second copy of the
+        # whole state in host memory (uint8 view: ml_dtypes like bf16 don't
+        # implement the buffer protocol themselves)
+        for arr in arrays:
+            f.write(arr.reshape(-1).view(np.uint8).data)
     os.replace(tmp, path)  # atomic: no torn checkpoints on crash
 
 
@@ -237,9 +241,13 @@ class Checkpoint:
 
     def latest_path(self) -> Optional[str]:
         steps = self._step_dirs()
-        if not steps:
-            return None
-        return os.path.join(self.directory, f"step_{max(steps)}")
+        if steps:
+            return os.path.join(self.directory, f"step_{max(steps)}")
+        # ``directory`` may itself be a concrete checkpoint (e.g. resume=".../best"
+        # or ".../step_120")
+        if os.path.isfile(os.path.join(self.directory, "state.tnn")):
+            return self.directory
+        return None
 
     def restore(self, train_state, path: Optional[str] = None,
                 scheduler=None, loader=None):

@@ -21,6 +21,8 @@ import numpy as np
 
 from ..checkpoint import Checkpoint
 from ..data.loader import DataLoader, prefetch
+from ..profiling import EventType, GlobalProfiler, profiled
+from ..profiling import profiler as _prof_mod
 from ..utils.config import TrainingConfig
 from ..utils.hardware import memory_usage_kb
 from ..utils.logging import get_logger
@@ -67,7 +69,11 @@ def evaluate(eval_step, state: TrainState, loader: DataLoader, batch_size: int,
             corrects += float(m["corrects"])
         total += len(labels)
         batches += 1
-    out = {"loss": loss_sum / max(batches, 1)}
+    if batches == 0:
+        # dataset smaller than one batch (drop-remainder): report honestly rather
+        # than a fake perfect loss; NaN also never wins the best-val comparison
+        return {"loss": float("nan")}
+    out = {"loss": loss_sum / batches}
     if total:
         out["accuracy"] = corrects / total
     return out
@@ -89,7 +95,14 @@ def train_model(
     The reference equivalent is train_model (src/nn/train.cpp:367) driving
     train_epoch/validate_model with best-val snapshots.
     """
-    log = get_logger("tnn.train", log_file=config.log_file or None)
+    log = get_logger("tnn.train")
+    if config.log_file:
+        # per-run file: replace sinks from previous runs, but leave caller-attached
+        # sinks alone when this run doesn't request a file
+        log.set_file_sink(config.log_file)
+    profiler_mode = config.profiler_type.upper()
+    profiling_on = profiler_mode not in ("", "NONE")
+    cumulative_prof = profiler_mode == "CUMULATIVE"
     optimizer = optimizer or config.make_optimizer()
     scheduler = scheduler or config.make_scheduler()
     plateau = getattr(scheduler, "host_driven", False)
@@ -120,70 +133,94 @@ def train_model(
     if config.shuffle and not resumed:
         train_loader.shuffle()
 
-    for epoch in range(int(config.epochs)):
-        t_epoch = time.perf_counter()
-        window_t0 = time.perf_counter()
-        n_batches = 0
-        m: Dict[str, Any] = {}
+    # profiler state is touched ONLY when this run asked for profiling (a NONE run
+    # never clobbers a caller's own enable()/events), and only right before the
+    # try whose finally restores it — no leak on early setup failures
+    if profiling_on:
+        GlobalProfiler.clear()
+        _prof_mod.enable(True)
+    try:
+        for epoch in range(int(config.epochs)):
+            t_epoch = time.perf_counter()
+            window_t0 = time.perf_counter()
+            n_batches = 0
+            m: Dict[str, Any] = {}
 
-        # a resumed first epoch continues mid-epoch from the restored cursor/order
-        # (an end-of-epoch checkpoint has no batches left -> start a fresh epoch)
-        continue_epoch = (resumed and epoch == 0
-                          and train_loader.remaining_batches(batch_size) > 0)
-        for data, labels in _staged_batches(train_loader, batch_size, config,
-                                            reset=not continue_epoch,
-                                            limit=config.max_steps):
-            state, m = step_fn(state, data, labels)
-            n_batches += 1
-            # async: pull metrics only at print interval so the device never waits
-            if n_batches % max(1, config.progress_print_interval) == 0:
-                loss = float(m["loss"])
-                acc = float(m.get("accuracy", 0.0))
-                dt_batch = (time.perf_counter() - window_t0) * 1e3 / max(
-                    1, config.progress_print_interval)
-                window_t0 = time.perf_counter()
-                log.info(
-                    "epoch %d batch %d: loss=%.4f acc=%.4f %.1f ms/batch (%.0f samples/s)",
-                    epoch, n_batches, loss, acc, dt_batch,
-                    batch_size * 1e3 / max(dt_batch, 1e-9))
-                if config.print_memory_usage:
-                    log.info("host RSS: %.1f MiB", memory_usage_kb() / 1024)
-                if metric_hook:
-                    metric_hook(int(state.step),
-                                {"loss": loss, "accuracy": acc, "epoch": epoch})
+            # a resumed first epoch continues mid-epoch from the restored cursor/order
+            # (an end-of-epoch checkpoint has no batches left -> start a fresh epoch)
+            continue_epoch = (resumed and epoch == 0
+                              and train_loader.remaining_batches(batch_size) > 0)
+            for data, labels in _staged_batches(train_loader, batch_size, config,
+                                                reset=not continue_epoch,
+                                                limit=config.max_steps):
+                # host-side span = dispatch of one compiled step (device runs async; use
+                # profiling.device_trace for per-HLO timing). CUMULATIVE keeps only
+                # constant-memory counters; NORMAL records one event per step.
+                if cumulative_prof:
+                    t_step = time.perf_counter()
+                    state, m = step_fn(state, data, labels)
+                    GlobalProfiler.tick("train_step", time.perf_counter() - t_step)
+                else:
+                    with profiled(f"train_step/epoch{epoch}", EventType.COMPUTE):
+                        state, m = step_fn(state, data, labels)
+                n_batches += 1
+                # async: pull metrics only at print interval so the device never waits
+                if n_batches % max(1, config.progress_print_interval) == 0:
+                    loss = float(m["loss"])
+                    acc = float(m.get("accuracy", 0.0))
+                    dt_batch = (time.perf_counter() - window_t0) * 1e3 / max(
+                        1, config.progress_print_interval)
+                    window_t0 = time.perf_counter()
+                    log.info(
+                        "epoch %d batch %d: loss=%.4f acc=%.4f %.1f ms/batch (%.0f samples/s)",
+                        epoch, n_batches, loss, acc, dt_batch,
+                        batch_size * 1e3 / max(dt_batch, 1e-9))
+                    if config.print_memory_usage:
+                        log.info("host RSS: %.1f MiB", memory_usage_kb() / 1024)
+                    if metric_hook:
+                        metric_hook(int(state.step),
+                                    {"loss": loss, "accuracy": acc, "epoch": epoch})
 
-        # final metric of the epoch (forces one sync)
-        epoch_metrics: Dict[str, Any] = {
-            "epoch": epoch,
-            "train_loss": float(m["loss"]) if n_batches else float("nan"),
-            "train_accuracy": float(m.get("accuracy", 0.0)) if n_batches else 0.0,
-            "batches": n_batches,
-            "epoch_seconds": time.perf_counter() - t_epoch,
-        }
+            # final metric of the epoch (forces one sync)
+            epoch_metrics: Dict[str, Any] = {
+                "epoch": epoch,
+                "train_loss": float(m["loss"]) if n_batches else float("nan"),
+                "train_accuracy": float(m.get("accuracy", 0.0)) if n_batches else 0.0,
+                "batches": n_batches,
+                "epoch_seconds": time.perf_counter() - t_epoch,
+            }
 
-        if val_loader is not None:
-            val = evaluate(eval_fn, state, val_loader, batch_size, config)
-            epoch_metrics["val_loss"] = val["loss"]
-            epoch_metrics["val_accuracy"] = val.get("accuracy", 0.0)
-            if plateau:
-                scheduler.observe(val["loss"])
-            score = val.get("accuracy", -val["loss"])
-            if score > best_val:
-                best_val = score
-                path = ckpt.save(state, model=model, scheduler=scheduler,
-                                 loader=train_loader,
-                                 extra={"epoch": epoch, **val}, best=True)
-                log.info("new best val %.4f -> %s", score, path)
+            if val_loader is not None:
+                val = evaluate(eval_fn, state, val_loader, batch_size, config)
+                epoch_metrics["val_loss"] = val["loss"]
+                epoch_metrics["val_accuracy"] = val.get("accuracy", 0.0)
+                if plateau and np.isfinite(val["loss"]):
+                    scheduler.observe(val["loss"])
+                score = val.get("accuracy", -val["loss"])
+                if score > best_val:
+                    best_val = score
+                    path = ckpt.save(state, model=model, scheduler=scheduler,
+                                     loader=train_loader,
+                                     extra={"epoch": epoch, **val}, best=True)
+                    log.info("new best val %.4f -> %s", score, path)
 
-        ckpt.save(state, model=model, scheduler=scheduler, loader=train_loader,
-                  extra={**epoch_metrics, "best_val": best_val})
-        log.info(
-            "epoch %d done in %.1fs: train loss=%.4f acc=%.4f%s", epoch,
-            epoch_metrics["epoch_seconds"], epoch_metrics["train_loss"],
-            epoch_metrics["train_accuracy"],
-            (f" | val loss={epoch_metrics['val_loss']:.4f} "
-             f"acc={epoch_metrics.get('val_accuracy', 0):.4f}")
-            if val_loader is not None else "")
-        history.append(epoch_metrics)
+            ckpt.save(state, model=model, scheduler=scheduler, loader=train_loader,
+                      extra={**epoch_metrics, "best_val": best_val})
+            log.info(
+                "epoch %d done in %.1fs: train loss=%.4f acc=%.4f%s", epoch,
+                epoch_metrics["epoch_seconds"], epoch_metrics["train_loss"],
+                epoch_metrics["train_accuracy"],
+                (f" | val loss={epoch_metrics['val_loss']:.4f} "
+                 f"acc={epoch_metrics.get('val_accuracy', 0):.4f}")
+                if val_loader is not None else "")
+            history.append(epoch_metrics)
+    finally:
+        if profiling_on:
+            for name, s in sorted(GlobalProfiler.summary().items()):
+                log.info("profile %s: n=%d total=%.3fs mean=%.1fms", name,
+                         int(s["count"]), s["total_s"], s["mean_s"] * 1e3)
+            for key, total in sorted(GlobalProfiler.counters.items()):
+                log.info("profile counter %s: total=%.3fs", key, total)
+            _prof_mod.enable(False)
 
     return state, history

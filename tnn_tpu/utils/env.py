@@ -16,7 +16,14 @@ _FALSE = {"0", "false", "no", "off", ""}
 
 
 def _strip_inline_comment(value: str) -> str:
-    if value and value[0] not in "\"'":
+    if value and value[0] in "\"'":
+        # comment starts only after the closing quote
+        close = value.find(value[0], 1)
+        if close != -1:
+            pos = value.find("#", close + 1)
+            if pos != -1:
+                value = value[:pos]
+    else:
         pos = value.find("#")
         if pos != -1:
             value = value[:pos]

@@ -35,10 +35,24 @@ class Logger:
             self.add_file_sink(log_file)
 
     def add_file_sink(self, path: str) -> None:
+        current = {h.baseFilename for h in self._log.handlers
+                   if isinstance(h, logging.FileHandler)}
+        if os.path.abspath(path) in current:
+            return
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
         fh = logging.FileHandler(path)
         fh.setFormatter(logging.Formatter(_FORMAT, _DATEFMT))
         self._log.addHandler(fh)
+
+    def set_file_sink(self, path: Optional[str]) -> None:
+        """Replace ALL file sinks with ``path`` (None = console only). For per-run
+        logs: keeps run B's lines out of run A's file."""
+        for h in [h for h in self._log.handlers
+                  if isinstance(h, logging.FileHandler)]:
+            self._log.removeHandler(h)
+            h.close()
+        if path:
+            self.add_file_sink(path)
 
     def set_level(self, level: str) -> None:
         self._log.setLevel(getattr(logging, level.upper()))
@@ -72,9 +86,20 @@ class Logger:
         return self._Timer(self, label)
 
 
-def get_logger(name: str = "tnn", level: str = "info",
+def get_logger(name: str = "tnn", level: Optional[str] = None,
                log_file: Optional[str] = None) -> Logger:
-    """Process-global named loggers (parity: Logger singleton use in the reference)."""
+    """Process-global named loggers (parity: Logger singleton use in the reference).
+
+    A cached logger picks up a newly requested ``log_file`` (extra sink); ``level``
+    only reconfigures when explicitly passed, so a default-level call never
+    downgrades a logger someone set to debug.
+    """
     if name not in _loggers:
-        _loggers[name] = Logger(name, level, log_file)
+        _loggers[name] = Logger(name, level or "info", log_file)
+    else:
+        log = _loggers[name]
+        if level is not None:
+            log.set_level(level)
+        if log_file:
+            log.add_file_sink(log_file)
     return _loggers[name]
