@@ -1,0 +1,156 @@
+// Batch assembly: parallel row gather + fused u8->f32 normalize, shuffled
+// epoch sampler, and mmap token-stream windows.
+//
+// Capability parity: the reference's BaseDataLoader::get_batch copies rows into a
+// batch tensor on one thread (include/data_loading/data_loader.hpp:25-116) and its
+// OpenWebText loader mmaps a token file (open_webtext_data_loader.hpp:11-45). Here
+// the gather is threaded and the normalize (x/255 - mean)/std is fused into the
+// same pass — one read of the source bytes, one write of the staged batch.
+#include <fcntl.h>
+#include <random>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+#include "common.hpp"
+
+// dst[i,:] = src[idx[i],:]
+TNN_API void tnn_gather_rows_f32(const float* src, int64_t row_elems,
+                                 const int64_t* idx, int64_t n, float* dst) {
+  tnn::parallel_for(
+      n,
+      [&](int64_t lo, int64_t hi) {
+        for (int64_t i = lo; i < hi; ++i)
+          std::memcpy(dst + i * row_elems, src + idx[i] * row_elems,
+                      static_cast<size_t>(row_elems) * sizeof(float));
+      },
+      16);
+}
+
+TNN_API void tnn_gather_rows_u8(const uint8_t* src, int64_t row_elems,
+                                const int64_t* idx, int64_t n, uint8_t* dst) {
+  tnn::parallel_for(
+      n,
+      [&](int64_t lo, int64_t hi) {
+        for (int64_t i = lo; i < hi; ++i)
+          std::memcpy(dst + i * row_elems, src + idx[i] * row_elems,
+                      static_cast<size_t>(row_elems));
+      },
+      16);
+}
+
+// Fused gather + normalize: dst[i,e] = (src[idx[i],e]/255 - mean[c])/std[c]
+// where c = e % channels (HWC rows). mean/std may be null -> just scale by 1/255.
+TNN_API void tnn_gather_u8_normalize_f32(const uint8_t* src, int64_t row_elems,
+                                         const int64_t* idx, int64_t n, float* dst,
+                                         const float* mean, const float* stddev,
+                                         int64_t channels) {
+  // Precompute per-channel affine: y = x*a[c] + b[c]
+  std::vector<float> a(static_cast<size_t>(channels)), b(static_cast<size_t>(channels));
+  for (int64_t c = 0; c < channels; ++c) {
+    float s = stddev ? stddev[c] : 1.0f;
+    float m = mean ? mean[c] : 0.0f;
+    a[static_cast<size_t>(c)] = 1.0f / (255.0f * s);
+    b[static_cast<size_t>(c)] = -m / s;
+  }
+  tnn::parallel_for(
+      n,
+      [&](int64_t lo, int64_t hi) {
+        for (int64_t i = lo; i < hi; ++i) {
+          const uint8_t* row = src + idx[i] * row_elems;
+          float* out = dst + i * row_elems;
+          if (channels == 1) {
+            float a0 = a[0], b0 = b[0];
+            for (int64_t e = 0; e < row_elems; ++e) out[e] = row[e] * a0 + b0;
+          } else {
+            for (int64_t e = 0; e < row_elems; ++e) {
+              int64_t c = e % channels;
+              out[e] = row[e] * a[static_cast<size_t>(c)] + b[static_cast<size_t>(c)];
+            }
+          }
+        }
+      },
+      8);
+}
+
+// Deterministic epoch permutation (Fisher-Yates over mt19937_64). Matches the
+// loader contract: same seed -> same order, so checkpoints can replay it.
+TNN_API void tnn_epoch_permutation(int64_t n, uint64_t seed, int64_t* out) {
+  for (int64_t i = 0; i < n; ++i) out[i] = i;
+  std::mt19937_64 gen(seed);
+  for (int64_t i = n - 1; i > 0; --i) {
+    uint64_t j = gen() % static_cast<uint64_t>(i + 1);
+    std::swap(out[i], out[static_cast<int64_t>(j)]);
+  }
+}
+
+// ---------------------------------------------------------------------------
+// mmap token stream (parity: open_webtext_data_loader.hpp mmap + window reads)
+// ---------------------------------------------------------------------------
+
+namespace {
+struct TokenFile {
+  const uint8_t* data = nullptr;
+  size_t bytes = 0;
+  int fd = -1;
+  int dtype_bytes = 2;
+};
+}  // namespace
+
+TNN_API void* tnn_tokens_open(const char* path, int dtype_bytes) {
+  if (dtype_bytes != 2 && dtype_bytes != 4) return nullptr;
+  int fd = ::open(path, O_RDONLY);
+  if (fd < 0) return nullptr;
+  struct stat st;
+  if (fstat(fd, &st) != 0 || st.st_size == 0) {
+    ::close(fd);
+    return nullptr;
+  }
+  void* p = mmap(nullptr, static_cast<size_t>(st.st_size), PROT_READ, MAP_PRIVATE, fd, 0);
+  if (p == MAP_FAILED) {
+    ::close(fd);
+    return nullptr;
+  }
+  auto* tf = new TokenFile();
+  tf->data = static_cast<const uint8_t*>(p);
+  tf->bytes = static_cast<size_t>(st.st_size);
+  tf->fd = fd;
+  tf->dtype_bytes = dtype_bytes;
+  return tf;
+}
+
+TNN_API int64_t tnn_tokens_len(void* handle) {
+  auto* tf = static_cast<TokenFile*>(handle);
+  return static_cast<int64_t>(tf->bytes) / tf->dtype_bytes;
+}
+
+// Copy batch windows: out[i,:] = tokens[offsets[i] : offsets[i]+window], widened
+// to int32. Threaded across the batch.
+TNN_API void tnn_tokens_windows(void* handle, const int64_t* offsets, int64_t n,
+                                int64_t window, int32_t* out) {
+  auto* tf = static_cast<TokenFile*>(handle);
+  tnn::parallel_for(
+      n,
+      [&](int64_t lo, int64_t hi) {
+        for (int64_t i = lo; i < hi; ++i) {
+          int32_t* dst = out + i * window;
+          if (tf->dtype_bytes == 2) {
+            const uint16_t* src =
+                reinterpret_cast<const uint16_t*>(tf->data) + offsets[i];
+            for (int64_t t = 0; t < window; ++t) dst[t] = src[t];
+          } else {
+            const int32_t* src =
+                reinterpret_cast<const int32_t*>(tf->data) + offsets[i];
+            std::memcpy(dst, src, static_cast<size_t>(window) * sizeof(int32_t));
+          }
+        }
+      },
+      4);
+}
+
+TNN_API void tnn_tokens_close(void* handle) {
+  auto* tf = static_cast<TokenFile*>(handle);
+  if (tf->data) munmap(const_cast<uint8_t*>(tf->data), tf->bytes);
+  if (tf->fd >= 0) ::close(tf->fd);
+  delete tf;
+}

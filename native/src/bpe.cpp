@@ -1,0 +1,321 @@
+// GPT-2 byte-level BPE tokenizer: encode + decode over the reference vocab.bin.
+//
+// Capability parity-and-beyond: the reference Tokenizer is DECODE-ONLY
+// (include/tokenizer/tokenizer.hpp:11-68, vocab.bin = u32 count then per token
+// u32 len + raw bytes). This adds the encode path: GPT-2 pretokenization (the
+// \p{L}/\p{N} regex implemented as a hand-rolled UTF-8 scanner over generated
+// tables matching Python `re` classes exactly) + greedy lowest-rank pair merging,
+// where rank == token id (GPT-2's vocab is in merge order).
+#include <cstdio>
+#include <string>
+#include <string_view>
+#include <unordered_map>
+#include <vector>
+
+#include "common.hpp"
+#include "unicode_tables.hpp"
+
+namespace {
+
+bool in_ranges(uint32_t cp, const uint32_t (*ranges)[2], size_t n) {
+  size_t lo = 0, hi = n;
+  while (lo < hi) {
+    size_t mid = (lo + hi) / 2;
+    if (cp < ranges[mid][0])
+      hi = mid;
+    else if (cp > ranges[mid][1])
+      lo = mid + 1;
+    else
+      return true;
+  }
+  return false;
+}
+
+bool is_letter(uint32_t cp) {
+  return in_ranges(cp, tnn_unicode::kLetter, tnn_unicode::kLetter_n);
+}
+bool is_digit(uint32_t cp) {
+  return in_ranges(cp, tnn_unicode::kDigit, tnn_unicode::kDigit_n);
+}
+bool is_space(uint32_t cp) {
+  return in_ranges(cp, tnn_unicode::kSpace, tnn_unicode::kSpace_n);
+}
+
+// Decode one UTF-8 codepoint at s[i]; advances len_out. Invalid bytes are treated
+// as single-byte "other" codepoints (never letter/digit/space), matching how the
+// Python path would see them only after .encode("utf-8") of valid text — raw
+// invalid input just flows through as bytes.
+uint32_t decode_utf8(const uint8_t* s, size_t n, size_t i, size_t* len_out) {
+  uint8_t c = s[i];
+  if (c < 0x80) {
+    *len_out = 1;
+    return c;
+  }
+  size_t need = (c >= 0xF0) ? 4 : (c >= 0xE0) ? 3 : (c >= 0xC0) ? 2 : 1;
+  if (need == 1 || i + need > n) {
+    *len_out = 1;
+    return 0xFFFD000 + c;  // out-of-unicode sentinel: classified as "other"
+  }
+  uint32_t cp = c & (0xFF >> (need + 1));
+  for (size_t k = 1; k < need; ++k) {
+    if ((s[i + k] & 0xC0) != 0x80) {
+      *len_out = 1;
+      return 0xFFFD000 + c;
+    }
+    cp = (cp << 6) | (s[i + k] & 0x3F);
+  }
+  *len_out = need;
+  return cp;
+}
+
+struct Bpe {
+  std::vector<std::string> vocab;
+  std::unordered_map<std::string_view, int32_t> encoder;  // views into vocab
+  int32_t eot = -1;
+  int32_t byte_token[256];
+
+  void build() {
+    encoder.reserve(vocab.size() * 2);
+    for (size_t i = 0; i < vocab.size(); ++i) {
+      auto [it, fresh] =
+          encoder.emplace(std::string_view(vocab[i]), static_cast<int32_t>(i));
+      (void)it;
+      (void)fresh;  // first id wins, as in the Python tokenizer
+    }
+    auto e = encoder.find(std::string_view("<|endoftext|>"));
+    eot = (e != encoder.end()) ? e->second : -1;
+    for (int b = 0; b < 256; ++b) {
+      char c = static_cast<char>(b);
+      auto it = encoder.find(std::string_view(&c, 1));
+      byte_token[b] = (it != encoder.end()) ? it->second : -1;
+    }
+  }
+
+  // Greedy lowest-rank adjacent pair merge over the word's bytes.
+  void bpe_word(std::string_view word, std::vector<int32_t>& out) const {
+    auto whole = encoder.find(word);
+    if (whole != encoder.end()) {  // single-token fast path (common for words)
+      out.push_back(whole->second);
+      return;
+    }
+    // pieces as (offset, len) into word
+    std::vector<std::pair<uint32_t, uint32_t>> parts;
+    parts.reserve(word.size());
+    for (uint32_t i = 0; i < word.size(); ++i) parts.push_back({i, 1});
+    std::string scratch;
+    while (parts.size() > 1) {
+      int32_t best_rank = -1;
+      size_t best_i = 0;
+      for (size_t i = 0; i + 1 < parts.size(); ++i) {
+        // adjacent pieces are contiguous in the original word
+        std::string_view cand =
+            word.substr(parts[i].first, parts[i].second + parts[i + 1].second);
+        auto it = encoder.find(cand);
+        if (it != encoder.end() &&
+            (best_rank < 0 || it->second < best_rank)) {
+          best_rank = it->second;
+          best_i = i;
+        }
+      }
+      if (best_rank < 0) break;
+      parts[best_i].second += parts[best_i + 1].second;
+      parts.erase(parts.begin() + static_cast<int64_t>(best_i) + 1);
+    }
+    for (auto [off, len] : parts) {
+      std::string_view piece = word.substr(off, len);
+      auto it = encoder.find(piece);
+      if (it != encoder.end()) {
+        out.push_back(it->second);
+      } else {
+        for (char c : piece) {
+          int32_t bt = byte_token[static_cast<uint8_t>(c)];
+          if (bt >= 0) out.push_back(bt);
+        }
+      }
+    }
+  }
+
+  // GPT-2 pretokenizer: 's|'t|'re|'ve|'m|'ll|'d| ?L+| ?N+| ?[^\s L N]+|\s+(?!\S)|\s+
+  // Emits [start, end) spans of text.
+  void encode(std::string_view text, std::vector<int32_t>& out) const {
+    const uint8_t* s = reinterpret_cast<const uint8_t*>(text.data());
+    size_t n = text.size();
+    size_t i = 0;
+    while (i < n) {
+      // specials: <|endoftext|> passes through as one token
+      if (eot >= 0 && s[i] == '<' && text.compare(i, 13, "<|endoftext|>") == 0) {
+        out.push_back(eot);
+        i += 13;
+        continue;
+      }
+      // contractions (case-sensitive, ASCII)
+      if (s[i] == '\'' && i + 1 < n) {
+        size_t cl = 0;
+        char c1 = static_cast<char>(s[i + 1]);
+        char c2 = (i + 2 < n) ? static_cast<char>(s[i + 2]) : '\0';
+        if (c1 == 's' || c1 == 't' || c1 == 'm' || c1 == 'd')
+          cl = 2;
+        else if ((c1 == 'r' && c2 == 'e') || (c1 == 'v' && c2 == 'e') ||
+                 (c1 == 'l' && c2 == 'l'))
+          cl = 3;
+        if (cl) {
+          bpe_word(text.substr(i, cl), out);
+          i += cl;
+          continue;
+        }
+      }
+      size_t start = i;
+      size_t j = i;
+      // optional single literal space before a letter/digit/other run
+      size_t after_space = j;
+      if (s[j] == ' ' && j + 1 < n) after_space = j + 1;
+      size_t cl;
+      uint32_t cp = decode_utf8(s, n, after_space, &cl);
+      if (is_letter(cp)) {
+        j = after_space + cl;
+        while (j < n) {
+          uint32_t c = decode_utf8(s, n, j, &cl);
+          if (!is_letter(c)) break;
+          j += cl;
+        }
+        bpe_word(text.substr(start, j - start), out);
+        i = j;
+        continue;
+      }
+      if (is_digit(cp)) {
+        j = after_space + cl;
+        while (j < n) {
+          uint32_t c = decode_utf8(s, n, j, &cl);
+          if (!is_digit(c)) break;
+          j += cl;
+        }
+        bpe_word(text.substr(start, j - start), out);
+        i = j;
+        continue;
+      }
+      if (!is_space(cp)) {  // "other" run: not space, not letter, not digit
+        // " <|endoftext|>": the space is its own \s+ token (the special is a
+        // piece boundary in the Python tokenizer's pre-split)
+        if (eot >= 0 && after_space > i && s[after_space] == '<' &&
+            text.compare(after_space, 13, "<|endoftext|>") == 0) {
+          bpe_word(text.substr(i, 1), out);
+          i = after_space;
+          continue;
+        }
+        j = after_space + cl;
+        while (j < n) {
+          // stop an "other" run at a special token boundary
+          if (eot >= 0 && s[j] == '<' && text.compare(j, 13, "<|endoftext|>") == 0)
+            break;
+          uint32_t c = decode_utf8(s, n, j, &cl);
+          if (is_space(c) || is_letter(c) || is_digit(c)) break;
+          j += cl;
+        }
+        bpe_word(text.substr(start, j - start), out);
+        i = j;
+        continue;
+      }
+      // whitespace run (s[i] itself is whitespace here)
+      j = i;
+      while (j < n) {
+        uint32_t c = decode_utf8(s, n, j, &cl);
+        if (!is_space(c)) break;
+        j += cl;
+      }
+      // a following special is a piece boundary: \s+(?!\S) sees end-of-piece and
+      // keeps the full run
+      bool at_boundary =
+          j == n || (eot >= 0 && s[j] == '<' &&
+                     text.compare(j, 13, "<|endoftext|>") == 0);
+      if (!at_boundary && j - i > 1) {
+        // \s+(?!\S): leave the last whitespace char for the next token
+        size_t last = i;
+        size_t k = i;
+        while (k < j) {  // find start of final ws codepoint
+          last = k;
+          decode_utf8(s, n, k, &cl);
+          k += cl;
+        }
+        if (last > i) {
+          bpe_word(text.substr(i, last - i), out);
+          i = last;
+          continue;
+        }
+      }
+      bpe_word(text.substr(i, j - i), out);
+      i = j;
+    }
+  }
+};
+
+}  // namespace
+
+TNN_API void* tnn_bpe_load(const char* vocab_path) {
+  FILE* f = fopen(vocab_path, "rb");
+  if (!f) return nullptr;
+  uint32_t count = 0;
+  if (fread(&count, 4, 1, f) != 1 || count > 10'000'000) {
+    fclose(f);
+    return nullptr;
+  }
+  auto* bpe = new Bpe();
+  bpe->vocab.reserve(count);
+  for (uint32_t i = 0; i < count; ++i) {
+    uint32_t len = 0;
+    if (fread(&len, 4, 1, f) != 1 || len > 1'000'000) {
+      fclose(f);
+      delete bpe;
+      return nullptr;
+    }
+    std::string tok(len, '\0');
+    if (len && fread(tok.data(), 1, len, f) != len) {
+      fclose(f);
+      delete bpe;
+      return nullptr;
+    }
+    bpe->vocab.push_back(std::move(tok));
+  }
+  fclose(f);
+  bpe->build();
+  return bpe;
+}
+
+TNN_API void tnn_bpe_free(void* h) { delete static_cast<Bpe*>(h); }
+
+TNN_API int32_t tnn_bpe_vocab_size(void* h) {
+  return static_cast<int32_t>(static_cast<Bpe*>(h)->vocab.size());
+}
+
+TNN_API int32_t tnn_bpe_eot(void* h) { return static_cast<Bpe*>(h)->eot; }
+
+// Encode text -> ids. Returns the number of ids produced; writes at most max_out.
+// Call with max_out=0 to size the buffer first.
+TNN_API int64_t tnn_bpe_encode(void* h, const char* text, int64_t text_len,
+                               int32_t* out, int64_t max_out) {
+  auto* bpe = static_cast<Bpe*>(h);
+  std::vector<int32_t> ids;
+  ids.reserve(static_cast<size_t>(text_len) / 3 + 8);
+  bpe->encode(std::string_view(text, static_cast<size_t>(text_len)), ids);
+  int64_t n = static_cast<int64_t>(ids.size());
+  if (out && max_out > 0)
+    std::memcpy(out, ids.data(),
+                static_cast<size_t>(std::min(n, max_out)) * sizeof(int32_t));
+  return n;
+}
+
+// Decode ids -> bytes. Returns bytes produced (caller sizes via max_out=0 pass).
+// Out-of-range ids emit "<unk>" (parity: tokenizer.hpp:40-44).
+TNN_API int64_t tnn_bpe_decode(void* h, const int32_t* ids, int64_t n, char* out,
+                               int64_t max_out) {
+  auto* bpe = static_cast<Bpe*>(h);
+  int64_t written = 0;
+  for (int64_t i = 0; i < n; ++i) {
+    std::string_view piece = "<unk>";
+    if (ids[i] >= 0 && static_cast<size_t>(ids[i]) < bpe->vocab.size())
+      piece = bpe->vocab[static_cast<size_t>(ids[i])];
+    if (out && written + static_cast<int64_t>(piece.size()) <= max_out)
+      std::memcpy(out + written, piece.data(), piece.size());
+    written += static_cast<int64_t>(piece.size());
+  }
+  return written;
+}

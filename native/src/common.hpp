@@ -1,0 +1,49 @@
+// Shared helpers for the tnn_host native runtime.
+//
+// This is the TPU-native analog of the reference's native host-side runtime
+// (SURVEY.md §2.1/§2.5): where TNN runs CPU kernels for compute, a TPU framework's
+// native work is the HOST side — dataset parsing, batch assembly, tokenization,
+// and the distributed control plane. Device compute belongs to XLA.
+#pragma once
+
+#include <algorithm>
+#include <atomic>
+#include <cstdint>
+#include <cstring>
+#include <thread>
+#include <vector>
+
+#if defined(_WIN32)
+#error "tnn_host builds on POSIX only"
+#endif
+
+#define TNN_API extern "C" __attribute__((visibility("default")))
+
+namespace tnn {
+
+// Simple blocked parallel-for over a half-open range. Analog of the reference's
+// parallel_for (include/threading/thread_handler.hpp:37) without the TBB/OpenMP
+// dependency: std::thread is enough for IO-bound and memcpy-bound host work.
+template <typename F>
+void parallel_for(int64_t n, F&& body, int64_t grain = 1024) {
+  if (n <= 0) return;
+  unsigned hw = std::thread::hardware_concurrency();
+  int64_t max_threads = std::max<int64_t>(1, hw ? hw : 4);
+  int64_t threads = std::min<int64_t>(max_threads, (n + grain - 1) / grain);
+  if (threads <= 1) {
+    body(int64_t{0}, n);
+    return;
+  }
+  std::vector<std::thread> pool;
+  pool.reserve(threads - 1);
+  int64_t chunk = (n + threads - 1) / threads;
+  for (int64_t t = 1; t < threads; ++t) {
+    int64_t lo = t * chunk, hi = std::min(n, lo + chunk);
+    if (lo >= hi) break;
+    pool.emplace_back([=, &body] { body(lo, hi); });
+  }
+  body(int64_t{0}, std::min(n, chunk));
+  for (auto& th : pool) th.join();
+}
+
+}  // namespace tnn

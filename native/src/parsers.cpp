@@ -1,0 +1,178 @@
+// Dataset parsers: MNIST CSV, CIFAR-10/100 binary.
+//
+// Capability parity with the reference's native loaders
+// (include/data_loading/mnist_data_loader.hpp, cifar10_data_loader.hpp,
+// cifar100_data_loader.hpp), rebuilt as flat C entry points: Python owns the
+// arrays (numpy), C++ does the byte crunching with a thread pool.
+#include <cerrno>
+#include <cstdio>
+#include <fcntl.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+#include "common.hpp"
+
+namespace {
+
+struct MappedFile {
+  const char* data = nullptr;
+  size_t size = 0;
+  int fd = -1;
+
+  bool open(const char* path) {
+    fd = ::open(path, O_RDONLY);
+    if (fd < 0) return false;
+    struct stat st;
+    if (fstat(fd, &st) != 0 || st.st_size == 0) {
+      ::close(fd);
+      fd = -1;
+      return false;
+    }
+    size = static_cast<size_t>(st.st_size);
+    void* p = mmap(nullptr, size, PROT_READ, MAP_PRIVATE, fd, 0);
+    if (p == MAP_FAILED) {
+      ::close(fd);
+      fd = -1;
+      return false;
+    }
+    data = static_cast<const char*>(p);
+    return true;
+  }
+
+  ~MappedFile() {
+    if (data) munmap(const_cast<char*>(data), size);
+    if (fd >= 0) ::close(fd);
+  }
+};
+
+// Positions of line starts (excluding blank lines); optionally skip a header.
+std::vector<size_t> line_starts(const char* data, size_t size, bool skip_header) {
+  std::vector<size_t> starts;
+  size_t pos = 0;
+  while (pos < size) {
+    size_t eol = pos;
+    while (eol < size && data[eol] != '\n') ++eol;
+    if (eol > pos && !(eol == pos + 1 && data[pos] == '\r')) starts.push_back(pos);
+    pos = eol + 1;
+  }
+  if (skip_header && !starts.empty()) starts.erase(starts.begin());
+  return starts;
+}
+
+}  // namespace
+
+// Rows in an MNIST-style CSV (after optional header). header=1 -> skip first line.
+TNN_API int64_t tnn_mnist_csv_rows(const char* path, int header) {
+  MappedFile f;
+  if (!f.open(path)) return -1;
+  return static_cast<int64_t>(line_starts(f.data, f.size, header != 0).size());
+}
+
+// Parse "label,p0,p1,...,p783" rows -> images[N*784] u8, labels[N] i32.
+// Returns rows parsed, or -1 on IO error, -2 on malformed row.
+TNN_API int64_t tnn_mnist_csv_parse(const char* path, int header, uint8_t* images,
+                                    int32_t* labels, int64_t max_rows,
+                                    int64_t pixels_per_row) {
+  MappedFile f;
+  if (!f.open(path)) return -1;
+  std::vector<size_t> starts = line_starts(f.data, f.size, header != 0);
+  int64_t n = std::min<int64_t>(max_rows, static_cast<int64_t>(starts.size()));
+  std::atomic<bool> bad{false};
+  const char* data = f.data;
+  size_t size = f.size;
+  tnn::parallel_for(
+      n,
+      [&](int64_t lo, int64_t hi) {
+        for (int64_t r = lo; r < hi; ++r) {
+          size_t pos = starts[static_cast<size_t>(r)];
+          int32_t value = 0;
+          bool in_number = false;
+          int64_t field = 0;  // 0 = label, 1.. = pixels
+          uint8_t* img = images + r * pixels_per_row;
+          while (pos < size && data[pos] != '\n') {
+            char c = data[pos++];
+            if (c >= '0' && c <= '9') {
+              value = value * 10 + (c - '0');
+              in_number = true;
+            } else if (c == ',') {
+              if (field == 0)
+                labels[r] = value;
+              else if (field <= pixels_per_row)
+                img[field - 1] = static_cast<uint8_t>(value);
+              ++field;
+              value = 0;
+              in_number = false;
+            } else if (c == '\r' || c == ' ') {
+              // ignore
+            } else {
+              bad.store(true, std::memory_order_relaxed);
+              return;
+            }
+          }
+          if (in_number || field > 0) {  // flush last field
+            if (field == 0)
+              labels[r] = value;
+            else if (field <= pixels_per_row)
+              img[field - 1] = static_cast<uint8_t>(value);
+            ++field;
+          }
+          if (field != pixels_per_row + 1) {
+            bad.store(true, std::memory_order_relaxed);
+            return;
+          }
+        }
+      },
+      64);
+  if (bad.load()) return -2;
+  return n;
+}
+
+// CIFAR-10 binary: records of [label u8][3072 bytes CHW]. Returns records parsed.
+// CIFAR-100: records of [coarse u8][fine u8][3072 bytes]; coarse may be null.
+// Both convert CHW -> HWC (parity with the Python loader's layout) in parallel.
+static int64_t cifar_parse(const char* path, int label_bytes, uint8_t* images_hwc,
+                           int32_t* labels_first, int32_t* labels_second,
+                           int64_t max_records) {
+  MappedFile f;
+  if (!f.open(path)) return -1;
+  const int64_t kImg = 3072, kHW = 1024;  // 32*32
+  int64_t rec = label_bytes + kImg;
+  int64_t n = std::min<int64_t>(max_records, static_cast<int64_t>(f.size) / rec);
+  const uint8_t* data = reinterpret_cast<const uint8_t*>(f.data);
+  tnn::parallel_for(
+      n,
+      [&](int64_t lo, int64_t hi) {
+        for (int64_t r = lo; r < hi; ++r) {
+          const uint8_t* src = data + r * rec;
+          if (labels_first) labels_first[r] = src[0];
+          if (labels_second && label_bytes > 1) labels_second[r] = src[1];
+          const uint8_t* chw = src + label_bytes;
+          uint8_t* out = images_hwc + r * kImg;
+          for (int64_t px = 0; px < kHW; ++px) {
+            out[px * 3 + 0] = chw[px];
+            out[px * 3 + 1] = chw[kHW + px];
+            out[px * 3 + 2] = chw[2 * kHW + px];
+          }
+        }
+      },
+      32);
+  return n;
+}
+
+TNN_API int64_t tnn_cifar10_parse(const char* path, uint8_t* images_hwc,
+                                  int32_t* labels, int64_t max_records) {
+  return cifar_parse(path, 1, images_hwc, labels, nullptr, max_records);
+}
+
+TNN_API int64_t tnn_cifar100_parse(const char* path, uint8_t* images_hwc,
+                                   int32_t* coarse, int32_t* fine,
+                                   int64_t max_records) {
+  return cifar_parse(path, 2, images_hwc, coarse, fine, max_records);
+}
+
+TNN_API int64_t tnn_cifar_records(const char* path, int label_bytes) {
+  MappedFile f;
+  if (!f.open(path)) return -1;
+  return static_cast<int64_t>(f.size) / (label_bytes + 3072);
+}

@@ -144,6 +144,8 @@ class ArrayDataLoader(DataLoader):
     """In-memory (data, labels) arrays — the workhorse for MNIST/CIFAR-scale sets."""
 
     def __init__(self, data: np.ndarray, labels: np.ndarray, seed: int = 0):
+        from .. import native
+
         super().__init__(seed)
         if len(data) != len(labels):
             raise ValueError(f"data/labels length mismatch: {len(data)} vs {len(labels)}")
@@ -152,8 +154,17 @@ class ArrayDataLoader(DataLoader):
         self._num_samples = len(data)
         self._data_shape = tuple(data.shape[1:])
         self._label_shape = tuple(labels.shape[1:])
+        # threaded native row gather (native/src/batch.cpp) for the batch copy;
+        # identical output to numpy fancy indexing
+        self._native_gather = (native.available() and data.ndim >= 2
+                               and data.dtype in (np.float32, np.uint8)
+                               and data.flags["C_CONTIGUOUS"])
 
     def _get(self, indices):
+        if self._native_gather:
+            from ..native import api
+
+            return api.gather_rows(self.data, indices), self.labels[indices]
         return self.data[indices], self.labels[indices]
 
 

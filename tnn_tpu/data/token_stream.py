@@ -23,8 +23,20 @@ class TokenStreamDataLoader(DataLoader):
 
     def __init__(self, path: str, context_length: int, dtype=np.uint16, seed: int = 0,
                  pad_token_id: Optional[int] = None):
+        from .. import native
+
         super().__init__(seed)
         self.tokens = np.memmap(path, dtype=dtype, mode="r")
+        # threaded native window reads when the runtime is built (same mmap
+        # underneath; output is identical); dtypes the native reader doesn't
+        # speak, or any init failure, silently keep the numpy path
+        self._native_tokens = None
+        if native.available() and np.dtype(dtype) in (np.uint16, np.int32,
+                                                      np.uint32):
+            try:
+                self._native_tokens = native.api.TokenFile(path, dtype)
+            except (ValueError, OSError):
+                self._native_tokens = None
         self.context_length = int(context_length)
         self.pad_token_id = pad_token_id
         # valid window starts are 0..L-S-1 (each needs S tokens + 1 label lookahead)
@@ -34,12 +46,20 @@ class TokenStreamDataLoader(DataLoader):
 
     def _get(self, indices: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
         S = self.context_length
-        data = np.empty((len(indices), S), np.int32)
-        labels = np.empty((len(indices), S), np.int32)
-        for b, start in enumerate(indices):
-            window = np.asarray(self.tokens[start:start + S + 1], np.int32)
-            data[b] = window[:-1]
-            labels[b] = window[1:]
+        if self._native_tokens is not None:
+            full = self._native_tokens.windows(np.asarray(indices, np.int64), S + 1)
+            # views into the freshly assembled buffer; masking labels in place
+            # would also hit data (they overlap in `full`), so copy only then
+            data, labels = full[:, :-1], full[:, 1:]
+            if self.pad_token_id is not None:
+                labels = labels.copy()
+        else:
+            data = np.empty((len(indices), S), np.int32)
+            labels = np.empty((len(indices), S), np.int32)
+            for b, start in enumerate(indices):
+                window = np.asarray(self.tokens[start:start + S + 1], np.int32)
+                data[b] = window[:-1]
+                labels[b] = window[1:]
         if self.pad_token_id is not None:
             # loss masks these out (losses.softmax_cross_entropy ignore_index)
             labels[labels == self.pad_token_id] = -1

@@ -33,6 +33,7 @@ class Tokenizer:
         self._vocab: List[bytes] = []
         self._encoder: Dict[bytes, int] = {}
         self._special: Dict[str, int] = {}
+        self._native = None  # native BPE fast path (tests prove output-identical)
 
     # -- loading --------------------------------------------------------------
 
@@ -45,6 +46,13 @@ class Tokenizer:
                 (n,) = struct.unpack("<I", f.read(4))
                 self._vocab.append(f.read(n) if n else b"")
         self._build_encoder()
+        try:
+            from .. import native
+
+            if native.available():
+                self._native = native.api.BpeTokenizer(vocab_path)
+        except (ValueError, OSError):
+            self._native = None
         return self
 
     def save(self, vocab_path: str) -> None:
@@ -100,6 +108,8 @@ class Tokenizer:
     def encode(self, text: str, allowed_special: bool = True) -> List[int]:
         if not self._vocab:
             raise RuntimeError("tokenizer not loaded")
+        if self._native is not None and allowed_special:
+            return self._native.encode(text).tolist()
         out: List[int] = []
         pieces = [text]
         if allowed_special and _END_OF_TEXT in self._special and _END_OF_TEXT in text:

@@ -1,0 +1,16 @@
+"""Native host runtime: ctypes bindings over libtnn_host.so.
+
+The reference framework is all-native C++ (SURVEY.md §2); on TPU the device compute
+belongs to XLA, so the native layer here is the HOST runtime: dataset parsers, batch
+assembly (threaded gather + fused normalize), mmap token streams, the GPT-2 BPE
+tokenizer, and the distributed control-plane transport.
+
+Build model: C++ sources live in ``native/``; the .so is compiled on demand (g++,
+no external deps) into ``native/build/``. Every entry point has a pure-Python
+fallback — ``available()`` is False and callers fall back silently when the
+toolchain is missing or TNN_NATIVE=0 disables it.
+"""
+from .lib import available, build_native, get_lib
+from . import api
+
+__all__ = ["available", "build_native", "get_lib", "api"]

@@ -108,7 +108,11 @@ class Profiler:
         profiler's clock. Cross-host skew beyond origin alignment is accepted, as in
         the reference.
         """
+        if other is self:
+            return
         delta = self._origin - other._origin
+        # copy under other's lock, then insert under ours — never hold both
+        # (self-merge or concurrent mutual merges would deadlock otherwise)
         with other._lock:
             evs = list(other._events)
             ctrs = dict(other._counters)
