@@ -1,0 +1,73 @@
+#!/usr/bin/env python
+"""Control-plane worker: trains per the deployed config (parity: examples/tcp_worker.cpp).
+
+    python examples/dist_worker.py --coordinator host:5555 [--rank 0]
+
+Receives a TrainingConfig dict from the coordinator, runs train_model between the
+"start" and "done" barriers, and answers profiling/save/health RPCs from the
+background event loop. For real multi-host data parallelism, also set
+config["jax_coordinator"] so each worker calls jax.distributed.initialize and the
+train step's collectives span hosts.
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from tnn_tpu.distributed import Worker  # noqa: E402
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--coordinator", required=True, help="host:port")
+    ap.add_argument("--rank", type=int, default=None)
+    args = ap.parse_args(argv)
+    host, port = args.coordinator.rsplit(":", 1)
+
+    w = Worker(host, int(port), rank=args.rank).start()
+    print(f"joined as rank {w.rank}/{w.world}")
+
+    # config arrives via the event loop; wait for it
+    import time
+    while w.config is None and w.running:
+        time.sleep(0.05)
+    config = dict(w.config or {})
+    per_rank = (config.pop("ranks", {}) or {}).get(str(w.rank), {})
+    config.update(per_rank)
+
+    if "jax_coordinator" in config:  # multi-host XLA data plane
+        import jax
+
+        jax.distributed.initialize(config["jax_coordinator"],
+                                   num_processes=w.world, process_id=w.rank)
+
+    from tnn_tpu import models
+    from tnn_tpu.data.loader import SyntheticDataLoader
+    from tnn_tpu.train import train_model
+    from tnn_tpu.utils.config import TrainingConfig
+
+    known = set(TrainingConfig.__dataclass_fields__)
+    cfg = TrainingConfig().update({k: v for k, v in config.items() if k in known})
+    model = models.create(cfg.model_name)
+    if cfg.dataset_name in ("", "synthetic"):
+        shape = (28, 28, 1) if "mnist" in cfg.model_name else (32, 32, 3)
+        loader = SyntheticDataLoader(20 * cfg.batch_size, shape,
+                                     100 if "100" in cfg.model_name else 10,
+                                     seed=cfg.seed + w.rank)
+    else:
+        from tnn_tpu.data import factory
+
+        loader = factory.create(cfg.dataset_name, cfg.dataset_path, train=True)
+
+    w.barrier("start", timeout=600)
+    state, history = train_model(model, cfg, loader)
+    w.on_save = lambda path: None  # model already snapshotted by train_model
+    print(f"rank {w.rank}: trained {len(history)} epochs, "
+          f"final loss {history[-1]['train_loss']:.4f}")
+    w.barrier("done", timeout=600)
+    w.join(timeout=60)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,277 @@
+// Control-plane TCP transport: length-prefixed framed messages with background
+// receive threads and a process-wide inbound queue per endpoint.
+//
+// Capability parity: the reference's Communicator data+control plane
+// (include/distributed/tcp_communicator.hpp — asio coroutines, 4MB packets,
+// per-peer queues). On TPU the DATA plane is XLA collectives over ICI/DCN
+// (SURVEY.md §2.4 "TPU mapping note"); what remains native is exactly this:
+// the coordinator/worker CONTROL channel (config deploy, barriers, profiling
+// RPC, heartbeats, shutdown).
+//
+// Wire format: [u32 magic 'TNNC'][u32 command][u64 len][len payload bytes].
+#include <arpa/inet.h>
+#include <condition_variable>
+#include <cstring>
+#include <deque>
+#include <map>
+#include <mutex>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <string>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include "common.hpp"
+
+namespace {
+
+constexpr uint32_t kMagic = 0x544E4E43;  // "TNNC"
+constexpr uint64_t kMaxPayload = 1ull << 32;
+
+struct Frame {
+  int64_t conn;
+  int32_t command;
+  std::vector<uint8_t> payload;
+};
+
+struct Conn {
+  int fd = -1;
+  std::thread reader;
+  std::mutex send_mu;
+  std::atomic<bool> open{false};
+};
+
+struct Endpoint {
+  int listen_fd = -1;
+  int port = 0;
+  std::thread acceptor;
+  std::atomic<bool> running{true};
+  std::atomic<int64_t> next_conn{0};
+
+  std::mutex mu;  // guards conns map
+  std::map<int64_t, std::unique_ptr<Conn>> conns;
+
+  std::mutex q_mu;
+  std::condition_variable q_cv;
+  std::deque<Frame> inbox;
+
+  void enqueue(Frame f) {
+    {
+      std::lock_guard<std::mutex> g(q_mu);
+      inbox.push_back(std::move(f));
+    }
+    q_cv.notify_one();
+  }
+
+  // conn = -3 sentinel frame announces a disconnected peer (command = conn id)
+  void reader_loop(int64_t id, Conn* c) {
+    std::vector<uint8_t> hdr(16);
+    while (running.load() && c->open.load()) {
+      size_t got = 0;
+      while (got < 16) {
+        ssize_t r = ::recv(c->fd, hdr.data() + got, 16 - got, 0);
+        if (r <= 0) goto closed;
+        got += static_cast<size_t>(r);
+      }
+      {
+        uint32_t magic, cmd;
+        uint64_t len;
+        std::memcpy(&magic, hdr.data(), 4);
+        std::memcpy(&cmd, hdr.data() + 4, 4);
+        std::memcpy(&len, hdr.data() + 8, 8);
+        if (magic != kMagic || len > kMaxPayload) goto closed;
+        Frame f;
+        f.conn = id;
+        f.command = static_cast<int32_t>(cmd);
+        f.payload.resize(len);
+        size_t off = 0;
+        while (off < len) {
+          ssize_t r = ::recv(c->fd, f.payload.data() + off, len - off, 0);
+          if (r <= 0) goto closed;
+          off += static_cast<size_t>(r);
+        }
+        enqueue(std::move(f));
+      }
+    }
+  closed:
+    if (c->open.exchange(false)) {
+      Frame bye;
+      bye.conn = -3;
+      bye.command = static_cast<int32_t>(id);
+      enqueue(std::move(bye));
+    }
+  }
+
+  int64_t add_conn(int fd) {
+    int one = 1;
+    setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+    auto c = std::make_unique<Conn>();
+    c->fd = fd;
+    c->open.store(true);
+    int64_t id = next_conn.fetch_add(1);
+    Conn* raw = c.get();
+    raw->reader = std::thread([this, id, raw] { reader_loop(id, raw); });
+    std::lock_guard<std::mutex> g(mu);
+    conns[id] = std::move(c);
+    return id;
+  }
+
+  void accept_loop() {
+    while (running.load()) {
+      int fd = ::accept(listen_fd, nullptr, nullptr);
+      if (fd < 0) {
+        if (!running.load()) return;
+        continue;
+      }
+      int64_t id = add_conn(fd);
+      Frame hello;  // conn = -2 sentinel announces a new peer (command = conn id)
+      hello.conn = -2;
+      hello.command = static_cast<int32_t>(id);
+      enqueue(std::move(hello));
+    }
+  }
+};
+
+}  // namespace
+
+// Create an endpoint; port 0 picks a free port; port < 0 -> client-only (no listener).
+TNN_API void* tnn_ctl_create(const char* bind_addr, int port) {
+  auto* ep = new Endpoint();
+  if (port >= 0) {
+    ep->listen_fd = ::socket(AF_INET, SOCK_STREAM, 0);
+    if (ep->listen_fd < 0) {
+      delete ep;
+      return nullptr;
+    }
+    int one = 1;
+    setsockopt(ep->listen_fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_port = htons(static_cast<uint16_t>(port));
+    addr.sin_addr.s_addr =
+        bind_addr && *bind_addr ? inet_addr(bind_addr) : INADDR_ANY;
+    if (bind(ep->listen_fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0 ||
+        listen(ep->listen_fd, 64) != 0) {
+      ::close(ep->listen_fd);
+      delete ep;
+      return nullptr;
+    }
+    socklen_t alen = sizeof(addr);
+    getsockname(ep->listen_fd, reinterpret_cast<sockaddr*>(&addr), &alen);
+    ep->port = ntohs(addr.sin_port);
+    ep->acceptor = std::thread([ep] { ep->accept_loop(); });
+  }
+  return ep;
+}
+
+TNN_API int tnn_ctl_port(void* h) { return static_cast<Endpoint*>(h)->port; }
+
+// Connect to a remote endpoint; returns the local conn id or -1.
+TNN_API int64_t tnn_ctl_connect(void* h, const char* host, int port) {
+  auto* ep = static_cast<Endpoint*>(h);
+  int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return -1;
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  addr.sin_addr.s_addr = inet_addr(host);
+  if (::connect(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0) {
+    ::close(fd);
+    return -1;
+  }
+  return ep->add_conn(fd);
+}
+
+// Send one framed message. Returns 0 on success, -1 if the conn is gone.
+TNN_API int tnn_ctl_send(void* h, int64_t conn, int32_t command,
+                         const uint8_t* data, int64_t len) {
+  auto* ep = static_cast<Endpoint*>(h);
+  Conn* c = nullptr;
+  {
+    std::lock_guard<std::mutex> g(ep->mu);
+    auto it = ep->conns.find(conn);
+    if (it == ep->conns.end()) return -1;
+    c = it->second.get();
+  }
+  if (!c->open.load()) return -1;
+  uint8_t hdr[16];
+  uint32_t cmd = static_cast<uint32_t>(command);
+  uint64_t l = static_cast<uint64_t>(len);
+  std::memcpy(hdr, &kMagic, 4);
+  std::memcpy(hdr + 4, &cmd, 4);
+  std::memcpy(hdr + 8, &l, 8);
+  std::lock_guard<std::mutex> g(c->send_mu);
+  auto send_all = [&](const uint8_t* p, size_t n) {
+    size_t off = 0;
+    while (off < n) {
+      ssize_t r = ::send(c->fd, p + off, n - off, MSG_NOSIGNAL);
+      if (r <= 0) return false;
+      off += static_cast<size_t>(r);
+    }
+    return true;
+  };
+  if (!send_all(hdr, 16) || (len > 0 && !send_all(data, static_cast<size_t>(len))))
+    return -1;
+  return 0;
+}
+
+// Wait for the next inbound frame. Returns payload length (>=0) and fills
+// conn/command; -1 on timeout. Sentinel frames: conn=-2 peer connected
+// (command = its id), conn=-3 peer disconnected (command = its id).
+// Two-phase: call with buf=null to learn the size (frame stays queued), then
+// with a big-enough buf to consume it.
+TNN_API int64_t tnn_ctl_recv(void* h, double timeout_s, int64_t* conn_out,
+                             int32_t* cmd_out, uint8_t* buf, int64_t buf_len) {
+  auto* ep = static_cast<Endpoint*>(h);
+  std::unique_lock<std::mutex> lk(ep->q_mu);
+  if (!ep->q_cv.wait_for(lk, std::chrono::duration<double>(timeout_s),
+                         [&] { return !ep->inbox.empty(); }))
+    return -1;
+  Frame& f = ep->inbox.front();
+  *conn_out = f.conn;
+  *cmd_out = f.command;
+  int64_t n = static_cast<int64_t>(f.payload.size());
+  if (n > 0 && (buf == nullptr || buf_len < n)) return n;  // peek size only
+  if (n > 0) std::memcpy(buf, f.payload.data(), static_cast<size_t>(n));
+  ep->inbox.pop_front();
+  return n;
+}
+
+TNN_API void tnn_ctl_close_conn(void* h, int64_t conn) {
+  auto* ep = static_cast<Endpoint*>(h);
+  std::unique_ptr<Conn> c;
+  {
+    std::lock_guard<std::mutex> g(ep->mu);
+    auto it = ep->conns.find(conn);
+    if (it == ep->conns.end()) return;
+    c = std::move(it->second);
+    ep->conns.erase(it);
+  }
+  c->open.store(false);
+  ::shutdown(c->fd, SHUT_RDWR);
+  if (c->reader.joinable()) c->reader.join();
+  ::close(c->fd);
+}
+
+TNN_API void tnn_ctl_destroy(void* h) {
+  auto* ep = static_cast<Endpoint*>(h);
+  ep->running.store(false);
+  if (ep->listen_fd >= 0) {
+    ::shutdown(ep->listen_fd, SHUT_RDWR);
+    ::close(ep->listen_fd);
+  }
+  if (ep->acceptor.joinable()) ep->acceptor.join();
+  std::vector<std::unique_ptr<Conn>> conns;
+  {
+    std::lock_guard<std::mutex> g(ep->mu);
+    for (auto& [id, c] : ep->conns) conns.push_back(std::move(c));
+    ep->conns.clear();
+  }
+  for (auto& c : conns) {
+    c->open.store(false);
+    ::shutdown(c->fd, SHUT_RDWR);
+    if (c->reader.joinable()) c->reader.join();
+    ::close(c->fd);
+  }
+  delete ep;
+}

@@ -1,0 +1,327 @@
+"""Control-plane tests: coordinator/worker protocol over localhost TCP.
+
+The reference tests distributed logic without a cluster via IN_PROCESS endpoints
+(SURVEY.md §4); the analog here is coordinator + workers as threads in one process
+over loopback sockets — same framed protocol as a real multi-host run.
+"""
+import threading
+import time
+
+import pytest
+
+from tnn_tpu.distributed import Command, Coordinator, Worker
+from tnn_tpu.distributed.transport import PyTransport, make_transport
+from tnn_tpu.profiling import EventType, GlobalProfiler
+
+
+def _spawn_worker(port, results, name="w", **kw):
+    def run():
+        w = Worker("127.0.0.1", port, **kw).start()
+        results[name] = w
+        w.join(timeout=30)
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    return t
+
+
+def _await_workers(results, n, timeout=10.0):
+    """wait_for_workers returns when the coordinator saw the handshake, which can
+    be before the worker thread stores its Worker object — wait for both."""
+    deadline = time.monotonic() + timeout
+    while len(results) < n:
+        assert time.monotonic() < deadline, f"only {len(results)}/{n} registered"
+        time.sleep(0.01)
+    return list(results.values())
+
+
+class TestProtocol:
+    def test_handshake_config_barrier_shutdown(self):
+        with Coordinator(num_workers=2) as coord:
+            res = {}
+            t1 = _spawn_worker(coord.port(), res, "a", heartbeat_interval=0.2)
+            t2 = _spawn_worker(coord.port(), res, "b", heartbeat_interval=0.2)
+            ranks = coord.wait_for_workers(timeout=15)
+            assert ranks == [0, 1]
+            _await_workers(res, 2)
+            coord.deploy_config({"model": "x", "ranks": {"0": {}, "1": {}}},
+                                timeout=15)
+            assert all(w.config["model"] == "x" for w in res.values())
+
+            # barrier: workers block until coordinator releases
+            done = []
+
+            def at_barrier(w):
+                w.barrier("sync1", timeout=15)
+                done.append(w.rank)
+
+            bts = [threading.Thread(target=at_barrier, args=(w,))
+                   for w in res.values()]
+            for t in bts:
+                t.start()
+            coord.barrier("sync1", timeout=15)
+            for t in bts:
+                t.join(timeout=15)
+            assert sorted(done) == [0, 1]
+
+            coord.set_train_mode(False)
+            time.sleep(0.3)
+            assert all(not w.training for w in res.values())
+
+            coord.shutdown()
+            t1.join(timeout=10)
+            t2.join(timeout=10)
+            assert not any(w.running for w in res.values())
+
+    def test_explicit_rank_request(self):
+        with Coordinator(num_workers=1) as coord:
+            res = {}
+            t = _spawn_worker(coord.port(), res, rank=5)
+            coord.wait_for_workers(timeout=15)
+            _await_workers(res, 1)
+            assert list(res.values())[0].rank == 5
+            coord.shutdown()
+            t.join(timeout=10)
+
+    def test_profiling_rpc_merges_workers(self):
+        with Coordinator(num_workers=1) as coord:
+            res = {}
+            t = _spawn_worker(coord.port(), res)
+            coord.wait_for_workers(timeout=15)
+            _await_workers(res, 1)
+            GlobalProfiler.clear()
+            GlobalProfiler.add_event(EventType.COMPUTE, 0.0, 1.0, "span-x")
+            merged = coord.collect_profiles(timeout=15)
+            assert any(e.name == "span-x" for e in merged.events)
+            coord.clear_profiling()
+            time.sleep(0.3)
+            assert GlobalProfiler.events == []
+            coord.shutdown()
+            t.join(timeout=10)
+
+    def test_custom_rpc(self):
+        with Coordinator(num_workers=1) as coord:
+            res = {}
+            t = _spawn_worker(coord.port(), res)
+            coord.wait_for_workers(timeout=15)
+            w = _await_workers(res, 1)[0]
+            w.on("add", lambda obj: {"sum": obj["a"] + obj["b"]})
+            assert coord.send_custom(w.rank, {"name": "add", "a": 2, "b": 3})
+            assert coord.recv_custom(timeout=15)["sum"] == 5
+            # worker -> coordinator direction
+            w.send_custom({"name": "status", "ok": True})
+            assert coord.recv_custom(timeout=15)["ok"] is True
+            coord.shutdown()
+            t.join(timeout=10)
+
+    def test_save_rpc(self, tmp_path):
+        with Coordinator(num_workers=1) as coord:
+            res = {}
+            t = _spawn_worker(coord.port(), res)
+            coord.wait_for_workers(timeout=15)
+            saved = []
+            _await_workers(res, 1)[0].on_save = saved.append
+            coord.save_all(str(tmp_path / "snap"), timeout=15)
+            assert saved == [str(tmp_path / "snap")]
+            coord.shutdown()
+            t.join(timeout=10)
+
+
+class TestFailureDetection:
+    def test_disconnect_detected_and_callback_fires(self):
+        failed = []
+        with Coordinator(num_workers=2, on_failure=failed.append) as coord:
+            res = {}
+            t1 = _spawn_worker(coord.port(), res, "a")
+            t2 = _spawn_worker(coord.port(), res, "b")
+            coord.wait_for_workers(timeout=15)
+            _await_workers(res, 2)
+            victim = res["a"]
+            victim_rank = victim.rank
+            victim._running = False
+            victim._t.close()  # abrupt death (no SHUTDOWN_ACK)
+            deadline = time.monotonic() + 10
+            while victim_rank not in coord.failed_workers():
+                assert time.monotonic() < deadline, "failure not detected"
+                time.sleep(0.05)
+            assert failed == [victim_rank]
+            # broadcasts now skip the dead worker without raising
+            coord.set_train_mode(False)
+            coord.shutdown()
+            t1.join(timeout=10)
+            t2.join(timeout=10)
+
+    def test_heartbeat_timeout_detected(self):
+        with Coordinator(num_workers=1, heartbeat_timeout=0.6) as coord:
+            res = {}
+            t = _spawn_worker(coord.port(), res, heartbeat_interval=60.0)
+            coord.wait_for_workers(timeout=15)
+            w = _await_workers(res, 1)[0]
+            # worker is connected but silent (stalled process): one initial
+            # heartbeat, then nothing -> flagged after the timeout
+            deadline = time.monotonic() + 10
+            while w.rank not in coord.failed_workers():
+                assert time.monotonic() < deadline, "stall not detected"
+                time.sleep(0.1)
+            coord.shutdown(timeout=2)
+            t.join(timeout=10)
+
+
+class TestRobustness:
+    def test_rank_collision_assigns_free_rank(self):
+        with Coordinator(num_workers=2) as coord:
+            res = {}
+            t1 = _spawn_worker(coord.port(), res, "a", rank=1)
+            time.sleep(0.3)  # ensure a registers first
+            t2 = _spawn_worker(coord.port(), res, "b")  # auto-rank
+            ranks = coord.wait_for_workers(timeout=15)
+            assert ranks == [0, 1]
+            _await_workers(res, 2)
+            assert res["a"].rank == 1 and res["b"].rank == 0
+            coord.shutdown()
+            t1.join(timeout=10)
+            t2.join(timeout=10)
+
+    def test_barrier_releases_when_worker_dies(self):
+        """A crash mid-wait shrinks the barrier target instead of hanging."""
+        with Coordinator(num_workers=2, heartbeat_timeout=60) as coord:
+            res = {}
+            t1 = _spawn_worker(coord.port(), res, "a")
+            t2 = _spawn_worker(coord.port(), res, "b")
+            coord.wait_for_workers(timeout=15)
+            _await_workers(res, 2)
+            res["a"]._running = False
+            res["a"]._t.close()  # dies before reaching the barrier
+            survivor = res["b"]
+            done = []
+
+            def arrive():
+                survivor.barrier("b", timeout=20)
+                done.append(True)
+
+            bt = threading.Thread(target=arrive, daemon=True)
+            bt.start()
+            coord.barrier("b", timeout=20)  # must not wait for the dead worker
+            bt.join(timeout=10)
+            assert done
+            coord.shutdown(timeout=2)
+            t1.join(timeout=10)
+            t2.join(timeout=10)
+
+    def test_mismatched_barrier_arrivals_not_lost(self):
+        """An early arrival for barrier B survives the collection of barrier A."""
+        with Coordinator(num_workers=1) as coord:
+            res = {}
+            t = _spawn_worker(coord.port(), res)
+            coord.wait_for_workers(timeout=15)
+            w = _await_workers(res, 1)[0]
+            order = []
+
+            def go():
+                w.barrier("second", timeout=20)  # arrives "early"
+                order.append("released")
+
+            bt = threading.Thread(target=go, daemon=True)
+            bt.start()
+            time.sleep(0.3)  # let the "second" arrival land first
+            coord.barrier("second", timeout=15)
+            bt.join(timeout=10)
+            assert order == ["released"]
+            coord.shutdown()
+            t.join(timeout=10)
+
+    def test_unknown_command_does_not_kill_pump(self):
+        with Coordinator(num_workers=1) as coord:
+            res = {}
+            t = _spawn_worker(coord.port(), res)
+            coord.wait_for_workers(timeout=15)
+            w = _await_workers(res, 1)[0]
+            # send a raw frame with an out-of-enum command straight at the pump
+            w._t.send(w._conn, 999, b'{"x": 1}')
+            time.sleep(0.3)
+            assert coord._pump.is_alive()
+            # protocol still functional afterwards
+            w.on("ping", lambda obj: {"pong": 1})
+            coord.send_custom(w.rank, {"name": "ping"})
+            assert coord.recv_custom(timeout=15)["pong"] == 1
+            coord.shutdown()
+            t.join(timeout=10)
+
+    def test_save_all_without_handler_raises(self):
+        with Coordinator(num_workers=1) as coord:
+            res = {}
+            t = _spawn_worker(coord.port(), res)
+            coord.wait_for_workers(timeout=15)
+            _await_workers(res, 1)
+            with pytest.raises(RuntimeError, match="did not save"):
+                coord.save_all("/tmp/nowhere", timeout=15)
+            coord.shutdown()
+            t.join(timeout=10)
+
+    def test_failed_worker_can_rejoin(self):
+        """Restarting a dead rank re-admits it (reference leaves this a stub)."""
+        failed = []
+        with Coordinator(num_workers=2, on_failure=failed.append) as coord:
+            res = {}
+            t1 = _spawn_worker(coord.port(), res, "a")
+            t2 = _spawn_worker(coord.port(), res, "b")
+            coord.wait_for_workers(timeout=15)
+            _await_workers(res, 2)
+            dead_rank = res["a"].rank
+            res["a"]._running = False
+            res["a"]._t.close()
+            deadline = time.monotonic() + 10
+            while dead_rank not in coord.failed_workers():
+                assert time.monotonic() < deadline
+                time.sleep(0.05)
+            # restart with the same rank
+            res2 = {}
+            t3 = _spawn_worker(coord.port(), res2, "a2", rank=dead_rank)
+            new = _await_workers(res2, 1)[0]
+            assert new.rank == dead_rank
+            deadline = time.monotonic() + 10
+            while dead_rank in coord.failed_workers():
+                assert time.monotonic() < deadline, "rejoin not registered"
+                time.sleep(0.05)
+            coord.shutdown()
+            for t in (t1, t2, t3):
+                t.join(timeout=10)
+
+
+class TestTransportInterop:
+    def test_python_worker_native_coordinator(self):
+        """Wire-format compatibility: both transports speak identical frames."""
+        coord = Coordinator(num_workers=1)  # native if available
+        try:
+            res = {}
+
+            def run():
+                w = Worker("127.0.0.1", coord.port(),
+                           transport=PyTransport(listen_port=None)).start()
+                res["w"] = w
+                w.barrier("x", timeout=15)
+                w.join(timeout=20)
+
+            t = threading.Thread(target=run, daemon=True)
+            t.start()
+            coord.wait_for_workers(timeout=15)
+            coord.barrier("x", timeout=15)
+            coord.shutdown()
+            t.join(timeout=10)
+            assert "w" in res
+        finally:
+            coord.close()
+
+    def test_large_payload(self):
+        """Frames beyond the 64KB recv buffer go through the two-phase path."""
+        with Coordinator(num_workers=1) as coord:
+            res = {}
+            t = _spawn_worker(coord.port(), res)
+            coord.wait_for_workers(timeout=15)
+            big = "x" * 300_000
+            w = _await_workers(res, 1)[0]
+            w.on("echo", lambda obj: {"blob": obj["blob"]})
+            coord.send_custom(w.rank, {"name": "echo", "blob": big})
+            assert coord.recv_custom(timeout=15)["blob"] == big
+            coord.shutdown()
+            t.join(timeout=10)

@@ -1,0 +1,20 @@
+"""Distributed control plane: coordinator/worker orchestration over TCP.
+
+Division of labor on TPU (SURVEY.md §2.4 "TPU mapping note"):
+- DATA plane — tensors, gradients, activations — is XLA collectives over ICI/DCN,
+  compiled into the step via shardings (tnn_tpu/parallel/). It is NOT here.
+- CONTROL plane — config deploy, barriers, profiler collection, health/heartbeat,
+  checkpoint triggers, shutdown — is this package: a small framed-TCP protocol
+  (native transport in native/src/control.cpp with a pure-Python fallback).
+
+Reference parity: Coordinator (include/distributed/coordinator.hpp:50), Worker
+event loop (worker.hpp:41), CommandType protocol (command_type.hpp:20-79). The
+reference's failure handling is print-only stubs (worker.hpp:216-218 throws "Not
+implemented yet"); here heartbeat-based failure detection actually works.
+"""
+from .transport import Transport, make_transport
+from .protocol import Command
+from .coordinator import Coordinator
+from .worker import Worker
+
+__all__ = ["Transport", "make_transport", "Command", "Coordinator", "Worker"]

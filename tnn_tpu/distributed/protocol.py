@@ -1,0 +1,50 @@
+"""Control-plane command protocol.
+
+Parity: the reference's 33-command enum (include/distributed/command_type.hpp:20-79)
+minus the data-plane jobs (FORWARD_JOB/BACKWARD_JOB move through XLA collectives
+here, not TCP) plus working health commands (the reference declares
+HEALTH_CHECK/ERROR_REPORT but its handlers are stubs, worker.hpp:216-277).
+
+Payloads are JSON (UTF-8) — control messages are small and debuggability beats
+binary packing at this layer; bulk tensors never travel this channel.
+"""
+from __future__ import annotations
+
+import enum
+import json
+from typing import Any, Dict, Tuple
+
+
+class Command(enum.IntEnum):
+    HANDSHAKE = 1          # worker -> coordinator: {"rank", "host", "devices"}
+    HANDSHAKE_ACK = 2      # coordinator -> worker: {"rank", "world"}
+    CONFIG_TRANSFER = 3    # coordinator -> worker: arbitrary config dict
+    CONFIG_RECEIVED = 4    # worker -> coordinator ack
+    TRAIN_MODE = 5
+    EVAL_MODE = 6
+    BARRIER = 7            # both ways: {"name"}; coordinator releases with BARRIER_OK
+    BARRIER_OK = 8
+    START_PROFILING = 9
+    REPORT_PROFILING = 10  # worker -> coordinator: Profiler.to_dict()
+    CLEAR_PROFILING = 11
+    SAVE_TO_FILE = 12      # coordinator -> worker: {"path"}
+    SAVED = 13
+    HEARTBEAT = 14         # worker -> coordinator: {"rank", "seq"}
+    HEALTH_CHECK = 15      # coordinator -> worker; worker answers HEALTH_OK
+    HEALTH_OK = 16
+    ERROR_REPORT = 17      # worker -> coordinator: {"rank", "error"}
+    CUSTOM = 18            # user payloads {"name", ...} via Worker.on()
+    SHUTDOWN = 19
+    SHUTDOWN_ACK = 20
+
+
+def pack(obj: Dict[str, Any]) -> bytes:
+    return json.dumps(obj).encode()
+
+
+def unpack(payload: bytes) -> Dict[str, Any]:
+    return json.loads(payload.decode()) if payload else {}
+
+
+def parse(command: int, payload: bytes) -> Tuple[Command, Dict[str, Any]]:
+    return Command(command), unpack(payload)

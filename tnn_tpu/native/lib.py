@@ -68,6 +68,21 @@ def _configure(lib: ctypes.CDLL) -> None:
     lib.tnn_bpe_decode.restype = i64
     lib.tnn_bpe_decode.argtypes = [c.c_void_p, p(i32), i64, c.c_char_p, i64]
 
+    lib.tnn_ctl_create.restype = c.c_void_p
+    lib.tnn_ctl_create.argtypes = [c.c_char_p, c.c_int]
+    lib.tnn_ctl_port.restype = c.c_int
+    lib.tnn_ctl_port.argtypes = [c.c_void_p]
+    lib.tnn_ctl_connect.restype = i64
+    lib.tnn_ctl_connect.argtypes = [c.c_void_p, c.c_char_p, c.c_int]
+    lib.tnn_ctl_send.restype = c.c_int
+    lib.tnn_ctl_send.argtypes = [c.c_void_p, i64, i32, p(u8), i64]
+    lib.tnn_ctl_recv.restype = i64
+    lib.tnn_ctl_recv.argtypes = [c.c_void_p, c.c_double, p(i64), p(i32), p(u8), i64]
+    lib.tnn_ctl_close_conn.restype = None
+    lib.tnn_ctl_close_conn.argtypes = [c.c_void_p, i64]
+    lib.tnn_ctl_destroy.restype = None
+    lib.tnn_ctl_destroy.argtypes = [c.c_void_p]
+
     lib.tnn_tokens_open.restype = c.c_void_p
     lib.tnn_tokens_open.argtypes = [c.c_char_p, c.c_int]
     lib.tnn_tokens_len.restype = i64
@@ -92,8 +107,14 @@ def get_lib() -> Optional[ctypes.CDLL]:
         try:
             if not os.path.isfile(_SO_PATH):
                 build_native()
-            lib = ctypes.CDLL(_SO_PATH)
-            _configure(lib)
+            try:
+                lib = ctypes.CDLL(_SO_PATH)
+                _configure(lib)
+            except AttributeError:
+                # stale .so from before a symbol was added — rebuild once
+                build_native(force=True)
+                lib = ctypes.CDLL(_SO_PATH)
+                _configure(lib)
             _lib = lib
         except (OSError, RuntimeError, AttributeError, subprocess.SubprocessError):
             _lib = None

@@ -170,9 +170,11 @@ def make_eval_step(model, loss_fn: Callable | str = "softmax_cross_entropy",
 
 
 def make_predict(model):
+    """Jitted (params, net_state, data) -> logits — inference needs no TrainState."""
+
     @jax.jit
-    def predict(state: TrainState, data):
-        out, _ = model.apply({"params": state.params, "state": state.net_state},
+    def predict(params, net_state, data):
+        out, _ = model.apply({"params": params, "state": net_state},
                              data, train=False)
         return out
 
