@@ -6,13 +6,17 @@ Baseline (BASELINE.md): the reference's flagship run is CIFAR-100 WRN-16-8 at
 ~102-110 ms/batch for bs=256 over a 2-machine RoCE pipeline => ~2.4k img/s
 (sample_logs/cifar100_wrn16_8:348-368). vs_baseline = our img/s per chip / 2400.
 
-Timing note: on this box's tunneled `axon` TPU platform, jax.block_until_ready does NOT
-actually wait; the only true sync is a value fetch (~90ms round trip). So we time many
-steps and subtract the separately-measured fetch latency.
+Timing utilities live in benchmarks/common.py (axon relay: block_until_ready does
+not wait; sync is a value fetch whose latency is measured and subtracted).
+The wider harness is benchmarks/run_all.py; this file stays the driver's
+single-metric entry point.
 """
 import json
+import os
 import sys
 import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 import jax
 import jax.numpy as jnp
@@ -24,12 +28,8 @@ WARMUP_STEPS = 8
 MEASURE_STEPS = 100
 
 
-def _sync(x) -> float:
-    """True device sync: fetch one scalar (block_until_ready lies on axon relay)."""
-    return float(jnp.ravel(x)[0].astype(jnp.float32))
-
-
 def main():
+    from benchmarks.common import fetch_latency, sync
     from tnn_tpu import models, nn
     from tnn_tpu.train import create_train_state, make_train_step
 
@@ -46,18 +46,13 @@ def main():
 
     for _ in range(WARMUP_STEPS):
         state, m = step(state, data, labels)
-    _sync(m["loss"])
-
-    # fetch round-trip latency (amortised out below)
-    t0 = time.perf_counter()
-    _sync(m["loss"])
-    fetch_latency = time.perf_counter() - t0
+    lat = fetch_latency(m["loss"])
 
     t0 = time.perf_counter()
     for _ in range(MEASURE_STEPS):
         state, m = step(state, data, labels)
-    _sync(m["loss"])
-    dt = (time.perf_counter() - t0 - fetch_latency) / MEASURE_STEPS
+    sync(m["loss"])
+    dt = (time.perf_counter() - t0 - lat) / MEASURE_STEPS
 
     img_s = BATCH / dt
     print(json.dumps({

@@ -1,0 +1,81 @@
+"""Benchmark utilities: axon-aware device timing, verification, MFU.
+
+Pattern parity: the reference's benchmark harness verifies numerics against a
+reference implementation before timing (benchmarks/gemm_benchmark.cpp:20-33 checks
+custom AVX2 GEMM vs MKL) — every benchmark here does the same against numpy/XLA.
+
+Timing on this box's tunneled `axon` TPU: jax.block_until_ready does NOT wait (the
+relay queues executions); the only true sync is a value fetch (~90ms round trip).
+So we time N iterations then fetch one scalar, subtracting the separately measured
+fetch latency (same approach as bench.py).
+"""
+from __future__ import annotations
+
+import time
+from typing import Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# bf16 peak of one TPU v5e chip (the hardware this repo benches on)
+V5E_BF16_PEAK_FLOPS = 197e12
+
+
+def sync(x) -> float:
+    """True device sync via scalar fetch (first leaf of any pytree)."""
+    leaf = jax.tree_util.tree_leaves(x)[0]
+    return float(jnp.ravel(leaf)[0].astype(jnp.float32))
+
+
+def fetch_latency(x, repeats: int = 3) -> float:
+    sync(x)
+    t0 = time.perf_counter()
+    for _ in range(repeats):
+        sync(x)
+    return (time.perf_counter() - t0) / repeats
+
+
+def time_fn(fn: Callable, *args, iters: int = 50, warmup: int = 5) -> float:
+    """Mean seconds per call of a jitted fn (device time, fetch-corrected)."""
+    out = None
+    for _ in range(warmup):
+        out = fn(*args)
+    lat = fetch_latency(out)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    sync(out)
+    return max((time.perf_counter() - t0 - lat) / iters, 1e-9)
+
+
+def verify(name: str, got, want, rtol: float = 2e-2, atol: float = 2e-2) -> None:
+    """Correctness gate before timing (reference: check_match, gemm_benchmark.cpp:20).
+    Tolerances default to bf16-friendly bounds."""
+    got = np.asarray(got, np.float32)
+    want = np.asarray(want, np.float32)
+    err = np.max(np.abs(got - want) / (np.abs(want) + 1.0))
+    if not np.allclose(got, want, rtol=rtol, atol=atol):
+        raise AssertionError(f"{name}: verification FAILED (max rel err {err:.2e})")
+    print(f"  {name}: verified (max rel err {err:.2e})")
+
+
+def report(name: str, seconds: float, flops: Optional[float] = None,
+           items: Optional[float] = None, item_name: str = "items",
+           extra: Optional[Dict] = None) -> Dict:
+    """One result line: ms, GFLOP/s + MFU when flops given, items/s when given."""
+    out: Dict = {"bench": name, "ms": seconds * 1e3}
+    if flops:
+        out["tflops"] = flops / seconds / 1e12
+        out["mfu"] = flops / seconds / V5E_BF16_PEAK_FLOPS
+    if items:
+        out[f"{item_name}_per_s"] = items / seconds
+    if extra:
+        out.update(extra)
+    bits = [f"{name}: {out['ms']:.3f} ms"]
+    if flops:
+        bits.append(f"{out['tflops']:.1f} TFLOP/s ({out['mfu'] * 100:.1f}% MFU)")
+    if items:
+        bits.append(f"{out[f'{item_name}_per_s']:.0f} {item_name}/s")
+    print("  " + ", ".join(bits))
+    return out

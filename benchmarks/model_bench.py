@@ -1,0 +1,126 @@
+#!/usr/bin/env python
+"""Model-level benchmarks: train-step throughput + MFU, GPT-2 decode tok/s.
+
+Parity: the reference's pipeline_benchmark.cpp (whole-model throughput) and the
+north-star metrics in BASELINE.md — WRN-16-8 CIFAR-100 img/s/chip and GPT-2
+inference tokens/sec.
+
+    python benchmarks/model_bench.py [--quick] [--models wrn,resnet9,gpt2]
+"""
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from benchmarks.common import fetch_latency, report, sync
+
+
+def _count_params(params) -> int:
+    return sum(int(np.prod(p.shape)) for p in jax.tree_util.tree_leaves(params))
+
+
+def _time_steps(step, state, data, labels, iters):
+    state, m = step(state, data, labels)
+    for _ in range(4):
+        state, m = step(state, data, labels)
+    lat = fetch_latency(m["loss"])
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        state, m = step(state, data, labels)
+    sync(m["loss"])
+    return max((time.perf_counter() - t0 - lat) / iters, 1e-9)
+
+
+def bench_train(model_name: str, input_shape, num_classes: int, batch: int,
+                iters: int, flops_per_sample: float, label: str):
+    from tnn_tpu import models, nn
+    from tnn_tpu.train import create_train_state, make_train_step
+
+    print(f"{label} train step (bs={batch})")
+    model = models.create(model_name)
+    opt = nn.SGD(lr=0.1, momentum=0.9)
+    rng = jax.random.PRNGKey(0)
+    state = create_train_state(model, opt, rng, (batch,) + input_shape)
+    step = make_train_step(model, opt)
+    rs = np.random.RandomState(0)
+    data = jnp.asarray(rs.randn(batch, *input_shape), jnp.bfloat16)
+    labels = jnp.asarray(rs.randint(0, num_classes, batch), np.int32)
+    dt = _time_steps(step, state, data, labels, iters)
+    # train step ~= 3x forward FLOPs (fwd + 2x bwd)
+    return report(f"{label}_train", dt, flops=3 * flops_per_sample * batch,
+                  items=batch, item_name="img")
+
+
+def bench_gpt2_train(batch: int, seq: int, iters: int, size="small"):
+    from tnn_tpu import models, nn
+    from tnn_tpu.train import create_train_state, make_train_step
+
+    print(f"gpt2_{size} train step (bs={batch}, S={seq})")
+    model = models.create(f"gpt2_{size}")
+    opt = nn.AdamW(lr=1e-4)
+    state = create_train_state(model, opt, jax.random.PRNGKey(0), (batch, seq))
+    step = make_train_step(model, opt)
+    rs = np.random.RandomState(0)
+    ids = jnp.asarray(rs.randint(0, 50257, (batch, seq)), np.int32)
+    dt = _time_steps(step, state, ids, ids, iters)
+    n_params = _count_params(state.params)
+    # 6ND fwd+bwd (Kaplan approximation; the attention S^2 term is omitted, so
+    # MFU is slightly undercounted at long S)
+    flops = 6.0 * n_params * batch * seq
+    return report(f"gpt2_{size}_train", dt, flops=flops, items=batch * seq,
+                  item_name="tok")
+
+
+def bench_gpt2_decode(batch: int, prompt: int, new: int, size="small"):
+    from tnn_tpu import models
+    from tnn_tpu.models.gpt2 import generate
+
+    print(f"gpt2_{size} decode (bs={batch}, prompt={prompt}, new={new})")
+    model = models.create(f"gpt2_{size}")
+    variables = model.init(jax.random.PRNGKey(0), (batch, 8))
+    params = variables["params"]
+    rs = np.random.RandomState(0)
+    ids = rs.randint(0, 50257, (batch, prompt)).astype(np.int32)
+    out = generate(model, params, ids, new)  # compile
+    lat = fetch_latency(out)
+    t0 = time.perf_counter()
+    out = generate(model, params, ids, new)
+    sync(out)
+    dt = max(time.perf_counter() - t0 - lat, 1e-9)
+    return report(f"gpt2_{size}_decode", dt, items=batch * new, item_name="tok",
+                  extra={"batch": batch})
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true")
+    ap.add_argument("--models", default="wrn,resnet9,gpt2,decode")
+    args = ap.parse_args(argv)
+    q = args.quick
+    wanted = set(args.models.split(","))
+    print(f"devices: {jax.devices()}")
+    results = []
+    if "resnet9" in wanted:
+        results.append(bench_train(
+            "cifar10_resnet9", (32, 32, 3), 10, 64 if q else 256,
+            5 if q else 50, flops_per_sample=0.93e9, label="resnet9_cifar10"))
+    if "wrn" in wanted:
+        results.append(bench_train(
+            "cifar100_wrn16_8", (32, 32, 3), 100, 64 if q else 256,
+            5 if q else 50, flops_per_sample=2.4e9, label="wrn16_8_cifar100"))
+    if "gpt2" in wanted:
+        results.append(bench_gpt2_train(2 if q else 8, 128 if q else 512,
+                                        3 if q else 10))
+    if "decode" in wanted:
+        results.append(bench_gpt2_decode(1, 16 if q else 64, 16 if q else 128))
+    return results
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,37 @@
+#!/usr/bin/env python
+"""Run every benchmark; print one JSON line per result plus a summary table.
+
+    python benchmarks/run_all.py [--quick] [--json results.json]
+"""
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from benchmarks import model_bench, ops_bench  # noqa: E402
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true")
+    ap.add_argument("--json", default="", help="also write results to this file")
+    args = ap.parse_args(argv)
+
+    results = []
+    results.extend(ops_bench.main(["--quick"] if args.quick else []))
+    results.extend(model_bench.main(["--quick"] if args.quick else []))
+    results = [r for r in results if r]
+
+    print("\n== results ==")
+    for r in results:
+        print(json.dumps(r))
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(results, f, indent=2)
+    return results
+
+
+if __name__ == "__main__":
+    main()

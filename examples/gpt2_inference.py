@@ -57,10 +57,13 @@ def main(argv=None):
         prompt_ids = np.frombuffer(args.prompt.encode(), np.uint8).astype(
             np.int32)[None] % model.vocab_size
 
-    # generate twice: first call compiles, second measures steady-state decode
+    # generate twice: first call compiles, second measures steady-state decode.
+    # np.asarray forces completion — without it the relay would still be running
+    # the first call when the timer starts.
     out = generate(model, params, prompt_ids, args.max_new_tokens,
                    temperature=args.temperature,
                    rng=jax.random.PRNGKey(args.seed))
+    np.asarray(out)
     t0 = time.perf_counter()
     out = generate(model, params, prompt_ids, args.max_new_tokens,
                    temperature=args.temperature,

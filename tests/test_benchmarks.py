@@ -1,0 +1,30 @@
+"""Benchmark harness smoke tests (quick shapes, CPU-safe): the verification gates
+must pass and each bench must produce a result dict."""
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def test_ops_bench_quick():
+    from benchmarks import ops_bench
+
+    results = ops_bench.main(["--quick"])
+    results = [r for r in results if r]
+    names = {r["bench"] for r in results}
+    assert {"gemm_bf16", "conv2d_3x3_bf16", "dense_fwd_bwd_bf16"} <= names
+    assert all(r["ms"] > 0 for r in results)
+    assert any(n.startswith("sdpa_causal") for n in names)
+
+
+def test_model_bench_quick():
+    from benchmarks import model_bench
+
+    results = model_bench.main(["--quick", "--models", "resnet9,decode"])
+    results = [r for r in results if r]
+    names = {r["bench"] for r in results}
+    assert "resnet9_cifar10_train" in names
+    assert "gpt2_small_decode" in names
+    img = next(r for r in results if r["bench"] == "resnet9_cifar10_train")
+    assert img["img_per_s"] > 0 and 0 < img["mfu"] < 2
