@@ -47,9 +47,15 @@ def load_mnist_csv(path: str) -> Tuple[np.ndarray, np.ndarray]:
 
 
 def _has_header(path: str) -> int:
+    """1 if the first line is a header (its first field is not numeric) else 0.
+    float() handles both int label CSVs and float feature CSVs ('0.43', '-1.2')."""
     with open(path, "r") as f:
         first = f.readline()
-    return 0 if first.split(",")[0].strip().isdigit() else 1
+    try:
+        float(first.split(",")[0].strip())
+        return 0
+    except ValueError:
+        return 1
 
 
 class MNISTDataLoader(ArrayDataLoader):
@@ -225,3 +231,41 @@ def _decode_image_pil(path: str, image_size) -> np.ndarray:
             f"PIL unavailable to decode {path}; provide images.npy instead") from e
     img = Image.open(path).convert("RGB").resize((image_size[1], image_size[0]))
     return np.asarray(img, np.uint8)
+
+
+# -- Regression CSVs (WiFi RSSI localisation etc.) ----------------------------
+
+
+class RegressionCSVDataLoader(ArrayDataLoader):
+    """Generic numeric-CSV regression loader (parity: RegressionDataLoader +
+    UJI/UTS WiFi loaders, include/data_loading/{regression,wifi}_data_loader.hpp).
+
+    Each row is ``feature_0,...,feature_{F-1},target_0,...,target_{T-1}``; the last
+    ``num_targets`` columns are the regression targets (float32), the rest are
+    features. ``normalize`` standardizes features to zero mean / unit variance with
+    stats from this split (pass ``stats`` from the train loader for eval splits —
+    the reference normalizes train/test with train statistics).
+    """
+
+    def __init__(self, path: str, num_targets: int = 1, normalize: bool = True,
+                 stats: Optional[Tuple[np.ndarray, np.ndarray]] = None,
+                 seed: int = 0):
+        raw = np.loadtxt(path, delimiter=",", skiprows=_has_header(path),
+                         dtype=np.float32)
+        if raw.ndim == 1:
+            raw = raw[None]
+        if not 1 <= num_targets < raw.shape[1]:
+            raise ValueError(f"{path}: num_targets must be in [1, "
+                             f"{raw.shape[1] - 1}], got {num_targets}")
+        feats = raw[:, :-num_targets]
+        targets = raw[:, -num_targets:]
+        if normalize:
+            if stats is None:
+                mean = feats.mean(0)
+                std = feats.std(0)
+                std[std == 0] = 1.0
+                stats = (mean, std)
+            feats = (feats - stats[0]) / stats[1]
+        self.stats = stats
+        super().__init__(np.ascontiguousarray(feats),
+                         np.ascontiguousarray(targets), seed)

@@ -8,6 +8,7 @@ from .datasets import (
     CIFAR100DataLoader,
     ImageFolderDataLoader,
     MNISTDataLoader,
+    RegressionCSVDataLoader,
 )
 from .loader import DataLoader, SyntheticDataLoader
 from .token_stream import OpenWebTextDataLoader
@@ -47,3 +48,5 @@ register_loader("synthetic_cifar",
 register_loader("synthetic_mnist",
                 lambda path, num_samples=2048, **kw:
                 SyntheticDataLoader(num_samples, (28, 28, 1), 10, **kw))
+register_loader("regression_csv",
+                lambda path, **kw: RegressionCSVDataLoader(path, **kw))

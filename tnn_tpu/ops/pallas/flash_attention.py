@@ -24,8 +24,11 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-DEFAULT_BLOCK_Q = 128
-DEFAULT_BLOCK_K = 128
+# Tuned on v5e at GPT-2 geometry (B=8,H=12,S=1024,D=64): 128/128 -> 2.04ms,
+# 512/512 -> 0.54ms, 512/1024 -> 0.43ms (vs 0.82ms XLA-fused SDPA). Large k
+# blocks amortize the per-grid-step overhead; VMEM at D<=128 stays ~1-2MB.
+DEFAULT_BLOCK_Q = 512
+DEFAULT_BLOCK_K = 1024
 _NEG_INF = -1e30
 
 
