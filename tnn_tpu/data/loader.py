@@ -194,24 +194,29 @@ def split_microbatches(data: np.ndarray, labels: np.ndarray,
     return list(zip(np.split(data, num_microbatches), np.split(labels, num_microbatches)))
 
 
-def prefetch(iterator: Iterator, size: int = 2, to_device: bool = True) -> Iterator:
+def prefetch(iterator: Iterator, size: int = 2, to_device=True) -> Iterator:
     """Background-thread prefetch with optional H2D staging.
 
     Overlaps host batch assembly and host→device transfer with device compute —
     the TPU replacement for the reference's async stream pipeline (CUDAFlow/Task,
     include/device/flow.hpp:28). ``jax.device_put`` is async: the transfer rides
     ahead while the previous step executes.
+
+    ``to_device`` may be a callable(batch) -> batch for custom placement (e.g. a
+    mesh batch sharding); True uses plain jax.device_put; False stages nothing.
     """
     q: "queue.Queue" = queue.Queue(maxsize=size)
     sentinel = object()
     stop = threading.Event()
     err: list = []
+    place = to_device if callable(to_device) else (
+        jax.device_put if to_device else None)
 
     def producer():
         try:
             for item in iterator:
-                if to_device:
-                    item = jax.device_put(item)
+                if place is not None:
+                    item = place(item)
                 while not stop.is_set():
                     try:
                         q.put(item, timeout=0.1)

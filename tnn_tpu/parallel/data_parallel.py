@@ -42,18 +42,21 @@ def shard_params_fsdp(params, mesh: Mesh, min_size: int = 2 ** 16):
 
 
 def make_dp_train_step(model, optimizer, mesh: Mesh, loss_fn="softmax_cross_entropy",
-                       scheduler=None, fsdp: bool = False, donate: bool = True):
+                       scheduler=None, fsdp: bool = False, donate: bool = True,
+                       **step_kw):
     """Build a data-parallel train step over ``mesh``.
 
     Returns (step, place_state, place_batch):
       step(state, data, labels) -> (state, metrics) — jitted with shardings
       place_state(state) -> state placed per the chosen param strategy
       place_batch(data, labels) -> batch sharded over the data axis
+
+    Extra keyword args (grad_accum, augment, ...) pass through to make_train_step.
     """
     from ..train.step import make_train_step
 
     step = make_train_step(model, optimizer, loss_fn=loss_fn, scheduler=scheduler,
-                           donate=donate)
+                           donate=donate, **step_kw)
     batch_sharding = NamedSharding(mesh, P(("data", "fsdp") if fsdp else "data"))
     repl = mesh_lib.replicated(mesh)
 

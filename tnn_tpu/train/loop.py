@@ -30,7 +30,7 @@ from .step import TrainState, create_train_state, make_eval_step, make_train_ste
 
 
 def _staged_batches(loader: DataLoader, batch_size: int, config: TrainingConfig,
-                    reset: bool = True, limit: int = -1):
+                    reset: bool = True, limit: int = -1, place=None):
     """io-dtype cast on the producer thread + async device_put, so both the cast and
     the H2D transfer overlap device compute (prefetch's to_device staging).
 
@@ -53,16 +53,17 @@ def _staged_batches(loader: DataLoader, batch_size: int, config: TrainingConfig,
                 data = data.astype(io_dtype)
             yield data, labels
 
-    return prefetch(gen(), to_device=True)
+    return prefetch(gen(), to_device=place if place is not None else True)
 
 
 def evaluate(eval_step, state: TrainState, loader: DataLoader, batch_size: int,
-             config: Optional[TrainingConfig] = None) -> Dict[str, float]:
+             config: Optional[TrainingConfig] = None,
+             place=None) -> Dict[str, float]:
     """Full-dataset validation (parity: validate_model, src/nn/train.cpp:388) —
     aggregates corrects/loss over all complete batches."""
     total, corrects, loss_sum, batches = 0, 0.0, 0.0, 0
     cfg = config or TrainingConfig()
-    for data, labels in _staged_batches(loader, batch_size, cfg):
+    for data, labels in _staged_batches(loader, batch_size, cfg, place=place):
         m = eval_step(state, data, labels)
         loss_sum += float(m["loss"])
         if "corrects" in m:
@@ -124,10 +125,45 @@ def train_model(
         resumed = True
         log.info("resumed from %s at step %d", config.resume, int(state.step))
 
-    step_fn = make_train_step(
-        model, optimizer, loss_fn=config.loss, scheduler=scheduler,
-        grad_accum=config.gradient_accumulation_steps, augment=augment)
-    eval_fn = make_eval_step(model, loss_fn=config.loss)
+    # multi-chip: mesh_axes like {"data": 8} or {"data": 4, "fsdp": 2} turn the
+    # SAME train step into a sharded program — GSPMD inserts the gradient
+    # all-reduce over ICI (the reference's DP never all-reduces; SURVEY.md §2.4)
+    mesh = None
+    place_batch = None
+    if any(int(v) > 1 for v in (config.mesh_axes or {}).values()):
+        from .. import parallel
+
+        axes = {k: int(v) for k, v in config.mesh_axes.items()}
+        unsupported = set(axes) - {"data", "fsdp"}
+        if any(axes[a] > 1 for a in unsupported):
+            raise ValueError(
+                f"train_model auto-sharding handles data/fsdp axes; got {axes}. "
+                f"Use tnn_tpu.parallel directly for tp/pipe/seq layouts.")
+        shard_ways = axes.get("data", 1) * axes.get("fsdp", 1)
+        if batch_size % shard_ways:
+            raise ValueError(
+                f"batch_size {batch_size} not divisible by the "
+                f"data*fsdp mesh size {shard_ways} (mesh_axes={axes})")
+        mesh = parallel.make_mesh(**{k: axes.get(k, 1) for k in ("data", "fsdp")})
+        step_fn, place_state, _place = parallel.make_dp_train_step(
+            model, optimizer, mesh, loss_fn=config.loss, scheduler=scheduler,
+            fsdp=axes.get("fsdp", 1) > 1,
+            grad_accum=config.gradient_accumulation_steps, augment=augment)
+        state = place_state(state)
+        place_batch = lambda batch: _place(*batch)  # noqa: E731
+        log.info("mesh %s: batch sharded over %d devices",
+                 dict(mesh.shape), mesh.size)
+    else:
+        step_fn = make_train_step(
+            model, optimizer, loss_fn=config.loss, scheduler=scheduler,
+            grad_accum=config.gradient_accumulation_steps, augment=augment)
+    base_eval = make_eval_step(model, loss_fn=config.loss)
+    if mesh is not None:
+        def eval_fn(state, data, labels, _f=base_eval, _m=mesh):
+            with _m:
+                return _f(state, data, labels)
+    else:
+        eval_fn = base_eval
 
     history: List[Dict[str, Any]] = []
     if config.shuffle and not resumed:
@@ -152,7 +188,8 @@ def train_model(
                               and train_loader.remaining_batches(batch_size) > 0)
             for data, labels in _staged_batches(train_loader, batch_size, config,
                                                 reset=not continue_epoch,
-                                                limit=config.max_steps):
+                                                limit=config.max_steps,
+                                                place=place_batch):
                 # host-side span = dispatch of one compiled step (device runs async; use
                 # profiling.device_trace for per-HLO timing). CUMULATIVE keeps only
                 # constant-memory counters; NORMAL records one event per step.
@@ -191,7 +228,8 @@ def train_model(
             }
 
             if val_loader is not None:
-                val = evaluate(eval_fn, state, val_loader, batch_size, config)
+                val = evaluate(eval_fn, state, val_loader, batch_size, config,
+                               place=place_batch)
                 epoch_metrics["val_loss"] = val["loss"]
                 epoch_metrics["val_accuracy"] = val.get("accuracy", 0.0)
                 if plateau and np.isfinite(val["loss"]):
