@@ -7,9 +7,12 @@ at include/nn/blocks_impl/cpu/flash_attention.hpp:18-80 used Br=64/Bc=64 online 
 same algorithm, here actually working and TPU-tiled).
 
 Forward: online-softmax accumulation over key blocks with O(block) VMEM, grid
-(batch*heads, q_blocks, k_blocks), causal blocks fully above the diagonal skipped.
-Backward: recompute-based VJP in plain XLA (correct everywhere; a fused Pallas backward
-is a later optimisation).
+(batch*heads, q_blocks, k_blocks), causal blocks fully above the diagonal skipped;
+the per-row logsumexp L is written out for the backward.
+Backward: blockwise Pallas kernels too (FlashAttention-2 style) — one pass
+accumulating dQ over key blocks, one accumulating dK/dV over query blocks, both
+O(block) memory, so long-context TRAINING never materializes the (S, S) logits
+(the earlier XLA recompute backward OOMed at S=8k).
 
 Falls back to interpret mode off-TPU so the same code path tests on CPU.
 """
@@ -32,7 +35,7 @@ DEFAULT_BLOCK_K = 1024
 _NEG_INF = -1e30
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr, *,
                 scale: float, causal: bool, bq: int, bk: int, kv_len: int):
     qi = pl.program_id(1)
     ki = pl.program_id(2)
@@ -80,8 +83,23 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
     @pl.when(ki == nk - 1)
     def _final():
         l = l_scr[:, :1]
-        l = jnp.where(l == 0.0, 1.0, l)  # fully-masked rows -> 0 output
-        o_ref[0] = (acc_scr[:] / l).astype(o_ref.dtype)
+        lsafe = jnp.where(l == 0.0, 1.0, l)  # fully-masked rows -> 0 output
+        o_ref[0] = (acc_scr[:] / lsafe).astype(o_ref.dtype)
+        # logsumexp per row for the backward; +inf on fully-masked/padded rows
+        # makes their p = exp(s - L) exactly 0 there (never NaN)
+        m = m_scr[:, :1]
+        lse = jnp.where(l > 0.0, m + jnp.log(lsafe), jnp.inf)
+        # lane-replicated (bq, 128) layout — same as the reference TPU kernel's
+        # l/m outputs; the backward reads [:, :1] without any relayout
+        lse_ref[0] = jnp.broadcast_to(lse, lse_ref.shape[1:])
+
+
+def _block_geometry(sq: int, skv: int, block_q: int, block_k: int):
+    """Shared fwd/bwd block sizing — the backward must pad exactly like the
+    forward did (the saved lse's padded shape encodes this)."""
+    bq = min(block_q, max(sq, 8))
+    bk = min(block_k, max(skv, 8))
+    return bq, bk, pl.cdiv(sq, bq) * bq, pl.cdiv(skv, bk) * bk
 
 
 def _pad_to(x, size, axis):
@@ -105,10 +123,7 @@ def _flash_fwd(q, k, v, causal, scale, block_q, block_k):
     skv = k.shape[2]
     if scale is None:
         scale = 1.0 / math.sqrt(d)
-    bq = min(block_q, max(sq, 8))
-    bk = min(block_k, max(skv, 8))
-    sq_p = pl.cdiv(sq, bq) * bq
-    skv_p = pl.cdiv(skv, bk) * bk
+    bq, bk, sq_p, skv_p = _block_geometry(sq, skv, block_q, block_k)
 
     qf = _pad_to(q.reshape(b * h, sq, d), sq_p, 1)
     kf = _pad_to(k.reshape(b * h, skv, d), skv_p, 1)
@@ -117,7 +132,7 @@ def _flash_fwd(q, k, v, causal, scale, block_q, block_k):
     grid = (b * h, sq_p // bq, skv_p // bk)
     kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal,
                                bq=bq, bk=bk, kv_len=skv)
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
@@ -128,9 +143,16 @@ def _flash_fwd(q, k, v, causal, scale, block_q, block_k):
             pl.BlockSpec((1, bk, d), lambda bh, qi, ki: (bh, ki, 0),
                          memory_space=pltpu.VMEM),
         ],
-        out_specs=pl.BlockSpec((1, bq, d), lambda bh, qi, ki: (bh, qi, 0),
-                               memory_space=pltpu.VMEM),
-        out_shape=jax.ShapeDtypeStruct((b * h, sq_p, d), q.dtype),
+        out_specs=[
+            pl.BlockSpec((1, bq, d), lambda bh, qi, ki: (bh, qi, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bq, 128), lambda bh, qi, ki: (bh, qi, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, sq_p, d), q.dtype),
+            jax.ShapeDtypeStruct((b * h, sq_p, 128), jnp.float32),
+        ],
         scratch_shapes=[
             pltpu.VMEM((bq, 128), jnp.float32),  # running max (lanes broadcast)
             pltpu.VMEM((bq, 128), jnp.float32),  # running denominator
@@ -139,31 +161,154 @@ def _flash_fwd(q, k, v, causal, scale, block_q, block_k):
         interpret=jax.default_backend() != "tpu",
     )(qf, kf, vf)
     out = out[:, :sq].reshape(b, h, sq, d)
-    return out, (q, k, v, out)
+    # keep only one lane of the lane-replicated lse as the residual (4 bytes/row
+    # held between fwd and bwd, not 512); the bwd re-broadcasts transiently
+    return out, (q, k, v, out, lse[:, :, 0])
+
+
+def _attn_probs(q, k, lse_col, k_start, q_start, *, scale, causal, bq, bk, kv_len):
+    """Recompute P_ij = exp(S_ij - L_i) for one (q block, k block) tile, masked."""
+    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * scale
+    kpos = k_start + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+    mask = kpos < kv_len
+    if causal:
+        qpos = q_start + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+        mask = jnp.logical_and(mask, qpos >= kpos)
+    s = jnp.where(mask, s, _NEG_INF)
+    # L = +inf on fully-masked/padded rows -> p = 0 there (see _fwd_kernel)
+    return jnp.exp(s - lse_col)
+
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, dq_ref,
+                   dq_scr, *, scale, causal, bq, bk, kv_len):
+    qi, ki, nk = pl.program_id(1), pl.program_id(2), pl.num_programs(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        dq_scr[:] = jnp.zeros_like(dq_scr)
+
+    q_start, k_start = qi * bq, ki * bk
+    live = (k_start <= q_start + bq - 1) if causal else True
+
+    @pl.when(live)
+    def _block():
+        q, k, v, do = q_ref[0], k_ref[0], v_ref[0], do_ref[0]
+        lse_col = lse_ref[0][:, :1]                    # (bq, 1), lane-replicated
+        do32 = do.astype(jnp.float32)
+        # delta_i = rowsum(dO_i * O_i), recomputed per block (elementwise, cheap)
+        delta = jnp.sum(do32 * o_ref[0].astype(jnp.float32), axis=1,
+                        keepdims=True)
+        p = _attn_probs(q, k, lse_col, k_start, q_start, scale=scale,
+                        causal=causal, bq=bq, bk=bk, kv_len=kv_len)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * scale                  # (bq, bk) f32
+        dq_scr[:] += jax.lax.dot_general(ds.astype(k.dtype), k,
+                                         (((1,), (0,)), ((), ())),
+                                         preferred_element_type=jnp.float32)
+
+    @pl.when(ki == nk - 1)
+    def _final():
+        dq_ref[0] = dq_scr[:].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
+                    dk_ref, dv_ref, dk_scr, dv_scr, *, scale, causal, bq, bk,
+                    kv_len):
+    # grid: (bh, k_blocks, q_blocks) — accumulate over q for one k/v block
+    ki, qi, nq = pl.program_id(1), pl.program_id(2), pl.num_programs(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    q_start, k_start = qi * bq, ki * bk
+    live = (k_start <= q_start + bq - 1) if causal else True
+
+    @pl.when(live)
+    def _block():
+        q, k, v, do = q_ref[0], k_ref[0], v_ref[0], do_ref[0]
+        lse_col = lse_ref[0][:, :1]
+        delta = jnp.sum(do.astype(jnp.float32) * o_ref[0].astype(jnp.float32),
+                        axis=1, keepdims=True)
+        p = _attn_probs(q, k, lse_col, k_start, q_start, scale=scale,
+                        causal=causal, bq=bq, bk=bk, kv_len=kv_len)
+        pt = p.astype(do.dtype)
+        dv_scr[:] += jax.lax.dot_general(pt, do, (((0,), (0,)), ((), ())),
+                                         preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = (p * (dp - delta) * scale).astype(q.dtype)
+        dk_scr[:] += jax.lax.dot_general(ds, q, (((0,), (0,)), ((), ())),
+                                         preferred_element_type=jnp.float32)
+
+    @pl.when(qi == nq - 1)
+    def _final():
+        dk_ref[0] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
 
 
 def _flash_bwd(causal, scale, block_q, block_k, residuals, g):
-    """Recompute-based backward in plain XLA (softmax re-derived in f32)."""
-    q, k, v, o = residuals
-    d = q.shape[-1]
+    """Blockwise Pallas backward: never materializes the (S, S) matrix."""
+    q, k, v, o, lse_row = residuals
+    b, h, sq, d = q.shape
+    skv = k.shape[2]
     if scale is None:
         scale = 1.0 / math.sqrt(d)
-    sq, skv = q.shape[-2], k.shape[-2]
-    logits = jnp.einsum("bhqd,bhkd->bhqk", q, k,
-                        preferred_element_type=jnp.float32) * scale
-    if causal:
-        qpos = jnp.arange(sq)[:, None]
-        kpos = jnp.arange(skv)[None, :]
-        logits = jnp.where(qpos >= kpos, logits, _NEG_INF)
-    p = jax.nn.softmax(logits, axis=-1)  # (b,h,q,k) f32
-    g32 = g.astype(jnp.float32)
-    dv = jnp.einsum("bhqk,bhqd->bhkd", p, g32)
-    dp = jnp.einsum("bhqd,bhkd->bhqk", g32, v.astype(jnp.float32))
-    delta = jnp.sum(g32 * o.astype(jnp.float32), axis=-1, keepdims=True)  # (b,h,q,1)
-    ds = p * (dp - delta) * scale
-    dq = jnp.einsum("bhqk,bhkd->bhqd", ds, k.astype(jnp.float32))
-    dk = jnp.einsum("bhqk,bhqd->bhkd", ds, q.astype(jnp.float32))
-    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+    bq, bk, sq_p, skv_p = _block_geometry(sq, skv, block_q, block_k)
+
+    qf = _pad_to(q.reshape(b * h, sq, d), sq_p, 1)
+    kf = _pad_to(k.reshape(b * h, skv, d), skv_p, 1)
+    vf = _pad_to(v.reshape(b * h, skv, d), skv_p, 1)
+    of = _pad_to(o.reshape(b * h, sq, d), sq_p, 1)
+    dof = _pad_to(g.reshape(b * h, sq, d), sq_p, 1)
+    # transient lane-replication back to the kernel's (bq, 128) layout
+    lse = jnp.broadcast_to(lse_row[:, :, None], (b * h, sq_p, 128))
+
+    interpret = jax.default_backend() != "tpu"
+    common = dict(scale=scale, causal=causal, bq=bq, bk=bk, kv_len=skv)
+    q_spec = pl.BlockSpec((1, bq, d), lambda bh, i, j: (bh, i, 0),
+                          memory_space=pltpu.VMEM)
+    lse_spec = pl.BlockSpec((1, bq, 128), lambda bh, i, j: (bh, i, 0),
+                            memory_space=pltpu.VMEM)
+    kv_spec = pl.BlockSpec((1, bk, d), lambda bh, i, j: (bh, j, 0),
+                           memory_space=pltpu.VMEM)
+
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, **common),
+        grid=(b * h, sq_p // bq, skv_p // bk),
+        in_specs=[q_spec, kv_spec, kv_spec, q_spec, q_spec, lse_spec],
+        out_specs=q_spec,
+        out_shape=jax.ShapeDtypeStruct((b * h, sq_p, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
+        interpret=interpret,
+    )(qf, kf, vf, of, dof, lse)
+
+    # transposed grid: blocks indexed (bh, k block, q block)
+    qT_spec = pl.BlockSpec((1, bq, d), lambda bh, j, i: (bh, i, 0),
+                           memory_space=pltpu.VMEM)
+    lseT_spec = pl.BlockSpec((1, bq, 128), lambda bh, j, i: (bh, i, 0),
+                             memory_space=pltpu.VMEM)
+    kvT_spec = pl.BlockSpec((1, bk, d), lambda bh, j, i: (bh, j, 0),
+                            memory_space=pltpu.VMEM)
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, **common),
+        grid=(b * h, skv_p // bk, sq_p // bq),
+        in_specs=[qT_spec, kvT_spec, kvT_spec, qT_spec, qT_spec, lseT_spec],
+        out_specs=[kvT_spec, kvT_spec],
+        out_shape=[jax.ShapeDtypeStruct((b * h, skv_p, d), k.dtype),
+                   jax.ShapeDtypeStruct((b * h, skv_p, d), v.dtype)],
+        scratch_shapes=[pltpu.VMEM((bk, d), jnp.float32),
+                        pltpu.VMEM((bk, d), jnp.float32)],
+        interpret=interpret,
+    )(qf, kf, vf, of, dof, lse)
+
+    dq = dq[:, :sq].reshape(b, h, sq, d)
+    dk = dk[:, :skv].reshape(b, h, skv, d)
+    dv = dv[:, :skv].reshape(b, h, skv, d)
+    return dq, dk, dv
 
 
 flash_attention.defvjp(_flash_fwd, _flash_bwd)
