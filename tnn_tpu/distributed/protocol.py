@@ -6,13 +6,21 @@ here, not TCP) plus working health commands (the reference declares
 HEALTH_CHECK/ERROR_REPORT but its handlers are stubs, worker.hpp:216-277).
 
 Payloads are JSON (UTF-8) — control messages are small and debuggability beats
-binary packing at this layer; bulk tensors never travel this channel.
+binary packing at this layer; bulk tensors never travel this channel. Payloads
+above a threshold are zlib-compressed, tagged by a one-byte header (the
+reference declares CompressionType {NONE, ZSTD, QUANTIZATION} in its packet
+format but never implements any — packet.hpp:10-57; here compression works).
 """
 from __future__ import annotations
 
 import enum
 import json
+import zlib
 from typing import Any, Dict, Tuple
+
+_RAW = b"\x00"
+_ZLIB = b"\x01"
+COMPRESS_THRESHOLD = 4096  # bytes of JSON before compression kicks in
 
 
 class Command(enum.IntEnum):
@@ -39,11 +47,21 @@ class Command(enum.IntEnum):
 
 
 def pack(obj: Dict[str, Any]) -> bytes:
-    return json.dumps(obj).encode()
+    raw = json.dumps(obj).encode()
+    if len(raw) > COMPRESS_THRESHOLD:
+        return _ZLIB + zlib.compress(raw, level=3)
+    return _RAW + raw
 
 
 def unpack(payload: bytes) -> Dict[str, Any]:
-    return json.loads(payload.decode()) if payload else {}
+    if not payload:
+        return {}
+    tag, body = payload[:1], payload[1:]
+    if tag == _ZLIB:
+        body = zlib.decompress(body)
+    elif tag != _RAW:
+        raise ValueError(f"unknown payload tag {tag!r}")
+    return json.loads(body.decode())
 
 
 def parse(command: int, payload: bytes) -> Tuple[Command, Dict[str, Any]]:
