@@ -5,20 +5,17 @@ This is the TPU analog of the reference's IN_PROCESS endpoint trick
 tested in one process — here on a virtual 8-device mesh — without real hardware.
 
 The dev box exposes a real TPU through a sitecustomize that pre-imports jax, so env vars
-alone don't stick; jax.config.update after import is required. TNN_TEST_PLATFORM
-overrides for running the suite on hardware.
+alone don't stick; the shared workaround lives in tnn_tpu.utils.platform.
+TNN_TEST_PLATFORM overrides for running the suite on hardware.
 """
 import os
+import sys
 
-_platform = os.environ.get("TNN_TEST_PLATFORM", "cpu")
-os.environ["JAX_PLATFORMS"] = _platform
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-import jax  # noqa: E402
+from tnn_tpu.utils.platform import force_platform  # noqa: E402
 
-jax.config.update("jax_platforms", _platform)
+jax = force_platform(os.environ.get("TNN_TEST_PLATFORM", "cpu"), n_devices=8)
 
 import pytest  # noqa: E402
 

@@ -30,9 +30,17 @@ def _ring_attention_local(q, k, v, *, axis: str, causal: bool, scale: float):
 
     qpos = (idx * s_local + jnp.arange(s_local))[:, None]  # global query positions
 
-    def block(carry, kv_and_owner):
-        m_prev, l_prev, acc = carry
-        k_blk, v_blk, owner = kv_and_owner
+    b, h, s, d = q.shape
+    m0 = jnp.full((b, h, s, 1), -1e30, jnp.float32)
+    l0 = jnp.zeros((b, h, s, 1), jnp.float32)
+    acc0 = jnp.zeros((b, h, s, d), jnp.float32)
+
+    perm = [(i, (i + 1) % ring) for i in range(ring)]
+
+    def attend(m_prev, l_prev, acc, k_blk, v_blk, r):
+        """One online-softmax block update against the K/V block held after r hops."""
+        # after r hops this device holds the block originally owned by (idx - r) % ring
+        owner = jnp.mod(idx - r, ring)
         logits = jnp.einsum("bhqd,bhkd->bhqk", q, k_blk,
                             preferred_element_type=jnp.float32) * scale
         if causal:
@@ -46,24 +54,22 @@ def _ring_attention_local(q, k, v, *, axis: str, causal: bool, scale: float):
         l_new = alpha * l_prev + l_cur
         acc = acc * alpha + jnp.einsum("bhqk,bhkd->bhqd", p.astype(v_blk.dtype), v_blk,
                                        preferred_element_type=jnp.float32)
-        return (m_new, l_new, acc), None
+        return m_new, l_new, acc
 
-    b, h, s, d = q.shape
-    m0 = jnp.full((b, h, s, 1), -1e30, jnp.float32)
-    l0 = jnp.zeros((b, h, s, 1), jnp.float32)
-    acc0 = jnp.zeros((b, h, s, d), jnp.float32)
+    def block(carry, r):
+        # lax.scan (not a Python loop): one compiled body regardless of ring size,
+        # so compile time stays flat as the ring grows.
+        m_prev, l_prev, acc, k_blk, v_blk = carry
+        m_new, l_new, acc = attend(m_prev, l_prev, acc, k_blk, v_blk, r)
+        k_blk = jax.lax.ppermute(k_blk, axis, perm)
+        v_blk = jax.lax.ppermute(v_blk, axis, perm)
+        return (m_new, l_new, acc, k_blk, v_blk), None
 
-    perm = [(i, (i + 1) % ring) for i in range(ring)]
-    carry = (m0, l0, acc0)
-    k_blk, v_blk = k, v
-    for r in range(ring):
-        # after r hops this device holds the block originally owned by (idx - r) % ring
-        owner = jnp.mod(idx - r, ring)
-        carry, _ = block(carry, (k_blk, v_blk, owner))
-        if r < ring - 1:
-            k_blk = jax.lax.ppermute(k_blk, axis, perm)
-            v_blk = jax.lax.ppermute(v_blk, axis, perm)
-    m, l, acc = carry
+    # Scan the first ring-1 blocks (each ending with a K/V hop); the final block
+    # attends outside the scan so no ICI hop is wasted shipping K/V a full circle.
+    (m, l, acc, k_last, v_last), _ = jax.lax.scan(
+        block, (m0, l0, acc0, k, v), jnp.arange(ring - 1))
+    m, l, acc = attend(m, l, acc, k_last, v_last, ring - 1)
     l = jnp.where(l == 0.0, 1.0, l)
     return (acc / l).astype(q.dtype)
 

@@ -1,0 +1,63 @@
+"""Platform forcing for environments whose sitecustomize pins the JAX platform.
+
+The dev/driver image registers a TPU PJRT plugin ("axon") from a sitecustomize at
+interpreter start and pins ``jax_platforms`` via config, so JAX_PLATFORMS env vars set
+by a caller do NOT redirect the platform — only ``jax.config.update`` after import
+does. Every entry point that must run on a specific platform (tests/conftest.py,
+bench.py, __graft_entry__.py) goes through these helpers so the workaround lives in
+exactly one place.
+"""
+from __future__ import annotations
+
+import os
+import re
+
+_COUNT_FLAG = "--xla_force_host_platform_device_count"
+
+
+def force_platform(platform: str = "cpu", n_devices: int | None = None):
+    """Force the JAX platform (and, for cpu, the virtual device count). Returns jax.
+
+    Safe to call before the backend is initialized; after initialization use
+    :func:`ensure_cpu_devices`, which also resets an already-created backend.
+    """
+    os.environ["JAX_PLATFORMS"] = platform
+    if platform == "cpu" and n_devices is not None:
+        flags = os.environ.get("XLA_FLAGS", "")
+        flag = f"{_COUNT_FLAG}={n_devices}"
+        if _COUNT_FLAG in flags:
+            # Replace an existing count rather than appending a duplicate: XLA honors
+            # the first occurrence, so append-only would silently keep the old count.
+            flags = re.sub(rf"{_COUNT_FLAG}=\d+", flag, flags)
+        else:
+            flags = (flags + " " + flag).strip()
+        os.environ["XLA_FLAGS"] = flags
+
+    import jax
+
+    jax.config.update("jax_platforms", platform)
+    if platform == "cpu" and n_devices is not None:
+        try:
+            jax.config.update("jax_num_cpu_devices", n_devices)
+        except Exception:
+            pass  # older jax: the XLA_FLAGS env set above is the only knob
+    return jax
+
+
+def ensure_cpu_devices(n_devices: int):
+    """Force the virtual n-device CPU platform, resetting a live backend if needed.
+
+    clear_backends runs BEFORE the config updates: once a backend exists, the
+    jax_num_cpu_devices update raises (and XLA_FLAGS was already parsed), so
+    clearing afterwards would re-create a 1-device CPU client. Clearing when no
+    backend exists yet is a no-op, so the unconditional order is always safe.
+    """
+    from jax.extend import backend as _jeb
+
+    _jeb.clear_backends()
+    jax = force_platform("cpu", n_devices)
+    devs = jax.devices()
+    assert devs[0].platform == "cpu" and len(devs) >= n_devices, (
+        f"could not force {n_devices} CPU devices: got {len(devs)} x {devs[0].platform}"
+    )
+    return jax
