@@ -230,6 +230,50 @@ class TestRobustness:
             coord.shutdown()
             t.join(timeout=10)
 
+    def test_dead_arrival_cannot_release_barrier_for_absent_worker(self):
+        """A arrives, B arrives then dies, C never arrives: the barrier must NOT
+        release (count-based barriers released here: 2 arrivals >= 2 live), and
+        must release later once C actually arrives."""
+        with Coordinator(num_workers=3, heartbeat_timeout=600) as coord:
+            res = {}
+            ts = [_spawn_worker(coord.port(), res, n) for n in ("a", "b", "c")]
+            coord.wait_for_workers(timeout=15)
+            _await_workers(res, 3)
+            wa, wb, wc = res["a"], res["b"], res["c"]
+            released = []
+
+            def arrive(w):
+                try:
+                    w.barrier("gate", timeout=30)
+                    released.append(w.rank)
+                except TimeoutError:
+                    pass
+
+            ta = threading.Thread(target=arrive, args=(wa,), daemon=True)
+            tb = threading.Thread(target=arrive, args=(wb,), daemon=True)
+            ta.start()
+            tb.start()
+            time.sleep(0.4)  # both arrivals land at the coordinator
+            wb._running = False
+            wb._t.close()  # B dies after arriving
+            deadline = time.monotonic() + 10
+            while wb.rank not in coord.failed_workers():
+                assert time.monotonic() < deadline
+                time.sleep(0.05)
+            with pytest.raises(TimeoutError):
+                coord.barrier("gate", timeout=1.5)  # C never arrived
+            assert released == []
+            # once C arrives, the barrier completes for the live set {A, C}
+            tc = threading.Thread(target=arrive, args=(wc,), daemon=True)
+            tc.start()
+            coord.barrier("gate", timeout=15)
+            ta.join(timeout=10)
+            tc.join(timeout=10)
+            assert sorted(released) == sorted([wa.rank, wc.rank])
+            coord.shutdown(timeout=2)
+            for t in ts:
+                t.join(timeout=10)
+
     def test_unknown_command_does_not_kill_pump(self):
         with Coordinator(num_workers=1) as coord:
             res = {}
@@ -311,6 +355,44 @@ class TestTransportInterop:
             assert "w" in res
         finally:
             coord.close()
+
+    def test_concurrent_large_sends_do_not_interleave(self):
+        """PyTransport.send from many threads must not corrupt the stream: each
+        large frame arrives whole and byte-identical (per-connection send lock;
+        the native transport's send_mu equivalent)."""
+        recv = PyTransport(listen_port=0)
+        send = PyTransport(listen_port=None)
+        try:
+            conn = send.connect("127.0.0.1", recv.port())
+            n_threads, frames_each, size = 4, 8, 256 * 1024
+
+            def blast(tag):
+                payload = bytes([tag]) * size
+                for _ in range(frames_each):
+                    assert send.send(conn, tag, payload)
+
+            threads = [threading.Thread(target=blast, args=(t,), daemon=True)
+                       for t in range(1, n_threads + 1)]
+            for t in threads:
+                t.start()
+            got = 0
+            deadline = time.monotonic() + 30
+            while got < n_threads * frames_each:
+                assert time.monotonic() < deadline, f"only {got} frames arrived"
+                ev = recv.recv(timeout=1.0)
+                if ev is None or ev[0] != "msg":
+                    continue
+                _, _, cmd, payload = ev
+                assert len(payload) == size
+                # an interleaved write shows up as mixed bytes within a frame
+                assert payload == bytes([cmd]) * size, \
+                    f"frame for tag {cmd} corrupted"
+                got += 1
+            for t in threads:
+                t.join(timeout=10)
+        finally:
+            send.close()
+            recv.close()
 
     def test_large_payload(self):
         """Frames beyond the 64KB recv buffer go through the two-phase path."""

@@ -46,7 +46,7 @@ class Coordinator:
         self._queues: Dict[Command, "queue.Queue"] = {
             c: queue.Queue() for c in Command}
         self._lock = threading.Lock()
-        self._barrier_counts: Dict[str, int] = {}
+        self._barrier_ranks: Dict[str, set] = {}  # name -> ranks that arrived
         self._barrier_cv = threading.Condition()
         self._running = True
         self._pump = threading.Thread(target=self._pump_loop, daemon=True)
@@ -84,11 +84,17 @@ class Coordinator:
             self._log.error("worker %s reported: %s", msg.get("rank"),
                             msg.get("error"))
         if command == Command.BARRIER:
-            # count by name — an early arrival for a future barrier must not be
-            # lost just because the coordinator is collecting a different one
+            # track WHICH ranks arrived, per barrier name — a dead worker's
+            # arrival must not release a barrier a live worker never reached,
+            # and early arrivals for other barriers are never lost
             name = unpack(payload).get("name")
+            with self._lock:
+                h = self._by_conn.get(conn)
+            if h is None:
+                self._log.warning("BARRIER %r from unknown conn %d", name, conn)
+                return
             with self._barrier_cv:
-                self._barrier_counts[name] = self._barrier_counts.get(name, 0) + 1
+                self._barrier_ranks.setdefault(name, set()).add(h.rank)
                 self._barrier_cv.notify_all()
             return
         if command == Command.HANDSHAKE and self._membership_complete():
@@ -117,6 +123,11 @@ class Coordinator:
             h.alive = True
             h.last_heartbeat = time.monotonic()
             self._by_conn[conn] = h
+        # purge arrivals from the rank's previous life: a pre-crash BARRIER must
+        # not release a barrier the restarted worker never reached
+        with self._barrier_cv:
+            for ranks in self._barrier_ranks.values():
+                ranks.discard(rank)
         self._t.send(conn, Command.HANDSHAKE_ACK,
                      pack({"rank": rank, "world": self.num_workers}))
         self._log.info("worker %d rejoined", rank)
@@ -223,18 +234,30 @@ class Coordinator:
         """
         deadline = time.monotonic() + timeout
         while True:
-            live = self.num_workers - len(self.failed_workers())
+            failed = set(self.failed_workers())
+            with self._lock:
+                joined = set(self._workers)
+            # before the full membership has joined, never release — everyone
+            # currently present arriving is not the same as everyone arriving
+            # (live is joined-minus-failed, NOT range(num_workers): a worker may
+            # have requested an out-of-range rank, and a phantom in-range rank
+            # that never joins could otherwise block every barrier forever)
+            ready = len(joined) >= self.num_workers
+            live = joined - failed
             with self._barrier_cv:
-                arrived = self._barrier_counts.get(name, 0)
-                if arrived >= live:
-                    self._barrier_counts[name] = arrived - live
+                arrived = set(self._barrier_ranks.get(name, ()))
+                if ready and live and live <= arrived:
+                    # release consumes this occurrence entirely; workers only
+                    # re-arrive after BARRIER_OK (sent below, after the clear),
+                    # so nothing can leak into the next same-name barrier
+                    self._barrier_ranks.pop(name, None)
                     break
                 self._barrier_cv.wait(timeout=0.2)
             if time.monotonic() >= deadline:
                 raise TimeoutError(
-                    f"barrier {name}: {arrived}/{live} "
-                    f"(failed workers: {self.failed_workers()})")
-            if live == 0:
+                    f"barrier {name}: arrived {sorted(arrived)} of live "
+                    f"{sorted(live)} (failed workers: {sorted(failed)})")
+            if ready and not live:
                 raise RuntimeError(f"barrier {name}: all workers failed")
         self.broadcast(Command.BARRIER_OK, {"name": name})
 

@@ -122,6 +122,7 @@ class PyTransport(Transport):
     def __init__(self, bind: str = "", listen_port: Optional[int] = 0):
         self._inbox: "queue.Queue[Event]" = queue.Queue()
         self._conns = {}
+        self._send_locks = {}  # conn -> Lock; frames must not interleave
         self._next = 0
         self._lock = threading.Lock()
         self._running = True
@@ -139,6 +140,7 @@ class PyTransport(Transport):
             conn = self._next
             self._next += 1
             self._conns[conn] = sock
+            self._send_locks[conn] = threading.Lock()
         threading.Thread(target=self._read_loop, args=(conn, sock),
                          daemon=True).start()
         return conn
@@ -173,6 +175,7 @@ class PyTransport(Transport):
             with self._lock:
                 alive = conn in self._conns
                 self._conns.pop(conn, None)
+                self._send_locks.pop(conn, None)
             if alive and self._running:
                 self._inbox.put(("disconnect", conn, 0, b""))
 
@@ -185,12 +188,18 @@ class PyTransport(Transport):
         return self._add(sock)
 
     def send(self, conn: int, command: int, payload: bytes = b"") -> bool:
+        # Worker sends from several threads (event loop, heartbeat, profiler);
+        # a per-connection lock keeps large frames from interleaving on the wire
+        # (the native transport's send_mu, native/src/control.cpp, mirrored here).
         with self._lock:
             sock = self._conns.get(conn)
-        if sock is None:
+            send_lock = self._send_locks.get(conn)
+        if sock is None or send_lock is None:
             return False
         try:
-            sock.sendall(struct.pack("<IIQ", _MAGIC, command, len(payload)) + payload)
+            with send_lock:
+                sock.sendall(
+                    struct.pack("<IIQ", _MAGIC, command, len(payload)) + payload)
             return True
         except OSError:
             return False
@@ -204,6 +213,7 @@ class PyTransport(Transport):
     def close_conn(self, conn: int) -> None:
         with self._lock:
             sock = self._conns.pop(conn, None)
+            self._send_locks.pop(conn, None)
         if sock is not None:
             try:
                 sock.shutdown(socket.SHUT_RDWR)

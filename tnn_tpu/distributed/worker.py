@@ -120,15 +120,26 @@ class Worker:
             d["source"] = d.get("source") or f"worker{self.rank}"
             self._t.send(self._conn, Command.REPORT_PROFILING, pack(d))
         elif command == Command.SAVE_TO_FILE:
-            # honest ack: report whether anything was actually persisted
-            if self.on_save:
-                self.on_save(obj["path"])
-                self._t.send(self._conn, Command.SAVED,
-                             pack({"rank": self.rank, "ok": True}))
-            else:
-                self._t.send(self._conn, Command.SAVED,
-                             pack({"rank": self.rank, "ok": False,
-                                   "error": "no on_save handler registered"}))
+            # serviced OFF the event loop: an on_save that rendezvouses with
+            # the training thread (examples/dist_worker.py) must not block
+            # BARRIER_OK / CONFIG dispatch — that would deadlock a worker
+            # sitting in barrier() while the coordinator waits for the save.
+            # Honest ack either way: a raising handler still acks ok:False,
+            # when the save resolves, so save_all fails fast instead of
+            # timing out.
+            def _do_save(path=obj["path"]):
+                if self.on_save:
+                    try:
+                        self.on_save(path)
+                        reply = {"rank": self.rank, "ok": True}
+                    except Exception as e:
+                        reply = {"rank": self.rank, "ok": False, "error": str(e)}
+                else:
+                    reply = {"rank": self.rank, "ok": False,
+                             "error": "no on_save handler registered"}
+                self._t.send(self._conn, Command.SAVED, pack(reply))
+
+            threading.Thread(target=_do_save, daemon=True).start()
         elif command == Command.HEALTH_CHECK:
             self._t.send(self._conn, Command.HEALTH_OK, pack({"rank": self.rank}))
         elif command == Command.CUSTOM:

@@ -43,7 +43,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr, *,
 
     @pl.when(ki == 0)
     def _init():
-        m_scr[:] = jnp.full_like(m_scr, _NEG_INF)
+        m_scr[:] = jnp.full_like(m_scr, _NEG_INF)  # (bq, 1)
         l_scr[:] = jnp.zeros_like(l_scr)
         acc_scr[:] = jnp.zeros_like(acc_scr)
 
@@ -66,8 +66,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr, *,
             mask = jnp.logical_and(mask, qpos >= kpos)
         s = jnp.where(mask, s, _NEG_INF)
 
-        m_prev = m_scr[:, :1]                          # (bq, 1)
-        l_prev = l_scr[:, :1]
+        m_prev = m_scr[:]                              # (bq, 1)
+        l_prev = l_scr[:]
         m_cur = jnp.max(s, axis=1, keepdims=True)      # (bq, 1)
         m_new = jnp.maximum(m_prev, m_cur)
         p = jnp.exp(s - m_new)                         # (bq, bk) f32
@@ -77,21 +77,21 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr, *,
         acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
             p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
-        m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
-        l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
+        m_scr[:] = m_new
+        l_scr[:] = l_new
 
     @pl.when(ki == nk - 1)
     def _final():
-        l = l_scr[:, :1]
+        l = l_scr[:]
         lsafe = jnp.where(l == 0.0, 1.0, l)  # fully-masked rows -> 0 output
         o_ref[0] = (acc_scr[:] / lsafe).astype(o_ref.dtype)
         # logsumexp per row for the backward; +inf on fully-masked/padded rows
         # makes their p = exp(s - L) exactly 0 there (never NaN)
-        m = m_scr[:, :1]
-        lse = jnp.where(l > 0.0, m + jnp.log(lsafe), jnp.inf)
-        # lane-replicated (bq, 128) layout — same as the reference TPU kernel's
-        # l/m outputs; the backward reads [:, :1] without any relayout
-        lse_ref[0] = jnp.broadcast_to(lse, lse_ref.shape[1:])
+        m = m_scr[:]
+        # compact (bq, 1) column — 4 bytes/row in HBM end to end, vs the
+        # lane-replicated 128-lane layout that cost ~400MB transient f32 at
+        # B=8/H=12/S=8k (Mosaic pads narrow minor dims in VMEM transparently)
+        lse_ref[0] = jnp.where(l > 0.0, m + jnp.log(lsafe), jnp.inf)
 
 
 def _block_geometry(sq: int, skv: int, block_q: int, block_k: int):
@@ -146,23 +146,22 @@ def _flash_fwd(q, k, v, causal, scale, block_q, block_k):
         out_specs=[
             pl.BlockSpec((1, bq, d), lambda bh, qi, ki: (bh, qi, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, bq, 128), lambda bh, qi, ki: (bh, qi, 0),
+            pl.BlockSpec((1, bq, 1), lambda bh, qi, ki: (bh, qi, 0),
                          memory_space=pltpu.VMEM),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((b * h, sq_p, d), q.dtype),
-            jax.ShapeDtypeStruct((b * h, sq_p, 128), jnp.float32),
+            jax.ShapeDtypeStruct((b * h, sq_p, 1), jnp.float32),
         ],
         scratch_shapes=[
-            pltpu.VMEM((bq, 128), jnp.float32),  # running max (lanes broadcast)
-            pltpu.VMEM((bq, 128), jnp.float32),  # running denominator
-            pltpu.VMEM((bq, d), jnp.float32),    # output accumulator
+            pltpu.VMEM((bq, 1), jnp.float32),  # running max
+            pltpu.VMEM((bq, 1), jnp.float32),  # running denominator
+            pltpu.VMEM((bq, d), jnp.float32),  # output accumulator
         ],
         interpret=jax.default_backend() != "tpu",
     )(qf, kf, vf)
     out = out[:, :sq].reshape(b, h, sq, d)
-    # keep only one lane of the lane-replicated lse as the residual (4 bytes/row
-    # held between fwd and bwd, not 512); the bwd re-broadcasts transiently
+    # residual is the compact (b*h, sq_p) row vector; bwd reshapes (no broadcast)
     return out, (q, k, v, out, lse[:, :, 0])
 
 
@@ -194,7 +193,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, dq_ref,
     @pl.when(live)
     def _block():
         q, k, v, do = q_ref[0], k_ref[0], v_ref[0], do_ref[0]
-        lse_col = lse_ref[0][:, :1]                    # (bq, 1), lane-replicated
+        lse_col = lse_ref[0]                           # (bq, 1), compact
         do32 = do.astype(jnp.float32)
         # delta_i = rowsum(dO_i * O_i), recomputed per block (elementwise, cheap)
         delta = jnp.sum(do32 * o_ref[0].astype(jnp.float32), axis=1,
@@ -230,7 +229,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
     @pl.when(live)
     def _block():
         q, k, v, do = q_ref[0], k_ref[0], v_ref[0], do_ref[0]
-        lse_col = lse_ref[0][:, :1]
+        lse_col = lse_ref[0]                           # (bq, 1), compact
         delta = jnp.sum(do.astype(jnp.float32) * o_ref[0].astype(jnp.float32),
                         axis=1, keepdims=True)
         p = _attn_probs(q, k, lse_col, k_start, q_start, scale=scale,
@@ -264,14 +263,14 @@ def _flash_bwd(causal, scale, block_q, block_k, residuals, g):
     vf = _pad_to(v.reshape(b * h, skv, d), skv_p, 1)
     of = _pad_to(o.reshape(b * h, sq, d), sq_p, 1)
     dof = _pad_to(g.reshape(b * h, sq, d), sq_p, 1)
-    # transient lane-replication back to the kernel's (bq, 128) layout
-    lse = jnp.broadcast_to(lse_row[:, :, None], (b * h, sq_p, 128))
+    # reshape only — the kernels take the compact (bq, 1) column directly
+    lse = lse_row[:, :, None]
 
     interpret = jax.default_backend() != "tpu"
     common = dict(scale=scale, causal=causal, bq=bq, bk=bk, kv_len=skv)
     q_spec = pl.BlockSpec((1, bq, d), lambda bh, i, j: (bh, i, 0),
                           memory_space=pltpu.VMEM)
-    lse_spec = pl.BlockSpec((1, bq, 128), lambda bh, i, j: (bh, i, 0),
+    lse_spec = pl.BlockSpec((1, bq, 1), lambda bh, i, j: (bh, i, 0),
                             memory_space=pltpu.VMEM)
     kv_spec = pl.BlockSpec((1, bk, d), lambda bh, i, j: (bh, j, 0),
                            memory_space=pltpu.VMEM)
@@ -289,7 +288,7 @@ def _flash_bwd(causal, scale, block_q, block_k, residuals, g):
     # transposed grid: blocks indexed (bh, k block, q block)
     qT_spec = pl.BlockSpec((1, bq, d), lambda bh, j, i: (bh, i, 0),
                            memory_space=pltpu.VMEM)
-    lseT_spec = pl.BlockSpec((1, bq, 128), lambda bh, j, i: (bh, i, 0),
+    lseT_spec = pl.BlockSpec((1, bq, 1), lambda bh, j, i: (bh, i, 0),
                              memory_space=pltpu.VMEM)
     kvT_spec = pl.BlockSpec((1, bk, d), lambda bh, j, i: (bh, j, 0),
                             memory_space=pltpu.VMEM)

@@ -90,11 +90,18 @@ def train_model(
     augment: Optional[Callable] = None,
     state: Optional[TrainState] = None,
     metric_hook: Optional[Callable[[int, Dict[str, Any]], None]] = None,
+    state_hook: Optional[Callable[[TrainState], None]] = None,
 ) -> Tuple[TrainState, List[Dict[str, Any]]]:
     """Train ``model`` per ``config``; returns (final_state, per-epoch history).
 
     The reference equivalent is train_model (src/nn/train.cpp:367) driving
     train_epoch/validate_model with best-val snapshots.
+
+    ``state_hook`` receives the live TrainState at setup, at every progress-print
+    interval, and at each epoch end — it is how a control-plane save RPC arriving
+    MID-training can snapshot current weights (parity: worker SAVE_TO_FILE,
+    include/distributed/worker.hpp:287-303, which the reference can service any
+    time because its weights live in mutable host/device slabs).
     """
     log = get_logger("tnn.train")
     if config.log_file:
@@ -166,6 +173,8 @@ def train_model(
         eval_fn = base_eval
 
     history: List[Dict[str, Any]] = []
+    if state_hook:
+        state_hook(state)
     if config.shuffle and not resumed:
         train_loader.shuffle()
 
@@ -217,7 +226,11 @@ def train_model(
                     if metric_hook:
                         metric_hook(int(state.step),
                                     {"loss": loss, "accuracy": acc, "epoch": epoch})
+                    if state_hook:
+                        state_hook(state)
 
+            if state_hook:
+                state_hook(state)
             # final metric of the epoch (forces one sync)
             epoch_metrics: Dict[str, Any] = {
                 "epoch": epoch,
