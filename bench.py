@@ -6,13 +6,19 @@ Baseline (BASELINE.md): the reference's flagship run is CIFAR-100 WRN-16-8 at
 ~102-110 ms/batch for bs=256 over a 2-machine RoCE pipeline => ~2.4k img/s
 (sample_logs/cifar100_wrn16_8:348-368). vs_baseline = our img/s per chip / 2400.
 
-Robustness (round-1 postmortem): the TPU backend here is a relay ("axon") that can
-be down, in which case jax.devices() HANGS instead of raising. Before any in-process
-jax work we probe the backend in a subprocess with a hard timeout and retries; on
-failure we print one diagnostic JSON line and exit instead of a hung process or a
-raw traceback. Timing utilities live in benchmarks/common.py (on the relay,
-block_until_ready does not wait; sync is a value fetch whose latency is measured
-and subtracted). The wider harness is benchmarks/run_all.py; this file stays the
+Robustness (round-1/2 postmortems): the TPU backend here is a relay ("axon") that
+can be down, in which case jax.devices() HANGS instead of raising — and a relay
+that answers the init probe can still die mid-compile (round 2 failed with
+UNAVAILABLE .. /remote_compile Connection refused AFTER a clean probe). So the
+WHOLE measurement runs in a subprocess under a hard timeout, and transient
+failures (UNAVAILABLE / connection / hang) retry the full probe+run cycle.
+Successful results are also persisted to benchmarks/results/ so evidence
+survives even if a later gate catches the relay down.
+
+Timing utilities live in benchmarks/common.py (on the relay, block_until_ready
+does not wait; sync is a value fetch whose latency is measured and subtracted);
+a known-FLOP matmul self-check guards that assumption before the real
+measurement. The wider harness is benchmarks/run_all.py; this file stays the
 driver's single-metric entry point.
 """
 import json
@@ -27,12 +33,17 @@ BATCH = 256
 BASELINE_IMG_S = 2400.0
 WARMUP_STEPS = 8
 MEASURE_STEPS = 100
+METRIC = "wrn16_8_cifar100_train_img_per_sec_per_chip"
 
-# Worst case must stay comfortably under the driver gate's own timeout so the
-# diagnostic JSON always gets printed: 2 x 60s probes + one 15s wait = 135s.
 PROBE_TIMEOUT_S = int(os.environ.get("TNN_BENCH_PROBE_TIMEOUT", "60"))
-PROBE_RETRIES = int(os.environ.get("TNN_BENCH_PROBE_RETRIES", "2"))
-PROBE_RETRY_WAIT_S = 15
+# full probe+run attempts; transient failures (hang/UNAVAILABLE) retry the cycle
+RUN_ATTEMPTS = int(os.environ.get("TNN_BENCH_RUN_ATTEMPTS", "2"))
+RUN_TIMEOUT_S = int(os.environ.get("TNN_BENCH_RUN_TIMEOUT", "300"))
+RETRY_WAIT_S = int(os.environ.get("TNN_BENCH_RETRY_WAIT", "20"))
+# Hard ceiling on total wall time so the diagnostic JSON always prints before
+# any external gate kills the process (round-1 invariant, kept under retries):
+# attempts are skipped/clamped once the budget cannot fit them.
+TOTAL_BUDGET_S = int(os.environ.get("TNN_BENCH_TOTAL_BUDGET", "480"))
 
 _PROBE_SRC = """
 import json, os, jax
@@ -45,74 +56,60 @@ devs = jax.devices()
 print(json.dumps({"n": len(devs), "platform": devs[0].platform}))
 """
 
+_TRANSIENT_MARKERS = ("UNAVAILABLE", "Connection refused", "Connection reset",
+                      "connection", "timed out", "hung", "DEADLINE_EXCEEDED",
+                      "Socket closed", "Broken pipe")
+
+
+def _is_transient(err: str) -> bool:
+    low = str(err)
+    return any(m.lower() in low.lower() for m in _TRANSIENT_MARKERS)
+
 
 def probe_backend():
-    """Check backend init in a subprocess (a hung relay can't be interrupted in-process).
-
-    Returns (info_dict, None) on success or (None, error_string) after retries.
-    """
-    last_err = "unknown"
-    for attempt in range(1, PROBE_RETRIES + 1):
-        try:
-            out = subprocess.run(
-                [sys.executable, "-c", _PROBE_SRC],
-                capture_output=True, text=True, timeout=PROBE_TIMEOUT_S,
-                env=os.environ.copy(),
-            )
-            if out.returncode == 0:
-                for line in out.stdout.strip().splitlines():
-                    try:
-                        return json.loads(line), None
-                    except json.JSONDecodeError:
-                        continue
-                return None, f"probe printed no JSON: {out.stdout[-200:]!r}"
-            # Deterministic failure (ImportError, config error, ...) — retrying the
-            # identical subprocess cannot change the outcome; report immediately.
-            tail = (out.stderr or out.stdout).strip().splitlines()
-            return None, tail[-1] if tail else f"probe rc={out.returncode}"
-        except subprocess.TimeoutExpired:
-            last_err = (f"backend init hung >{PROBE_TIMEOUT_S}s "
-                        f"(attempt {attempt}/{PROBE_RETRIES}; relay down?)")
-        if attempt < PROBE_RETRIES:
-            time.sleep(PROBE_RETRY_WAIT_S)
-    return None, last_err
+    """Check backend init in a subprocess (a hung relay can't be interrupted
+    in-process). Returns (info_dict, None) or (None, error_string)."""
+    try:
+        out = subprocess.run(
+            [sys.executable, "-c", _PROBE_SRC],
+            capture_output=True, text=True, timeout=PROBE_TIMEOUT_S,
+            env=os.environ.copy(),
+        )
+    except subprocess.TimeoutExpired:
+        return None, f"backend init hung >{PROBE_TIMEOUT_S}s (relay down?)"
+    if out.returncode == 0:
+        for line in out.stdout.strip().splitlines():
+            try:
+                return json.loads(line), None
+            except json.JSONDecodeError:
+                continue
+        return None, f"probe printed no JSON: {out.stdout[-200:]!r}"
+    tail = (out.stderr or out.stdout).strip().splitlines()
+    return None, tail[-1] if tail else f"probe rc={out.returncode}"
 
 
-def fail(err, backend):
-    print(json.dumps({
-        "metric": "wrn16_8_cifar100_train_img_per_sec_per_chip",
-        "error": str(err)[:500],
-        "backend": backend,
-    }))
-    return 1
-
-
-def main():
+def measure():
+    """The actual benchmark; runs inside the TNN_BENCH_INNER subprocess."""
     backend = os.environ.get("JAX_PLATFORMS", "default")
     override = os.environ.get("TNN_BENCH_PLATFORM")
-    if override:
-        os.environ["JAX_PLATFORMS"] = backend = override
-
-    info, err = probe_backend()
-    if info is None:
-        return fail(err, backend)
-
     if override:
         from tnn_tpu.utils.platform import force_platform
 
         jax = force_platform(override)
+        backend = override
     else:
         import jax
     import jax.numpy as jnp
     import numpy as np
 
-    from benchmarks.common import fetch_latency, sync
+    from benchmarks.common import fetch_latency, sync, timing_selfcheck
     from tnn_tpu import models, nn
     from tnn_tpu.train import create_train_state, make_train_step
 
     platform = backend
     try:
         platform = jax.devices()[0].platform
+        selfcheck_mfu = timing_selfcheck()
         rng = jax.random.PRNGKey(0)
         model = models.create("cifar100_wrn16_8")  # bf16 compute, f32 master params
         opt = nn.SGD(lr=0.1, momentum=0.9, weight_decay=5e-4)
@@ -124,30 +121,113 @@ def main():
         data = jnp.asarray(rs.randn(BATCH, 32, 32, 3), jnp.bfloat16)
         labels = jnp.asarray(rs.randint(0, 100, BATCH), jnp.int32)
 
-        measure = MEASURE_STEPS if platform != "cpu" else 3
+        measure_steps = MEASURE_STEPS if platform != "cpu" else 3
         for _ in range(WARMUP_STEPS if platform != "cpu" else 1):
             state, m = step(state, data, labels)
         lat = fetch_latency(m["loss"])
 
         t0 = time.perf_counter()
-        for _ in range(measure):
+        for _ in range(measure_steps):
             state, m = step(state, data, labels)
         sync(m["loss"])
-        dt = (time.perf_counter() - t0 - lat) / measure
-    except Exception as e:  # noqa: BLE001 — one-line diagnostics beat a traceback here
-        return fail(f"{type(e).__name__}: {e}", platform)
+        dt = (time.perf_counter() - t0 - lat) / measure_steps
+    except Exception as e:  # noqa: BLE001 — one-line diagnostics beat a traceback
+        print(json.dumps({"metric": METRIC, "error": f"{type(e).__name__}: {e}"[:500],
+                          "backend": platform}))
+        return 1
 
     img_s = BATCH / dt
     out = {
-        "metric": "wrn16_8_cifar100_train_img_per_sec_per_chip",
+        "metric": METRIC,
         "value": round(img_s, 1),
         "unit": "img/s",
         "vs_baseline": round(img_s / BASELINE_IMG_S, 3),
     }
+    if platform == "tpu" and selfcheck_mfu:
+        out["timing_selfcheck_mfu"] = round(selfcheck_mfu, 4)
     if platform == "cpu":  # labeled so a CPU fallback can never masquerade as chip perf
         out["backend"] = "cpu"
     print(json.dumps(out))
     return 0
+
+
+def main():
+    if os.environ.get("TNN_BENCH_INNER"):
+        return measure()
+
+    last_err = "no attempt ran"
+    backend = os.environ.get("TNN_BENCH_PLATFORM") \
+        or os.environ.get("JAX_PLATFORMS", "default")
+    t_start = time.monotonic()
+
+    def budget_left():
+        return TOTAL_BUDGET_S - (time.monotonic() - t_start)
+
+    for attempt in range(1, RUN_ATTEMPTS + 1):
+        if budget_left() < PROBE_TIMEOUT_S + 30:
+            last_err = f"{last_err} (budget {TOTAL_BUDGET_S}s exhausted)"
+            break
+        info, err = probe_backend()
+        if info is None:
+            last_err = err
+            if not _is_transient(err):
+                break  # ImportError/config errors are deterministic: fail fast
+            if attempt < RUN_ATTEMPTS:
+                time.sleep(RETRY_WAIT_S)
+            continue
+        run_timeout = min(RUN_TIMEOUT_S, max(30, int(budget_left() - 15)))
+        env = dict(os.environ, TNN_BENCH_INNER="1")
+        try:
+            out = subprocess.run([sys.executable, os.path.abspath(__file__)],
+                                 capture_output=True, text=True,
+                                 timeout=run_timeout, env=env)
+        except subprocess.TimeoutExpired:
+            last_err = f"bench run hung >{run_timeout}s (relay died mid-run?)"
+            if attempt < RUN_ATTEMPTS:
+                time.sleep(RETRY_WAIT_S)
+            continue
+        sys.stderr.write(out.stderr or "")
+        result = None
+        for line in (out.stdout or "").strip().splitlines():
+            try:
+                parsed = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if isinstance(parsed, dict) and parsed.get("metric") == METRIC:
+                result = parsed
+        if result is None:
+            last_err = (f"bench subprocess printed no result "
+                        f"(rc={out.returncode}): {(out.stdout or '')[-200:]!r}")
+        elif "value" in result:
+            print(json.dumps(result))
+            _persist(result)
+            return 0
+        else:
+            last_err = result.get("error", "unknown error")
+            if not _is_transient(last_err):
+                print(json.dumps(result))  # deterministic failure: report as-is
+                return 1
+        if attempt < RUN_ATTEMPTS:
+            time.sleep(RETRY_WAIT_S)
+
+    print(json.dumps({"metric": METRIC, "error": str(last_err)[:500],
+                      "backend": backend}))
+    return 1
+
+
+def _persist(result):
+    """Keep successful runs as committed-able artifacts (round-2 lesson: the
+    end-of-round gate can catch the relay down; mid-round evidence must live
+    in the repo)."""
+    try:
+        d = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         "benchmarks", "results")
+        os.makedirs(d, exist_ok=True)
+        stamp = time.strftime("%Y%m%d_%H%M%S")
+        with open(os.path.join(d, f"bench_{stamp}.json"), "w") as f:
+            json.dump(dict(result, unix_time=time.time()), f, indent=2)
+    except OSError:
+        pass  # persistence is best-effort; the JSON line already printed
 
 
 if __name__ == "__main__":

@@ -49,6 +49,34 @@ def time_fn(fn: Callable, *args, iters: int = 50, warmup: int = 5) -> float:
     return max((time.perf_counter() - t0 - lat) / iters, 1e-9)
 
 
+def timing_selfcheck(max_mfu: float = 1.05, min_mfu: float = 1e-4) -> float:
+    """Guard the fetch-corrected timing scheme with a known-FLOP matmul.
+
+    The scheme assumes the relay executes N dispatched steps back-to-back and
+    that one scalar fetch waits for all of them. If the relay ever pipelines
+    differently (e.g. dropping work, or block_until_ready starts waiting), the
+    implied MFU of a plain matmul goes impossible (>105% peak) or absurd
+    (<0.01%) — fail loudly instead of reporting fiction. Returns implied MFU.
+
+    Off-TPU the check is skipped (no trustworthy peak to compare against, and
+    the emulated-bf16 matmuls would just burn CPU time for no signal).
+    """
+    if jax.devices()[0].platform != "tpu":
+        return 0.0
+    n = 4096
+    x = jnp.ones((n, n), jnp.bfloat16)
+    f = jax.jit(lambda a: a @ a)
+    secs = time_fn(f, x, iters=20, warmup=3)
+    mfu = (2 * n**3 / secs) / V5E_BF16_PEAK_FLOPS
+    if not (min_mfu <= mfu <= max_mfu):
+        raise AssertionError(
+            f"timing self-check FAILED: {n}x{n} bf16 matmul implies "
+            f"{mfu * 100:.1f}% MFU — the dispatch/fetch timing assumption is "
+            f"broken on this backend; do not trust these numbers")
+    print(f"  timing self-check: {n}x{n} matmul at {mfu * 100:.1f}% MFU (sane)")
+    return mfu
+
+
 def verify(name: str, got, want, rtol: float = 2e-2, atol: float = 2e-2) -> None:
     """Correctness gate before timing (reference: check_match, gemm_benchmark.cpp:20).
     Tolerances default to bf16-friendly bounds."""

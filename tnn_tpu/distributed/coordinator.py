@@ -117,17 +117,20 @@ class Coordinator:
                     "rejected handshake on conn %d (rank %s %s)", conn, rank,
                     "unknown" if h is None else "already alive")
                 return
+            # purge arrivals from the rank's previous life BEFORE marking it
+            # alive — once alive, barrier() counts the rank as live, and a
+            # stale pre-crash arrival could release a barrier the restarted
+            # worker never reached (nested under _lock; nothing acquires _lock
+            # while holding _barrier_cv, so the ordering cannot deadlock)
+            with self._barrier_cv:
+                for ranks in self._barrier_ranks.values():
+                    ranks.discard(rank)
             self._by_conn.pop(h.conn, None)
             h.conn = conn
             h.info = info
             h.alive = True
             h.last_heartbeat = time.monotonic()
             self._by_conn[conn] = h
-        # purge arrivals from the rank's previous life: a pre-crash BARRIER must
-        # not release a barrier the restarted worker never reached
-        with self._barrier_cv:
-            for ranks in self._barrier_ranks.values():
-                ranks.discard(rank)
         self._t.send(conn, Command.HANDSHAKE_ACK,
                      pack({"rank": rank, "world": self.num_workers}))
         self._log.info("worker %d rejoined", rank)
