@@ -196,8 +196,11 @@ def main():
             if isinstance(parsed, dict) and parsed.get("metric") == METRIC:
                 result = parsed
         if result is None:
+            tail = (out.stderr or out.stdout or "").strip().splitlines()
             last_err = (f"bench subprocess printed no result "
-                        f"(rc={out.returncode}): {(out.stdout or '')[-200:]!r}")
+                        f"(rc={out.returncode}): {tail[-1][-200:] if tail else ''!r}")
+            if not _is_transient(last_err):
+                break  # crash before measure() (ImportError, ...) won't heal
         elif "value" in result:
             print(json.dumps(result))
             _persist(result)

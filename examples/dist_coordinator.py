@@ -16,6 +16,10 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from tnn_tpu.utils.platform import apply_env_platform  # noqa: E402
+
+apply_env_platform()  # TNN_PLATFORM=cpu routes around the pinned TPU platform
+
 from tnn_tpu.distributed import Coordinator  # noqa: E402
 
 

@@ -16,6 +16,10 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from tnn_tpu.utils.platform import apply_env_platform  # noqa: E402
+
+apply_env_platform()  # TNN_PLATFORM=cpu routes around the pinned TPU platform
+
 import jax  # noqa: E402
 import numpy as np  # noqa: E402
 

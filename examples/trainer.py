@@ -16,6 +16,10 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from tnn_tpu.utils.platform import apply_env_platform  # noqa: E402
+
+apply_env_platform()  # TNN_PLATFORM=cpu routes around the pinned TPU platform
+
 from tnn_tpu import models  # noqa: E402
 from tnn_tpu.data import factory  # noqa: E402
 from tnn_tpu.data.loader import SyntheticDataLoader  # noqa: E402
@@ -57,6 +61,11 @@ def main(argv=None):
                                                         "CUMULATIVE"])
     ap.add_argument("--num-classes", type=int, default=10,
                     help="classes for synthetic data")
+    ap.add_argument("--mesh", default=None,
+                    help="parallel layout, e.g. data=2,pipe=4 or data=2,model=2 "
+                         "(axes: data fsdp model pipe)")
+    ap.add_argument("--num-microbatches", type=int, default=None,
+                    help="pipeline microbatches per step (with --mesh pipe=N)")
     args = ap.parse_args(argv)
 
     load_env_file()  # .env, as in the reference
@@ -73,6 +82,11 @@ def main(argv=None):
             setattr(cfg, field, v)
     if args.lr is not None:
         cfg.optimizer = {**cfg.optimizer, "lr": args.lr}
+    if args.mesh is not None:
+        cfg.mesh_axes = {k: int(v) for k, v in
+                         (kv.split("=") for kv in args.mesh.split(",") if kv)}
+    if args.num_microbatches is not None:
+        cfg.num_microbatches = args.num_microbatches
 
     model = models.create(cfg.model_name)
     train_loader, val_loader = build_loaders(cfg, args.num_classes)

@@ -218,6 +218,36 @@ class TestMeshTrainModel:
         loader = SyntheticDataLoader(32, (8, 8, 3), 10)
         cfg = TrainingConfig(epochs=1, batch_size=16,
                              snapshot_dir=str(tmp_path / "x"),
-                             mesh_axes={"model": 8})
+                             mesh_axes={"seq": 8})
         with pytest.raises(ValueError, match="data/fsdp"):
             train_model(model, cfg, loader)
+
+    def test_config_driven_pipeline_and_tp(self, tmp_path):
+        """mesh_axes={'data':2,'pipe':4} and {'data':4,'model':2} both train
+        end-to-end from config alone (parity: the reference's mode-driven
+        tcp_coordinator.cpp:27-97 — here one config knob, no runtime fork)."""
+        from tnn_tpu import nn
+        from tnn_tpu.data.loader import SyntheticDataLoader
+        from tnn_tpu.train import train_model
+        from tnn_tpu.utils.config import TrainingConfig
+
+        conv = nn.Sequential([
+            nn.Conv2D(4, 3, padding="same", use_bias=False), nn.BatchNorm(),
+            nn.Activation("relu"), nn.GlobalAvgPool(), nn.Dense(10)])
+        loader = SyntheticDataLoader(64, (8, 8, 3), 10)
+        cfg = TrainingConfig(epochs=1, batch_size=16, num_microbatches=2,
+                             snapshot_dir=str(tmp_path / "pp"),
+                             mesh_axes={"data": 2, "pipe": 4},
+                             progress_print_interval=2)
+        state, history = train_model(conv, cfg, loader)
+        assert len(history) == 1 and np.isfinite(history[0]["train_loss"])
+
+        # data x model (Megatron TP) from config — param-name rules shard the
+        # transformer kernels; non-matching conv params just replicate, so the
+        # same code path runs any model
+        cfg2 = TrainingConfig(epochs=1, batch_size=16, max_steps=2,
+                              snapshot_dir=str(tmp_path / "tp"),
+                              mesh_axes={"data": 4, "model": 2},
+                              progress_print_interval=2)
+        state2, history2 = train_model(conv, cfg2, loader)
+        assert len(history2) == 1 and np.isfinite(history2[0]["train_loss"])

@@ -10,7 +10,8 @@ from . import data_parallel, mesh, partitioner, pipeline, ring_attention, tensor
 from .data_parallel import make_dp_train_step, shard_params_fsdp
 from .mesh import batch_sharding, data_mesh, make_mesh, replicated
 from .partitioner import SeqPartition, balanced_partitions, partition_model, split
-from .pipeline import StagePipeline, spmd_pipeline, stack_stage_params
+from .pipeline import (HeteroPipeline, StagePipeline, make_pipeline_eval_step,
+                       make_pipeline_train_step, spmd_pipeline, stack_stage_params)
 from .ring_attention import ring_attention
 from .tensor_parallel import DEFAULT_TP_RULES, shard_params_tp, spec_tree
 
@@ -19,7 +20,8 @@ __all__ = [
     "make_dp_train_step", "shard_params_fsdp",
     "batch_sharding", "data_mesh", "make_mesh", "replicated",
     "SeqPartition", "balanced_partitions", "partition_model", "split",
-    "StagePipeline", "spmd_pipeline", "stack_stage_params",
+    "HeteroPipeline", "StagePipeline", "make_pipeline_eval_step",
+    "make_pipeline_train_step", "spmd_pipeline", "stack_stage_params",
     "ring_attention",
     "DEFAULT_TP_RULES", "shard_params_tp", "spec_tree",
 ]

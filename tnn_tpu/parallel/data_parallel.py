@@ -43,13 +43,18 @@ def shard_params_fsdp(params, mesh: Mesh, min_size: int = 2 ** 16):
 
 def make_dp_train_step(model, optimizer, mesh: Mesh, loss_fn="softmax_cross_entropy",
                        scheduler=None, fsdp: bool = False, donate: bool = True,
-                       **step_kw):
+                       tp: bool = False, **step_kw):
     """Build a data-parallel train step over ``mesh``.
 
     Returns (step, place_state, place_batch):
       step(state, data, labels) -> (state, metrics) — jitted with shardings
       place_state(state) -> state placed per the chosen param strategy
       place_batch(data, labels) -> batch sharded over the data axis
+
+    ``tp=True`` additionally shards transformer params over the "model" axis per
+    the Megatron rules in tensor_parallel.py — GSPMD then propagates the
+    activation shardings and inserts the TP all-reduces, composing data x model
+    parallelism in the same jitted step (beyond the reference, which has no TP).
 
     Extra keyword args (grad_accum, augment, ...) pass through to make_train_step.
     """
@@ -61,8 +66,17 @@ def make_dp_train_step(model, optimizer, mesh: Mesh, loss_fn="softmax_cross_entr
     repl = mesh_lib.replicated(mesh)
 
     def place_state(state: TrainState) -> TrainState:
-        if fsdp:
-            params = shard_params_fsdp(state.params, mesh)
+        if fsdp and tp:
+            # composing them needs merged per-param specs (fsdp re-placement
+            # would silently erase the tp shardings) — not wired up yet
+            raise NotImplementedError("fsdp + tp on the same params")
+        if fsdp or tp:
+            if tp:
+                from .tensor_parallel import shard_params_tp
+
+                params = shard_params_tp(state.params, mesh)
+            else:
+                params = shard_params_fsdp(state.params, mesh)
             # moments follow their param's sharding where shapes match
             opt_state = _match_opt_sharding(state.opt_state, params, mesh)
             return TrainState(params, opt_state, jax.device_put(state.net_state, repl),

@@ -4,22 +4,30 @@ Parity-and-beyond with the reference's microbatch pipeline runtime
 (docs/pipeline_architecture.md; Coordinator chain wiring coordinator.hpp:418-433; Worker
 FORWARD_JOB/BACKWARD_JOB loop worker.hpp:145-193; Job{tensor, mb_id} job.hpp:93-129).
 
-Two TPU-native implementations:
+Three TPU-native implementations:
 
-1. ``spmd_pipeline`` — the performance path. Stages are a stacked pytree of
-   identical-structure block params sharded over the "pipe" mesh axis; the GPipe
-   fill/drain schedule is a lax.scan over ticks inside shard_map, activations hop
-   stages via collective-permute over ICI. jax.grad straight through it yields the
-   backward pipeline automatically (ppermute transposes to the reverse hop) — no
-   hand-written BACKWARD_JOB protocol. One compiled XLA program, zero host round trips
-   per microbatch (the reference serializes every hop through TCP/RDMA).
+1. ``spmd_pipeline`` — homogeneous stages (stacked identical-structure params
+   sharded over the "pipe" axis); GPipe fill/drain as a lax.scan inside shard_map
+   with ppermute hops. jax.grad straight through it yields the backward pipeline.
 
-2. ``StagePipeline`` — the generality path, mirroring the reference's
-   coordinator/worker shape for heterogeneous stages: each stage is a separate jitted
-   program placed on its own device; microbatches flow via device-to-device transfers;
-   JAX's async dispatch overlaps stages like the reference's semi-async schedule.
-   Activation residuals are held by jax.vjp closures — the analog of the reference's
-   per-mb layer caches (include/nn/layer.hpp:113-114).
+2. ``HeteroPipeline`` / ``make_pipeline_train_step`` — the flagship path:
+   ARBITRARY heterogeneous stages (shape-changing conv groups, different param
+   structures) in ONE compiled SPMD program. Per-stage params/state are packed
+   into padded f32 rows stacked over the pipe axis; activations hop as padded
+   flat buffers over ICI; lax.switch on the stage index runs each device's own
+   decode -> stage.apply -> encode. BatchNorm statistics update correctly under
+   pipelining: each stage's packed net_state threads through the schedule scan
+   and is committed only on ticks where that stage processed a real microbatch,
+   reproducing the per-microbatch BN semantics of single-device gradient
+   accumulation exactly. This is the capability the reference runs as its
+   headline distributed benchmark (WRN-16-8 CIFAR-100 through a multi-stage
+   pipeline, sample_logs/cifar100_wrn16_8) — there via per-hop TCP/RDMA
+   serialization, here as one XLA program with zero host round trips.
+
+3. ``StagePipeline`` — the generality path mirroring the reference's
+   coordinator/worker shape: each stage a separate jitted program on its own
+   device, microbatches flowing via device-to-device transfers, JAX async
+   dispatch overlapping stages like the reference's semi-async schedule.
 """
 from __future__ import annotations
 
@@ -27,7 +35,8 @@ from typing import Any, Callable, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
-from jax.sharding import Mesh, PartitionSpec as P
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from . import mesh as mesh_lib
 
@@ -110,7 +119,381 @@ def stack_stage_params(per_stage_params: Sequence) -> Any:
 
 
 # ---------------------------------------------------------------------------
-# 2. Host-orchestrated heterogeneous-stage pipeline
+# 2. Compiled heterogeneous-stage pipeline (shape-changing stages, correct BN)
+# ---------------------------------------------------------------------------
+
+
+class _TreeCodec:
+    """Pack/unpack a fixed-structure pytree into one flat f32 vector.
+
+    Static metadata (treedef + per-leaf shape/dtype/offset) is captured once at
+    init; packing casts every leaf to f32 (lossless for f32/bf16 params and the
+    f32 BatchNorm stats used here) so heterogeneous stage structures become
+    uniform (pp, max_len) rows shardable over the pipe mesh axis.
+    """
+
+    def __init__(self, template):
+        leaves, self.treedef = jax.tree_util.tree_flatten(template)
+        self.info = []
+        off = 0
+        for leaf in leaves:
+            n = int(np.prod(leaf.shape)) if leaf.shape else 1
+            self.info.append((tuple(leaf.shape), jnp.dtype(leaf.dtype), off, n))
+            off += n
+        self.size = off
+
+    def pack(self, tree, padded_len: int) -> jax.Array:
+        leaves = jax.tree_util.tree_leaves(tree)
+        if not leaves:
+            return jnp.zeros((padded_len,), jnp.float32)
+        vec = jnp.concatenate(
+            [jnp.ravel(x).astype(jnp.float32) for x in leaves])
+        return jnp.pad(vec, (0, padded_len - vec.shape[0]))
+
+    def unpack(self, vec: jax.Array):
+        leaves = [vec[o:o + n].reshape(shape).astype(dt)
+                  for shape, dt, o, n in self.info]
+        return jax.tree_util.tree_unflatten(self.treedef, leaves)
+
+
+class HeteroPipeline:
+    """Compile-time plan for a heterogeneous-stage SPMD pipeline.
+
+    Built from a list of stage Modules (e.g. ``partitioner.partition_model``
+    output). Owns the static metadata — per-stage activation shapes from shape
+    propagation, packed param/state codecs, buffer sizes — and provides
+    ``pipeline_loss``, the differentiable (packed_params, packed_state, data,
+    labels, rng) -> (loss, aux) function whose jax.grad IS the backward
+    pipeline (ppermute transposes to the reverse hop; the scan's saved
+    residuals are the per-microbatch activation caches the reference keeps by
+    hand, include/nn/layer.hpp:113-114).
+    """
+
+    def __init__(self, stages: Sequence, mesh: Mesh, input_shape,
+                 input_dtype=jnp.bfloat16, num_microbatches: int = 4,
+                 axis: str = "pipe", loss_fn: Optional[Callable] = None,
+                 compute_accuracy: bool = True, data_axis: Optional[str] = None):
+        from ..nn import losses as losses_lib
+
+        self.stages = list(stages)
+        self.mesh = mesh
+        self.axis = axis
+        self.pp = mesh_lib.axis_size(mesh, axis)
+        # dp x pp in ONE program: the microbatch batch dim shards over the data
+        # axis (each data rank pipelines its slice; grads auto-psum because the
+        # params are replicated over data in the shard_map in_specs). The
+        # reference offers dp OR pp per run, never composed — and its dp never
+        # all-reduces (coordinator.hpp:37-40).
+        self.data_axis = data_axis if (
+            data_axis and mesh_lib.axis_size(mesh, data_axis) > 1) else None
+        self.dp = mesh_lib.axis_size(mesh, data_axis) if self.data_axis else 1
+        # input_shape is the per-microbatch GLOBAL shape; stages see local slices
+        if self.dp > 1:
+            if input_shape[0] % self.dp:
+                raise ValueError(f"microbatch size {input_shape[0]} not "
+                                 f"divisible by data axis {self.dp}")
+            input_shape = (input_shape[0] // self.dp,) + tuple(input_shape[1:])
+        if self.pp != len(self.stages):
+            raise ValueError(f"{len(self.stages)} stages need mesh {axis} size "
+                             f"{len(self.stages)}, got {self.pp}")
+        self.num_mb = int(num_microbatches)
+        if isinstance(loss_fn, str) or loss_fn is None:
+            loss_fn = losses_lib.get(loss_fn or "softmax_cross_entropy")
+        self.loss_fn = loss_fn
+        self.compute_accuracy = bool(compute_accuracy)
+
+        # shape propagation (parity: deploy_stages shape chain,
+        # coordinator.hpp:368-456): microbatch-shaped activations per boundary
+        self.in_shapes: List[Tuple[int, ...]] = []
+        self.in_dtypes: List[Any] = []
+        shape, dtype = tuple(input_shape), jnp.dtype(input_dtype)
+        self._init_shape0 = shape
+        rng0 = jax.random.PRNGKey(0)
+        self._stage_vars_shape = []
+        for stage in self.stages:
+            self.in_shapes.append(shape)
+            self.in_dtypes.append(dtype)
+            v_shape = jax.eval_shape(
+                lambda s=stage, sh=shape: s.init(rng0, sh))
+            out = jax.eval_shape(
+                lambda v, x, s=stage: s.apply(v, x, train=False)[0],
+                v_shape, jax.ShapeDtypeStruct(shape, dtype))
+            self._stage_vars_shape.append(v_shape)
+            shape, dtype = out.shape, out.dtype
+        self.out_shape, self.out_dtype = shape, dtype
+
+        # packed-row codecs; rows padded to the widest stage
+        self.p_codecs = [_TreeCodec(v["params"]) for v in self._stage_vars_shape]
+        self.s_codecs = [_TreeCodec(v["state"]) for v in self._stage_vars_shape]
+        self.p_len = max(max(c.size for c in self.p_codecs), 1)
+        self.s_len = max(max(c.size for c in self.s_codecs), 1)
+        # activation hop buffer: elements of the widest boundary, one dtype wide
+        # enough for every boundary (bf16 boundaries stay bf16; mixed promotes)
+        self.buf_elems = max(int(np.prod(s)) for s in self.in_shapes[1:] + [self.out_shape]) \
+            if self.pp > 1 else int(np.prod(self.out_shape))
+        self.buf_dtype = self.in_dtypes[1] if self.pp > 1 else self.out_dtype
+        for d in self.in_dtypes[2:] + [self.out_dtype]:
+            self.buf_dtype = jnp.promote_types(self.buf_dtype, d)
+        # the stage-0 injection rides the same buffer: its dtype must survive
+        # the round trip. Integer inputs (token ids) go through f32 — exact for
+        # ids < 2^24 — because jax's lattice would otherwise pick bf16 and
+        # silently round ids > 256.
+        d0 = self.in_dtypes[0]
+        if jnp.issubdtype(d0, jnp.integer):
+            self.buf_dtype = jnp.promote_types(self.buf_dtype, jnp.float32)
+        else:
+            self.buf_dtype = jnp.promote_types(self.buf_dtype, d0)
+        # stage-0 injection buffer must fit the raw input too
+        self.buf_elems = max(self.buf_elems, int(np.prod(self.in_shapes[0])))
+
+    # -- state management -----------------------------------------------------
+
+    def init_packed(self, rng: jax.Array) -> Tuple[jax.Array, jax.Array]:
+        """Initialize every stage and pack into ((pp, p_len), (pp, s_len)) rows,
+        placed sharded over the pipe axis."""
+        keys = jax.random.split(rng, self.pp)
+        p_rows, s_rows = [], []
+        for i, stage in enumerate(self.stages):
+            v = stage.init(keys[i], self.in_shapes[i])
+            p_rows.append(self.p_codecs[i].pack(v["params"], self.p_len))
+            s_rows.append(self.s_codecs[i].pack(v["state"], self.s_len))
+        sharding = NamedSharding(self.mesh, P(self.axis))
+        return (jax.device_put(jnp.stack(p_rows), sharding),
+                jax.device_put(jnp.stack(s_rows), sharding))
+
+    def unpack_stage_variables(self, packed_params, packed_state) -> List[dict]:
+        """Back to per-stage {"params", "state"} pytrees (checkpoint/export)."""
+        pr = np.asarray(packed_params)
+        sr = np.asarray(packed_state)
+        return [{"params": self.p_codecs[i].unpack(jnp.asarray(pr[i])),
+                 "state": self.s_codecs[i].unpack(jnp.asarray(sr[i]))}
+                for i in range(self.pp)]
+
+    def pack_stage_variables(self, variables: Sequence[dict]):
+        """Inverse of unpack (restore from a per-stage checkpoint)."""
+        sharding = NamedSharding(self.mesh, P(self.axis))
+        p = jnp.stack([self.p_codecs[i].pack(v["params"], self.p_len)
+                       for i, v in enumerate(variables)])
+        s = jnp.stack([self.s_codecs[i].pack(v["state"], self.s_len)
+                       for i, v in enumerate(variables)])
+        return jax.device_put(p, sharding), jax.device_put(s, sharding)
+
+    # -- the compiled schedule ------------------------------------------------
+
+    def _encode(self, x) -> jax.Array:
+        flat = jnp.ravel(x).astype(self.buf_dtype)
+        return jnp.pad(flat, (0, self.buf_elems - flat.shape[0]))
+
+    def _make_branch(self, i: int, train: bool):
+        """Branch i of the per-tick lax.switch: decode this stage's input from
+        the hop buffer, run the stage, encode the output, and (last stage only)
+        compute loss/corrects against the tick's labels."""
+        stage = self.stages[i]
+        in_shape, in_dtype = self.in_shapes[i], self.in_dtypes[i]
+        p_codec, s_codec = self.p_codecs[i], self.s_codecs[i]
+        is_last = i == self.pp - 1
+
+        def branch(p_vec, s_vec, buf, labels_mb, key):
+            x = buf[:int(np.prod(in_shape))].reshape(in_shape).astype(in_dtype)
+            variables = {"params": p_codec.unpack(p_vec),
+                         "state": s_codec.unpack(s_vec)}
+            out, new_state = stage.apply(variables, x, train=train, rng=key)
+            new_s_vec = s_codec.pack(new_state, self.s_len)
+            if is_last:
+                loss = self.loss_fn(out, labels_mb).astype(jnp.float32)
+                if self.compute_accuracy:
+                    from ..nn import metrics as metrics_lib
+
+                    corr = metrics_lib.class_corrects(out, labels_mb).astype(
+                        jnp.float32)
+                else:
+                    corr = jnp.zeros((), jnp.float32)
+            else:
+                loss = jnp.zeros((), jnp.float32)
+                corr = jnp.zeros((), jnp.float32)
+            return self._encode(out), new_s_vec, loss, corr
+
+        return branch
+
+    def pipeline_loss(self, packed_params, packed_state, data, labels, rng,
+                      train: bool = True):
+        """(mean loss over microbatches, (new_packed_state, metrics)).
+
+        ``data``: (num_mb * mb, ...) or (num_mb, mb, ...); labels likewise.
+        Differentiable w.r.t. packed_params. Run under ``self.mesh``.
+        """
+        num_mb, pp, axis = self.num_mb, self.pp, self.axis
+        mb = self.in_shapes[0][0]  # LOCAL microbatch size (per data shard)
+        mb_global = mb * self.dp
+        if data.shape[0] != num_mb:
+            if data.shape[0] != num_mb * mb_global:
+                raise ValueError(f"batch {data.shape[0]} != num_microbatches "
+                                 f"{num_mb} x microbatch {mb_global}")
+            data = data.reshape((num_mb, mb_global) + data.shape[1:])
+            labels = labels.reshape((num_mb, mb_global) + labels.shape[1:])
+        branches = [self._make_branch(i, train) for i in range(pp)]
+        n_ticks = num_mb + pp - 1
+
+        def per_device(p_rows, s_rows, data_mb, labels_mb, key):
+            p_vec = p_rows[0]   # local (1, p_len) row -> (p_len,)
+            stage = jax.lax.axis_index(axis)
+            if self.data_axis is not None:
+                # distinct dropout masks per data shard — without this every
+                # shard would reuse the replicated key on different samples
+                key = jax.random.fold_in(key, jax.lax.axis_index(self.data_axis))
+            # encode all injected microbatches once (stage 0 consumes them)
+            inject = jax.vmap(self._encode)(data_mb)
+
+            def tick(carry, t):
+                recv, s_vec, loss_acc, corr_acc = carry
+                inp = jnp.where(stage == 0, inject[jnp.minimum(t, num_mb - 1)],
+                                recv)
+                m_idx = jnp.clip(t - (pp - 1), 0, num_mb - 1)
+                key_t = jax.random.fold_in(jax.random.fold_in(key, t), stage)
+                out_buf, new_s, loss, corr = jax.lax.switch(
+                    stage, branches, p_vec, s_vec, inp, labels_mb[m_idx], key_t)
+                # a stage holds a real microbatch only during its active window;
+                # outside it the input is schedule garbage — state/loss must not
+                # absorb it (this is what keeps BatchNorm statistics exact)
+                active = jnp.logical_and(stage <= t, t - stage < num_mb)
+                s_vec = jnp.where(active, new_s, s_vec)
+                emit = jnp.logical_and(active, stage == pp - 1)
+                loss_acc = loss_acc + jnp.where(emit, loss, 0.0)
+                corr_acc = corr_acc + jnp.where(emit, corr, 0.0)
+                perm = [(i, (i + 1) % pp) for i in range(pp)]
+                recv = jax.lax.ppermute(out_buf, axis, perm)
+                return (recv, s_vec, loss_acc, corr_acc), None
+
+            zero_buf = jnp.zeros((self.buf_elems,), self.buf_dtype)
+            (recv, s_vec, loss_acc, corr_acc), _ = jax.lax.scan(
+                tick, (zero_buf, s_rows[0], jnp.zeros((), jnp.float32),
+                       jnp.zeros((), jnp.float32)),
+                jnp.arange(n_ticks))
+            if self.data_axis is not None:
+                # data ranks saw different samples: average the running-stat
+                # updates (sync-BN-style state merge; normalization itself used
+                # per-shard batch stats — standard "ghost BN" dp semantics) and
+                # reduce loss/corrects so outputs are data-axis invariant
+                s_vec = jax.lax.pmean(s_vec, self.data_axis)
+                loss_acc = jax.lax.pmean(loss_acc, self.data_axis)
+                corr_acc = jax.lax.psum(corr_acc, self.data_axis)
+            return s_vec[None], loss_acc[None], corr_acc[None]
+
+        dp_ax = self.data_axis
+        in_specs = (P(axis), P(axis), P(None, dp_ax), P(None, dp_ax), P())
+        out_specs = (P(axis), P(axis), P(axis))
+        fn = jax.shard_map(per_device, mesh=self.mesh, in_specs=in_specs,
+                           out_specs=out_specs, check_vma=False)
+        new_state, losses, corrects = fn(packed_params, packed_state, data,
+                                         labels, rng)
+        # only the last stage's accumulators are nonzero; sum is exact
+        loss = jnp.sum(losses) / num_mb
+        metrics = {"loss": loss}
+        if self.compute_accuracy:
+            metrics["accuracy"] = jnp.sum(corrects) / (num_mb * mb_global)
+        return loss, (new_state, metrics)
+
+
+def make_pipeline_train_step(stages: Sequence, optimizer, mesh: Mesh,
+                             input_shape, *, loss_fn=None,
+                             num_microbatches: int = 4, axis: str = "pipe",
+                             input_dtype=jnp.bfloat16, scheduler=None,
+                             donate: bool = True, compute_accuracy: bool = True,
+                             data_axis: Optional[str] = None,
+                             augment: Optional[Callable] = None):
+    """Config-to-running-pipeline in one call (parity: the reference's
+    coordinator deploy + async_train_batch + UPDATE_PARAMETERS cycle,
+    coordinator.hpp:165-223, as ONE jitted program).
+
+    ``input_shape`` is the per-MICROBATCH input shape (mb, H, W, C).
+    Returns ``(pipe, step_fn, init_fn)``:
+      * ``init_fn(rng) -> TrainState`` — packed params/state sharded over pipe,
+        optimizer state over the packed rows (elementwise optimizers are
+        leaf-order invariant, so packed updates match per-tree updates exactly).
+      * ``step_fn(state, data, labels) -> (state, metrics)`` — full batch of
+        num_microbatches * mb samples through fill/drain, grads from jax.grad
+        of the schedule, one optimizer update (microbatch gradient
+        accumulation, parity: distributed/train.hpp:19-79).
+    """
+    from ..nn.schedulers import NoOp
+    from ..train.step import TrainState
+
+    pipe = HeteroPipeline(stages, mesh, input_shape, input_dtype=input_dtype,
+                          num_microbatches=num_microbatches, axis=axis,
+                          loss_fn=loss_fn, compute_accuracy=compute_accuracy,
+                          data_axis=data_axis)
+    scheduler = scheduler or NoOp()
+    host_driven = getattr(scheduler, "host_driven", False)
+
+    def init_fn(rng: jax.Array) -> TrainState:
+        init_rng, step_rng = jax.random.split(rng)
+        p, s = pipe.init_packed(init_rng)
+
+        def place(x):  # moment rows shard with the params; scalars replicate
+            spec = P(axis) if getattr(x, "ndim", 0) >= 1 else P()
+            return jax.device_put(x, NamedSharding(mesh, spec))
+
+        opt_state = jax.tree_util.tree_map(place, optimizer.init(p))
+        return TrainState(params=p, opt_state=opt_state, net_state=s,
+                          step=jnp.zeros((), jnp.int32), rng=step_rng)
+
+    def step(state: TrainState, data, labels, lr_scale):
+        rng, aug_rng, sub = jax.random.split(state.rng, 3)
+        if augment is not None:  # on-device augmentation, fused into the step
+            data = augment(aug_rng, data)
+        grad_fn = jax.value_and_grad(pipe.pipeline_loss, has_aux=True)
+        # pipeline_loss already averages over microbatches, so grads carry the
+        # 1/num_mb factor — same math as single-device gradient accumulation
+        (loss, (new_net, metrics)), grads = grad_fn(
+            state.params, state.net_state, data, labels, sub, True)
+        if not host_driven:
+            lr_scale = scheduler.scale(state.step)
+        new_params, new_opt = optimizer.update(grads, state.opt_state,
+                                               state.params, lr_scale=lr_scale)
+        metrics = dict(metrics, lr_scale=lr_scale)
+        return TrainState(new_params, new_opt, new_net,
+                          state.step + 1, rng), metrics
+
+    jitted = jax.jit(step, donate_argnums=(0,) if donate else ())
+
+    if host_driven:
+        def step_fn(state, data, labels):
+            with mesh:
+                return jitted(state, data, labels,
+                              jnp.asarray(scheduler.current_scale(), jnp.float32))
+    else:
+        def step_fn(state, data, labels):
+            with mesh:
+                return jitted(state, data, labels, jnp.ones((), jnp.float32))
+
+    return pipe, step_fn, init_fn
+
+
+def make_pipeline_eval_step(pipe: HeteroPipeline):
+    """Jitted (state, data, labels) -> metrics through the same pipeline
+    (train=False: BatchNorm uses running stats, no state mutation)."""
+
+    def ev(state, data, labels):
+        _, (_, metrics) = pipe.pipeline_loss(
+            state.params, state.net_state, data, labels,
+            jax.random.PRNGKey(0), False)
+        if "accuracy" in metrics:
+            mb_global = pipe.in_shapes[0][0] * pipe.dp
+            metrics = dict(metrics, corrects=metrics.pop("accuracy")
+                           * (pipe.num_mb * mb_global))
+        return metrics
+
+    jitted = jax.jit(ev)
+
+    def eval_fn(state, data, labels):
+        with pipe.mesh:
+            return jitted(state, data, labels)
+
+    return eval_fn
+
+
+# ---------------------------------------------------------------------------
+# 3. Host-orchestrated heterogeneous-stage pipeline
 # ---------------------------------------------------------------------------
 
 

@@ -119,8 +119,92 @@ def train_model(
     sample_shape = tuple(train_loader.data_shape)
     input_shape = (batch_size,) + sample_shape
     rng = jax.random.PRNGKey(config.seed)
-    if state is None:
-        state = create_train_state(model, optimizer, rng, input_shape)
+
+    # multi-chip: mesh_axes drives the parallel layout from config (parity:
+    # the reference's mode/endpoint config, examples/tcp_coordinator.cpp:27-97):
+    #   {"data": 8}                 -> DP, grads all-reduced by GSPMD
+    #   {"data": 4, "fsdp": 2}      -> DP + ZeRO-style param sharding
+    #   {"data": 2, "model": 4}     -> DP x Megatron TP (transformers)
+    #   {"pipe": 4}                 -> compiled heterogeneous pipeline
+    #   {"data": 2, "pipe": 4}      -> DP x PP in one program
+    # (the reference offers data OR pipeline per run; its DP never all-reduces,
+    # coordinator.hpp:37-40)
+    axes = {k: int(v) for k, v in (config.mesh_axes or {}).items() if int(v) > 1}
+    mesh = None
+    place_batch = None
+    pipe = None
+    if "pipe" in axes:
+        from .. import parallel
+        from ..parallel import partitioner
+        from ..parallel.pipeline import (make_pipeline_eval_step,
+                                         make_pipeline_train_step)
+
+        bad = set(axes) - {"pipe", "data"}
+        if bad:
+            raise ValueError(f"pipeline runs compose with 'data' only; got {axes}")
+        pp, dp = axes["pipe"], axes.get("data", 1)
+        if int(config.gradient_accumulation_steps) > 1:
+            raise ValueError(
+                "pipeline runs accumulate over num_microbatches; "
+                "gradient_accumulation_steps > 1 would be silently ignored — "
+                "set num_microbatches instead")
+        num_mb = max(1, int(config.num_microbatches))
+        if batch_size % (num_mb * dp):
+            raise ValueError(f"batch_size {batch_size} not divisible by "
+                             f"num_microbatches*data = {num_mb}*{dp}")
+        mb_global = batch_size // num_mb
+        mesh = parallel.make_mesh(data=dp, pipe=pp)
+        stages = partitioner.partition_model(
+            model, pp, (mb_global,) + sample_shape, strategy="balanced")
+        io_dtype = jax.numpy.dtype(config.io_dtype)
+        pipe, step_fn, init_fn = make_pipeline_train_step(
+            stages, optimizer, mesh, (mb_global,) + sample_shape,
+            loss_fn=config.loss, num_microbatches=num_mb,
+            input_dtype=io_dtype, scheduler=scheduler,
+            data_axis="data" if dp > 1 else None, augment=augment)
+        if state is None:
+            state = init_fn(rng)
+        eval_fn = make_pipeline_eval_step(pipe)
+        log.info("pipeline mesh %s: %d stages x %d microbatches (dp=%d)",
+                 dict(mesh.shape), pp, num_mb, dp)
+    else:
+        if state is None:
+            state = create_train_state(model, optimizer, rng, input_shape)
+        if axes:
+            from .. import parallel
+
+            unsupported = set(axes) - {"data", "fsdp", "model"}
+            if unsupported:
+                raise ValueError(
+                    f"train_model auto-sharding handles data/fsdp/model/pipe "
+                    f"axes; got {axes}. Use tnn_tpu.parallel directly for "
+                    f"seq (ring attention) layouts.")
+            shard_ways = axes.get("data", 1) * axes.get("fsdp", 1)
+            if batch_size % shard_ways:
+                raise ValueError(
+                    f"batch_size {batch_size} not divisible by the "
+                    f"data*fsdp mesh size {shard_ways} (mesh_axes={axes})")
+            mesh = parallel.make_mesh(
+                **{k: axes.get(k, 1) for k in ("data", "fsdp", "model")})
+            step_fn, place_state, _place = parallel.make_dp_train_step(
+                model, optimizer, mesh, loss_fn=config.loss, scheduler=scheduler,
+                fsdp=axes.get("fsdp", 1) > 1, tp=axes.get("model", 1) > 1,
+                grad_accum=config.gradient_accumulation_steps, augment=augment)
+            state = place_state(state)
+            place_batch = lambda batch: _place(*batch)  # noqa: E731
+            log.info("mesh %s: batch sharded over %d devices",
+                     dict(mesh.shape), mesh.size)
+        else:
+            step_fn = make_train_step(
+                model, optimizer, loss_fn=config.loss, scheduler=scheduler,
+                grad_accum=config.gradient_accumulation_steps, augment=augment)
+        base_eval = make_eval_step(model, loss_fn=config.loss)
+        if mesh is not None:
+            def eval_fn(state, data, labels, _f=base_eval, _m=mesh):
+                with _m:
+                    return _f(state, data, labels)
+        else:
+            eval_fn = base_eval
 
     ckpt = Checkpoint(config.snapshot_dir)
     best_val = -float("inf")
@@ -131,46 +215,6 @@ def train_model(
         best_val = float(meta.get("extra", {}).get("best_val", -float("inf")))
         resumed = True
         log.info("resumed from %s at step %d", config.resume, int(state.step))
-
-    # multi-chip: mesh_axes like {"data": 8} or {"data": 4, "fsdp": 2} turn the
-    # SAME train step into a sharded program — GSPMD inserts the gradient
-    # all-reduce over ICI (the reference's DP never all-reduces; SURVEY.md §2.4)
-    mesh = None
-    place_batch = None
-    if any(int(v) > 1 for v in (config.mesh_axes or {}).values()):
-        from .. import parallel
-
-        axes = {k: int(v) for k, v in config.mesh_axes.items()}
-        unsupported = set(axes) - {"data", "fsdp"}
-        if any(axes[a] > 1 for a in unsupported):
-            raise ValueError(
-                f"train_model auto-sharding handles data/fsdp axes; got {axes}. "
-                f"Use tnn_tpu.parallel directly for tp/pipe/seq layouts.")
-        shard_ways = axes.get("data", 1) * axes.get("fsdp", 1)
-        if batch_size % shard_ways:
-            raise ValueError(
-                f"batch_size {batch_size} not divisible by the "
-                f"data*fsdp mesh size {shard_ways} (mesh_axes={axes})")
-        mesh = parallel.make_mesh(**{k: axes.get(k, 1) for k in ("data", "fsdp")})
-        step_fn, place_state, _place = parallel.make_dp_train_step(
-            model, optimizer, mesh, loss_fn=config.loss, scheduler=scheduler,
-            fsdp=axes.get("fsdp", 1) > 1,
-            grad_accum=config.gradient_accumulation_steps, augment=augment)
-        state = place_state(state)
-        place_batch = lambda batch: _place(*batch)  # noqa: E731
-        log.info("mesh %s: batch sharded over %d devices",
-                 dict(mesh.shape), mesh.size)
-    else:
-        step_fn = make_train_step(
-            model, optimizer, loss_fn=config.loss, scheduler=scheduler,
-            grad_accum=config.gradient_accumulation_steps, augment=augment)
-    base_eval = make_eval_step(model, loss_fn=config.loss)
-    if mesh is not None:
-        def eval_fn(state, data, labels, _f=base_eval, _m=mesh):
-            with _m:
-                return _f(state, data, labels)
-    else:
-        eval_fn = base_eval
 
     history: List[Dict[str, Any]] = []
     if state_hook:

@@ -44,6 +44,19 @@ def force_platform(platform: str = "cpu", n_devices: int | None = None):
     return jax
 
 
+def apply_env_platform():
+    """Honor TNN_PLATFORM / TNN_NUM_DEVICES if set (entry-point helper).
+
+    Call before any jax work in CLI entry points: on images whose sitecustomize
+    pins the platform at interpreter start, plain JAX_PLATFORMS on the process
+    environment does nothing — this routes through the config-update workaround.
+    """
+    platform = os.environ.get("TNN_PLATFORM")
+    if platform:
+        n = int(os.environ.get("TNN_NUM_DEVICES", "0")) or None
+        force_platform(platform, n)
+
+
 def ensure_cpu_devices(n_devices: int):
     """Force the virtual n-device CPU platform, resetting a live backend if needed.
 
