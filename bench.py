@@ -199,8 +199,11 @@ def main():
             tail = (out.stderr or out.stdout or "").strip().splitlines()
             last_err = (f"bench subprocess printed no result "
                         f"(rc={out.returncode}): {tail[-1][-200:] if tail else ''!r}")
-            if not _is_transient(last_err):
-                break  # crash before measure() (ImportError, ...) won't heal
+            # signal-killed or silent deaths (relay dying mid-run, OOM kill)
+            # are transient and worth the retry; only a clean-exit crash with
+            # a non-transient message (ImportError, ...) is deterministic
+            if out.returncode >= 0 and tail and not _is_transient(last_err):
+                break
         elif "value" in result:
             print(json.dumps(result))
             _persist(result)

@@ -52,6 +52,11 @@ class Worker:
         ack = unpack(ev[3])
         self.rank = int(ack["rank"])
         self.world = int(ack["world"])
+        # stamp this process's profiler with its rank so events merged at the
+        # coordinator attribute to "workerN", not the default "main" (parity:
+        # per-source rows in the reference's Gantt, visualize_profiler.py)
+        if GlobalProfiler.source in ("", "main"):
+            GlobalProfiler.source = f"worker{self.rank}"
 
     # -- registration ----------------------------------------------------------
 

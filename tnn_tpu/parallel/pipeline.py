@@ -269,6 +269,20 @@ class HeteroPipeline:
                  "state": self.s_codecs[i].unpack(jnp.asarray(sr[i]))}
                 for i in range(self.pp)]
 
+    def place_train_state(self, state):
+        """Re-apply the pipe-axis sharding to a TrainState whose leaves lost
+        placement (e.g. after a checkpoint restore loads host arrays)."""
+        rows = NamedSharding(self.mesh, P(self.axis))
+
+        def place(x):
+            spec = P(self.axis) if getattr(x, "ndim", 0) >= 1 else P()
+            return jax.device_put(x, NamedSharding(self.mesh, spec))
+
+        return state._replace(
+            params=jax.device_put(state.params, rows),
+            opt_state=jax.tree_util.tree_map(place, state.opt_state),
+            net_state=jax.device_put(state.net_state, rows))
+
     def pack_stage_variables(self, variables: Sequence[dict]):
         """Inverse of unpack (restore from a per-stage checkpoint)."""
         sharding = NamedSharding(self.mesh, P(self.axis))

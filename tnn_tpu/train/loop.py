@@ -215,6 +215,12 @@ def train_model(
         best_val = float(meta.get("extra", {}).get("best_val", -float("inf")))
         resumed = True
         log.info("resumed from %s at step %d", config.resume, int(state.step))
+        # restore loads host arrays with no sharding — re-apply the layout or
+        # a resumed FSDP/TP/pipeline run silently trains fully replicated
+        if pipe is not None:
+            state = pipe.place_train_state(state)
+        elif mesh is not None:
+            state = place_state(state)
 
     history: List[Dict[str, Any]] = []
     if state_hook:
