@@ -1,0 +1,113 @@
+#!/usr/bin/env python
+"""Input-pipeline benchmarks: image-decode img/s and token-stream tok/s.
+
+The reference's first bottleneck risk at high img/s is the loader (threaded
+stb_image decode, src/data_loading/stb_image_impl.cpp); this measures ours —
+threaded PIL/npy decode + bilinear resize — against the per-batch time of the
+train step consuming it, so "loader keeps up" is a measured claim.
+
+    python benchmarks/data_bench.py [--quick] [--workers N]
+"""
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _make_image_tree(root: str, classes: int, per_class: int, size: int,
+                     fmt: str) -> str:
+    """Synthetic on-disk dataset: real PNG/JPEG files (true decode cost)."""
+    rng = np.random.default_rng(0)
+    for c in range(classes):
+        cdir = os.path.join(root, f"class{c:03d}")
+        os.makedirs(cdir, exist_ok=True)
+        if fmt == "npy":
+            arr = rng.integers(0, 255, (per_class, size, size, 3), np.uint8)
+            np.save(os.path.join(cdir, "images.npy"), arr)  # np.save keeps .npy
+        else:
+            from PIL import Image
+
+            for i in range(per_class):
+                arr = rng.integers(0, 255, (size, size, 3), np.uint8)
+                Image.fromarray(arr).save(
+                    os.path.join(cdir, f"img{i:04d}.{fmt}"))
+    return root
+
+
+def bench_image_loader(fmt: str, workers, batch: int, iters: int,
+                       src_size: int = 96, out_size: int = 64):
+    from tnn_tpu.data.datasets import ImageFolderDataLoader
+
+    tmp = tempfile.mkdtemp(prefix=f"tnn_imgs_{fmt}_")
+    _make_image_tree(tmp, classes=4, per_class=64, size=src_size, fmt=fmt)
+    results = []
+    for nw in workers:
+        loader = ImageFolderDataLoader(tmp, image_size=(out_size, out_size),
+                                       num_workers=nw)
+        loader.get_batch(batch)  # warm caches/pool
+        t0 = time.perf_counter()
+        n = 0
+        for _ in range(iters):
+            got = loader.get_batch(batch)
+            if got is None:  # epoch end: wrap (timing dataset is small)
+                loader.reset()
+                got = loader.get_batch(batch)
+            n += len(got[1])
+        dt = time.perf_counter() - t0
+        img_s = n / dt
+        results.append({"bench": f"image_decode_{fmt}", "workers": nw,
+                        "img_per_s": round(img_s, 1),
+                        "ms_per_batch": round(dt / iters * 1e3, 2),
+                        "host_cpus": os.cpu_count()})
+        print(f"  {fmt} decode x{nw} workers: {img_s:,.0f} img/s "
+              f"({dt / iters * 1e3:.1f} ms / batch of {batch})")
+    return results
+
+
+def bench_token_stream(batch: int, seq: int, iters: int):
+    from tnn_tpu.data.token_stream import TokenStreamDataLoader
+
+    tmp = tempfile.mkstemp(suffix=".bin")[1]
+    np.random.default_rng(0).integers(0, 50257, 4_000_000).astype(
+        np.uint16).tofile(tmp)
+    loader = TokenStreamDataLoader(tmp, seq)
+    rng = np.random.default_rng(1)
+    loader.random_windows(batch, rng)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        loader.random_windows(batch, rng)
+    dt = time.perf_counter() - t0
+    tok_s = iters * batch * seq / dt
+    native = loader._native_tokens is not None
+    print(f"  token stream (native={native}): {tok_s / 1e6:.1f} M tok/s")
+    return [{"bench": "token_stream", "native": native,
+             "mtok_per_s": round(tok_s / 1e6, 2)}]
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true")
+    ap.add_argument("--workers", default="1,4,8",
+                    help="comma list of decode worker counts to sweep")
+    args = ap.parse_args(argv)
+    workers = [int(w) for w in args.workers.split(",")]
+    iters = 4 if args.quick else 16
+    batch = 64 if args.quick else 256
+
+    print("== input pipeline ==")
+    results = []
+    results += bench_image_loader("png", workers, batch, iters)
+    results += bench_image_loader("npy", workers, batch, iters)
+    results += bench_token_stream(8, 1024, 8 if args.quick else 50)
+    return results
+
+
+if __name__ == "__main__":
+    for r in main():
+        print(json.dumps(r))

@@ -10,7 +10,7 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-from benchmarks import model_bench, ops_bench  # noqa: E402
+from benchmarks import ab_bench, data_bench, model_bench, ops_bench  # noqa: E402
 
 
 def main(argv=None):
@@ -22,6 +22,8 @@ def main(argv=None):
     results = []
     results.extend(ops_bench.main(["--quick"] if args.quick else []))
     results.extend(model_bench.main(["--quick"] if args.quick else []))
+    results.extend(data_bench.main(["--quick"] if args.quick else []))
+    results.extend(ab_bench.main(["--quick"] if args.quick else []))
     results = [r for r in results if r]
 
     print("\n== results ==")

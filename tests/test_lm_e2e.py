@@ -1,0 +1,69 @@
+"""End-to-end language modeling on a REAL token stream (no synthetic noise):
+byte-level tokens over this repo's own source files, streamed through the mmap
+loader into the compiled train step — the full path of the reference's GPT-2 +
+OpenWebText setup (python/openwebtext.py -> open_webtext_data_loader.hpp),
+with training on top (the reference only ever runs GPT-2 inference)."""
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tnn_tpu import nn
+from tnn_tpu.data.token_stream import TokenStreamDataLoader
+from tnn_tpu.models.gpt2 import GPT2, generate
+from tnn_tpu.train import create_train_state, make_train_step
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+EOT = 256
+
+
+@pytest.fixture(scope="module")
+def byte_corpus(tmp_path_factory):
+    """uint16 byte-token .bin built from real source text (tnn_tpu/*.py)."""
+    out = tmp_path_factory.mktemp("corpus") / "train.bin"
+    chunks = []
+    src = os.path.join(REPO, "tnn_tpu")
+    for root, _, files in os.walk(src):
+        for name in sorted(files):
+            if name.endswith(".py"):
+                with open(os.path.join(root, name), "rb") as f:
+                    chunks.append(np.frombuffer(f.read(), np.uint8)
+                                  .astype(np.uint16))
+                chunks.append(np.array([EOT], np.uint16))
+    tokens = np.concatenate(chunks)
+    assert len(tokens) > 100_000  # real corpus, not a stub
+    tokens.tofile(str(out))
+    return str(out)
+
+
+def test_gpt2_learns_real_bytes(byte_corpus):
+    """A tiny GPT-2 on real source bytes: loss falls well below the uniform
+    -log(1/257)=5.55 floor within 40 steps, proving stream -> windows ->
+    compiled LM step works end to end."""
+    seq, batch = 64, 8
+    loader = TokenStreamDataLoader(byte_corpus, seq)
+    model = GPT2(vocab_size=257, max_len=seq, num_layers=2, d_model=64,
+                 num_heads=2, dropout=0.0)
+    opt = nn.AdamW(lr=1e-3, grad_clip_norm=1.0)
+    state = create_train_state(model, opt, jax.random.PRNGKey(0), (batch, seq))
+    step = make_train_step(model, opt, compute_accuracy=False)
+    rng = np.random.default_rng(0)
+    first = None
+    for i in range(40):
+        data, labels = loader.random_windows(batch, rng)
+        state, m = step(state, jnp.asarray(data, jnp.int32),
+                        jnp.asarray(labels, jnp.int32))
+        if first is None:
+            first = float(m["loss"])
+    final = float(m["loss"])
+    assert final < first * 0.8, (first, final)
+    assert final < 4.0, final  # clearly below the 5.55 uniform floor
+
+    # KV-cache sampling from the trained model produces tokens in-vocab
+    data, _ = loader.random_windows(1, rng)
+    toks = np.asarray(generate(model, state.params,
+                               jnp.asarray(data[:, :16], jnp.int32), 8,
+                               temperature=0.0, max_len=seq))
+    assert toks.shape == (1, 8) and int(toks.max()) < 257

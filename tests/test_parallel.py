@@ -201,11 +201,14 @@ def _align_ref_state(model, parts, pipe, pstate, opt, batch_shape):
                            opt_state=opt.init(ref_params))
 
 
-def test_hetero_pipeline_matches_grad_accum():
+@pytest.mark.parametrize("remat", [False, True])
+def test_hetero_pipeline_matches_grad_accum(remat):
     """pp=4 pipeline over shape-changing conv stages must reproduce
     single-device grad-accumulation EXACTLY — loss, accuracy, and BatchNorm
     running stats (the round-2 finding: StagePipeline froze BN; the compiled
-    pipeline updates it per microbatch like the reference's per-mb caches)."""
+    pipeline updates it per microbatch like the reference's per-mb caches).
+    remat=True (stage rematerialization, the 1F1B memory benefit) must not
+    change any numerics."""
     NUM_MB, MB = 4, 8
     B = NUM_MB * MB
     mesh = parallel.make_mesh(pipe=4)
@@ -215,7 +218,8 @@ def test_hetero_pipeline_matches_grad_accum():
     stages = parallel.split(model, parts)
     opt = nn.SGD(lr=0.1, momentum=0.9)
     pipe, step_fn, init_fn = parallel.make_pipeline_train_step(
-        stages, opt, mesh, (MB, 16, 16, 3), num_microbatches=NUM_MB)
+        stages, opt, mesh, (MB, 16, 16, 3), num_microbatches=NUM_MB,
+        remat=remat)
     pstate = init_fn(jax.random.PRNGKey(0))
 
     ref_opt = nn.SGD(lr=0.1, momentum=0.9)

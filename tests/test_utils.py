@@ -115,3 +115,51 @@ class TestLoggerHardware:
         assert memory_usage_kb() > 0
         info = device_info()
         assert info and "platform" in info[0]
+
+
+class TestAffinity:
+    """Parity: ThreadAffinity (utils/thread_affinity.hpp:22-158) + deep
+    HardwareInfo topology (hardware_info.hpp:13-168)."""
+
+    def test_cpu_sets_and_core_types(self):
+        from tnn_tpu.utils import affinity
+
+        cpus = affinity.available_cpus()
+        assert cpus and all(isinstance(c, int) for c in cpus)
+        types = affinity.core_types()
+        assert set(types) == set(cpus)
+        assert set(types.values()) <= {"P", "E"}
+        io = affinity.io_cpu_set()
+        assert set(io) <= set(cpus) and io
+
+    def test_parse_cpu_list(self):
+        from tnn_tpu.utils import affinity
+
+        assert affinity.parse_cpu_list("0-3,8,10-11") == [0, 1, 2, 3, 8, 10, 11]
+        assert affinity.parse_cpu_list("2") == [2]
+
+    def test_pin_current_thread_roundtrip(self):
+        import os
+
+        from tnn_tpu.utils import affinity
+
+        before = affinity.available_cpus()
+        assert affinity.pin_current_thread(before)  # pin to the full set: no-op
+        assert sorted(os.sched_getaffinity(0)) == before
+
+    def test_env_override_and_opt_in(self, monkeypatch):
+        from tnn_tpu.utils import affinity
+
+        monkeypatch.setenv("TNN_IO_CPUS", "0")
+        assert affinity.io_cpu_set() == [0]
+        monkeypatch.delenv("TNN_PIN_IO", raising=False)
+        assert affinity.pin_io_thread() is False  # off unless TNN_PIN_IO=1
+
+    def test_cpu_topology_report(self):
+        from tnn_tpu.utils.hardware import cpu_topology
+
+        topo = cpu_topology()
+        assert topo["logical_cores"] >= 1
+        assert topo["p_cores"] + topo["e_cores"] == len(
+            __import__("tnn_tpu.utils.affinity", fromlist=["x"]).available_cpus())
+        assert topo.get("mem_total_kb", 1) > 0

@@ -153,8 +153,18 @@ class ImageFolderDataLoader(DataLoader):
     """
 
     def __init__(self, path: str, image_size: Tuple[int, int] = (64, 64), seed: int = 0,
-                 class_names: Optional[Sequence[str]] = None, eager: bool = False):
+                 class_names: Optional[Sequence[str]] = None, eager: bool = False,
+                 num_workers: Optional[int] = None, resample: str = "bilinear"):
         super().__init__(seed)
+        # decode pool: PIL releases the GIL during decode/resize, so threads
+        # parallelize for real (parity: the reference's threaded stb_image
+        # loaders); workers optionally pin to the IO cpu set (TNN_PIN_IO=1,
+        # parity: ThreadAffinity, utils/thread_affinity.hpp:46)
+        if num_workers is None:
+            num_workers = min(8, max(1, (os.cpu_count() or 2) - 1))
+        self.num_workers = int(num_workers)
+        self.resample = resample
+        self._pool = None
         # user-pinned class order is preserved (it fixes the label mapping);
         # discovered classes are sorted for determinism
         if class_names is not None:
@@ -190,8 +200,11 @@ class ImageFolderDataLoader(DataLoader):
         self._label_shape = ()
         self._eager_cache: Optional[np.ndarray] = None
         if eager:
-            self._eager_cache = np.stack(
-                [self._decode(i) for i in range(self._num_samples)])
+            pool = self._decode_pool()
+            rng_idx = range(self._num_samples)
+            decoded = pool.map(self._decode, rng_idx) if pool is not None \
+                else (self._decode(i) for i in rng_idx)
+            self._eager_cache = np.stack(list(decoded))
 
     def _decode(self, i: int) -> np.ndarray:
         """One sample as uint8 HWC at image_size."""
@@ -204,16 +217,41 @@ class ImageFolderDataLoader(DataLoader):
             if arr.dtype != np.uint8:
                 arr = np.clip(arr * 255.0, 0, 255).astype(np.uint8)
             if arr.shape[:2] != self.image_size:
-                arr = _resize_nearest(arr[None], self.image_size)[0]
+                if self.resample == "bilinear":
+                    arr = _resize_bilinear(arr[None], self.image_size)[0]
+                else:
+                    arr = _resize_nearest(arr[None], self.image_size)[0]
             return arr
-        return _decode_image_pil(payload, self.image_size)
+        return _decode_image_pil(payload, self.image_size, self.resample)
+
+    def _decode_pool(self):
+        if self._pool is None and self.num_workers > 1:
+            from concurrent.futures import ThreadPoolExecutor
+
+            from ..utils import affinity
+
+            self._pool = ThreadPoolExecutor(
+                max_workers=self.num_workers,
+                thread_name_prefix="tnn-decode",
+                initializer=affinity.pin_io_thread)
+        return self._pool
 
     def _get(self, indices):
         if self._eager_cache is not None:
             batch = self._eager_cache[indices]
         else:
-            batch = np.stack([self._decode(int(i)) for i in indices])
+            pool = self._decode_pool()
+            if pool is not None and len(indices) > 1:
+                batch = np.stack(list(pool.map(
+                    self._decode, (int(i) for i in indices))))
+            else:
+                batch = np.stack([self._decode(int(i)) for i in indices])
         return batch.astype(np.float32) / 255.0, self._labels[indices]
+
+    def __del__(self):
+        pool = getattr(self, "_pool", None)
+        if pool is not None:
+            pool.shutdown(wait=False)
 
 
 def _resize_nearest(imgs: np.ndarray, image_size) -> np.ndarray:
@@ -223,13 +261,41 @@ def _resize_nearest(imgs: np.ndarray, image_size) -> np.ndarray:
     return imgs[:, yi[:, None], xi[None, :], :]
 
 
-def _decode_image_pil(path: str, image_size) -> np.ndarray:
+def _resize_bilinear(imgs: np.ndarray, image_size) -> np.ndarray:
+    """Vectorized bilinear resize for (N, H, W, C) uint8 (quality parity with
+    the reference's stb resize; the old nearest path survives as an option)."""
+    N, H0, W0, C = imgs.shape
+    H, W = image_size
+    if (H0, W0) == (H, W):
+        return imgs
+    # sample positions in source coordinates (align-corners=False convention)
+    ys = (np.arange(H) + 0.5) * H0 / H - 0.5
+    xs = (np.arange(W) + 0.5) * W0 / W - 0.5
+    y0 = np.clip(np.floor(ys).astype(np.int64), 0, H0 - 1)
+    x0 = np.clip(np.floor(xs).astype(np.int64), 0, W0 - 1)
+    y1 = np.minimum(y0 + 1, H0 - 1)
+    x1 = np.minimum(x0 + 1, W0 - 1)
+    wy = np.clip(ys - y0, 0.0, 1.0)[None, :, None, None]
+    wx = np.clip(xs - x0, 0.0, 1.0)[None, None, :, None]
+    f = imgs.astype(np.float32)
+    fy0, fy1 = f[:, y0], f[:, y1]
+    top = fy0[:, :, x0] * (1 - wx) + fy0[:, :, x1] * wx
+    bot = fy1[:, :, x0] * (1 - wx) + fy1[:, :, x1] * wx
+    out = top * (1 - wy) + bot * wy
+    return np.clip(out + 0.5, 0, 255).astype(np.uint8)
+
+
+def _decode_image_pil(path: str, image_size, resample: str = "bilinear") -> np.ndarray:
     try:
         from PIL import Image  # noqa: deferred optional dep
     except ImportError as e:
         raise ImportError(
             f"PIL unavailable to decode {path}; provide images.npy instead") from e
-    img = Image.open(path).convert("RGB").resize((image_size[1], image_size[0]))
+    sampling = getattr(Image, "Resampling", Image)  # Pillow<9.1 compat
+    rs = sampling.BILINEAR if resample == "bilinear" else sampling.NEAREST
+    img = Image.open(path).convert("RGB")
+    if img.size != (image_size[1], image_size[0]):
+        img = img.resize((image_size[1], image_size[0]), rs)
     return np.asarray(img, np.uint8)
 
 

@@ -213,6 +213,9 @@ def prefetch(iterator: Iterator, size: int = 2, to_device=True) -> Iterator:
         jax.device_put if to_device else None)
 
     def producer():
+        from ..utils import affinity
+
+        affinity.pin_io_thread()  # opt-in (TNN_PIN_IO=1): keep off XLA's cores
         try:
             for item in iterator:
                 if place is not None:

@@ -9,6 +9,7 @@ from . import (
     attention,
     blocks,
     embedding,
+    graph,
     initializers,
     layers,
     losses,
@@ -22,6 +23,7 @@ from .activations import Activation
 from .attention import MultiHeadAttention, sdpa
 from .transformer import EncoderBlock, GPTBlock
 from .blocks import Parallel, Residual, Sequential
+from .graph import Add, Concat, Graph, GraphNode
 from .embedding import ClassToken, Embedding, PositionalEmbedding
 from .layers import (
     AvgPool2D,

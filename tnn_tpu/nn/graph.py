@@ -1,0 +1,200 @@
+"""Arbitrary-DAG graph modules: multi-input/multi-output topologies by name.
+
+Reference capability being matched (not ported):
+  * ``Graph`` + JSON config round-trip + save/load_state — include/nn/graph.hpp:18-191
+  * ``GraphBuilder`` with Kahn toposort + compile — include/nn/graph_builder.hpp:51-108
+  * ``GraphExecutor`` fwd = edges in order / bwd = reverse — graph_executor.hpp:30-75
+  * NAry join layers (add/sub), include/nn/layers_impl/n_ary_layer.hpp
+
+TPU-first redesign: the graph is static configuration; execution is one pure
+``apply`` traced into whatever jitted program contains it, so the "executor"
+is XLA's scheduler and the backward pass is ``jax.grad`` of the traced forward
+(the reference hand-walks edges in reverse). Checkpointing reuses the module
+config round-trip — a Graph saves/loads through checkpoint.save_model like any
+other module.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+
+from ..core import rng as rnglib
+from ..core.module import Module, module_from_config, register_module
+
+
+@register_module("add")
+class Add(Module):
+    """Elementwise n-ary add join (parity: NAry add, n_ary_layer.hpp)."""
+
+    def _apply(self, params, state, *xs, train, rng):
+        out = xs[0]
+        for x in xs[1:]:
+            out = out + x
+        return out, state
+
+    def output_shape(self, *input_shapes):
+        return tuple(input_shapes[0])
+
+
+@register_module("concat")
+class Concat(Module):
+    """Concatenate inputs along ``axis`` (a join the reference lacks)."""
+
+    def __init__(self, axis: int = -1, name=None, policy=None):
+        super().__init__(name=name, policy=policy)
+        self.axis = int(axis)
+
+    def _apply(self, params, state, *xs, train, rng):
+        return jnp.concatenate(xs, axis=self.axis), state
+
+    def output_shape(self, *input_shapes):
+        shapes = [list(s) for s in input_shapes]
+        ax = self.axis if self.axis >= 0 else len(shapes[0]) + self.axis
+        out = list(shapes[0])
+        out[ax] = sum(s[ax] for s in shapes)
+        return tuple(out)
+
+    def _config(self):
+        return {"axis": self.axis}
+
+
+class GraphNode:
+    """One named node: a module plus the names of its inputs."""
+
+    def __init__(self, name: str, module: Module, inputs: Sequence[str]):
+        self.name = str(name)
+        self.module = module
+        self.inputs = [str(i) for i in inputs]
+
+
+@register_module("graph")
+class Graph(Module):
+    """DAG of named nodes over named graph inputs.
+
+    ``nodes`` is a sequence of (name, module, input_names) tuples or GraphNode.
+    ``inputs`` names the graph's positional inputs (default one, "input").
+    ``outputs`` names the returned values (default: every sink node, in
+    declaration order); multiple outputs return a tuple.
+
+    Topology is validated with a Kahn toposort at construction (parity:
+    GraphBuilder::compile, graph_builder.hpp:51-108) — cycles, unknown input
+    names, and duplicate node names are errors at build time, not trace time.
+    """
+
+    def __init__(self, nodes: Sequence, inputs: Sequence[str] = ("input",),
+                 outputs: Optional[Sequence[str]] = None, name=None, policy=None):
+        super().__init__(name=name, policy=policy)
+        self.nodes: List[GraphNode] = []
+        for n in nodes:
+            if isinstance(n, GraphNode):
+                self.nodes.append(n)
+            else:
+                nm, mod, ins = n
+                self.nodes.append(GraphNode(nm, mod, ins))
+        self.inputs = [str(i) for i in inputs]
+        names = [n.name for n in self.nodes]
+        dupes = {x for x in names if names.count(x) > 1}
+        if dupes or set(names) & set(self.inputs):
+            raise ValueError(f"duplicate node names: {sorted(dupes) or 'vs inputs'}")
+        known = set(self.inputs) | set(names)
+        for n in self.nodes:
+            missing = [i for i in n.inputs if i not in known]
+            if missing:
+                raise ValueError(f"node {n.name!r} consumes unknown {missing}")
+        if outputs is None:
+            consumed = {i for n in self.nodes for i in n.inputs}
+            outputs = [n.name for n in self.nodes if n.name not in consumed]
+        self.outputs = [str(o) for o in outputs]
+        for o in self.outputs:
+            if o not in known:
+                raise ValueError(f"unknown output {o!r}")
+        self._order = self._toposort()
+
+    def _toposort(self) -> List[GraphNode]:
+        """Kahn (parity: graph_builder.hpp:51-102). Raises on cycles."""
+        by_name = {n.name: n for n in self.nodes}
+        indeg = {n.name: sum(1 for i in n.inputs if i in by_name)
+                 for n in self.nodes}
+        consumers: Dict[str, List[str]] = {}
+        for n in self.nodes:
+            for i in n.inputs:
+                if i in by_name:
+                    consumers.setdefault(i, []).append(n.name)
+        ready = [n.name for n in self.nodes if indeg[n.name] == 0]
+        order = []
+        while ready:
+            cur = ready.pop(0)
+            order.append(by_name[cur])
+            for c in consumers.get(cur, ()):
+                indeg[c] -= 1
+                if indeg[c] == 0:
+                    ready.append(c)
+        if len(order) != len(self.nodes):
+            cyc = sorted(set(by_name) - {n.name for n in order})
+            raise ValueError(f"graph has a cycle through {cyc}")
+        return order
+
+    # -- init/apply ------------------------------------------------------------
+
+    def _init(self, rng, *input_shapes):
+        if len(input_shapes) != len(self.inputs):
+            raise ValueError(f"graph takes {len(self.inputs)} inputs "
+                             f"({self.inputs}), got {len(input_shapes)}")
+        shapes: Dict[str, Tuple[int, ...]] = dict(zip(self.inputs, input_shapes))
+        params: Dict[str, Any] = {}
+        state: Dict[str, Any] = {}
+        keys = rnglib.split_for(rng, len(self._order))
+        for node, k in zip(self._order, keys):
+            in_shapes = [tuple(shapes[i]) for i in node.inputs]
+            v = node.module.init(k, *in_shapes)
+            if v["params"]:
+                params[node.name] = v["params"]
+            if v["state"]:
+                state[node.name] = v["state"]
+            shapes[node.name] = node.module.output_shape(*in_shapes)
+        return params, state
+
+    def _apply(self, params, state, *xs, train, rng):
+        values: Dict[str, Any] = dict(zip(self.inputs, xs))
+        new_state: Dict[str, Any] = {}
+        keys = rnglib.split_for(rng, len(self._order))
+        for node, k in zip(self._order, keys):
+            v = {"params": params.get(node.name, {}),
+                 "state": state.get(node.name, {})}
+            ins = [values[i] for i in node.inputs]
+            out, st = node.module.apply(v, *ins, train=train, rng=k)
+            values[node.name] = out
+            if st:
+                new_state[node.name] = st
+        outs = tuple(values[o] for o in self.outputs)
+        return (outs[0] if len(outs) == 1 else outs), new_state
+
+    def output_shape(self, *input_shapes):
+        shapes: Dict[str, Tuple[int, ...]] = dict(zip(self.inputs, input_shapes))
+        for node in self._order:
+            shapes[node.name] = node.module.output_shape(
+                *[tuple(shapes[i]) for i in node.inputs])
+        outs = tuple(shapes[o] for o in self.outputs)
+        return outs[0] if len(outs) == 1 else outs
+
+    # -- config round-trip (parity: graph.hpp:119-183) --------------------------
+
+    def _config(self):
+        return {
+            "nodes": [{"name": n.name, "inputs": n.inputs,
+                       "module": n.module.get_config()} for n in self.nodes],
+            "inputs": list(self.inputs),
+            "outputs": list(self.outputs),
+        }
+
+    @classmethod
+    def from_config(cls, cfg):
+        from ..core.dtypes import DTypePolicy
+
+        cfg = dict(cfg)
+        cfg.pop("type", None)
+        policy = cfg.pop("policy", None)
+        nodes = [GraphNode(d["name"], module_from_config(d["module"]),
+                           d["inputs"]) for d in cfg.pop("nodes")]
+        return cls(nodes, **cfg, policy=DTypePolicy.from_config(policy))

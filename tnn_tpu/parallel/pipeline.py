@@ -172,7 +172,8 @@ class HeteroPipeline:
     def __init__(self, stages: Sequence, mesh: Mesh, input_shape,
                  input_dtype=jnp.bfloat16, num_microbatches: int = 4,
                  axis: str = "pipe", loss_fn: Optional[Callable] = None,
-                 compute_accuracy: bool = True, data_axis: Optional[str] = None):
+                 compute_accuracy: bool = True, data_axis: Optional[str] = None,
+                 remat: bool = False):
         from ..nn import losses as losses_lib
 
         self.stages = list(stages)
@@ -201,6 +202,16 @@ class HeteroPipeline:
             loss_fn = losses_lib.get(loss_fn or "softmax_cross_entropy")
         self.loss_fn = loss_fn
         self.compute_accuracy = bool(compute_accuracy)
+        # Schedule note: this is compiled lockstep GPipe — bubble fraction is
+        # (pp-1)/(num_mb+pp-1). Event-driven 1F1B (the reference's semi-async
+        # schedule, coordinator.hpp:165-223) does not map onto a lockstep SPMD
+        # scan; the compiled-regime equivalents are (a) hops cost ~0 (ICI
+        # ppermute inside one XLA program vs the reference's per-hop TCP/RDMA
+        # serialization), so num_mb can be raised until the bubble vanishes,
+        # and (b) ``remat=True`` rematerializes each stage in the backward,
+        # cutting saved activations per tick to the hop buffers — 1F1B's
+        # memory benefit without its schedule.
+        self.remat = bool(remat)
 
         # shape propagation (parity: deploy_stages shape chain,
         # coordinator.hpp:368-456): microbatch-shaped activations per boundary
@@ -307,12 +318,18 @@ class HeteroPipeline:
         p_codec, s_codec = self.p_codecs[i], self.s_codecs[i]
         is_last = i == self.pp - 1
 
-        def branch(p_vec, s_vec, buf, labels_mb, key):
-            x = buf[:int(np.prod(in_shape))].reshape(in_shape).astype(in_dtype)
+        def run_stage(p_vec, s_vec, x, key):
             variables = {"params": p_codec.unpack(p_vec),
                          "state": s_codec.unpack(s_vec)}
             out, new_state = stage.apply(variables, x, train=train, rng=key)
-            new_s_vec = s_codec.pack(new_state, self.s_len)
+            return out, s_codec.pack(new_state, self.s_len)
+
+        if self.remat and train:
+            run_stage = jax.checkpoint(run_stage)
+
+        def branch(p_vec, s_vec, buf, labels_mb, key):
+            x = buf[:int(np.prod(in_shape))].reshape(in_shape).astype(in_dtype)
+            out, new_s_vec = run_stage(p_vec, s_vec, x, key)
             if is_last:
                 loss = self.loss_fn(out, labels_mb).astype(jnp.float32)
                 if self.compute_accuracy:
@@ -414,7 +431,8 @@ def make_pipeline_train_step(stages: Sequence, optimizer, mesh: Mesh,
                              input_dtype=jnp.bfloat16, scheduler=None,
                              donate: bool = True, compute_accuracy: bool = True,
                              data_axis: Optional[str] = None,
-                             augment: Optional[Callable] = None):
+                             augment: Optional[Callable] = None,
+                             remat: bool = False):
     """Config-to-running-pipeline in one call (parity: the reference's
     coordinator deploy + async_train_batch + UPDATE_PARAMETERS cycle,
     coordinator.hpp:165-223, as ONE jitted program).
@@ -435,21 +453,16 @@ def make_pipeline_train_step(stages: Sequence, optimizer, mesh: Mesh,
     pipe = HeteroPipeline(stages, mesh, input_shape, input_dtype=input_dtype,
                           num_microbatches=num_microbatches, axis=axis,
                           loss_fn=loss_fn, compute_accuracy=compute_accuracy,
-                          data_axis=data_axis)
+                          data_axis=data_axis, remat=remat)
     scheduler = scheduler or NoOp()
     host_driven = getattr(scheduler, "host_driven", False)
 
     def init_fn(rng: jax.Array) -> TrainState:
         init_rng, step_rng = jax.random.split(rng)
         p, s = pipe.init_packed(init_rng)
-
-        def place(x):  # moment rows shard with the params; scalars replicate
-            spec = P(axis) if getattr(x, "ndim", 0) >= 1 else P()
-            return jax.device_put(x, NamedSharding(mesh, spec))
-
-        opt_state = jax.tree_util.tree_map(place, optimizer.init(p))
-        return TrainState(params=p, opt_state=opt_state, net_state=s,
-                          step=jnp.zeros((), jnp.int32), rng=step_rng)
+        state = TrainState(params=p, opt_state=optimizer.init(p), net_state=s,
+                           step=jnp.zeros((), jnp.int32), rng=step_rng)
+        return pipe.place_train_state(state)  # one placement rule for init+resume
 
     def step(state: TrainState, data, labels, lr_scale):
         rng, aug_rng, sub = jax.random.split(state.rng, 3)

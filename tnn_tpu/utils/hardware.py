@@ -37,6 +37,66 @@ def cpu_info() -> Dict[str, Any]:
     return info
 
 
+def cpu_topology() -> Dict[str, Any]:
+    """Deep host topology (parity: HardwareInfo, hardware_info.hpp:13-168 —
+    sockets/cores/threads, P/E core census, cache hierarchy, frequency range).
+    Reads /proc + sysfs; missing files simply omit their fields."""
+    from . import affinity
+
+    sys_cpu = "/sys/devices/system/cpu"
+    cpus = affinity.available_cpus()
+    topo: Dict[str, Any] = dict(cpu_info())
+    packages, cores = set(), set()
+    for c in cpus:
+        base = f"{sys_cpu}/cpu{c}/topology"
+        pkg = affinity._read_int(f"{base}/physical_package_id")
+        core = affinity._read_int(f"{base}/core_id")
+        if pkg is not None:
+            packages.add(pkg)
+        if pkg is not None and core is not None:
+            cores.add((pkg, core))
+    if packages:
+        topo["sockets"] = len(packages)
+    if cores:
+        topo["physical_cores"] = len(cores)
+        topo["threads_per_core"] = round(len(cpus) / len(cores), 2)
+    types = affinity.core_types()
+    topo["p_cores"] = sum(1 for t in types.values() if t == "P")
+    topo["e_cores"] = sum(1 for t in types.values() if t == "E")
+    # cache hierarchy of cpu0 (uniform on every machine we care about)
+    caches = []
+    idx = 0
+    while True:
+        base = f"{sys_cpu}/cpu{cpus[0] if cpus else 0}/cache/index{idx}"
+        if not os.path.isdir(base):
+            break
+        entry = {}
+        for key in ("level", "type", "size"):
+            try:
+                with open(os.path.join(base, key)) as f:
+                    entry[key] = f.read().strip()
+            except OSError:
+                pass
+        if entry:
+            caches.append(entry)
+        idx += 1
+    if caches:
+        topo["caches"] = caches
+    fmin = affinity._read_int(f"{sys_cpu}/cpu0/cpufreq/cpuinfo_min_freq")
+    fmax = affinity._read_int(f"{sys_cpu}/cpu0/cpufreq/cpuinfo_max_freq")
+    if fmax:
+        topo["freq_khz"] = {"min": fmin or 0, "max": fmax}
+    try:
+        with open("/proc/meminfo") as f:
+            for line in f:
+                if line.startswith("MemTotal:"):
+                    topo["mem_total_kb"] = int(line.split()[1])
+                    break
+    except OSError:
+        pass
+    return topo
+
+
 def device_info() -> List[Dict[str, Any]]:
     """Accelerator inventory (parity: DeviceManager discovery,
     include/device/device_manager.hpp:16)."""
