@@ -218,9 +218,52 @@ class TestMeshTrainModel:
         loader = SyntheticDataLoader(32, (8, 8, 3), 10)
         cfg = TrainingConfig(epochs=1, batch_size=16,
                              snapshot_dir=str(tmp_path / "x"),
-                             mesh_axes={"seq": 8})
+                             mesh_axes={"expert": 8})  # not a known layout axis
         with pytest.raises(ValueError, match="data/fsdp"):
             train_model(model, cfg, loader)
+
+    def test_config_driven_seq_parallel_gpt(self, tmp_path):
+        """mesh_axes={'data':2,'seq':4}: the model's attention is retargeted to
+        the ring backend and the train step runs dp x sp from config alone
+        (sequence parallelism is entirely beyond the reference)."""
+        import jax
+        import jax.numpy as jnp
+
+        from tnn_tpu import models, nn
+        from tnn_tpu.data.loader import ArrayDataLoader
+        from tnn_tpu.train import (create_train_state, make_train_step,
+                                   train_model)
+        from tnn_tpu.utils.config import TrainingConfig
+
+        seq, batch = 32, 8
+        rng = np.random.default_rng(0)
+        tokens = rng.integers(0, 64, (64, seq)).astype(np.int32)
+        labels = np.roll(tokens, -1, axis=1).astype(np.int32)
+
+        def fresh():
+            return models.GPT2(vocab_size=64, max_len=seq, num_layers=2,
+                               d_model=32, num_heads=4, dropout=0.0)
+
+        loader = ArrayDataLoader(tokens, labels, seed=0)
+        cfg = TrainingConfig(epochs=1, batch_size=batch, shuffle=False,
+                             snapshot_dir=str(tmp_path / "sp"),
+                             mesh_axes={"data": 2, "seq": 4},
+                             optimizer={"type": "sgd", "lr": 0.1},
+                             progress_print_interval=100)
+        state, history = train_model(fresh(), cfg, loader)
+        assert np.isfinite(history[0]["train_loss"])
+
+        # single-device reference over the same data/order/steps
+        ref_model = fresh()
+        opt = nn.SGD(lr=0.1)
+        rstate = create_train_state(ref_model, opt, jax.random.PRNGKey(cfg.seed),
+                                    (batch, seq))
+        step = make_train_step(ref_model, opt, donate=False)
+        ref_loader = ArrayDataLoader(tokens, labels, seed=0)
+        for data, lab in ref_loader.batches(batch):
+            rstate, rm = step(rstate, jnp.asarray(data), jnp.asarray(lab))
+        np.testing.assert_allclose(history[0]["train_loss"], float(rm["loss"]),
+                                   rtol=2e-2)
 
     def test_config_driven_pipeline_and_tp(self, tmp_path):
         """mesh_axes={'data':2,'pipe':4} and {'data':4,'model':2} both train

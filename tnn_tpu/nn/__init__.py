@@ -20,7 +20,8 @@ from . import (
     transformer,
 )
 from .activations import Activation
-from .attention import MultiHeadAttention, sdpa
+from .attention import (MultiHeadAttention, ring_context, sdpa,
+                        set_attention_backend)
 from .transformer import EncoderBlock, GPTBlock
 from .blocks import Parallel, Residual, Sequential
 from .graph import Add, Concat, Graph, GraphNode

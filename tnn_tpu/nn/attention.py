@@ -29,6 +29,77 @@ from ..core.module import Module, register_module
 from . import initializers
 
 
+# Ring (sequence-parallel) context: inside ``with ring_context(mesh):``, every
+# sdpa call that CAN run as a ring (no mask/kv_offset) does — regardless of the
+# model's configured backend. The context is authoritative because sequence
+# parallelism is a run-time deployment choice, not model configuration: the
+# model object is never mutated, so checkpoints keep their original backend
+# and the same model decodes single-chip after seq-parallel training.
+# sdpa(backend="ring") outside any context is an error (nothing to ring over).
+_RING_CTX = {"mesh": None, "axis": "seq", "batch_axis": None}
+
+
+class ring_context:
+    """with ring_context(mesh, axis="seq"): step(...) — seq-parallel attention.
+    ``batch_axis`` (a name or tuple of names) composes dp/fsdp x sp: each batch
+    shard runs its own ring instead of all-gathering at the shard_map boundary."""
+
+    def __init__(self, mesh, axis: str = "seq", batch_axis=None):
+        self.mesh, self.axis, self.batch_axis = mesh, axis, batch_axis
+
+    def __enter__(self):
+        self._prev = dict(_RING_CTX)
+        _RING_CTX.update(mesh=self.mesh, axis=self.axis,
+                         batch_axis=self.batch_axis)
+        return self
+
+    def __exit__(self, *exc):
+        _RING_CTX.update(self._prev)
+
+
+def set_attention_backend(module, backend: str) -> int:
+    """Recursively set ``backend`` on every attention-bearing submodule.
+
+    Returns how many modules were switched. Retargets a model built with
+    backend="xla" to "pallas" (etc.) without rebuilding it — the attribute is
+    read at trace time, not baked at init. (Sequence parallelism does NOT need
+    this: ring_context overrides backends without mutating the model.)
+
+    The walk follows Module attributes, list/tuple elements, dict values, and
+    non-Module wrappers exposing ``.module`` (Graph's GraphNode)."""
+    from ..core.module import Module
+
+    seen = set()
+    count = 0
+
+    def walk(m):
+        nonlocal count
+        if id(m) in seen or not isinstance(m, Module):
+            return
+        seen.add(id(m))
+        if hasattr(m, "backend"):
+            m.backend = backend
+            count += 1
+        for v in vars(m).values():
+            for x in _iter_candidates(v):
+                walk(x)
+
+    def _iter_candidates(v):
+        if isinstance(v, Module):
+            yield v
+        elif isinstance(v, (list, tuple)):
+            for x in v:
+                yield from _iter_candidates(x)
+        elif isinstance(v, dict):
+            for x in v.values():
+                yield from _iter_candidates(x)
+        elif hasattr(v, "module"):  # GraphNode-style wrapper
+            yield from _iter_candidates(v.module)
+
+    walk(module)
+    return count
+
+
 def sdpa(q, k, v, *, causal: bool = False, mask: Optional[jax.Array] = None,
          scale: Optional[float] = None, backend: str = "xla",
          kv_offset: Optional[jax.Array] = None):
@@ -37,6 +108,23 @@ def sdpa(q, k, v, *, causal: bool = False, mask: Optional[jax.Array] = None,
     ``kv_offset``: during cached decode, absolute position of q[0] within the kv
     sequence — builds the correct causal mask for S_q != S_kv.
     """
+    ringable = mask is None and kv_offset is None
+    if _RING_CTX["mesh"] is not None and ringable:
+        # context wins over the configured backend: inside a seq-parallel step
+        # the activations are seq-sharded, so local/full attention would be
+        # wrong or all-gather; mask/kv_offset calls (cached decode) fall
+        # through to their normal path untouched
+        from ..parallel.ring_attention import ring_attention
+
+        return ring_attention(q, k, v, _RING_CTX["mesh"],
+                              axis=_RING_CTX["axis"], causal=causal,
+                              scale=scale, batch_axis=_RING_CTX["batch_axis"])
+    if backend == "ring":
+        raise RuntimeError(
+            "backend='ring' needs an enclosing nn.attention.ring_context(mesh)"
+            " — e.g. train_model with mesh_axes={'seq': N}" if ringable else
+            "ring attention does not support mask/kv_offset (cached decode); "
+            "run decode outside the ring context with backend='xla'")
     if backend == "pallas":
         if mask is not None or kv_offset is not None:
             raise NotImplementedError(

@@ -75,11 +75,14 @@ def _ring_attention_local(q, k, v, *, axis: str, causal: bool, scale: float):
 
 
 def ring_attention(q, k, v, mesh: Mesh, *, axis: str = "seq", causal: bool = False,
-                   scale: Optional[float] = None):
+                   scale: Optional[float] = None, batch_axis: Optional[str] = None):
     """Attention over (B, H, S, D) tensors whose S dim is sharded over ``axis``.
 
     Call with global arrays sharded P(None, None, axis, None); returns the same
-    sharding. S must divide evenly by the ring size.
+    sharding. S must divide evenly by the ring size. ``batch_axis`` (one axis
+    name or a tuple, e.g. ("data", "fsdp")) additionally shards the batch dim:
+    each batch shard runs its own ring — without it, a batch-sharded input
+    would be all-gathered at the shard_map boundary.
     """
     if scale is None:
         scale = 1.0 / math.sqrt(q.shape[-1])
@@ -87,7 +90,13 @@ def ring_attention(q, k, v, mesh: Mesh, *, axis: str = "seq", causal: bool = Fal
     if q.shape[-2] % ring:
         raise ValueError(f"seq len {q.shape[-2]} not divisible by ring size {ring}")
     body = functools.partial(_ring_attention_local, axis=axis, causal=causal, scale=scale)
-    spec = P(None, None, axis, None)
+    if batch_axis is None:
+        ba = None
+    else:
+        names = (batch_axis,) if isinstance(batch_axis, str) else tuple(batch_axis)
+        live = tuple(n for n in names if mesh_lib.axis_size(mesh, n) > 1)
+        ba = live or None
+    spec = P(ba, None, axis, None)
     fn = jax.shard_map(body, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
                        check_vma=False)
     return fn(q, k, v)

@@ -170,26 +170,43 @@ def train_model(
     else:
         if state is None:
             state = create_train_state(model, optimizer, rng, input_shape)
+        ring = None  # set by the seq branch; wraps eval too
         if axes:
             from .. import parallel
 
-            unsupported = set(axes) - {"data", "fsdp", "model"}
+            unsupported = set(axes) - {"data", "fsdp", "model", "seq"}
             if unsupported:
                 raise ValueError(
-                    f"train_model auto-sharding handles data/fsdp/model/pipe "
-                    f"axes; got {axes}. Use tnn_tpu.parallel directly for "
-                    f"seq (ring attention) layouts.")
+                    f"train_model auto-sharding handles data/fsdp/model/seq/"
+                    f"pipe axes; got {axes}.")
             shard_ways = axes.get("data", 1) * axes.get("fsdp", 1)
             if batch_size % shard_ways:
                 raise ValueError(
                     f"batch_size {batch_size} not divisible by the "
                     f"data*fsdp mesh size {shard_ways} (mesh_axes={axes})")
             mesh = parallel.make_mesh(
-                **{k: axes.get(k, 1) for k in ("data", "fsdp", "model")})
+                **{k: axes.get(k, 1) for k in ("data", "fsdp", "model", "seq")})
             step_fn, place_state, _place = parallel.make_dp_train_step(
                 model, optimizer, mesh, loss_fn=config.loss, scheduler=scheduler,
                 fsdp=axes.get("fsdp", 1) > 1, tp=axes.get("model", 1) > 1,
                 grad_accum=config.gradient_accumulation_steps, augment=augment)
+            if axes.get("seq", 1) > 1:
+                # sequence/context parallelism: run steps inside a ring
+                # context — every sdpa call becomes ring attention with K/V
+                # rotating over ICI, with NO model mutation (checkpoints keep
+                # their configured backend, decode works after training).
+                # Beyond the reference, which has no sequence parallelism at
+                # all (SURVEY.md preamble).
+                from ..nn.attention import ring_context
+
+                batch_axes = tuple(a for a in ("data", "fsdp")
+                                   if axes.get(a, 1) > 1)
+                ring = ring_context(mesh, batch_axis=batch_axes or None)
+                base_step = step_fn
+
+                def step_fn(state, data, labels, _f=base_step, _r=ring):
+                    with _r:
+                        return _f(state, data, labels)
             state = place_state(state)
             place_batch = lambda batch: _place(*batch)  # noqa: E731
             log.info("mesh %s: batch sharded over %d devices",
@@ -200,7 +217,10 @@ def train_model(
                 grad_accum=config.gradient_accumulation_steps, augment=augment)
         base_eval = make_eval_step(model, loss_fn=config.loss)
         if mesh is not None:
-            def eval_fn(state, data, labels, _f=base_eval, _m=mesh):
+            def eval_fn(state, data, labels, _f=base_eval, _m=mesh, _r=ring):
+                if _r is not None:
+                    with _m, _r:
+                        return _f(state, data, labels)
                 with _m:
                     return _f(state, data, labels)
         else:
