@@ -1,0 +1,225 @@
+// Native image decode: baseline PNG (zlib) + bilinear resize, threaded batch.
+//
+// Reference capability being matched (not ported): the reference decodes its
+// image-folder datasets in C++ via stb_image (src/data_loading/stb_image_impl.cpp,
+// include/data_loading/image_data_loader.hpp). This implementation is written
+// from the PNG specification against the system zlib: 8-bit depth, color types
+// 0/2/3/4/6, non-interlaced (the overwhelming case for dataset files); anything
+// else reports failure and the Python caller falls back to PIL per image.
+// JPEG stays on the PIL path (a from-scratch baseline JPEG decoder is out of
+// scope; the reference vendors stb for the same reason).
+//
+// zlib is optional for the library as a whole: without <zlib.h> this file
+// compiles a stub whose decode always reports failure (Python falls back to
+// PIL), so parsers/tokenizer/control-plane keep building.
+#if !defined(__has_include) || __has_include(<zlib.h>)
+#define TNN_HAVE_ZLIB 1
+#include <zlib.h>
+#else
+#define TNN_HAVE_ZLIB 0
+#endif
+
+#include <cmath>
+#include <cstdio>
+#include <cstdlib>
+#include <vector>
+
+#include "common.hpp"
+
+#if TNN_HAVE_ZLIB
+
+namespace {
+
+struct Img {
+  int w = 0, h = 0;
+  std::vector<uint8_t> rgb;  // w*h*3
+};
+
+uint32_t be32(const uint8_t* p) {
+  return (uint32_t(p[0]) << 24) | (uint32_t(p[1]) << 16) |
+         (uint32_t(p[2]) << 8) | uint32_t(p[3]);
+}
+
+bool decode_png(const uint8_t* buf, size_t len, Img& out) {
+  static const uint8_t sig[8] = {137, 80, 78, 71, 13, 10, 26, 10};
+  if (len < 8 || memcmp(buf, sig, 8) != 0) return false;
+  size_t off = 8;
+  int w = 0, h = 0, depth = 0, color = 0, interlace = 0;
+  std::vector<uint8_t> idat, plte;
+  bool seen_ihdr = false;
+  while (off + 12 <= len) {
+    uint32_t clen = be32(buf + off);
+    const uint8_t* type = buf + off + 4;
+    if (off + 12 + clen > len) return false;
+    const uint8_t* data = buf + off + 8;
+    if (memcmp(type, "IHDR", 4) == 0) {
+      if (clen < 13) return false;
+      w = int(be32(data));
+      h = int(be32(data + 4));
+      depth = data[8];
+      color = data[9];
+      interlace = data[12];
+      // guard: 8-bit, non-interlaced, sane dimensions only
+      if (depth != 8 || interlace != 0 || w <= 0 || h <= 0 ||
+          int64_t(w) * h > int64_t(64) * 1024 * 1024)
+        return false;
+      seen_ihdr = true;
+    } else if (memcmp(type, "PLTE", 4) == 0) {
+      plte.assign(data, data + clen);
+    } else if (memcmp(type, "IDAT", 4) == 0) {
+      idat.insert(idat.end(), data, data + clen);
+    } else if (memcmp(type, "IEND", 4) == 0) {
+      break;
+    }
+    off += 12 + size_t(clen);
+  }
+  if (!seen_ihdr || idat.empty()) return false;
+  int ch;
+  switch (color) {
+    case 0: ch = 1; break;  // gray
+    case 2: ch = 3; break;  // rgb
+    case 3: ch = 1; break;  // palette index
+    case 4: ch = 2; break;  // gray+alpha
+    case 6: ch = 4; break;  // rgba
+    default: return false;
+  }
+  size_t stride = size_t(w) * ch;
+  std::vector<uint8_t> raw((stride + 1) * h);
+  uLongf raw_len = raw.size();
+  uLong src_len = idat.size();
+  if (uncompress2(raw.data(), &raw_len, idat.data(), &src_len) != Z_OK ||
+      raw_len != raw.size())
+    return false;
+
+  // per-row unfilter (PNG filters 0-4: None/Sub/Up/Average/Paeth)
+  std::vector<uint8_t> pix(stride * h);
+  int bpp = ch;
+  for (int y = 0; y < h; ++y) {
+    uint8_t f = raw[size_t(y) * (stride + 1)];
+    const uint8_t* src = raw.data() + size_t(y) * (stride + 1) + 1;
+    uint8_t* dst = pix.data() + size_t(y) * stride;
+    const uint8_t* up = y ? pix.data() + size_t(y - 1) * stride : nullptr;
+    if (f > 4) return false;
+    for (size_t x = 0; x < stride; ++x) {
+      int a = x >= size_t(bpp) ? dst[x - bpp] : 0;
+      int b = up ? up[x] : 0;
+      int c = (up && x >= size_t(bpp)) ? up[x - bpp] : 0;
+      int v = src[x];
+      switch (f) {
+        case 1: v += a; break;
+        case 2: v += b; break;
+        case 3: v += (a + b) / 2; break;
+        case 4: {
+          int p = a + b - c;
+          int pa = std::abs(p - a), pb = std::abs(p - b), pc = std::abs(p - c);
+          v += (pa <= pb && pa <= pc) ? a : (pb <= pc ? b : c);
+          break;
+        }
+        default: break;  // 0: none
+      }
+      dst[x] = uint8_t(v);
+    }
+  }
+
+  // expand to RGB (alpha dropped — dataset pipelines train on RGB)
+  out.w = w;
+  out.h = h;
+  out.rgb.resize(size_t(w) * h * 3);
+  for (int64_t i = 0; i < int64_t(w) * h; ++i) {
+    const uint8_t* s = pix.data() + i * ch;
+    uint8_t* d = out.rgb.data() + i * 3;
+    switch (color) {
+      case 0:
+      case 4: d[0] = d[1] = d[2] = s[0]; break;
+      case 2:
+      case 6: d[0] = s[0]; d[1] = s[1]; d[2] = s[2]; break;
+      case 3: {
+        size_t idx = size_t(s[0]) * 3;
+        if (idx + 2 >= plte.size()) return false;
+        d[0] = plte[idx]; d[1] = plte[idx + 1]; d[2] = plte[idx + 2];
+        break;
+      }
+    }
+  }
+  return true;
+}
+
+// Bilinear resize, same convention as the Python _resize_bilinear
+// (align-corners=False sampling, +0.5 round on store) so both paths agree.
+void resize_bilinear_rgb(const Img& src, int H, int W, uint8_t* out) {
+  if (src.h == H && src.w == W) {
+    memcpy(out, src.rgb.data(), size_t(H) * W * 3);
+    return;
+  }
+  for (int y = 0; y < H; ++y) {
+    float ys = (y + 0.5f) * src.h / H - 0.5f;
+    int y0 = std::max(0, std::min(int(std::floor(ys)), src.h - 1));
+    int y1 = std::min(y0 + 1, src.h - 1);
+    float wy = std::min(std::max(ys - y0, 0.0f), 1.0f);
+    for (int x = 0; x < W; ++x) {
+      float xs = (x + 0.5f) * src.w / W - 0.5f;
+      int x0 = std::max(0, std::min(int(std::floor(xs)), src.w - 1));
+      int x1 = std::min(x0 + 1, src.w - 1);
+      float wx = std::min(std::max(xs - x0, 0.0f), 1.0f);
+      const uint8_t* p00 = src.rgb.data() + (size_t(y0) * src.w + x0) * 3;
+      const uint8_t* p01 = src.rgb.data() + (size_t(y0) * src.w + x1) * 3;
+      const uint8_t* p10 = src.rgb.data() + (size_t(y1) * src.w + x0) * 3;
+      const uint8_t* p11 = src.rgb.data() + (size_t(y1) * src.w + x1) * 3;
+      uint8_t* d = out + (size_t(y) * W + x) * 3;
+      for (int c = 0; c < 3; ++c) {
+        float top = p00[c] * (1 - wx) + p01[c] * wx;
+        float bot = p10[c] * (1 - wx) + p11[c] * wx;
+        float v = top * (1 - wy) + bot * wy;
+        d[c] = uint8_t(std::min(std::max(v + 0.5f, 0.0f), 255.0f));
+      }
+    }
+  }
+}
+
+}  // namespace
+
+// Decode n PNG files into out (n, out_h, out_w, 3) uint8 with bilinear resize,
+// threaded across files. ok[i]=1 on success; failures leave their slot zeroed
+// and the caller falls back per image. Returns the failure count.
+TNN_API int64_t tnn_decode_png_batch(const char* const* paths, int64_t n,
+                                     int out_h, int out_w, uint8_t* out,
+                                     uint8_t* ok) {
+  std::atomic<int64_t> nfail{0};
+  int64_t frame = int64_t(out_h) * out_w * 3;
+  tnn::parallel_for(
+      n,
+      [&](int64_t lo, int64_t hi) {
+        for (int64_t i = lo; i < hi; ++i) {
+          ok[i] = 0;
+          memset(out + i * frame, 0, size_t(frame));
+          FILE* f = fopen(paths[i], "rb");
+          if (!f) { nfail++; continue; }
+          fseek(f, 0, SEEK_END);
+          long sz = ftell(f);
+          fseek(f, 0, SEEK_SET);
+          std::vector<uint8_t> buf(sz > 0 ? size_t(sz) : 0);
+          bool read_ok = sz > 0 && fread(buf.data(), 1, size_t(sz), f) == size_t(sz);
+          fclose(f);
+          Img img;
+          if (!read_ok || !decode_png(buf.data(), buf.size(), img)) {
+            nfail++;
+            continue;
+          }
+          resize_bilinear_rgb(img, out_h, out_w, out + i * frame);
+          ok[i] = 1;
+        }
+      },
+      /*grain=*/1);
+  return nfail.load();
+}
+
+#else  // !TNN_HAVE_ZLIB — stub: every decode fails, Python falls back to PIL
+
+TNN_API int64_t tnn_decode_png_batch(const char* const*, int64_t n, int out_h,
+                                     int out_w, uint8_t* out, uint8_t* ok) {
+  memset(out, 0, size_t(n) * out_h * out_w * 3);
+  memset(ok, 0, size_t(n));
+  return n;
+}
+
+#endif

@@ -165,6 +165,11 @@ class ImageFolderDataLoader(DataLoader):
         self.num_workers = int(num_workers)
         self.resample = resample
         self._pool = None
+        from .. import native as _native
+
+        # native from-spec PNG decoder (zlib + threaded bilinear resize,
+        # native/src/image.cpp); per-image PIL fallback covers everything else
+        self._native_png = _native.available() and resample == "bilinear"
         # user-pinned class order is preserved (it fixes the label mapping);
         # discovered classes are sorted for determinism
         if class_names is not None:
@@ -207,8 +212,21 @@ class ImageFolderDataLoader(DataLoader):
             self._eager_cache = np.stack(list(decoded))
 
     def _decode(self, i: int) -> np.ndarray:
-        """One sample as uint8 HWC at image_size."""
+        """One sample as uint8 HWC at image_size.
+
+        PNGs decode natively whenever the native path is on — including
+        batches of one and eager preloading — so a file's pixels never depend
+        on which batch it lands in (native and PIL resize differ slightly)."""
         kind, payload = self._items[i]
+        if kind == "img" and self._native_png \
+                and payload.lower().endswith(".png"):
+            from ..native import api as _api
+
+            out, ok = _api.decode_png_batch([payload], *self.image_size)
+            if ok[0]:
+                return out[0]
+            # unsupported variant (interlaced, 16-bit): deterministic per-file
+            # PIL fallback
         if kind == "npy":
             path, row = payload
             if path not in self._npy_cache:
@@ -240,12 +258,31 @@ class ImageFolderDataLoader(DataLoader):
         if self._eager_cache is not None:
             batch = self._eager_cache[indices]
         else:
+            idx = [int(i) for i in indices]
+            slots: list = [None] * len(idx)
+            if self._native_png:
+                png_pos = [j for j, i in enumerate(idx)
+                           if self._items[i][0] == "img"
+                           and self._items[i][1].lower().endswith(".png")]
+                if png_pos:
+                    from ..native import api as _api
+
+                    out, ok = _api.decode_png_batch(
+                        [self._items[idx[j]][1] for j in png_pos],
+                        *self.image_size)
+                    for j, frame, good in zip(png_pos, out, ok):
+                        if good:  # unsupported PNG variants fall back to PIL
+                            slots[j] = frame
+            rest = [j for j in range(len(idx)) if slots[j] is None]
             pool = self._decode_pool()
-            if pool is not None and len(indices) > 1:
-                batch = np.stack(list(pool.map(
-                    self._decode, (int(i) for i in indices))))
+            if pool is not None and len(rest) > 1:
+                for j, frame in zip(rest, pool.map(
+                        self._decode, (idx[j] for j in rest))):
+                    slots[j] = frame
             else:
-                batch = np.stack([self._decode(int(i)) for i in indices])
+                for j in rest:
+                    slots[j] = self._decode(idx[j])
+            batch = np.stack(slots)
         return batch.astype(np.float32) / 255.0, self._labels[indices]
 
     def __del__(self):

@@ -92,6 +92,10 @@ def _configure(lib: ctypes.CDLL) -> None:
     lib.tnn_tokens_close.restype = None
     lib.tnn_tokens_close.argtypes = [c.c_void_p]
 
+    lib.tnn_decode_png_batch.restype = i64
+    lib.tnn_decode_png_batch.argtypes = [p(c.c_char_p), i64, c.c_int, c.c_int,
+                                         p(u8), p(u8)]
+
 
 def get_lib() -> Optional[ctypes.CDLL]:
     """The loaded library, building it on first use; None if unavailable."""

@@ -57,7 +57,14 @@ class ring_context:
         _RING_CTX.update(self._prev)
 
 
-def set_attention_backend(module, backend: str) -> int:
+def count_attention_modules(module) -> int:
+    """How many submodules carry a switchable attention ``backend`` — used to
+    validate that a seq-parallel layout has attention to parallelize.
+    (backend=None in set_attention_backend counts without mutating.)"""
+    return set_attention_backend(module, None)
+
+
+def set_attention_backend(module, backend) -> int:
     """Recursively set ``backend`` on every attention-bearing submodule.
 
     Returns how many modules were switched. Retargets a model built with
@@ -78,7 +85,8 @@ def set_attention_backend(module, backend: str) -> int:
             return
         seen.add(id(m))
         if hasattr(m, "backend"):
-            m.backend = backend
+            if backend is not None:
+                m.backend = backend
             count += 1
         for v in vars(m).values():
             for x in _iter_candidates(v):

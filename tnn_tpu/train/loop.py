@@ -197,8 +197,18 @@ def train_model(
                 # their configured backend, decode works after training).
                 # Beyond the reference, which has no sequence parallelism at
                 # all (SURVEY.md preamble).
-                from ..nn.attention import ring_context
+                from ..nn.attention import (count_attention_modules,
+                                            ring_context)
 
+                if count_attention_modules(model) == 0:
+                    raise ValueError(
+                        f"mesh_axes={{'seq': {axes['seq']}}} but the model has "
+                        f"no attention modules — {axes['seq']}x devices would "
+                        f"replicate work with zero speedup")
+                if len(sample_shape) == 1 and sample_shape[0] % axes["seq"]:
+                    raise ValueError(
+                        f"sequence length {sample_shape[0]} not divisible by "
+                        f"mesh_axes['seq'] = {axes['seq']}")
                 batch_axes = tuple(a for a in ("data", "fsdp")
                                    if axes.get(a, 1) > 1)
                 ring = ring_context(mesh, batch_axis=batch_axes or None)
