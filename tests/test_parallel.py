@@ -184,19 +184,29 @@ def _conv_bn_net():
     ], name="convbn")
 
 
+def _global_key(part, local_key):
+    """Stage-local child key ("01_batchnorm") -> the unsplit model's key
+    ("04_batchnorm") — one place for the layer-naming convention."""
+    j, typ = int(local_key.split("_")[0]), local_key.split("_", 1)[1]
+    return f"{part.start + j:02d}_{typ}"
+
+
+def _merge_stage_vars(parts, stage_vars, ref_params, ref_net):
+    """Overlay per-stage {params,state} dicts onto the unsplit model's trees."""
+    for part, sv in zip(parts, stage_vars):
+        for lk, v in sv["params"].items():
+            ref_params[_global_key(part, lk)] = v
+        for lk, v in sv["state"].items():
+            ref_net[_global_key(part, lk)] = v
+    return ref_params, ref_net
+
+
 def _align_ref_state(model, parts, pipe, pstate, opt, batch_shape):
     """Build a single-device TrainState carrying the pipeline's exact init."""
     rstate = create_train_state(model, opt, jax.random.PRNGKey(0), batch_shape)
     stage_vars = pipe.unpack_stage_variables(pstate.params, pstate.net_state)
-    ref_params = dict(rstate.params)
-    ref_net = dict(rstate.net_state)
-    for part, sv in zip(parts, stage_vars):
-        for lk, v in sv["params"].items():
-            j, typ = int(lk.split("_")[0]), lk.split("_", 1)[1]
-            ref_params[f"{part.start + j:02d}_{typ}"] = v
-        for lk, v in sv["state"].items():
-            j, typ = int(lk.split("_")[0]), lk.split("_", 1)[1]
-            ref_net[f"{part.start + j:02d}_{typ}"] = v
+    ref_params, ref_net = _merge_stage_vars(
+        parts, stage_vars, dict(rstate.params), dict(rstate.net_state))
     return rstate._replace(params=ref_params, net_state=ref_net,
                            opt_state=opt.init(ref_params))
 
@@ -243,8 +253,7 @@ def test_hetero_pipeline_matches_grad_accum(remat):
     checked = 0
     for part, sv in zip(parts, final_vars):
         for lk, v in sv["state"].items():
-            j, typ = int(lk.split("_")[0]), lk.split("_", 1)[1]
-            ref_v = rstate.net_state[f"{part.start + j:02d}_{typ}"]
+            ref_v = rstate.net_state[_global_key(part, lk)]
             for kk in v:
                 np.testing.assert_allclose(np.asarray(v[kk]),
                                            np.asarray(ref_v[kk]), atol=1e-2)
@@ -312,6 +321,55 @@ def test_hetero_pipeline_wrn_family():
 
 
 # -- host-orchestrated heterogeneous pipeline --------------------------------
+
+def test_stage_pipeline_batchnorm_matches_grad_accum(rng):
+    """StagePipeline must UPDATE BatchNorm stats (the round-2 finding: it
+    froze them with train=False) and match single-device grad accumulation on
+    a BN-bearing conv model — loss and running stats."""
+    NUM_MB, MB = 4, 4
+    B = NUM_MB * MB
+    model = _conv_bn_net()
+    parts = parallel.partitioner.proportional_partitions(len(model.children),
+                                                         [1.0] * 2)
+    stages = parallel.split(model, parts)
+    pipe = parallel.StagePipeline(stages, nn.SGD(lr=0.1),
+                                  losses.get("softmax_cross_entropy"),
+                                  devices=jax.devices()[:2])
+    pipe.init(rng, (MB, 16, 16, 3), input_dtype=jnp.bfloat16)
+
+    # single-device twin with the same init
+    ref_opt = nn.SGD(lr=0.1)
+    rstate = create_train_state(model, ref_opt, jax.random.PRNGKey(0),
+                                (B, 16, 16, 3))
+    ref_params, ref_net = _merge_stage_vars(
+        parts, pipe.variables, dict(rstate.params), dict(rstate.net_state))
+    # stage params live on per-stage devices; the single-device twin needs one
+    dev0 = jax.devices()[0]
+    ref_params = jax.device_put(ref_params, dev0)
+    ref_net = jax.device_put(ref_net, dev0)
+    rstate = rstate._replace(params=ref_params, net_state=ref_net,
+                             opt_state=ref_opt.init(ref_params))
+    ref_step = make_train_step(model, ref_opt, grad_accum=NUM_MB, donate=False,
+                               compute_accuracy=False)
+
+    rs = np.random.RandomState(0)
+    for _ in range(3):
+        data = jnp.asarray(rs.randn(B, 16, 16, 3), jnp.bfloat16)
+        lab = jnp.asarray(rs.randint(0, 10, B), jnp.int32)
+        ploss = pipe.train_batch(data, lab, num_microbatches=NUM_MB)
+        rstate, rm = ref_step(rstate, data, lab)
+        np.testing.assert_allclose(ploss, float(rm["loss"]), rtol=2e-2)
+
+    moved = 0.0
+    for part, v in zip(parts, pipe.variables):
+        for lk, sv in v["state"].items():
+            ref_v = rstate.net_state[_global_key(part, lk)]
+            for kk in sv:
+                np.testing.assert_allclose(np.asarray(sv[kk]),
+                                           np.asarray(ref_v[kk]), atol=1e-2)
+                moved += float(jnp.abs(jnp.asarray(sv[kk])).sum())
+    assert moved > 0  # stats actually updated, not frozen at init
+
 
 def test_stage_pipeline_trains(rng):
     """2-stage heterogeneous pipeline learns a toy problem (parity:

@@ -532,11 +532,11 @@ class StagePipeline:
     programs + async dispatch; TCP/RoCE hops -> jax.device_put over ICI.
     """
 
-    def __init__(self, stages: Sequence, optimizer, loss_fn, devices=None,
-                 train: bool = False):
+    def __init__(self, stages: Sequence, optimizer, loss_fn, devices=None):
         self.stages = list(stages)
         self.optimizer = optimizer
         self.loss_fn = loss_fn
+        self._step_count = 0  # advances the default dropout rng per step
         devices = list(devices) if devices is not None else jax.devices()
         if len(devices) < len(self.stages):
             raise ValueError(f"{len(self.stages)} stages need as many devices, "
@@ -545,17 +545,29 @@ class StagePipeline:
         self.variables: List[Any] = []
         self.opt_states: List[Any] = []
         self._fwd = []
+        self._fwd_train = []
         for i, stage in enumerate(self.stages):
-            # pure apply for vjp; BatchNorm runs in inference mode inside the pipeline.
-            # net state is a real argument (closing over it would bake it into the
-            # compiled program and ignore later updates).
+            # pure apply for vjp; net state is a real argument (closing over it
+            # would bake it into the compiled program and ignore later updates)
             def apply_fn(params, net_state, x, stage=stage):
                 out, _ = stage.apply({"params": params, "state": net_state},
                                      x, train=False)
                 return out
 
+            def apply_train(params, net_state, x, key, stage=stage):
+                # train=True with the new state as aux: BatchNorm statistics
+                # update per microbatch exactly like single-device training
+                # (the earlier train=False here silently froze BN — a WRN
+                # through this pipeline would normalize with init-time stats
+                # forever)
+                out, new_state = stage.apply(
+                    {"params": params, "state": net_state}, x, train=True,
+                    rng=key)
+                return out, new_state
+
             # params are committed to the stage's device, so the jitted program runs there
             self._fwd.append(jax.jit(apply_fn))
+            self._fwd_train.append(jax.jit(apply_train))
 
     def init(self, rng, input_shape, input_dtype=None):
         """Initialize every stage, placing its params on its device
@@ -585,32 +597,48 @@ class StagePipeline:
             x = self._fwd[i](self.variables[i]["params"], self.variables[i]["state"], x)
         return x
 
-    def train_batch(self, data, labels, num_microbatches: int = 4):
+    def train_batch(self, data, labels, num_microbatches: int = 4, rng=None):
         """One training step: GPipe fill/drain with gradient accumulation
         (parity: async_train_batch, coordinator.hpp:165-223 + distributed/train.hpp:19-79).
 
         Async dispatch overlaps stage work across microbatches without explicit
-        scheduling — the queueing the reference does by hand.
+        scheduling — the queueing the reference does by hand. BatchNorm state
+        threads through the microbatches (mb k normalizes with mb k's batch
+        stats and updates the running stats mb k-1 left), matching
+        single-device gradient accumulation.
         """
         n = len(self.stages)
         mbs = jnp.split(data, num_microbatches)
         lbs = jnp.split(labels, num_microbatches)
         grads = [None] * n
+        if rng is None:
+            # default rng advances per step — a fixed key would apply the SAME
+            # dropout mask on every training step
+            rng = jax.random.fold_in(jax.random.PRNGKey(0), self._step_count)
+        self._step_count += 1
 
-        # fill: forward all microbatches, keeping vjp closures (activation residuals)
+        # fill: forward all microbatches, keeping vjp closures (activation
+        # residuals) and threading each stage's mutable state forward
+        states = [v["state"] for v in self.variables]
         vjps = []  # [mb][stage]
         outs = []
-        for mb in mbs:
+        for m, mb in enumerate(mbs):
             stage_vjps = []
             x = mb
             for i in range(n):
                 x = jax.device_put(x, self.devices[i])
-                fwd, st = self._fwd[i], self.variables[i]["state"]
-                x, vjp = jax.vjp(lambda p, xx, fwd=fwd, st=st: fwd(p, st, xx),
-                                 self.variables[i]["params"], x)
+                fwd, st = self._fwd_train[i], states[i]
+                key = jax.random.fold_in(jax.random.fold_in(rng, m), i)
+                x, vjp, new_st = jax.vjp(
+                    lambda p, xx, fwd=fwd, st=st, k=key: fwd(p, st, xx, k),
+                    self.variables[i]["params"], x, has_aux=True)
+                states[i] = new_st
                 stage_vjps.append(vjp)
             vjps.append(stage_vjps)
             outs.append(x)
+        for i in range(n):
+            self.variables[i] = {"params": self.variables[i]["params"],
+                                 "state": states[i]}
 
         # drain: loss grad per microbatch, backward through stages in reverse
         scale = 1.0 / num_microbatches
