@@ -23,6 +23,6 @@ timeout 1800 python examples/train_gpt2.py --tokens /tmp/pytok --steps 200 \
     --batch 16 --seq 512 --backend pallas --results benchmarks/results
 
 echo "== 4/4 commit the evidence =="
-git add benchmarks/results/*.json benchmarks/results/*.jsonl 2>/dev/null
+git add -A benchmarks/results/
 git commit -m "TPU benchmark evidence: headline, microbench suite, Pallas LM run" || true
 echo "done"

@@ -218,7 +218,7 @@ class TestMeshTrainModel:
         loader = SyntheticDataLoader(32, (8, 8, 3), 10)
         cfg = TrainingConfig(epochs=1, batch_size=16,
                              snapshot_dir=str(tmp_path / "x"),
-                             mesh_axes={"expert": 8})  # not a known layout axis
+                             mesh_axes={"tensor": 8})  # not a known layout axis
         with pytest.raises(ValueError, match="data/fsdp"):
             train_model(model, cfg, loader)
 

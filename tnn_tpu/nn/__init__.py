@@ -14,6 +14,7 @@ from . import (
     layers,
     losses,
     metrics,
+    moe,
     norms,
     optimizers,
     schedulers,
@@ -25,6 +26,7 @@ from .attention import (MultiHeadAttention, ring_context, sdpa,
 from .transformer import EncoderBlock, GPTBlock
 from .blocks import Parallel, Residual, Sequential
 from .graph import Add, Concat, Graph, GraphNode
+from .moe import MoE
 from .embedding import ClassToken, Embedding, PositionalEmbedding
 from .layers import (
     AvgPool2D,

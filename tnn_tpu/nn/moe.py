@@ -1,0 +1,159 @@
+"""Mixture-of-Experts FFN with top-k routing and expert parallelism.
+
+Beyond the reference: TNN has no MoE or expert parallelism of any kind. On TPU
+the canonical design (Mesh-TensorFlow/Switch/GShard lineage) is einsum
+dispatch/combine over an expert-stacked parameter tree: all experts' weights
+carry a leading E dim sharded over the "expert" mesh axis, and GSPMD lowers
+the dispatch/combine einsums into all-to-alls over ICI — no hand-written
+routing communication.
+
+Routing is top-k softmax gating with per-expert capacity; tokens over capacity
+fall through (their combine weight is zero) — the standard capacity trick that
+keeps every tensor static-shaped for XLA. The Switch-style load-balancing
+auxiliary loss travels through the layer's mutable state under "aux_loss";
+``make_train_step`` sums every such leaf into the training loss
+(train/step.py:aux_loss_sum), so MoE models get load balancing through the
+normal training path. (The compiled pipeline packs state opaquely and does not
+consume aux losses — noted limitation.)
+"""
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..core.module import Module, register_module
+from . import activations as act_lib
+from . import initializers
+
+
+@register_module("moe")
+class MoE(Module):
+    """Top-k routed expert FFN over (N, S, D) activations.
+
+    ``hidden`` defaults to 4*D (the transformer FFN convention). With
+    num_experts=1, top_k=1 and enough capacity this is exactly a Dense->act->
+    Dense block — the equivalence is tested.
+    """
+
+    def __init__(self, num_experts: int, hidden: Optional[int] = None,
+                 top_k: int = 2, capacity_factor: float = 2.0,
+                 activation: str = "gelu", aux_weight: float = 0.01,
+                 name=None, policy=None):
+        super().__init__(name=name, policy=policy)
+        self.num_experts = int(num_experts)
+        self.hidden = hidden if hidden is None else int(hidden)
+        self.top_k = int(top_k)
+        if not 1 <= self.top_k <= self.num_experts:
+            raise ValueError(f"top_k {top_k} not in [1, {num_experts}]")
+        self.capacity_factor = float(capacity_factor)
+        self.activation = activation
+        self.aux_weight = float(aux_weight)
+
+    def _init(self, rng, input_shape):
+        d = input_shape[-1]
+        h = self.hidden or 4 * d
+        e = self.num_experts
+        kg, ki, ko = jax.random.split(rng, 3)
+        pd = self.policy.param_dtype
+        init = initializers.get("xavier_uniform")
+        params = {
+            "gate": {"kernel": init(kg, (d, e), pd)},
+            "w_in": init(ki, (e, d, h), pd),
+            "b_in": jnp.zeros((e, h), pd),
+            "w_out": init(ko, (e, h, d), pd),
+            "b_out": jnp.zeros((e, d), pd),
+        }
+        # state structure must match _apply's exactly — a {} here would crash
+        # lax.scan carries (grad accumulation) on the first step
+        return params, {"aux_loss": jnp.zeros((), jnp.float32)}
+
+    def _capacity(self, tokens: int) -> int:
+        cap = math.ceil(self.top_k * tokens / self.num_experts
+                        * self.capacity_factor)
+        return max(1, min(int(cap), tokens))
+
+    def _apply(self, params, state, x, *, train, rng):
+        n, s, d = x.shape
+        t = n * s
+        e = self.num_experts
+        cap = self._capacity(t)
+        compute = self.policy.compute_dtype
+        xt = x.reshape(t, d)
+
+        # -- routing (f32 for a stable softmax) -------------------------------
+        gate_w = self.policy.cast_param(params["gate"]["kernel"])
+        logits = jax.lax.dot_general(
+            xt, gate_w, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)           # (T, E)
+        probs = jax.nn.softmax(logits, axis=-1)
+        top_p, top_e = jax.lax.top_k(probs, self.top_k)   # (T, k)
+        top_p = top_p / jnp.maximum(
+            jnp.sum(top_p, axis=-1, keepdims=True), 1e-9)  # renormalize
+
+        # per-expert positions via cumsum over (k-slot, token) order; tokens
+        # beyond an expert's capacity get weight zero (static shapes for XLA)
+        onehot = jax.nn.one_hot(top_e, e, dtype=jnp.float32)      # (T, k, E)
+        flat = onehot.transpose(1, 0, 2).reshape(self.top_k * t, e)
+        pos = jnp.cumsum(flat, axis=0) - flat                     # (k*T, E)
+        pos = pos.reshape(self.top_k, t, e).transpose(1, 0, 2)    # (T, k, E)
+        pos = jnp.sum(pos * onehot, axis=-1).astype(jnp.int32)    # (T, k)
+        in_cap = pos < cap
+        weight = top_p * in_cap                                   # (T, k)
+
+        # dispatch/combine tensors (T, E, C)
+        pos_oh = jax.nn.one_hot(jnp.where(in_cap, pos, cap), cap + 1,
+                                dtype=jnp.float32)[..., :cap]     # (T, k, C)
+        dispatch = jnp.einsum("tke,tkc->tec", onehot * in_cap[..., None],
+                              pos_oh)
+        combine = jnp.einsum("tke,tkc,tk->tec", onehot, pos_oh, weight)
+
+        # -- expert computation (batched over the expert dim; the leading E of
+        # every parameter shards over the "expert" mesh axis) -----------------
+        xe = jnp.einsum("tec,td->ecd", dispatch.astype(compute),
+                        xt.astype(compute))               # (E, C, D)
+        w_in = self.policy.cast_param(params["w_in"])
+        w_out = self.policy.cast_param(params["w_out"])
+        hmid = jnp.einsum("ecd,edh->ech", xe, w_in,
+                          preferred_element_type=jnp.float32)
+        hmid = hmid + self.policy.cast_param(params["b_in"])[:, None, :]
+        hmid = act_lib.get(self.activation)(hmid).astype(compute)
+        ye = jnp.einsum("ech,ehd->ecd", hmid, w_out,
+                        preferred_element_type=jnp.float32)
+        ye = ye + self.policy.cast_param(params["b_out"])[:, None, :]
+
+        out = jnp.einsum("tec,ecd->td", combine, ye.astype(jnp.float32))
+        out = out.astype(x.dtype).reshape(n, s, d)
+
+        # Switch-style load-balance aux loss: E * sum_e fraction_e * prob_e
+        frac_e = jnp.sum(onehot.sum(1), axis=0) / (t * self.top_k)   # (E,)
+        prob_e = jnp.mean(probs, axis=0)                             # (E,)
+        aux = self.aux_weight * e * jnp.sum(frac_e * prob_e)
+        return out, {"aux_loss": aux}
+
+    def output_shape(self, input_shape):
+        return tuple(input_shape)
+
+    def _config(self):
+        return {"num_experts": self.num_experts, "hidden": self.hidden,
+                "top_k": self.top_k, "capacity_factor": self.capacity_factor,
+                "activation": self.activation, "aux_weight": self.aux_weight}
+
+
+def ep_rules(axis: str = "expert"):
+    """Path rules for expert-stacked MoE params (w_in/b_in/w_out/b_out carry a
+    leading E dim; the gate replicates). Path-based, not shape-based — a gate
+    kernel whose input dim happens to equal E must not get expert-sharded."""
+    from jax.sharding import PartitionSpec as P
+
+    return [(r".*(^|/)(w_in|b_in|w_out|b_out)$", P(axis))]
+
+
+def shard_params_ep(params, mesh, axis: str = "expert"):
+    """Place expert-stacked leaves over the expert axis; everything else
+    replicates. GSPMD then inserts the dispatch/combine all-to-alls."""
+    from ..parallel.tensor_parallel import shard_params_tp
+
+    return shard_params_tp(params, mesh, rules=ep_rules(axis))

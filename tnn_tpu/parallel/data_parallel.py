@@ -25,6 +25,12 @@ def shard_params_fsdp(params, mesh: Mesh, min_size: int = 2 ** 16):
 
     Small params stay replicated (collective overhead beats memory win).
     """
+    return jax.tree_util.tree_map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
+        params, fsdp_spec_tree(params, mesh, min_size))
+
+
+def fsdp_spec_tree(params, mesh: Mesh, min_size: int = 2 ** 16):
     fsdp = mesh_lib.axis_size(mesh, "fsdp")
 
     def spec_for(x):
@@ -37,13 +43,12 @@ def shard_params_fsdp(params, mesh: Mesh, min_size: int = 2 ** 16):
                 return P(*spec)
         return P()
 
-    return jax.tree_util.tree_map(
-        lambda x: jax.device_put(x, NamedSharding(mesh, spec_for(x))), params)
+    return jax.tree_util.tree_map(spec_for, params)
 
 
 def make_dp_train_step(model, optimizer, mesh: Mesh, loss_fn="softmax_cross_entropy",
                        scheduler=None, fsdp: bool = False, donate: bool = True,
-                       tp: bool = False, **step_kw):
+                       tp: bool = False, ep: bool = False, **step_kw):
     """Build a data-parallel train step over ``mesh``.
 
     Returns (step, place_state, place_batch):
@@ -51,10 +56,14 @@ def make_dp_train_step(model, optimizer, mesh: Mesh, loss_fn="softmax_cross_entr
       place_state(state) -> state placed per the chosen param strategy
       place_batch(data, labels) -> batch sharded over the data axis
 
-    ``tp=True`` additionally shards transformer params over the "model" axis per
-    the Megatron rules in tensor_parallel.py — GSPMD then propagates the
-    activation shardings and inserts the TP all-reduces, composing data x model
-    parallelism in the same jitted step (beyond the reference, which has no TP).
+    ``tp=True`` shards transformer params over the "model" axis per the
+    Megatron rules in tensor_parallel.py; ``ep=True`` shards MoE expert stacks
+    over the "expert" axis; ``fsdp=True`` splits remaining large params over
+    "fsdp". The strategies COMPOSE: per-leaf specs from each enabled rule set
+    are merged (first non-replicated spec wins, in tp -> ep -> fsdp order) and
+    applied in one placement pass; GSPMD then propagates the activation
+    shardings and inserts the collectives (beyond the reference, which has
+    none of tp/ep/fsdp).
 
     Extra keyword args (grad_accum, augment, ...) pass through to make_train_step.
     """
@@ -66,17 +75,31 @@ def make_dp_train_step(model, optimizer, mesh: Mesh, loss_fn="softmax_cross_entr
     repl = mesh_lib.replicated(mesh)
 
     def place_state(state: TrainState) -> TrainState:
-        if fsdp and tp:
-            # composing them needs merged per-param specs (fsdp re-placement
-            # would silently erase the tp shardings) — not wired up yet
-            raise NotImplementedError("fsdp + tp on the same params")
-        if fsdp or tp:
+        if fsdp or tp or ep:
+            spec_trees = []
             if tp:
-                from .tensor_parallel import shard_params_tp
+                from .tensor_parallel import spec_tree
 
-                params = shard_params_tp(state.params, mesh)
-            else:
-                params = shard_params_fsdp(state.params, mesh)
+                spec_trees.append(spec_tree(state.params))
+            if ep:
+                from ..nn.moe import ep_rules
+                from .tensor_parallel import spec_tree
+
+                spec_trees.append(spec_tree(state.params, ep_rules()))
+            if fsdp:
+                spec_trees.append(fsdp_spec_tree(state.params, mesh))
+
+            def merge(*specs):
+                for s in specs:
+                    if s != P():
+                        return s
+                return P()
+
+            merged = jax.tree_util.tree_map(
+                merge, *spec_trees, is_leaf=lambda x: isinstance(x, P))
+            params = jax.tree_util.tree_map(
+                lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
+                state.params, merged)
             # moments follow their param's sharding where shapes match
             opt_state = _match_opt_sharding(state.opt_state, params, mesh)
             return TrainState(params, opt_state, jax.device_put(state.net_state, repl),

@@ -7,11 +7,12 @@ over chips; parallelism = sharding annotations over named axes, XLA inserts the
 collectives that the reference hand-rolls over TCP/RoCE.
 
 Canonical axis names:
-  data  — data parallelism (batch sharded, grads all-reduced)
-  fsdp  — parameter sharding on top of dp (ZeRO-style; beyond the reference)
-  model — tensor parallelism (Megatron-style; beyond the reference)
-  pipe  — pipeline stages (parity with the reference's PP)
-  seq   — sequence/context parallelism (ring attention; beyond the reference)
+  data   — data parallelism (batch sharded, grads all-reduced)
+  fsdp   — parameter sharding on top of dp (ZeRO-style; beyond the reference)
+  model  — tensor parallelism (Megatron-style; beyond the reference)
+  pipe   — pipeline stages (parity with the reference's PP)
+  seq    — sequence/context parallelism (ring attention; beyond the reference)
+  expert — expert parallelism (MoE dispatch/combine; beyond the reference)
 """
 from __future__ import annotations
 
@@ -22,16 +23,18 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
-AXES = ("data", "fsdp", "model", "pipe", "seq")
+AXES = ("data", "fsdp", "model", "pipe", "seq", "expert")
 
 
 def make_mesh(data: int = 1, fsdp: int = 1, model: int = 1, pipe: int = 1,
-              seq: int = 1, devices: Optional[Sequence] = None) -> Mesh:
+              seq: int = 1, expert: int = 1,
+              devices: Optional[Sequence] = None) -> Mesh:
     """Build a logical mesh with the canonical axis order.
 
     Any axis of size 1 is kept (zero cost, lets sharding specs stay uniform).
     """
-    sizes = {"data": data, "fsdp": fsdp, "model": model, "pipe": pipe, "seq": seq}
+    sizes = {"data": data, "fsdp": fsdp, "model": model, "pipe": pipe,
+             "seq": seq, "expert": expert}
     devices = list(devices) if devices is not None else jax.devices()
     need = math.prod(sizes.values())
     if need > len(devices):

@@ -174,21 +174,23 @@ def train_model(
         if axes:
             from .. import parallel
 
-            unsupported = set(axes) - {"data", "fsdp", "model", "seq"}
+            unsupported = set(axes) - {"data", "fsdp", "model", "seq", "expert"}
             if unsupported:
                 raise ValueError(
                     f"train_model auto-sharding handles data/fsdp/model/seq/"
-                    f"pipe axes; got {axes}.")
+                    f"expert/pipe axes; got {axes}.")
             shard_ways = axes.get("data", 1) * axes.get("fsdp", 1)
             if batch_size % shard_ways:
                 raise ValueError(
                     f"batch_size {batch_size} not divisible by the "
                     f"data*fsdp mesh size {shard_ways} (mesh_axes={axes})")
             mesh = parallel.make_mesh(
-                **{k: axes.get(k, 1) for k in ("data", "fsdp", "model", "seq")})
+                **{k: axes.get(k, 1)
+                   for k in ("data", "fsdp", "model", "seq", "expert")})
             step_fn, place_state, _place = parallel.make_dp_train_step(
                 model, optimizer, mesh, loss_fn=config.loss, scheduler=scheduler,
                 fsdp=axes.get("fsdp", 1) > 1, tp=axes.get("model", 1) > 1,
+                ep=axes.get("expert", 1) > 1,
                 grad_accum=config.gradient_accumulation_steps, augment=augment)
             if axes.get("seq", 1) > 1:
                 # sequence/context parallelism: run steps inside a ring

@@ -32,6 +32,18 @@ class TrainState(NamedTuple):
     rng: jax.Array
 
 
+def aux_loss_sum(net_state) -> jax.Array:
+    """Sum every "aux_loss" leaf a layer reported through its mutable state —
+    the channel MoE layers use for their load-balancing term (nn/moe.py). A
+    model with no such leaves contributes exactly 0."""
+    total = jnp.zeros((), jnp.float32)
+    flat, _ = jax.tree_util.tree_flatten_with_path(net_state)
+    for path, leaf in flat:
+        if path and getattr(path[-1], "key", None) == "aux_loss":
+            total = total + leaf.astype(jnp.float32)
+    return total
+
+
 def create_train_state(model, optimizer: Optimizer, rng: jax.Array, input_shape,
                        input_dtype=None) -> TrainState:
     init_rng, step_rng = jax.random.split(rng)
@@ -82,7 +94,7 @@ def make_train_step(
     def compute_loss(params, net_state, data, labels, sub):
         out, new_net_state = model.apply(
             {"params": params, "state": net_state}, data, train=True, rng=sub)
-        loss = loss_fn(out, labels)
+        loss = loss_fn(out, labels) + aux_loss_sum(new_net_state)
         return loss, (out, new_net_state)
 
     def step(state: TrainState, data, labels, lr_scale):
