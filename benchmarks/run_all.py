@@ -17,6 +17,8 @@ def main(argv=None):
     ap = argparse.ArgumentParser()
     ap.add_argument("--quick", action="store_true")
     ap.add_argument("--json", default="", help="also write results to this file")
+    ap.add_argument("--csv", default="benchmarks/results/regression.csv",
+                    help="append one row per result metric here ('' disables)")
     args = ap.parse_args(argv)
 
     results = []
@@ -32,7 +34,35 @@ def main(argv=None):
     if args.json:
         with open(args.json, "w") as f:
             json.dump(results, f, indent=2)
+    if args.csv:
+        _append_regression_csv(args.csv, results, quick=args.quick)
     return results
+
+
+def _append_regression_csv(path, results, quick):
+    """One long-format row per (run, bench, metric) — the committed regression
+    record across rounds (timestamped; the platform column keeps CPU smoke
+    runs from masquerading as chip numbers)."""
+    import csv
+    import time
+
+    import jax
+
+    platform = jax.devices()[0].platform
+    stamp = time.strftime("%Y-%m-%dT%H:%M:%S")
+    exists = os.path.exists(path)
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    with open(path, "a", newline="") as f:
+        w = csv.writer(f)
+        if not exists:
+            w.writerow(["time", "platform", "quick", "bench", "metric", "value"])
+        for r in results:
+            name = r.get("bench", "?")
+            for k, v in r.items():
+                if k != "bench" and isinstance(v, (int, float)) \
+                        and not isinstance(v, bool):
+                    w.writerow([stamp, platform, int(quick), name, k, v])
+    print(f"regression rows appended -> {path}")
 
 
 if __name__ == "__main__":

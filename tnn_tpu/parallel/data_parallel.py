@@ -20,14 +20,19 @@ from ..train.step import TrainState
 from . import mesh as mesh_lib
 
 
+def place_by_specs(params, mesh: Mesh, specs):
+    """device_put every leaf per its PartitionSpec — the one placement map
+    behind shard_params_fsdp/shard_params_tp/merged place_state."""
+    return jax.tree_util.tree_map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), params, specs)
+
+
 def shard_params_fsdp(params, mesh: Mesh, min_size: int = 2 ** 16):
     """ZeRO-3-style sharding: split each large param's first divisible dim over "fsdp".
 
     Small params stay replicated (collective overhead beats memory win).
     """
-    return jax.tree_util.tree_map(
-        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
-        params, fsdp_spec_tree(params, mesh, min_size))
+    return place_by_specs(params, mesh, fsdp_spec_tree(params, mesh, min_size))
 
 
 def fsdp_spec_tree(params, mesh: Mesh, min_size: int = 2 ** 16):
@@ -97,9 +102,7 @@ def make_dp_train_step(model, optimizer, mesh: Mesh, loss_fn="softmax_cross_entr
 
             merged = jax.tree_util.tree_map(
                 merge, *spec_trees, is_leaf=lambda x: isinstance(x, P))
-            params = jax.tree_util.tree_map(
-                lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
-                state.params, merged)
+            params = place_by_specs(state.params, mesh, merged)
             # moments follow their param's sharding where shapes match
             opt_state = _match_opt_sharding(state.opt_state, params, mesh)
             return TrainState(params, opt_state, jax.device_put(state.net_state, repl),

@@ -49,9 +49,9 @@ def spec_tree(params, rules: Optional[Sequence[Tuple[str, P]]] = None):
 
 def shard_params_tp(params, mesh: Mesh, rules=None):
     """Place params per the TP rules; un-matched params replicate."""
-    specs = spec_tree(params, rules)
-    return jax.tree_util.tree_map(
-        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), params, specs)
+    from .data_parallel import place_by_specs
+
+    return place_by_specs(params, mesh, spec_tree(params, rules))
 
 
 def logical_constraint(x, mesh: Mesh, spec: P):

@@ -184,6 +184,22 @@ def train_model(
                 raise ValueError(
                     f"batch_size {batch_size} not divisible by the "
                     f"data*fsdp mesh size {shard_ways} (mesh_axes={axes})")
+            if axes.get("expert", 1) > 1:
+                # same guard as the seq branch: an expert axis with nothing to
+                # shard silently replicates all work N ways
+                from jax.sharding import PartitionSpec as _P
+
+                from ..nn.moe import ep_rules
+                from ..parallel.tensor_parallel import spec_tree
+
+                ep_specs = spec_tree(state.params, ep_rules())
+                if all(s == _P() for s in jax.tree_util.tree_leaves(
+                        ep_specs, is_leaf=lambda x: isinstance(x, _P))):
+                    raise ValueError(
+                        f"mesh_axes={{'expert': {axes['expert']}}} but the "
+                        f"model has no MoE expert parameters — "
+                        f"{axes['expert']}x devices would replicate work "
+                        f"with zero speedup")
             mesh = parallel.make_mesh(
                 **{k: axes.get(k, 1)
                    for k in ("data", "fsdp", "model", "seq", "expert")})

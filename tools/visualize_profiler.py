@@ -1,0 +1,83 @@
+#!/usr/bin/env python
+"""Render a merged profiler timeline as a Gantt chart (PNG/SVG).
+
+Parity: the reference renders its coordinator-merged profiler as a matplotlib
+Gantt with one row per source and COMPUTE/COMMUNICATION coloring
+(visualizers/visualize_profiler.py in the reference). Input here is a profiler
+JSON (``Profiler.to_dict()`` saved to a file — e.g. what a coordinator writes
+after ``collect_profiles``) or a Chrome trace from ``to_chrome_trace``.
+
+    python tools/visualize_profiler.py profile.json -o timeline.png
+
+The Chrome-trace export (chrome://tracing / Perfetto) remains the richer
+viewer; this is the quick static picture.
+"""
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+COLORS = {"COMPUTE": "#4878d0", "COMMUNICATION": "#ee854a", "OTHER": "#9a9a9a"}
+
+
+def load_events(path: str):
+    with open(path) as f:
+        data = json.load(f)
+    if isinstance(data, dict) and "events" in data:  # Profiler.to_dict
+        return [(e.get("source") or data.get("source") or "local",
+                 e.get("type", "OTHER"), float(e["start"]), float(e["end"]),
+                 e.get("name", "")) for e in data["events"]]
+    if isinstance(data, list):  # chrome trace ("ph": "X", us timestamps)
+        out = []
+        for e in data:
+            if e.get("ph") != "X":
+                continue
+            src = e.get("args", {}).get("source") or f"tid{e.get('tid', 0)}"
+            cat = (e.get("cat") or "OTHER").upper()
+            t0 = float(e["ts"]) / 1e6
+            out.append((src, cat, t0, t0 + float(e.get("dur", 0)) / 1e6,
+                        e.get("name", "")))
+        return out
+    raise SystemExit(f"{path}: not a profiler JSON or chrome trace")
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("profile", help="profiler JSON or chrome-trace file")
+    ap.add_argument("-o", "--out", default="timeline.png")
+    ap.add_argument("--max-events", type=int, default=5000)
+    args = ap.parse_args(argv)
+
+    import matplotlib
+
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+    from matplotlib.patches import Patch
+
+    events = load_events(args.profile)
+    if not events:
+        raise SystemExit("no events to plot")
+    events.sort(key=lambda e: e[2])
+    events = events[: args.max_events]
+    t0 = min(e[2] for e in events)
+    sources = sorted({e[0] for e in events})
+    rows = {s: i for i, s in enumerate(sources)}
+
+    fig, ax = plt.subplots(figsize=(12, 1.2 + 0.6 * len(sources)))
+    for src, typ, start, end, name in events:
+        ax.barh(rows[src], max(end - start, 1e-9), left=start - t0, height=0.6,
+                color=COLORS.get(typ, COLORS["OTHER"]), edgecolor="none")
+    ax.set_yticks(range(len(sources)), sources)
+    ax.set_xlabel("seconds")
+    ax.set_title(os.path.basename(args.profile))
+    ax.legend(handles=[Patch(color=c, label=t) for t, c in COLORS.items()],
+              loc="upper right", fontsize=8)
+    fig.tight_layout()
+    fig.savefig(args.out, dpi=120)
+    print(f"wrote {args.out}: {len(events)} events, {len(sources)} sources")
+
+
+if __name__ == "__main__":
+    main()
