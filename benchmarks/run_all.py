@@ -17,7 +17,9 @@ def main(argv=None):
     ap = argparse.ArgumentParser()
     ap.add_argument("--quick", action="store_true")
     ap.add_argument("--json", default="", help="also write results to this file")
-    ap.add_argument("--csv", default="benchmarks/results/regression.csv",
+    ap.add_argument("--csv",
+                    default=os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                         "results", "regression.csv"),
                     help="append one row per result metric here ('' disables)")
     args = ap.parse_args(argv)
 

@@ -30,7 +30,7 @@ class GPT2(Module):
     def __init__(self, vocab_size: int = 50257, max_len: int = 1024, num_layers: int = 12,
                  d_model: int = 768, num_heads: int = 12, dropout: float = 0.0,
                  backend: str = "xla", tie_embeddings: bool = True,
-                 name=None, policy=None):
+                 moe_experts: int = 0, name=None, policy=None):
         super().__init__(name=name, policy=policy)
         self.vocab_size = int(vocab_size)
         self.max_len = int(max_len)
@@ -40,11 +40,13 @@ class GPT2(Module):
         self.dropout = float(dropout)
         self.backend = backend
         self.tie_embeddings = bool(tie_embeddings)
+        self.moe_experts = int(moe_experts)  # >0: MoE FFN in every block
         p = self.policy
         self.wte = Embedding(vocab_size, d_model, policy=p)
         self.wpe = PositionalEmbedding(max_len, policy=p)
         self.drop = Dropout(dropout, policy=p)
-        self.blocks = [GPTBlock(num_heads, dropout=dropout, backend=backend, policy=p)
+        self.blocks = [GPTBlock(num_heads, dropout=dropout, backend=backend,
+                                moe_experts=moe_experts, policy=p)
                        for _ in range(num_layers)]
         self.ln_f = LayerNorm(policy=p)
 
@@ -57,12 +59,16 @@ class GPT2(Module):
             "wpe": self.wpe.init(keys[1], emb_shape)["params"],
             "ln_f": self.ln_f.init(keys[2], emb_shape)["params"],
         }
+        state = {}
         for i, block in enumerate(self.blocks):
-            params[f"h{i}"] = block.init(keys[3 + i], emb_shape)["params"]
+            bv = block.init(keys[3 + i], emb_shape)
+            params[f"h{i}"] = bv["params"]
+            if bv["state"]:  # MoE blocks carry aux-loss state
+                state[f"h{i}"] = bv["state"]
         if not self.tie_embeddings:
             head = Dense(self.vocab_size, use_bias=False, policy=self.policy)
             params["head"] = head.init(keys[2], emb_shape)["params"]
-        return params, {}
+        return params, state
 
     def _trunk(self, params, ids, train, rng, offset=0):
         keys = rnglib.split_for(rng, self.num_layers + 1)
@@ -82,11 +88,15 @@ class GPT2(Module):
 
     def _apply(self, params, state, ids, *, train, rng):
         x, keys = self._trunk(params, ids, train, rng)
+        new_state = {}
         for i, block in enumerate(self.blocks):
-            x, _ = block.apply({"params": params[f"h{i}"], "state": {}}, x,
-                               train=train, rng=keys[i])
+            x, st = block.apply(
+                {"params": params[f"h{i}"], "state": state.get(f"h{i}", {})},
+                x, train=train, rng=keys[i])
+            if st:
+                new_state[f"h{i}"] = st
         x, _ = self.ln_f.apply({"params": params["ln_f"], "state": {}}, x)
-        return self._head(params, x), state
+        return self._head(params, x), new_state
 
     def output_shape(self, input_shape):
         return tuple(input_shape[:2]) + (self.vocab_size,)
@@ -111,10 +121,13 @@ class GPT2(Module):
         return self._head(params, x), new_caches
 
     def _config(self):
-        return {"vocab_size": self.vocab_size, "max_len": self.max_len,
-                "num_layers": self.num_layers, "d_model": self.d_model,
-                "num_heads": self.num_heads, "dropout": self.dropout,
-                "backend": self.backend, "tie_embeddings": self.tie_embeddings}
+        cfg = {"vocab_size": self.vocab_size, "max_len": self.max_len,
+               "num_layers": self.num_layers, "d_model": self.d_model,
+               "num_heads": self.num_heads, "dropout": self.dropout,
+               "backend": self.backend, "tie_embeddings": self.tie_embeddings}
+        if self.moe_experts:
+            cfg["moe_experts"] = self.moe_experts
+        return cfg
 
 
 def generate(model: GPT2, params, prompt_ids, max_new_tokens: int,

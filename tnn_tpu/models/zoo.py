@@ -61,3 +61,4 @@ register("gpt2_large")(lambda **kw: gpt2_lib.gpt2_large(**kw))
 register("flash_gpt2_small")(lambda **kw: gpt2_lib.gpt2_small(backend="pallas", **kw))
 register("flash_gpt2_medium")(lambda **kw: gpt2_lib.gpt2_medium(backend="pallas", **kw))
 register("flash_gpt2_large")(lambda **kw: gpt2_lib.gpt2_large(backend="pallas", **kw))
+register("moe_gpt2_small")(lambda **kw: gpt2_lib.gpt2_small(moe_experts=8, **kw))

@@ -41,10 +41,11 @@ class MoE(Module):
     def __init__(self, num_experts: int, hidden: Optional[int] = None,
                  top_k: int = 2, capacity_factor: float = 2.0,
                  activation: str = "gelu", aux_weight: float = 0.01,
-                 name=None, policy=None):
+                 hidden_ratio: int = 4, name=None, policy=None):
         super().__init__(name=name, policy=policy)
         self.num_experts = int(num_experts)
         self.hidden = hidden if hidden is None else int(hidden)
+        self.hidden_ratio = int(hidden_ratio)  # used when hidden is None
         self.top_k = int(top_k)
         if not 1 <= self.top_k <= self.num_experts:
             raise ValueError(f"top_k {top_k} not in [1, {num_experts}]")
@@ -54,7 +55,7 @@ class MoE(Module):
 
     def _init(self, rng, input_shape):
         d = input_shape[-1]
-        h = self.hidden or 4 * d
+        h = self.hidden or self.hidden_ratio * d
         e = self.num_experts
         kg, ki, ko = jax.random.split(rng, 3)
         pd = self.policy.param_dtype
@@ -139,7 +140,8 @@ class MoE(Module):
     def _config(self):
         return {"num_experts": self.num_experts, "hidden": self.hidden,
                 "top_k": self.top_k, "capacity_factor": self.capacity_factor,
-                "activation": self.activation, "aux_weight": self.aux_weight}
+                "activation": self.activation, "aux_weight": self.aux_weight,
+                "hidden_ratio": self.hidden_ratio}
 
 
 def ep_rules(axis: str = "expert"):

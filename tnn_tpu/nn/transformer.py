@@ -30,7 +30,7 @@ class GPTBlock(Module):
 
     def __init__(self, num_heads: int, mlp_ratio: int = 4, dropout: float = 0.0,
                  causal: bool = True, backend: str = "xla", activation: str = "gelu",
-                 name=None, policy=None):
+                 moe_experts: int = 0, moe_top_k: int = 2, name=None, policy=None):
         super().__init__(name=name, policy=policy)
         self.num_heads = int(num_heads)
         self.mlp_ratio = int(mlp_ratio)
@@ -38,12 +38,22 @@ class GPTBlock(Module):
         self.causal = bool(causal)
         self.backend = backend
         self.activation = activation
+        self.moe_experts = int(moe_experts)
+        self.moe_top_k = int(moe_top_k)
         p = self.policy
         self.ln1 = LayerNorm(policy=p)
         self.attn = MultiHeadAttention(num_heads, causal=causal, dropout=dropout,
                                        backend=backend, policy=p)
         self.ln2 = LayerNorm(policy=p)
         self.drop = Dropout(dropout, policy=p)
+        self.moe = None
+        if self.moe_experts > 0:  # MoE FFN replaces the dense MLP
+            from .moe import MoE
+
+            self.moe = MoE(self.moe_experts, top_k=self.moe_top_k,
+                           activation=activation,
+                           hidden_ratio=self.mlp_ratio,  # honor the FFN width
+                           policy=p)
 
     def _mlp_layers(self, d):
         p = self.policy
@@ -53,35 +63,45 @@ class GPTBlock(Module):
     def _init(self, rng, input_shape):
         d = input_shape[-1]
         k1, k2, k3, k4, k5 = jax.random.split(rng, 5)
-        fc, proj = self._mlp_layers(d)
-        mlp_shape = tuple(input_shape[:-1]) + (self.mlp_ratio * d,)
         params = {
             "ln1": self.ln1.init(k1, input_shape)["params"],
             "attn": self.attn.init(k2, input_shape)["params"],
             "ln2": self.ln2.init(k3, input_shape)["params"],
-            "fc": fc.init(k4, input_shape)["params"],
-            "proj": proj.init(k5, mlp_shape)["params"],
         }
-        return params, {}
+        state = {}
+        if self.moe is not None:
+            mv = self.moe.init(k4, input_shape)
+            params["moe"] = mv["params"]
+            state = mv["state"]  # {"aux_loss": 0} — structure must be stable
+        else:
+            fc, proj = self._mlp_layers(d)
+            mlp_shape = tuple(input_shape[:-1]) + (self.mlp_ratio * d,)
+            params["fc"] = fc.init(k4, input_shape)["params"]
+            params["proj"] = proj.init(k5, mlp_shape)["params"]
+        return params, state
 
     def _mlp(self, params, h, train, rng):
+        if self.moe is not None:
+            out, moe_state = self.moe.apply(
+                {"params": params["moe"], "state": {}}, h, train=train, rng=rng)
+            return out, moe_state
         d = h.shape[-1]
         fc, proj = self._mlp_layers(d)
         h, _ = fc.apply({"params": params["fc"], "state": {}}, h, train=train)
         h, _ = proj.apply({"params": params["proj"], "state": {}}, h, train=train)
-        return h
+        return h, {}
 
     def _apply(self, params, state, x, *, train, rng):
-        k1, k2, k3 = rnglib.split_for(rng, 3)
+        k1, k2, k3, k4 = rnglib.split_for(rng, 4)
         h, _ = self.ln1.apply({"params": params["ln1"], "state": {}}, x)
         h, _ = self.attn.apply({"params": params["attn"], "state": {}}, h,
                                train=train, rng=k1)
         h, _ = self.drop.apply({}, h, train=train, rng=k2)
         x = x + h
         h, _ = self.ln2.apply({"params": params["ln2"], "state": {}}, x)
-        h = self._mlp(params, h, train, rng)
+        h, new_state = self._mlp(params, h, train, k4)
         h, _ = self.drop.apply({}, h, train=train, rng=k3)
-        return x + h, state
+        return x + h, new_state
 
     # -- cached decode --------------------------------------------------------
 
@@ -93,16 +113,20 @@ class GPTBlock(Module):
         h, new_cache = self.attn.apply_cached({"params": params["attn"]}, h, cache, offset)
         x = x + h
         h, _ = self.ln2.apply({"params": params["ln2"], "state": {}}, x)
-        h = self._mlp(params, h, False, None)
+        h, _ = self._mlp(params, h, False, None)
         return x + h, new_cache
 
     def output_shape(self, input_shape):
         return tuple(input_shape)
 
     def _config(self):
-        return {"num_heads": self.num_heads, "mlp_ratio": self.mlp_ratio,
-                "dropout": self.dropout, "causal": self.causal,
-                "backend": self.backend, "activation": self.activation}
+        cfg = {"num_heads": self.num_heads, "mlp_ratio": self.mlp_ratio,
+               "dropout": self.dropout, "causal": self.causal,
+               "backend": self.backend, "activation": self.activation}
+        if self.moe_experts:
+            cfg["moe_experts"] = self.moe_experts
+            cfg["moe_top_k"] = self.moe_top_k
+        return cfg
 
 
 @register_module("encoder_block")

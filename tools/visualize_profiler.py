@@ -27,14 +27,21 @@ def load_events(path: str):
         data = json.load(f)
     if isinstance(data, dict) and "events" in data:  # Profiler.to_dict
         return [(e.get("source") or data.get("source") or "local",
-                 e.get("type", "OTHER"), float(e["start"]), float(e["end"]),
-                 e.get("name", "")) for e in data["events"]]
+                 str(e.get("type", "OTHER")).upper(), float(e["start"]),
+                 float(e["end"]), e.get("name", "")) for e in data["events"]]
+    if isinstance(data, dict) and "traceEvents" in data:
+        data = data["traceEvents"]  # Profiler.to_chrome_trace(path) wrapper
     if isinstance(data, list):  # chrome trace ("ph": "X", us timestamps)
+        # "M" metadata rows carry the tid -> source-name mapping
+        tid_names = {e.get("tid"): e["args"]["name"] for e in data
+                     if e.get("ph") == "M" and e.get("args", {}).get("name")}
         out = []
         for e in data:
             if e.get("ph") != "X":
                 continue
-            src = e.get("args", {}).get("source") or f"tid{e.get('tid', 0)}"
+            src = (e.get("args", {}).get("source")
+                   or tid_names.get(e.get("tid"))
+                   or f"tid{e.get('tid', 0)}")
             cat = (e.get("cat") or "OTHER").upper()
             t0 = float(e["ts"]) / 1e6
             out.append((src, cat, t0, t0 + float(e.get("dur", 0)) / 1e6,
