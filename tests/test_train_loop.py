@@ -294,3 +294,35 @@ class TestMeshTrainModel:
                               progress_print_interval=2)
         state2, history2 = train_model(conv, cfg2, loader)
         assert len(history2) == 1 and np.isfinite(history2[0]["train_loss"])
+
+
+class TestRemat:
+    def test_remat_numerically_identical(self):
+        """remat=True recomputes the forward in the backward — same losses and
+        params as the stored-activation path, bit for bit."""
+        import jax
+        import jax.numpy as jnp
+
+        from tnn_tpu import nn
+        from tnn_tpu.train import create_train_state, make_train_step
+
+        model = nn.Sequential([
+            nn.Conv2D(8, 3, padding="same"), nn.BatchNorm(),
+            nn.Activation("relu"), nn.GlobalAvgPool(), nn.Dense(10)])
+        opt = nn.SGD(lr=0.1, momentum=0.9)
+        rs = np.random.RandomState(0)
+        x = jnp.asarray(rs.randn(8, 12, 12, 3), jnp.bfloat16)
+        y = jnp.asarray(rs.randint(0, 10, 8), jnp.int32)
+
+        states = []
+        for remat in (False, True):
+            st = create_train_state(model, opt, jax.random.PRNGKey(0),
+                                    (8, 12, 12, 3))
+            step = make_train_step(model, opt, donate=False, remat=remat)
+            for _ in range(3):
+                st, m = step(st, x, y)
+            states.append((st, float(m["loss"])))
+        assert states[0][1] == states[1][1]
+        for a, b in zip(jax.tree_util.tree_leaves(states[0][0].params),
+                        jax.tree_util.tree_leaves(states[1][0].params)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))

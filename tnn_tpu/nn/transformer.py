@@ -92,7 +92,13 @@ class GPTBlock(Module):
         return h, {}
 
     def _apply(self, params, state, x, *, train, rng):
-        k1, k2, k3, k4 = rnglib.split_for(rng, 4)
+        # dense blocks keep their original 3-key split so pre-MoE seeded runs
+        # reproduce exactly; only MoE blocks draw a 4th key for the router
+        if self.moe is not None:
+            k1, k2, k3, k4 = rnglib.split_for(rng, 4)
+        else:
+            k1, k2, k3 = rnglib.split_for(rng, 3)
+            k4 = None
         h, _ = self.ln1.apply({"params": params["ln1"], "state": {}}, x)
         h, _ = self.attn.apply({"params": params["attn"], "state": {}}, h,
                                train=train, rng=k1)

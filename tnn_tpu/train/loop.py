@@ -161,7 +161,8 @@ def train_model(
             stages, optimizer, mesh, (mb_global,) + sample_shape,
             loss_fn=config.loss, num_microbatches=num_mb,
             input_dtype=io_dtype, scheduler=scheduler,
-            data_axis="data" if dp > 1 else None, augment=augment)
+            data_axis="data" if dp > 1 else None, augment=augment,
+            remat=bool(config.remat))
         if state is None:
             state = init_fn(rng)
         eval_fn = make_pipeline_eval_step(pipe)
@@ -207,7 +208,8 @@ def train_model(
                 model, optimizer, mesh, loss_fn=config.loss, scheduler=scheduler,
                 fsdp=axes.get("fsdp", 1) > 1, tp=axes.get("model", 1) > 1,
                 ep=axes.get("expert", 1) > 1,
-                grad_accum=config.gradient_accumulation_steps, augment=augment)
+                grad_accum=config.gradient_accumulation_steps, augment=augment,
+                remat=bool(config.remat))
             if axes.get("seq", 1) > 1:
                 # sequence/context parallelism: run steps inside a ring
                 # context — every sdpa call becomes ring attention with K/V
@@ -242,7 +244,8 @@ def train_model(
         else:
             step_fn = make_train_step(
                 model, optimizer, loss_fn=config.loss, scheduler=scheduler,
-                grad_accum=config.gradient_accumulation_steps, augment=augment)
+                grad_accum=config.gradient_accumulation_steps, augment=augment,
+                remat=bool(config.remat))
         base_eval = make_eval_step(model, loss_fn=config.loss)
         if mesh is not None:
             def eval_fn(state, data, labels, _f=base_eval, _m=mesh, _r=ring):

@@ -39,6 +39,7 @@ class TrainingConfig:
     # distributed params
     num_microbatches: int = 2
     mesh_axes: Dict[str, int] = dataclasses.field(default_factory=dict)  # e.g. {"data": 8}
+    remat: bool = False  # rematerialize forward in backward (memory for FLOPs)
 
     # beyond-reference params
     shuffle: bool = True

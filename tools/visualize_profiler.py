@@ -32,15 +32,20 @@ def load_events(path: str):
     if isinstance(data, dict) and "traceEvents" in data:
         data = data["traceEvents"]  # Profiler.to_chrome_trace(path) wrapper
     if isinstance(data, list):  # chrome trace ("ph": "X", us timestamps)
-        # "M" metadata rows carry the tid -> source-name mapping
-        tid_names = {e.get("tid"): e["args"]["name"] for e in data
-                     if e.get("ph") == "M" and e.get("args", {}).get("name")}
+        # "M" metadata rows carry the (pid, tid) -> row-name mapping; accept
+        # our own "__metadata" rows and standard thread_name entries, NOT
+        # process_name (which would label threads with the process)
+        tid_names = {(e.get("pid"), e.get("tid")): e["args"]["name"]
+                     for e in data
+                     if e.get("ph") == "M" and e.get("args", {}).get("name")
+                     and (e.get("cat") == "__metadata"
+                          or e.get("name") == "thread_name")}
         out = []
         for e in data:
             if e.get("ph") != "X":
                 continue
             src = (e.get("args", {}).get("source")
-                   or tid_names.get(e.get("tid"))
+                   or tid_names.get((e.get("pid"), e.get("tid")))
                    or f"tid{e.get('tid', 0)}")
             cat = (e.get("cat") or "OTHER").upper()
             t0 = float(e["ts"]) / 1e6
