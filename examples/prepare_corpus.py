@@ -64,22 +64,40 @@ def encode_byte(paths):
     return np.concatenate(chunks)
 
 
-def encode_bpe(paths, vocab_path):
-    from tnn_tpu.data.tokenizer import Tokenizer
+def encode_bpe(paths, vocab_path, out_dir, train_vocab_size):
+    from tnn_tpu.data.tokenizer import Tokenizer, train_bpe
 
-    tok = Tokenizer().load(vocab_path)
-    chunks = []
-    for path in paths:
+    def read(path):
         try:
             with open(path, "r", encoding="utf-8", errors="ignore") as f:
-                text = f.read()
+                return f.read()
         except OSError:
+            return ""
+
+    if vocab_path:
+        tok = Tokenizer().load(vocab_path)
+    else:
+        # no vocab given: learn one from the corpus itself (the reference
+        # outsources this step to tiktoken; here it is standalone)
+        print(f"training {train_vocab_size}-token BPE vocab from the corpus...")
+        tok = train_bpe((read(p) for p in paths), vocab_size=train_vocab_size)
+        tok.save(os.path.join(out_dir, "vocab.bin"))
+    if tok.vocab_size > 65536:
+        raise SystemExit(f"vocab_size {tok.vocab_size} exceeds the uint16 "
+                         f"token format (max 65536) — ids would silently wrap")
+    eot = tok.eot_token
+    chunks = []
+    for path in paths:
+        text = read(path)
+        if not text:
             continue
-        ids = tok.encode(text, append_eot=True) if hasattr(tok, "encode") else []
+        ids = tok.encode(text)
+        if eot is not None:
+            ids = ids + [eot]
         chunks.append(np.asarray(ids, np.uint16))
     if not chunks:
         raise SystemExit("no input files matched")
-    return np.concatenate(chunks)
+    return np.concatenate(chunks), tok.vocab_size
 
 
 def main(argv=None):
@@ -89,7 +107,12 @@ def main(argv=None):
                     help="files or directories to read")
     ap.add_argument("--glob", default="*.py", help="filename pattern in dirs")
     ap.add_argument("--mode", choices=["byte", "bpe"], default="byte")
-    ap.add_argument("--vocab", default="", help="vocab.bin for --mode bpe")
+    ap.add_argument("--vocab", default="",
+                    help="vocab.bin for --mode bpe (omit to TRAIN one from the "
+                         "corpus into <out>/vocab.bin)")
+    ap.add_argument("--train-vocab-size", type=int, default=4096,
+                    help="vocab size when training a BPE vocab (--mode bpe, "
+                         "no --vocab)")
     ap.add_argument("--val-fraction", type=float, default=0.05)
     ap.add_argument("--max-mb", type=float, default=64.0,
                     help="stop reading input after this many MB")
@@ -97,16 +120,13 @@ def main(argv=None):
 
     paths = list(iter_files(args.source, args.glob,
                             int(args.max_mb * 1e6) if args.max_mb else 0))
+    os.makedirs(args.out, exist_ok=True)
     if args.mode == "byte":
         tokens = encode_byte(paths)
         vocab_size = BYTE_EOT + 1
     else:
-        if not args.vocab:
-            raise SystemExit("--mode bpe needs --vocab vocab.bin")
-        tokens = encode_bpe(paths, args.vocab)
-        vocab_size = 50257
-
-    os.makedirs(args.out, exist_ok=True)
+        tokens, vocab_size = encode_bpe(paths, args.vocab, args.out,
+                                        args.train_vocab_size)
     n_val = int(len(tokens) * args.val_fraction)
     train, val = tokens[:-n_val] if n_val else tokens, tokens[-n_val:]
     train.tofile(os.path.join(args.out, "train.bin"))

@@ -530,3 +530,44 @@ def test_bilinear_resize_quality():
     # identity resize is exact
     same = _resize_bilinear(img, (2, 2))
     np.testing.assert_array_equal(same, img)
+
+
+class TestTrainBpe:
+    """BPE vocabulary TRAINING (the reference outsources this to tiktoken;
+    here train -> save -> encode -> decode is fully standalone)."""
+
+    def test_round_trip_and_compression(self):
+        from tnn_tpu.data.tokenizer import train_bpe
+
+        corpus = ("the quick brown fox jumps over the lazy dog. " * 50
+                  + "pack my box with five dozen liquor jugs. " * 50)
+        tok = train_bpe([corpus], vocab_size=400)
+        assert 256 < tok.vocab_size <= 400
+        ids = tok.encode(corpus)
+        assert tok.decode(ids) == corpus            # lossless
+        assert len(ids) < len(corpus.encode()) / 2  # merges actually compress
+
+    def test_save_load_and_native_parity(self, tmp_path):
+        from tnn_tpu import native
+        from tnn_tpu.data.tokenizer import Tokenizer, train_bpe
+
+        text = "hello hello world, worldly words withhold wholly. " * 30
+        tok = train_bpe([text], vocab_size=320)
+        path = str(tmp_path / "vocab.bin")
+        tok.save(path)
+        loaded = Tokenizer().load(path)
+        assert loaded.vocab_size == tok.vocab_size
+        ids = tok.encode(text)
+        assert loaded.encode(text) == ids
+        assert loaded.decode(ids) == text
+        if native.available():  # native engine speaks the same trained vocab
+            assert loaded._native is not None
+            assert loaded._native.encode(text).tolist() == ids
+
+    def test_eot_token_reserved(self):
+        from tnn_tpu.data.tokenizer import train_bpe
+
+        tok = train_bpe(["abc " * 10], vocab_size=300)
+        assert tok.eot_token == tok.vocab_size - 1
+        ids = tok.encode("abc<|endoftext|>abc")
+        assert tok.eot_token in ids

@@ -153,3 +153,58 @@ def _split_keep(text: str, sep: str) -> List[str]:
         if piece:
             out.append(piece)
     return out
+
+
+def train_bpe(texts, vocab_size: int = 1024, min_pair_count: int = 2) -> Tokenizer:
+    """Learn a byte-level BPE vocabulary from raw text (the piece the reference
+    ecosystem outsources to tiktoken — python/openwebtext.py just calls it; here
+    the whole tokenizer lifecycle is standalone: train -> save -> encode -> decode).
+
+    Layout: ids 0-255 are raw bytes, merged tokens follow IN MERGE ORDER, then
+    <|endoftext|> — exactly the invariant ``Tokenizer.encode`` relies on
+    (lowest-id pair wins == lowest merge rank) and the reference vocab.bin
+    format stores. ``save()`` writes a file both the Python and native BPE
+    engines load.
+
+    Classic iterative BPE (count pairs, merge the most frequent, repeat);
+    O(merges x corpus) — meant for corpus-prep tooling, not hot paths.
+    """
+    from collections import Counter
+
+    word_counts: Counter = Counter()
+    for text in texts:
+        for w in _PRETOKEN_RE.findall(text):
+            word_counts[w.encode("utf-8")] += 1
+    words = [[bytes([b]) for b in w] for w in word_counts]
+    counts = list(word_counts.values())
+    vocab: List[bytes] = [bytes([i]) for i in range(256)]
+    n_merges = max(0, int(vocab_size) - 256 - 1)  # reserve <|endoftext|>
+    for _ in range(n_merges):
+        pair_counts: Counter = Counter()
+        for parts, c in zip(words, counts):
+            for a, b in zip(parts, parts[1:]):
+                pair_counts[(a, b)] += c
+        if not pair_counts:
+            break
+        (a, b), cnt = pair_counts.most_common(1)[0]
+        if cnt < min_pair_count:
+            break
+        merged = a + b
+        vocab.append(merged)
+        for parts in words:
+            if len(parts) < 2:
+                continue
+            out, i = [], 0
+            while i < len(parts):
+                if i + 1 < len(parts) and parts[i] == a and parts[i + 1] == b:
+                    out.append(merged)
+                    i += 2
+                else:
+                    out.append(parts[i])
+                    i += 1
+            parts[:] = out
+    vocab.append(_END_OF_TEXT.encode())
+    tok = Tokenizer()
+    tok._vocab = vocab
+    tok._build_encoder()
+    return tok

@@ -38,6 +38,7 @@ def load_events(path: str):
         tid_names = {(e.get("pid"), e.get("tid")): e["args"]["name"]
                      for e in data
                      if e.get("ph") == "M" and e.get("args", {}).get("name")
+                     and e.get("name") != "process_name"
                      and (e.get("cat") == "__metadata"
                           or e.get("name") == "thread_name")}
         out = []
