@@ -102,7 +102,7 @@ def measure():
     import jax.numpy as jnp
     import numpy as np
 
-    from benchmarks.common import fetch_latency, sync, timing_selfcheck
+    from benchmarks.common import sync, time_loop, timing_selfcheck
     from tnn_tpu import models, nn
     from tnn_tpu.train import create_train_state, make_train_step
 
@@ -124,13 +124,19 @@ def measure():
         measure_steps = MEASURE_STEPS if platform != "cpu" else 3
         for _ in range(WARMUP_STEPS if platform != "cpu" else 1):
             state, m = step(state, data, labels)
-        lat = fetch_latency(m["loss"])
-
-        t0 = time.perf_counter()
-        for _ in range(measure_steps):
-            state, m = step(state, data, labels)
         sync(m["loss"])
-        dt = (time.perf_counter() - t0 - lat) / measure_steps
+        holder = {"s": state}
+
+        def run(n):
+            t0 = time.perf_counter()
+            m = None
+            for _ in range(n):
+                holder["s"], m = step(holder["s"], data, labels)
+            sync(m["loss"])
+            return time.perf_counter() - t0
+
+        dt = time_loop(run, measure_steps,
+                       min_delta=0.35 if platform != "cpu" else 0.01, pairs=3)
     except Exception as e:  # noqa: BLE001 — one-line diagnostics beat a traceback
         print(json.dumps({"metric": METRIC, "error": f"{type(e).__name__}: {e}"[:500],
                           "backend": platform}))

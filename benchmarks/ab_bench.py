@@ -28,20 +28,29 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def _bench_loop(run_step, iters, sync):
+    """Difference-of-two-runs (common.time_loop): the one fetch per run cancels
+    instead of inflating every iteration by latency/iters — important for the
+    A/B comparison, where the torch path has no fetch at all."""
+    from benchmarks.common import time_loop
+
     run_step()  # compile/warm
     sync()
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        run_step()
-    sync()
-    return (time.perf_counter() - t0) / iters
+
+    def run(n):
+        t0 = time.perf_counter()
+        for _ in range(n):
+            run_step()
+        sync()
+        return time.perf_counter() - t0
+
+    return time_loop(run, iters)
 
 
 def bench_tnn(batch, iters):
     import jax
     import jax.numpy as jnp
 
-    from benchmarks.common import fetch_latency, sync
+    from benchmarks.common import sync
     from tnn_tpu import models, nn
     from tnn_tpu.train import create_train_state, make_train_step
 

@@ -5,9 +5,11 @@ reference implementation before timing (benchmarks/gemm_benchmark.cpp:20-33 chec
 custom AVX2 GEMM vs MKL) — every benchmark here does the same against numpy/XLA.
 
 Timing on this box's tunneled `axon` TPU: jax.block_until_ready does NOT wait (the
-relay queues executions); the only true sync is a value fetch (~90ms round trip).
-So we time N iterations then fetch one scalar, subtracting the separately measured
-fetch latency (same approach as bench.py).
+relay queues executions); the only true sync is a value fetch, whose round trip
+varies 87-135 ms per sample. All timing therefore uses difference-of-two-runs
+(``time_loop``): time N1 iterations + one fetch, then N2 > N1 iterations + one
+fetch, dt = (t2 - t1)/(N2 - N1) — the fetch round trip cancels instead of being
+subtracted as a separately-sampled (and jittery) constant.
 """
 from __future__ import annotations
 
@@ -36,17 +38,60 @@ def fetch_latency(x, repeats: int = 3) -> float:
     return (time.perf_counter() - t0) / repeats
 
 
+def time_loop(run: Callable[[int], float], iters: int, *, min_delta: float = 0.35,
+              pairs: int = 3, cap: int = 4000) -> float:
+    """Difference-of-two-runs timing. ``run(n)`` executes n iterations, blocks
+    on the last result, and returns elapsed seconds.
+
+    Times N1 iterations + one fetch, then N2 > N1 iterations + one fetch;
+    dt = (t2 - t1) / (N2 - N1). The relay executes dispatches FIFO
+    back-to-back and a fetch of the LAST output waits for all previous
+    executions (measured: fetch-last wall time scales linearly in N), so the
+    fetch round trip — which varies 87-135 ms per sample on this relay, enough
+    to push a subtract-one-latency-sample scheme past 100% implied MFU —
+    cancels exactly. N2 auto-escalates until the delta is well clear of that
+    jitter; the median over ``pairs`` fresh pairs rejects stragglers.
+    (Single-compiled-scan timing was tried and rejected: chaining iterations
+    through the scan carry needs optimization barriers to stop XLA hoisting
+    loop-invariant work, and those barriers pin layouts, which distorted conv
+    timings 4x.)
+    """
+    n1 = max(1, iters // 4)
+    n2 = max(iters, n1 + 1)
+    t1 = run(n1)
+    attempts = 0
+    while True:
+        t2 = run(n2)
+        delta = t2 - t1
+        attempts += 1
+        if delta >= min_delta or n2 >= cap or attempts >= 8:
+            break
+        n2 = min(cap, int(n2 * min(max(2.0, 0.45 / max(delta, 1e-4)), 8.0)) + 1)
+    # ``delta`` was measured at the final n2 (growth only happens on continue)
+    dts = [max(delta, 1e-9) / (n2 - n1)]
+    for _ in range(pairs - 1):
+        ta, tb = run(n1), run(n2)
+        dts.append(max(tb - ta, 1e-9) / (n2 - n1))
+    dts.sort()
+    return dts[len(dts) // 2]
+
+
 def time_fn(fn: Callable, *args, iters: int = 50, warmup: int = 5) -> float:
-    """Mean seconds per call of a jitted fn (device time, fetch-corrected)."""
+    """Mean seconds per call of a jitted fn (device time, via ``time_loop``)."""
     out = None
-    for _ in range(warmup):
-        out = fn(*args)
-    lat = fetch_latency(out)
-    t0 = time.perf_counter()
-    for _ in range(iters):
+    for _ in range(max(warmup, 1)):
         out = fn(*args)
     sync(out)
-    return max((time.perf_counter() - t0 - lat) / iters, 1e-9)
+
+    def run(n: int) -> float:
+        t0 = time.perf_counter()
+        o = None
+        for _ in range(n):
+            o = fn(*args)
+        sync(o)
+        return time.perf_counter() - t0
+
+    return time_loop(run, iters)
 
 
 def timing_selfcheck(max_mfu: float = 1.05, min_mfu: float = 1e-4) -> float:

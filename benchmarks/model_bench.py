@@ -18,7 +18,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from benchmarks.common import fetch_latency, report, sync
+from benchmarks.common import report, sync, time_loop
 
 
 def _count_params(params) -> int:
@@ -26,15 +26,23 @@ def _count_params(params) -> int:
 
 
 def _time_steps(step, state, data, labels, iters):
-    state, m = step(state, data, labels)
-    for _ in range(4):
-        state, m = step(state, data, labels)
-    lat = fetch_latency(m["loss"])
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        state, m = step(state, data, labels)
+    """Difference-of-two-runs timing (time_loop) with the train state threaded
+    through every iteration — state evolves across runs, which is fine: each
+    step costs the same regardless of the values it carries."""
+    holder = {"s": state}
+    for _ in range(5):
+        holder["s"], m = step(holder["s"], data, labels)
     sync(m["loss"])
-    return max((time.perf_counter() - t0 - lat) / iters, 1e-9)
+
+    def run(n):
+        t0 = time.perf_counter()
+        m = None
+        for _ in range(n):
+            holder["s"], m = step(holder["s"], data, labels)
+        sync(m["loss"])
+        return time.perf_counter() - t0
+
+    return time_loop(run, iters)
 
 
 def bench_train(model_name: str, input_shape, num_classes: int, batch: int,
@@ -88,11 +96,17 @@ def bench_gpt2_decode(batch: int, prompt: int, new: int, size="small"):
     rs = np.random.RandomState(0)
     ids = rs.randint(0, 50257, (batch, prompt)).astype(np.int32)
     out = generate(model, params, ids, new)  # compile
-    lat = fetch_latency(out)
-    t0 = time.perf_counter()
-    out = generate(model, params, ids, new)
     sync(out)
-    dt = max(time.perf_counter() - t0 - lat, 1e-9)
+
+    def run(n):
+        t0 = time.perf_counter()
+        o = None
+        for _ in range(n):
+            o = generate(model, params, ids, new)
+        sync(o)
+        return time.perf_counter() - t0
+
+    dt = time_loop(run, 4, min_delta=0.3, cap=64)
     return report(f"gpt2_{size}_decode", dt, items=batch * new, item_name="tok",
                   extra={"batch": batch})
 
