@@ -15,9 +15,9 @@ failures (UNAVAILABLE / connection / hang) retry the full probe+run cycle.
 Successful results are also persisted to benchmarks/results/ so evidence
 survives even if a later gate catches the relay down.
 
-Timing utilities live in benchmarks/common.py (on the relay, block_until_ready
-does not wait; sync is a value fetch whose latency is measured and subtracted);
-a known-FLOP matmul self-check guards that assumption before the real
+Timing uses benchmarks/common.py:time_loop — difference-of-two-runs, which
+cancels the relay's jittery fetch round trip instead of subtracting a sampled
+latency; a known-FLOP matmul self-check guards the scheme before the real
 measurement. The wider harness is benchmarks/run_all.py; this file stays the
 driver's single-metric entry point.
 """

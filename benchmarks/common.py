@@ -30,14 +30,6 @@ def sync(x) -> float:
     return float(jnp.ravel(leaf)[0].astype(jnp.float32))
 
 
-def fetch_latency(x, repeats: int = 3) -> float:
-    sync(x)
-    t0 = time.perf_counter()
-    for _ in range(repeats):
-        sync(x)
-    return (time.perf_counter() - t0) / repeats
-
-
 def time_loop(run: Callable[[int], float], iters: int, *, min_delta: float = 0.35,
               pairs: int = 3, cap: int = 4000) -> float:
     """Difference-of-two-runs timing. ``run(n)`` executes n iterations, blocks
@@ -68,10 +60,18 @@ def time_loop(run: Callable[[int], float], iters: int, *, min_delta: float = 0.3
             break
         n2 = min(cap, int(n2 * min(max(2.0, 0.45 / max(delta, 1e-4)), 8.0)) + 1)
     # ``delta`` was measured at the final n2 (growth only happens on continue)
-    dts = [max(delta, 1e-9) / (n2 - n1)]
+    dts = [delta / (n2 - n1)] if delta > 0 else []
     for _ in range(pairs - 1):
         ta, tb = run(n1), run(n2)
-        dts.append(max(tb - ta, 1e-9) / (n2 - n1))
+        if tb - ta > 0:
+            dts.append((tb - ta) / (n2 - n1))
+    if not dts:
+        # fail loudly: a clamped near-zero dt would report trillion-scale
+        # throughput into regression.csv instead of an error
+        raise RuntimeError(
+            f"time_loop: no positive run-pair delta at n1={n1}, n2={n2} "
+            f"(last delta {delta * 1e3:.1f} ms) — relay stall or the workload "
+            f"is too fast for cap={cap}; raise cap or fix the backend")
     dts.sort()
     return dts[len(dts) // 2]
 
@@ -95,7 +95,7 @@ def time_fn(fn: Callable, *args, iters: int = 50, warmup: int = 5) -> float:
 
 
 def timing_selfcheck(max_mfu: float = 1.05, min_mfu: float = 1e-4) -> float:
-    """Guard the fetch-corrected timing scheme with a known-FLOP matmul.
+    """Guard the difference-of-two-runs timing scheme with a known-FLOP matmul.
 
     The scheme assumes the relay executes N dispatched steps back-to-back and
     that one scalar fetch waits for all of them. If the relay ever pipelines

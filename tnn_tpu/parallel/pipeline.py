@@ -489,9 +489,11 @@ def make_pipeline_train_step(stages: Sequence, optimizer, mesh: Mesh,
                 return jitted(state, data, labels,
                               jnp.asarray(scheduler.current_scale(), jnp.float32))
     else:
+        one = jnp.ones((), jnp.float32)  # hoisted: no per-step H2D transfer
+
         def step_fn(state, data, labels):
             with mesh:
-                return jitted(state, data, labels, jnp.ones((), jnp.float32))
+                return jitted(state, data, labels, one)
 
     return pipe, step_fn, init_fn
 

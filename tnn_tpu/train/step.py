@@ -169,8 +169,10 @@ def make_train_step(
             return jitted(state, data, labels,
                           jnp.asarray(scheduler.current_scale(), jnp.float32))
     else:
+        one = jnp.ones((), jnp.float32)  # hoisted: no per-step H2D transfer
+
         def wrapped(state, data, labels):
-            return jitted(state, data, labels, jnp.ones((), jnp.float32))
+            return jitted(state, data, labels, one)
 
     return wrapped
 
