@@ -65,12 +65,13 @@ def bench_train(model_name: str, input_shape, num_classes: int, batch: int,
                   items=batch, item_name="img")
 
 
-def bench_gpt2_train(batch: int, seq: int, iters: int, size="small"):
+def bench_gpt2_train(batch: int, seq: int, iters: int, size="small", flash=False):
     from tnn_tpu import models, nn
     from tnn_tpu.train import create_train_state, make_train_step
 
-    print(f"gpt2_{size} train step (bs={batch}, S={seq})")
-    model = models.create(f"gpt2_{size}")
+    name = f"flash_gpt2_{size}" if flash else f"gpt2_{size}"
+    print(f"{name} train step (bs={batch}, S={seq})")
+    model = models.create(name)
     opt = nn.AdamW(lr=1e-4)
     state = create_train_state(model, opt, jax.random.PRNGKey(0), (batch, seq))
     step = make_train_step(model, opt)
@@ -81,7 +82,7 @@ def bench_gpt2_train(batch: int, seq: int, iters: int, size="small"):
     # 6ND fwd+bwd (Kaplan approximation; the attention S^2 term is omitted, so
     # MFU is slightly undercounted at long S)
     flops = 6.0 * n_params * batch * seq
-    return report(f"gpt2_{size}_train", dt, flops=flops, items=batch * seq,
+    return report(f"{name}_train", dt, flops=flops, items=batch * seq,
                   item_name="tok")
 
 
@@ -114,7 +115,7 @@ def bench_gpt2_decode(batch: int, prompt: int, new: int, size="small"):
 def main(argv=None):
     ap = argparse.ArgumentParser()
     ap.add_argument("--quick", action="store_true")
-    ap.add_argument("--models", default="wrn,resnet9,gpt2,decode")
+    ap.add_argument("--models", default="wrn,resnet9,gpt2,gpt2_flash,decode")
     args = ap.parse_args(argv)
     q = args.quick
     wanted = set(args.models.split(","))
@@ -131,6 +132,11 @@ def main(argv=None):
     if "gpt2" in wanted:
         results.append(bench_gpt2_train(2 if q else 8, 128 if q else 512,
                                         3 if q else 10))
+    if "gpt2_flash" in wanted:
+        # the pallas-attention variant, at the context length where fused
+        # attention matters (reference ships gpt2 + flash_gpt2 side by side)
+        results.append(bench_gpt2_train(2 if q else 8, 128 if q else 1024,
+                                        3 if q else 10, flash=True))
     if "decode" in wanted:
         results.append(bench_gpt2_decode(1, 16 if q else 64, 16 if q else 128))
     return results

@@ -99,6 +99,35 @@ def test_flash_backward_blockwise_matches_xla(causal, seq):
                                    atol=5e-3, err_msg=name)
 
 
+def test_flash_backward_independent_geometry():
+    """Backward block geometry independent of the forward's: fwd runs a single
+    256-block while bwd runs 64-blocks over seq=200 — exercising the +inf
+    re-padding of the unpadded lse residual (rows 200..255 must contribute
+    p=0 to dK/dV, not NaN/garbage)."""
+    rs = np.random.RandomState(7)
+    shape = (1, 2, 200, 64)
+    q = jnp.asarray(rs.randn(*shape), jnp.float32)
+    k = jnp.asarray(rs.randn(*shape), jnp.float32)
+    v = jnp.asarray(rs.randn(*shape), jnp.float32)
+    g = jnp.asarray(rs.randn(*shape), jnp.float32)
+
+    from tnn_tpu.ops.pallas.flash_attention import flash_attention
+
+    def loss_flash(q, k, v):
+        return jnp.vdot(
+            flash_attention(q, k, v, True, None, 256, 256, 64, 64), g)
+
+    def loss_xla(q, k, v):
+        return jnp.vdot(sdpa(q, k, v, causal=True, backend="xla"), g)
+
+    gp = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gx = jax.grad(loss_xla, argnums=(0, 1, 2))(q, k, v)
+    for name, a, b in zip("dq dk dv".split(), gp, gx):
+        assert np.all(np.isfinite(np.asarray(a))), name
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=5e-3,
+                                   atol=5e-3, err_msg=name)
+
+
 def test_flash_backward_memory_scales_with_blocks():
     """The backward must not materialize the (S, S) matrix: its jaxpr contains
     no S x S-shaped intermediate (the whole point vs the XLA recompute path)."""

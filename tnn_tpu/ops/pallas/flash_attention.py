@@ -27,11 +27,17 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-# Tuned on v5e at GPT-2 geometry (B=8,H=12,S=1024,D=64): 128/128 -> 2.04ms,
-# 512/512 -> 0.54ms, 512/1024 -> 0.43ms (vs 0.82ms XLA-fused SDPA). Large k
-# blocks amortize the per-grid-step overhead; VMEM at D<=128 stays ~1-2MB.
-DEFAULT_BLOCK_Q = 512
+# Tuned on v5e (honest difference-timing, B=8/H=12/D=64). Forward is best at
+# 1024/1024 (S=1024: 0.42ms = 30.9 TFLOP/s; S=4096: 5.36ms = 38.5 TFLOP/s —
+# 4-5x the stock jax.experimental pallas flash kernel on the same shapes, and
+# ~78% of the D=64-contraction MXU ceiling). The backward prefers smaller q
+# blocks (S=4096 fwd+bwd: 512/1024 -> 36.2 TFLOP/s-equiv vs 28.1 at
+# 1024/1024), so fwd and bwd carry separate block defaults. 2048-wide blocks
+# fail to compile (VMEM).
+DEFAULT_BLOCK_Q = 1024
 DEFAULT_BLOCK_K = 1024
+DEFAULT_BLOCK_Q_BWD = 512
+DEFAULT_BLOCK_K_BWD = 1024
 _NEG_INF = -1e30
 
 
@@ -95,30 +101,46 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr, *,
 
 
 def _block_geometry(sq: int, skv: int, block_q: int, block_k: int):
-    """Shared fwd/bwd block sizing — the backward must pad exactly like the
-    forward did (the saved lse's padded shape encodes this)."""
+    """Block sizing + padded lengths. Forward and backward call this with
+    their OWN block sizes — the lse residual is saved unpadded and the
+    backward re-pads it (+inf) to its own geometry."""
     bq = min(block_q, max(sq, 8))
     bk = min(block_k, max(skv, 8))
     return bq, bk, pl.cdiv(sq, bq) * bq, pl.cdiv(skv, bk) * bk
 
 
-def _pad_to(x, size, axis):
+def _pad_to(x, size, axis, value=0):
     pad = size - x.shape[axis]
     if pad == 0:
         return x
     widths = [(0, 0)] * x.ndim
     widths[axis] = (0, pad)
-    return jnp.pad(x, widths)
+    return jnp.pad(x, widths, constant_values=value)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
 def flash_attention(q, k, v, causal: bool = False, scale: Optional[float] = None,
-                    block_q: int = DEFAULT_BLOCK_Q, block_k: int = DEFAULT_BLOCK_K):
-    """Fused attention over (B, H, S, Dh) tensors. Differentiable; O(block) fwd memory."""
+                    block_q: int = DEFAULT_BLOCK_Q, block_k: int = DEFAULT_BLOCK_K,
+                    block_q_bwd: Optional[int] = None,
+                    block_k_bwd: Optional[int] = None):
+    """Fused attention over (B, H, S, Dh) tensors. Differentiable; O(block) fwd memory.
+
+    Forward and backward take independent block geometry (the backward's three
+    matmul chain prefers smaller q blocks — see the tuning note above).
+    ``block_*_bwd=None`` resolves to min(caller's fwd block, tuned bwd
+    default): a caller shrinking blocks to fit VMEM shrinks the backward too,
+    while the stock defaults give the tuned (512, 1024) backward."""
     return _flash_fwd(q, k, v, causal, scale, block_q, block_k)[0]
 
 
-def _flash_fwd(q, k, v, causal, scale, block_q, block_k):
+def _bwd_blocks(block_q, block_k, block_q_bwd, block_k_bwd):
+    bq = block_q_bwd if block_q_bwd is not None else min(block_q, DEFAULT_BLOCK_Q_BWD)
+    bk = block_k_bwd if block_k_bwd is not None else min(block_k, DEFAULT_BLOCK_K_BWD)
+    return bq, bk
+
+
+def _flash_fwd(q, k, v, causal, scale, block_q, block_k,
+               block_q_bwd=None, block_k_bwd=None):
     b, h, sq, d = q.shape
     skv = k.shape[2]
     if scale is None:
@@ -161,8 +183,9 @@ def _flash_fwd(q, k, v, causal, scale, block_q, block_k):
         interpret=jax.default_backend() != "tpu",
     )(qf, kf, vf)
     out = out[:, :sq].reshape(b, h, sq, d)
-    # residual is the compact (b*h, sq_p) row vector; bwd reshapes (no broadcast)
-    return out, (q, k, v, out, lse[:, :, 0])
+    # residual is the compact UNPADDED (b*h, sq) row vector — the backward may
+    # use different block geometry and re-pads with +inf itself
+    return out, (q, k, v, out, lse[:, :sq, 0])
 
 
 def _attn_probs(q, k, lse_col, k_start, q_start, *, scale, causal, bq, bk, kv_len):
@@ -249,22 +272,25 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
         dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
 
 
-def _flash_bwd(causal, scale, block_q, block_k, residuals, g):
+def _flash_bwd(causal, scale, block_q, block_k, block_q_bwd, block_k_bwd,
+               residuals, g):
     """Blockwise Pallas backward: never materializes the (S, S) matrix."""
     q, k, v, o, lse_row = residuals
     b, h, sq, d = q.shape
     skv = k.shape[2]
     if scale is None:
         scale = 1.0 / math.sqrt(d)
-    bq, bk, sq_p, skv_p = _block_geometry(sq, skv, block_q, block_k)
+    bq_bwd, bk_bwd = _bwd_blocks(block_q, block_k, block_q_bwd, block_k_bwd)
+    bq, bk, sq_p, skv_p = _block_geometry(sq, skv, bq_bwd, bk_bwd)
 
     qf = _pad_to(q.reshape(b * h, sq, d), sq_p, 1)
     kf = _pad_to(k.reshape(b * h, skv, d), skv_p, 1)
     vf = _pad_to(v.reshape(b * h, skv, d), skv_p, 1)
     of = _pad_to(o.reshape(b * h, sq, d), sq_p, 1)
     dof = _pad_to(g.reshape(b * h, sq, d), sq_p, 1)
-    # reshape only — the kernels take the compact (bq, 1) column directly
-    lse = lse_row[:, :, None]
+    # +inf on padded q rows makes their recomputed p exactly 0, so they add
+    # nothing to dK/dV (their dQ rows are sliced off anyway)
+    lse = _pad_to(lse_row, sq_p, 1, value=jnp.inf)[:, :, None]
 
     interpret = jax.default_backend() != "tpu"
     common = dict(scale=scale, causal=causal, bq=bq, bk=bk, kv_len=skv)
