@@ -417,6 +417,57 @@ def test_ring_attention_grads(rng):
     np.testing.assert_allclose(np.asarray(g1), np.asarray(g2), rtol=1e-4, atol=1e-5)
 
 
+# -- ulysses (all-to-all) sequence parallelism --------------------------------
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ulysses_matches_local(causal, rng):
+    from tnn_tpu.nn.attention import sdpa
+
+    mesh = parallel.make_mesh(seq=8)
+    rs = np.random.RandomState(0)
+    q = jnp.asarray(rs.randn(2, 8, 64, 16), jnp.float32)  # heads % sp == 0
+    k = jnp.asarray(rs.randn(2, 8, 64, 16), jnp.float32)
+    v = jnp.asarray(rs.randn(2, 8, 64, 16), jnp.float32)
+    ref = sdpa(q, k, v, causal=causal)
+    out = parallel.ulysses_attention(q, k, v, mesh, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-4, atol=1e-5)
+
+
+def test_ulysses_grads_match_ring(rng):
+    mesh = parallel.make_mesh(seq=4)
+    rs = np.random.RandomState(1)
+    q = jnp.asarray(rs.randn(1, 4, 32, 8), jnp.float32)
+    k = jnp.asarray(rs.randn(1, 4, 32, 8), jnp.float32)
+    v = jnp.asarray(rs.randn(1, 4, 32, 8), jnp.float32)
+    gu = jax.grad(lambda q: jnp.sum(
+        parallel.ulysses_attention(q, k, v, mesh, causal=True) ** 2))(q)
+    gr = jax.grad(lambda q: jnp.sum(
+        parallel.ring_attention(q, k, v, mesh, causal=True) ** 2))(q)
+    np.testing.assert_allclose(np.asarray(gu), np.asarray(gr), rtol=1e-4, atol=1e-5)
+
+
+def test_ulysses_rejects_indivisible_heads():
+    mesh = parallel.make_mesh(seq=8)
+    q = jnp.zeros((1, 4, 64, 8), jnp.float32)  # 4 heads, sp=8
+    with pytest.raises(ValueError, match="num_heads"):
+        parallel.ulysses_attention(q, q, q, mesh, causal=True)
+
+
+def test_ulysses_context_drives_sdpa(rng):
+    """ring_context(method='ulysses') reroutes every sdpa call — the config
+    knob train_model exposes as seq_parallel_method."""
+    from tnn_tpu.nn.attention import ring_context, sdpa
+
+    mesh = parallel.make_mesh(seq=8)
+    rs = np.random.RandomState(2)
+    q = jnp.asarray(rs.randn(1, 8, 64, 16), jnp.float32)
+    ref = sdpa(q, q, q, causal=True)
+    with ring_context(mesh, method="ulysses"):
+        out = sdpa(q, q, q, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-4,
+                               atol=1e-5)
+
+
 # -- tensor parallel ---------------------------------------------------------
 
 def test_tp_sharding_rules(rng):

@@ -222,10 +222,12 @@ class TestMeshTrainModel:
         with pytest.raises(ValueError, match="data/fsdp"):
             train_model(model, cfg, loader)
 
-    def test_config_driven_seq_parallel_gpt(self, tmp_path):
+    @pytest.mark.parametrize("method", ["ring", "ulysses"])
+    def test_config_driven_seq_parallel_gpt(self, tmp_path, method):
         """mesh_axes={'data':2,'seq':4}: the model's attention is retargeted to
-        the ring backend and the train step runs dp x sp from config alone
-        (sequence parallelism is entirely beyond the reference)."""
+        the configured context-parallel scheme and the train step runs dp x sp
+        from config alone (sequence parallelism is entirely beyond the
+        reference). Both schemes must match the single-device loss."""
         import jax
         import jax.numpy as jnp
 
@@ -248,6 +250,7 @@ class TestMeshTrainModel:
         cfg = TrainingConfig(epochs=1, batch_size=batch, shuffle=False,
                              snapshot_dir=str(tmp_path / "sp"),
                              mesh_axes={"data": 2, "seq": 4},
+                             seq_parallel_method=method,
                              optimizer={"type": "sgd", "lr": 0.1},
                              progress_print_interval=100)
         state, history = train_model(fresh(), cfg, loader)

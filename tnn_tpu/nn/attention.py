@@ -36,21 +36,28 @@ from . import initializers
 # model object is never mutated, so checkpoints keep their original backend
 # and the same model decodes single-chip after seq-parallel training.
 # sdpa(backend="ring") outside any context is an error (nothing to ring over).
-_RING_CTX = {"mesh": None, "axis": "seq", "batch_axis": None}
+_RING_CTX = {"mesh": None, "axis": "seq", "batch_axis": None, "method": "ring"}
 
 
 class ring_context:
     """with ring_context(mesh, axis="seq"): step(...) — seq-parallel attention.
     ``batch_axis`` (a name or tuple of names) composes dp/fsdp x sp: each batch
-    shard runs its own ring instead of all-gathering at the shard_map boundary."""
+    shard runs its own ring instead of all-gathering at the shard_map boundary.
+    ``method`` picks the context-parallel scheme: "ring" (K/V rotation — any
+    head count) or "ulysses" (all-to-all head re-sharding — needs
+    num_heads % sp == 0, runs the Pallas flash kernel locally)."""
 
-    def __init__(self, mesh, axis: str = "seq", batch_axis=None):
+    def __init__(self, mesh, axis: str = "seq", batch_axis=None,
+                 method: str = "ring"):
+        if method not in ("ring", "ulysses"):
+            raise ValueError(f"unknown seq-parallel method {method!r}")
         self.mesh, self.axis, self.batch_axis = mesh, axis, batch_axis
+        self.method = method
 
     def __enter__(self):
         self._prev = dict(_RING_CTX)
         _RING_CTX.update(mesh=self.mesh, axis=self.axis,
-                         batch_axis=self.batch_axis)
+                         batch_axis=self.batch_axis, method=self.method)
         return self
 
     def __exit__(self, *exc):
@@ -122,6 +129,13 @@ def sdpa(q, k, v, *, causal: bool = False, mask: Optional[jax.Array] = None,
         # the activations are seq-sharded, so local/full attention would be
         # wrong or all-gather; mask/kv_offset calls (cached decode) fall
         # through to their normal path untouched
+        if _RING_CTX["method"] == "ulysses":
+            from ..parallel.ulysses import ulysses_attention
+
+            return ulysses_attention(q, k, v, _RING_CTX["mesh"],
+                                     axis=_RING_CTX["axis"], causal=causal,
+                                     scale=scale,
+                                     batch_axis=_RING_CTX["batch_axis"])
         from ..parallel.ring_attention import ring_attention
 
         return ring_attention(q, k, v, _RING_CTX["mesh"],

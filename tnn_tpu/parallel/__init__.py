@@ -6,7 +6,8 @@ by construction), and intra-op threading. This package adds correct DP, FSDP, te
 parallelism, and ring-attention sequence parallelism on top — all as sharding
 annotations + XLA collectives over ICI, replacing ~4.4k LoC of TCP/RoCE runtime.
 """
-from . import data_parallel, mesh, partitioner, pipeline, ring_attention, tensor_parallel
+from . import (data_parallel, mesh, partitioner, pipeline, ring_attention,
+               tensor_parallel, ulysses)
 from .data_parallel import make_dp_train_step, shard_params_fsdp
 from .mesh import batch_sharding, data_mesh, make_mesh, replicated
 from .partitioner import SeqPartition, balanced_partitions, partition_model, split
@@ -14,6 +15,7 @@ from .pipeline import (HeteroPipeline, StagePipeline, make_pipeline_eval_step,
                        make_pipeline_train_step, spmd_pipeline, stack_stage_params)
 from .ring_attention import ring_attention
 from .tensor_parallel import DEFAULT_TP_RULES, shard_params_tp, spec_tree
+from .ulysses import ulysses_attention
 
 __all__ = [
     "data_parallel", "mesh", "partitioner", "pipeline", "ring_attention", "tensor_parallel",
@@ -22,6 +24,6 @@ __all__ = [
     "SeqPartition", "balanced_partitions", "partition_model", "split",
     "HeteroPipeline", "StagePipeline", "make_pipeline_eval_step",
     "make_pipeline_train_step", "spmd_pipeline", "stack_stage_params",
-    "ring_attention",
+    "ring_attention", "ulysses_attention",
     "DEFAULT_TP_RULES", "shard_params_tp", "spec_tree",
 ]

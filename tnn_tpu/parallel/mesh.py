@@ -66,6 +66,30 @@ def axis_size(mesh: Mesh, name: str) -> int:
     return int(mesh.shape.get(name, 1))
 
 
+def seq_shard_map(body, mesh: Mesh, axis: str, batch_axis=None):
+    """Wrap a per-device (q, k, v) -> out body for context-parallel attention.
+
+    Shared by ring_attention and ulysses_attention so the two schemes stay
+    drop-in interchangeable: activations are (B, H, S, D) with S sharded over
+    ``axis``; ``batch_axis`` (one name or a tuple, e.g. ("data", "fsdp"))
+    additionally shards B so each batch shard runs its own ring/all-to-all
+    group — without it, a batch-sharded input would be all-gathered at the
+    shard_map boundary. Degenerate (size-1) batch axes are dropped.
+    """
+    import jax as _jax
+    from jax.sharding import PartitionSpec as P
+
+    if batch_axis is None:
+        ba = None
+    else:
+        names = (batch_axis,) if isinstance(batch_axis, str) else tuple(batch_axis)
+        live = tuple(n for n in names if axis_size(mesh, n) > 1)
+        ba = live or None
+    spec = P(ba, None, axis, None)
+    return _jax.shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
+                          out_specs=spec, check_vma=False)
+
+
 def local_mesh_info() -> Dict[str, int]:
     """Device census (parity: HardwareInfo intent, utils/hardware_info.hpp:126)."""
     devs = jax.devices()

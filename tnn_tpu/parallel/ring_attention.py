@@ -17,7 +17,7 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
-from jax.sharding import Mesh, PartitionSpec as P
+from jax.sharding import Mesh
 
 from . import mesh as mesh_lib
 
@@ -90,13 +90,4 @@ def ring_attention(q, k, v, mesh: Mesh, *, axis: str = "seq", causal: bool = Fal
     if q.shape[-2] % ring:
         raise ValueError(f"seq len {q.shape[-2]} not divisible by ring size {ring}")
     body = functools.partial(_ring_attention_local, axis=axis, causal=causal, scale=scale)
-    if batch_axis is None:
-        ba = None
-    else:
-        names = (batch_axis,) if isinstance(batch_axis, str) else tuple(batch_axis)
-        live = tuple(n for n in names if mesh_lib.axis_size(mesh, n) > 1)
-        ba = live or None
-    spec = P(ba, None, axis, None)
-    fn = jax.shard_map(body, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
-                       check_vma=False)
-    return fn(q, k, v)
+    return mesh_lib.seq_shard_map(body, mesh, axis, batch_axis)(q, k, v)

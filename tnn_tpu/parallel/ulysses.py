@@ -1,0 +1,88 @@
+"""Ulysses (all-to-all) sequence parallelism — the second context-parallel scheme.
+
+Beyond the reference: TNN has NO sequence/context parallelism (SURVEY.md §5 — its
+long-context story is single-device flash attention at fixed seq_len=1024). The build
+charter asks for "ring attention or all-to-all sequence/context parallelism"; this
+package ships BOTH, because they trade off differently:
+
+  * ring_attention: K/V blocks rotate via ppermute; works for any head count, ICI
+    traffic overlaps compute, but the blockwise accumulation runs as jnp ops (the
+    online-softmax recurrence spans devices, so the single-chip Pallas kernel can't
+    cover the cross-device loop).
+  * ulysses_attention (this module): one all-to-all re-shards activations from
+    seq-sharded to HEAD-sharded; each device then holds the FULL sequence for H/sp
+    heads and runs the tuned single-chip Pallas flash kernel locally; a second
+    all-to-all restores seq sharding. Per DeepSpeed-Ulysses (arXiv:2309.14509) the
+    a2a moves O(S·d/sp) bytes per device vs ring's O(S·d) — but requires
+    num_heads % sp == 0.
+
+Differentiable end-to-end: all_to_all transposes to all_to_all in the VJP and the
+local attention is the custom-VJP flash kernel (or XLA softmax attention off-TPU).
+"""
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh
+
+from . import mesh as mesh_lib
+
+
+def _local_full_attention(q, k, v, *, causal: bool, scale: float):
+    """Single-device attention on (B, h_local, S, D) — full sequence present, so
+    plain causal masking is correct. Pallas flash on TPU, XLA softmax elsewhere
+    (interpret-mode pallas is too slow for the test matrix here)."""
+    if jax.default_backend() == "tpu":
+        from ..ops.pallas.flash_attention import flash_attention
+
+        return flash_attention(q, k, v, causal, scale)
+    logits = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                        preferred_element_type=jnp.float32) * scale
+    if causal:
+        s = q.shape[-2]
+        mask = jnp.arange(s)[:, None] >= jnp.arange(s)[None, :]
+        logits = jnp.where(mask, logits, -1e30)
+    probs = jax.nn.softmax(logits, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", probs.astype(v.dtype), v,
+                      preferred_element_type=jnp.float32).astype(v.dtype)
+
+
+def _ulysses_local(q, k, v, *, axis: str, causal: bool, scale: float):
+    """Per-device body under shard_map. q/k/v: (B, H, S_local, D) — the full
+    head dim with a sequence shard. Two all-to-alls bracket local attention."""
+    # (B, H, S/sp, D) -> (B, H/sp, S, D): scatter heads, gather sequence
+    fwd = functools.partial(jax.lax.all_to_all, axis_name=axis, split_axis=1,
+                            concat_axis=2, tiled=True)
+    qh, kh, vh = fwd(q), fwd(k), fwd(v)
+    oh = _local_full_attention(qh, kh, vh, causal=causal, scale=scale)
+    # (B, H/sp, S, D) -> (B, H, S/sp, D): scatter sequence, gather heads
+    return jax.lax.all_to_all(oh, axis_name=axis, split_axis=2, concat_axis=1,
+                              tiled=True)
+
+
+def ulysses_attention(q, k, v, mesh: Mesh, *, axis: str = "seq",
+                      causal: bool = False, scale: Optional[float] = None,
+                      batch_axis: Optional[str] = None):
+    """Attention over (B, H, S, D) tensors whose S dim is sharded over ``axis``.
+
+    Same contract as ``ring_attention`` (call with global arrays sharded
+    P(None, None, axis, None); returns the same sharding) so the two schemes are
+    drop-in interchangeable where num_heads % sp == 0. ``batch_axis`` composes
+    dp/fsdp x sp exactly as in ring_attention.
+    """
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    sp = mesh_lib.axis_size(mesh, axis)
+    heads, seq = q.shape[1], q.shape[-2]
+    if seq % sp:
+        raise ValueError(f"seq len {seq} not divisible by sp size {sp}")
+    if heads % sp:
+        raise ValueError(
+            f"num_heads {heads} not divisible by sp size {sp} — Ulysses shards "
+            f"heads during attention; use ring_attention for this layout")
+    body = functools.partial(_ulysses_local, axis=axis, causal=causal, scale=scale)
+    return mesh_lib.seq_shard_map(body, mesh, axis, batch_axis)(q, k, v)

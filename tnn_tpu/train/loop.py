@@ -231,7 +231,8 @@ def train_model(
                         f"mesh_axes['seq'] = {axes['seq']}")
                 batch_axes = tuple(a for a in ("data", "fsdp")
                                    if axes.get(a, 1) > 1)
-                ring = ring_context(mesh, batch_axis=batch_axes or None)
+                ring = ring_context(mesh, batch_axis=batch_axes or None,
+                                    method=config.seq_parallel_method)
                 base_step = step_fn
 
                 def step_fn(state, data, labels, _f=base_step, _r=ring):

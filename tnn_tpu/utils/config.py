@@ -40,6 +40,7 @@ class TrainingConfig:
     num_microbatches: int = 2
     mesh_axes: Dict[str, int] = dataclasses.field(default_factory=dict)  # e.g. {"data": 8}
     remat: bool = False  # rematerialize forward in backward (memory for FLOPs)
+    seq_parallel_method: str = "ring"  # "ring" (K/V rotation) | "ulysses" (all-to-all)
 
     # beyond-reference params
     shuffle: bool = True
