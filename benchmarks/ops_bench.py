@@ -120,6 +120,38 @@ def bench_attention(quick=False):
     return out
 
 
+def bench_long_context(quick=False):
+    """Long-context flash attention fwd+bwd — the capability the reference
+    caps at seq_len=1024 (example_models.cpp:385). The Pallas kernels keep
+    O(block) memory, so S=16k TRAINS on one chip; the XLA path would
+    materialize (S, S) f32 logits (1 GB at S=16k) per head-batch."""
+    if quick or jax.devices()[0].platform != "tpu":
+        print("long-context: skipped (quick/off-TPU)")
+        return []
+    from tnn_tpu.ops.pallas.flash_attention import flash_attention
+
+    out = []
+    B, H, D = 1, 12, 64
+    for S in (8192, 16384):
+        rs = np.random.RandomState(0)
+        q = jnp.asarray(rs.randn(B, H, S, D), jnp.bfloat16)
+        k = jnp.asarray(rs.randn(B, H, S, D), jnp.bfloat16)
+        v = jnp.asarray(rs.randn(B, H, S, D), jnp.bfloat16)
+        flops = 4.0 * B * H * S * S * D * 0.5  # causal forward
+
+        f = jax.jit(lambda q, k, v: flash_attention(q, k, v, True))
+        dt = time_fn(f, q, k, v, iters=10)
+        out.append(report(f"flash_causal_S{S}_fwd", dt, flops=flops))
+
+        g = jax.jit(jax.grad(
+            lambda q, k, v: jnp.sum(
+                flash_attention(q, k, v, True).astype(jnp.float32)),
+            argnums=(0, 1, 2)))
+        dt = time_fn(g, q, k, v, iters=5)
+        out.append(report(f"flash_causal_S{S}_fwd_bwd", dt, flops=3.5 * flops))
+    return out
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser()
     ap.add_argument("--quick", action="store_true", help="small shapes (CI/CPU)")
@@ -130,6 +162,7 @@ def main(argv=None):
     results.append(bench_conv2d(args.quick))
     results.append(bench_dense_train(args.quick))
     results.extend(bench_attention(args.quick))
+    results.extend(bench_long_context(args.quick))
     return results
 
 

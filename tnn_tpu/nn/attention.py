@@ -154,6 +154,18 @@ def sdpa(q, k, v, *, causal: bool = False, mask: Optional[jax.Array] = None,
         from ..ops.pallas.flash_attention import flash_attention
 
         return flash_attention(q, k, v, causal=causal, scale=scale)
+    return local_xla_attention(q, k, v, causal=causal, mask=mask, scale=scale,
+                               kv_offset=kv_offset)
+
+
+def local_xla_attention(q, k, v, *, causal: bool = False,
+                        mask: Optional[jax.Array] = None,
+                        scale: Optional[float] = None,
+                        kv_offset: Optional[jax.Array] = None):
+    """The plain XLA softmax-attention math — sdpa's "xla" backend, and the
+    single source of truth for any caller that must bypass the seq-parallel
+    context routing (e.g. ulysses' off-TPU local attention, which would
+    recurse through sdpa)."""
     sq, skv = q.shape[-2], k.shape[-2]
     if scale is None:
         scale = 1.0 / math.sqrt(q.shape[-1])

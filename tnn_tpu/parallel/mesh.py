@@ -76,18 +76,15 @@ def seq_shard_map(body, mesh: Mesh, axis: str, batch_axis=None):
     group — without it, a batch-sharded input would be all-gathered at the
     shard_map boundary. Degenerate (size-1) batch axes are dropped.
     """
-    import jax as _jax
-    from jax.sharding import PartitionSpec as P
-
     if batch_axis is None:
         ba = None
     else:
         names = (batch_axis,) if isinstance(batch_axis, str) else tuple(batch_axis)
         live = tuple(n for n in names if axis_size(mesh, n) > 1)
         ba = live or None
-    spec = P(ba, None, axis, None)
-    return _jax.shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
-                          out_specs=spec, check_vma=False)
+    spec = PartitionSpec(ba, None, axis, None)
+    return jax.shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
+                         out_specs=spec, check_vma=False)
 
 
 def local_mesh_info() -> Dict[str, int]:

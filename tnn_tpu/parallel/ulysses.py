@@ -26,7 +26,6 @@ import math
 from typing import Optional
 
 import jax
-import jax.numpy as jnp
 from jax.sharding import Mesh
 
 from . import mesh as mesh_lib
@@ -34,21 +33,17 @@ from . import mesh as mesh_lib
 
 def _local_full_attention(q, k, v, *, causal: bool, scale: float):
     """Single-device attention on (B, h_local, S, D) — full sequence present, so
-    plain causal masking is correct. Pallas flash on TPU, XLA softmax elsewhere
-    (interpret-mode pallas is too slow for the test matrix here)."""
+    plain causal masking is correct. Pallas flash on TPU, the shared XLA
+    softmax math elsewhere (interpret-mode pallas is too slow for the test
+    matrix; local_xla_attention bypasses sdpa's context routing, which would
+    recurse back into ulysses)."""
     if jax.default_backend() == "tpu":
         from ..ops.pallas.flash_attention import flash_attention
 
         return flash_attention(q, k, v, causal, scale)
-    logits = jnp.einsum("bhqd,bhkd->bhqk", q, k,
-                        preferred_element_type=jnp.float32) * scale
-    if causal:
-        s = q.shape[-2]
-        mask = jnp.arange(s)[:, None] >= jnp.arange(s)[None, :]
-        logits = jnp.where(mask, logits, -1e30)
-    probs = jax.nn.softmax(logits, axis=-1)
-    return jnp.einsum("bhqk,bhkd->bhqd", probs.astype(v.dtype), v,
-                      preferred_element_type=jnp.float32).astype(v.dtype)
+    from ..nn.attention import local_xla_attention
+
+    return local_xla_attention(q, k, v, causal=causal, scale=scale)
 
 
 def _ulysses_local(q, k, v, *, axis: str, causal: bool, scale: float):
