@@ -140,6 +140,15 @@ def bench_long_context(quick=False):
         flops = 4.0 * B * H * S * S * D * 0.5  # causal forward
 
         f = jax.jit(lambda q, k, v: flash_attention(q, k, v, True))
+        # verify-before-time at the FULL sequence length (one head — the f32
+        # reference materializes the (S, S) logits, ~1 GB at S=16k on device)
+        ref = jax.jit(lambda q, k, v: jax.nn.softmax(jnp.where(
+            jnp.tril(jnp.ones((S, S), bool)),
+            jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
+                       k.astype(jnp.float32)) / np.sqrt(D),
+            -1e30), axis=-1) @ v.astype(jnp.float32))
+        verify(f"flash_S{S}", f(q[:, :1], k[:, :1], v[:, :1]),
+               ref(q[:, :1], k[:, :1], v[:, :1]), rtol=5e-2, atol=5e-2)
         dt = time_fn(f, q, k, v, iters=10)
         out.append(report(f"flash_causal_S{S}_fwd", dt, flops=flops))
 

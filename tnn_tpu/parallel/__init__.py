@@ -12,7 +12,8 @@ from .data_parallel import make_dp_train_step, shard_params_fsdp
 from .mesh import batch_sharding, data_mesh, make_mesh, replicated
 from .partitioner import SeqPartition, balanced_partitions, partition_model, split
 from .pipeline import (HeteroPipeline, StagePipeline, make_pipeline_eval_step,
-                       make_pipeline_train_step, spmd_pipeline, stack_stage_params)
+                       make_pipeline_train_step, spmd_pipeline,
+                       spmd_pipeline_interleaved, stack_stage_params)
 from .ring_attention import ring_attention
 from .tensor_parallel import DEFAULT_TP_RULES, shard_params_tp, spec_tree
 from .ulysses import ulysses_attention
@@ -23,7 +24,8 @@ __all__ = [
     "batch_sharding", "data_mesh", "make_mesh", "replicated",
     "SeqPartition", "balanced_partitions", "partition_model", "split",
     "HeteroPipeline", "StagePipeline", "make_pipeline_eval_step",
-    "make_pipeline_train_step", "spmd_pipeline", "stack_stage_params",
+    "make_pipeline_train_step", "spmd_pipeline", "spmd_pipeline_interleaved",
+    "stack_stage_params",
     "ring_attention", "ulysses_attention",
     "DEFAULT_TP_RULES", "shard_params_tp", "spec_tree",
 ]

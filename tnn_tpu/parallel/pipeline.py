@@ -118,6 +118,98 @@ def stack_stage_params(per_stage_params: Sequence) -> Any:
     return jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *per_stage_params)
 
 
+def spmd_pipeline_interleaved(block_fn: Callable, stacked_params, x_microbatches,
+                              mesh: Mesh, axis: str = "pipe",
+                              virtual: int = 2):
+    """Interleaved (Megatron-style) schedule: beats the plain GPipe bubble.
+
+    The reference's best schedule is semi-async 1F1B (coordinator.hpp:165-223),
+    whose bubble equals GPipe's — only INTERLEAVING virtual stages shrinks it.
+    Here the L = virtual*pp stages place round-robin (stage s on device s%pp),
+    so each device holds ``virtual`` chunks of 1/v the work; the bubble drops
+    from (pp-1)*T to (pp-1)*T/v.
+
+    This maps onto a compiled lockstep scan because the interleaved forward
+    schedule is TIGHT: with sub-tick
+        tau(s=c*pp+d, m) = d + (m %% pp) + pp*(c + v*(m // pp))
+    every stage's input arrives over ICI exactly at the sub-tick it is
+    consumed (the chunk-boundary hop d=pp-1 -> d=0 has slack 1, same as the
+    in-chunk hop), so no inter-stage queues exist — one ppermute per sub-tick
+    and a dynamic chunk-select per device. jax.grad transposes the scan into
+    the interleaved backward.
+
+    Args mirror ``spmd_pipeline`` with ``stacked_params`` leading dim
+    L = virtual * pp (stage s params at index s). num_mb must be a multiple
+    of pp (Megatron's constraint — the round-robin rounds must fill).
+    """
+    pp = mesh_lib.axis_size(mesh, axis)
+    v = int(virtual)
+    leaves = jax.tree_util.tree_leaves(stacked_params)
+    L = leaves[0].shape[0]
+    if v < 1 or L != v * pp:
+        raise ValueError(f"stacked_params leading dim {L} != virtual {v} * pipe {pp}")
+    num_mb = x_microbatches.shape[0]
+    if num_mb < 1:
+        raise ValueError("need at least one microbatch")
+    if num_mb % pp:
+        raise ValueError(f"interleaved schedule needs num_microbatches "
+                         f"({num_mb}) divisible by pipe size ({pp})")
+    stage0 = jax.tree_util.tree_map(lambda a: a[0], stacked_params)
+    act = jax.eval_shape(block_fn, stage0, jax.ShapeDtypeStruct(
+        x_microbatches.shape[1:], x_microbatches.dtype))
+    if act.shape != x_microbatches.shape[1:]:
+        raise ValueError(f"pipeline stages must preserve activation shape, got "
+                         f"{x_microbatches.shape[1:]} -> {act.shape}")
+    # round-robin placement: device d's local chunk c is global stage c*pp + d,
+    # so re-order rows to (d*v + c) before sharding the leading axis over pp
+    order = np.argsort([(s % pp) * v + s // pp for s in range(L)], kind="stable")
+    placed = jax.tree_util.tree_map(lambda a: a[order], stacked_params)
+    # last sub-tick: stage L-1 = (c=v-1, d=pp-1) processing mb num_mb-1
+    n_ticks = ((pp - 1) + ((num_mb - 1) % pp)
+               + pp * ((v - 1) + v * ((num_mb - 1) // pp)) + 1)
+
+    def per_device(params, xs):
+        # local params: (v, ...) — this device's chunks; chunk c = stage c*pp+d
+        d = jax.lax.axis_index(axis)
+        mb_shape = xs.shape[1:]
+        outputs0 = jnp.zeros((num_mb,) + mb_shape, act.dtype)
+        zero = jnp.zeros(mb_shape, act.dtype)
+        perm = [(i, (i + 1) % pp) for i in range(pp)]
+
+        def tick(carry, u):
+            recv, outputs = carry
+            # invert tau: which (chunk c, microbatch m) does device d run now?
+            w = u - d
+            q, j = w // pp, jnp.mod(w, pp)
+            c = jnp.mod(q, v)
+            m = (q // v) * pp + j
+            active = jnp.logical_and(w >= 0, m < num_mb)
+            m_idx = jnp.clip(m, 0, num_mb - 1)
+            inject = jnp.logical_and(c == 0, d == 0)
+            inp = jnp.where(inject, xs[m_idx].astype(act.dtype), recv)
+            chunk = jax.tree_util.tree_map(
+                lambda a: jax.lax.dynamic_index_in_dim(a, c, 0, keepdims=False),
+                params)
+            out = block_fn(chunk, inp).astype(act.dtype)
+            emit = jnp.logical_and(active,
+                                   jnp.logical_and(c == v - 1, d == pp - 1))
+            cur = jax.lax.dynamic_index_in_dim(outputs, m_idx, 0, keepdims=False)
+            outputs = jax.lax.dynamic_update_index_in_dim(
+                outputs, jnp.where(emit, out, cur), m_idx, 0)
+            recv = jax.lax.ppermute(out, axis, perm)
+            return (recv, outputs), None
+
+        (recv, outputs), _ = jax.lax.scan(
+            tick, (zero, outputs0), jnp.arange(n_ticks))
+        return outputs[None]
+
+    in_specs = (jax.tree_util.tree_map(lambda _: P(axis), placed), P())
+    fn = jax.shard_map(per_device, mesh=mesh, in_specs=in_specs,
+                       out_specs=P(axis), check_vma=False)
+    stacked_out = fn(placed, x_microbatches)  # (pp, num_mb, ...)
+    return stacked_out[-1]
+
+
 # ---------------------------------------------------------------------------
 # 2. Compiled heterogeneous-stage pipeline (shape-changing stages, correct BN)
 # ---------------------------------------------------------------------------
@@ -204,13 +296,13 @@ class HeteroPipeline:
         self.compute_accuracy = bool(compute_accuracy)
         # Schedule note: this is compiled lockstep GPipe — bubble fraction is
         # (pp-1)/(num_mb+pp-1). Event-driven 1F1B (the reference's semi-async
-        # schedule, coordinator.hpp:165-223) does not map onto a lockstep SPMD
-        # scan; the compiled-regime equivalents are (a) hops cost ~0 (ICI
-        # ppermute inside one XLA program vs the reference's per-hop TCP/RDMA
-        # serialization), so num_mb can be raised until the bubble vanishes,
-        # and (b) ``remat=True`` rematerializes each stage in the backward,
-        # cutting saved activations per tick to the hop buffers — 1F1B's
-        # memory benefit without its schedule.
+        # schedule, coordinator.hpp:165-223) has the SAME bubble as GPipe; its
+        # memory benefit comes here from ``remat=True`` (saved activations per
+        # tick shrink to the hop buffers), and hops cost ~0 (ICI ppermute
+        # inside one XLA program vs per-hop TCP/RDMA serialization), so
+        # num_mb can be raised until the bubble vanishes. The schedule that
+        # genuinely beats both — interleaved virtual stages, bubble/v — is
+        # implemented for homogeneous stacks as ``spmd_pipeline_interleaved``.
         self.remat = bool(remat)
 
         # shape propagation (parity: deploy_stages shape chain,
