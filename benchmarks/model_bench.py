@@ -96,6 +96,7 @@ def bench_gpt2_decode(batch: int, prompt: int, new: int, size="small"):
     params = variables["params"]
     rs = np.random.RandomState(0)
     ids = rs.randint(0, 50257, (batch, prompt)).astype(np.int32)
+    # generate() sizes the KV cache to the request by default (see gpt2.py)
     out = generate(model, params, ids, new)  # compile
     sync(out)
 
@@ -139,6 +140,8 @@ def main(argv=None):
                                         3 if q else 10, flash=True))
     if "decode" in wanted:
         results.append(bench_gpt2_decode(1, 16 if q else 64, 16 if q else 128))
+        if not q:  # serving-shaped batched decode (throughput mode)
+            results.append(bench_gpt2_decode(8, 64, 128))
     return results
 
 

@@ -46,6 +46,26 @@ from . import mesh as mesh_lib
 # ---------------------------------------------------------------------------
 
 
+def _homogeneous_pipeline_setup(block_fn, stacked_params, x_microbatches,
+                                mesh: Mesh, axis: str):
+    """Shared validation + activation-shape inference for the homogeneous
+    compiled pipelines (spmd_pipeline / spmd_pipeline_interleaved).
+
+    Returns (pp, num_mb, act) where ``act`` is the per-microbatch activation
+    ShapeDtypeStruct every stage must preserve."""
+    pp = mesh_lib.axis_size(mesh, axis)
+    num_mb = x_microbatches.shape[0]
+    if num_mb < 1:
+        raise ValueError("need at least one microbatch")
+    stage0 = jax.tree_util.tree_map(lambda a: a[0], stacked_params)
+    act = jax.eval_shape(block_fn, stage0, jax.ShapeDtypeStruct(
+        x_microbatches.shape[1:], x_microbatches.dtype))
+    if act.shape != x_microbatches.shape[1:]:
+        raise ValueError(f"pipeline stages must preserve activation shape, got "
+                         f"{x_microbatches.shape[1:]} -> {act.shape}")
+    return pp, num_mb, act
+
+
 def spmd_pipeline(block_fn: Callable, stacked_params, x_microbatches, mesh: Mesh,
                   axis: str = "pipe"):
     """Run microbatches through a chain of identical-structure stages.
@@ -61,17 +81,8 @@ def spmd_pipeline(block_fn: Callable, stacked_params, x_microbatches, mesh: Mesh
     Returns: (num_mb, mb_size, ...) outputs of the last stage.
     Differentiable end-to-end.
     """
-    pp = mesh_lib.axis_size(mesh, axis)
-    num_mb = x_microbatches.shape[0]
-    if num_mb < 1:
-        raise ValueError("need at least one microbatch")
-    # activation dtype/shape between stages = block output (stages are homogeneous)
-    stage0 = jax.tree_util.tree_map(lambda a: a[0], stacked_params)
-    act = jax.eval_shape(block_fn, stage0, jax.ShapeDtypeStruct(
-        x_microbatches.shape[1:], x_microbatches.dtype))
-    if act.shape != x_microbatches.shape[1:]:
-        raise ValueError(f"pipeline stages must preserve activation shape, got "
-                         f"{x_microbatches.shape[1:]} -> {act.shape}")
+    pp, num_mb, act = _homogeneous_pipeline_setup(
+        block_fn, stacked_params, x_microbatches, mesh, axis)
 
     def per_device(params, xs):
         # shard_map keeps the sharded leading dim at local size 1 — drop it
@@ -142,24 +153,15 @@ def spmd_pipeline_interleaved(block_fn: Callable, stacked_params, x_microbatches
     L = virtual * pp (stage s params at index s). num_mb must be a multiple
     of pp (Megatron's constraint — the round-robin rounds must fill).
     """
-    pp = mesh_lib.axis_size(mesh, axis)
     v = int(virtual)
-    leaves = jax.tree_util.tree_leaves(stacked_params)
-    L = leaves[0].shape[0]
+    pp, num_mb, act = _homogeneous_pipeline_setup(
+        block_fn, stacked_params, x_microbatches, mesh, axis)
+    L = jax.tree_util.tree_leaves(stacked_params)[0].shape[0]
     if v < 1 or L != v * pp:
         raise ValueError(f"stacked_params leading dim {L} != virtual {v} * pipe {pp}")
-    num_mb = x_microbatches.shape[0]
-    if num_mb < 1:
-        raise ValueError("need at least one microbatch")
     if num_mb % pp:
         raise ValueError(f"interleaved schedule needs num_microbatches "
                          f"({num_mb}) divisible by pipe size ({pp})")
-    stage0 = jax.tree_util.tree_map(lambda a: a[0], stacked_params)
-    act = jax.eval_shape(block_fn, stage0, jax.ShapeDtypeStruct(
-        x_microbatches.shape[1:], x_microbatches.dtype))
-    if act.shape != x_microbatches.shape[1:]:
-        raise ValueError(f"pipeline stages must preserve activation shape, got "
-                         f"{x_microbatches.shape[1:]} -> {act.shape}")
     # round-robin placement: device d's local chunk c is global stage c*pp + d,
     # so re-order rows to (d*v + c) before sharding the leading axis over pp
     order = np.argsort([(s % pp) * v + s // pp for s in range(L)], kind="stable")
