@@ -103,6 +103,7 @@ def main(argv=None):
     print("== input pipeline ==")
     results = []
     results += bench_image_loader("png", workers, batch, iters)
+    results += bench_image_loader("jpg", workers, batch, iters)
     results += bench_image_loader("npy", workers, batch, iters)
     results += bench_token_stream(8, 1024, 8 if args.quick else 50)
     return results

@@ -6,8 +6,8 @@
 // from the PNG specification against the system zlib: 8-bit depth, color types
 // 0/2/3/4/6, non-interlaced (the overwhelming case for dataset files); anything
 // else reports failure and the Python caller falls back to PIL per image.
-// JPEG stays on the PIL path (a from-scratch baseline JPEG decoder is out of
-// scope; the reference vendors stb for the same reason).
+// JPEG dispatches on magic bytes to the from-spec baseline decoder in
+// jpeg.cpp (progressive/12-bit variants report failure -> PIL fallback).
 //
 // zlib is optional for the library as a whole: without <zlib.h> this file
 // compiles a stub whose decode always reports failure (Python falls back to
@@ -26,14 +26,14 @@
 
 #include "common.hpp"
 
-#if TNN_HAVE_ZLIB
-
 namespace {
 
 struct Img {
   int w = 0, h = 0;
   std::vector<uint8_t> rgb;  // w*h*3
 };
+
+#if TNN_HAVE_ZLIB
 
 uint32_t be32(const uint8_t* p) {
   return (uint32_t(p[0]) << 24) | (uint32_t(p[1]) << 16) |
@@ -144,6 +144,12 @@ bool decode_png(const uint8_t* buf, size_t len, Img& out) {
   return true;
 }
 
+#else  // !TNN_HAVE_ZLIB: PNG unavailable (PIL fallback); JPEG still decodes
+
+bool decode_png(const uint8_t*, size_t, Img&) { return false; }
+
+#endif  // TNN_HAVE_ZLIB
+
 // Bilinear resize, same convention as the Python _resize_bilinear
 // (align-corners=False sampling, +0.5 round on store) so both paths agree.
 void resize_bilinear_rgb(const Img& src, int H, int W, uint8_t* out) {
@@ -178,12 +184,13 @@ void resize_bilinear_rgb(const Img& src, int H, int W, uint8_t* out) {
 
 }  // namespace
 
-// Decode n PNG files into out (n, out_h, out_w, 3) uint8 with bilinear resize,
+// Decode n image files (PNG via zlib, baseline JPEG via jpeg.cpp — dispatched
+// on magic bytes) into out (n, out_h, out_w, 3) uint8 with bilinear resize,
 // threaded across files. ok[i]=1 on success; failures leave their slot zeroed
 // and the caller falls back per image. Returns the failure count.
-TNN_API int64_t tnn_decode_png_batch(const char* const* paths, int64_t n,
-                                     int out_h, int out_w, uint8_t* out,
-                                     uint8_t* ok) {
+TNN_API int64_t tnn_decode_image_batch(const char* const* paths, int64_t n,
+                                       int out_h, int out_w, uint8_t* out,
+                                       uint8_t* ok) {
   std::atomic<int64_t> nfail{0};
   int64_t frame = int64_t(out_h) * out_w * 3;
   tnn::parallel_for(
@@ -201,7 +208,16 @@ TNN_API int64_t tnn_decode_png_batch(const char* const* paths, int64_t n,
           bool read_ok = sz > 0 && fread(buf.data(), 1, size_t(sz), f) == size_t(sz);
           fclose(f);
           Img img;
-          if (!read_ok || !decode_png(buf.data(), buf.size(), img)) {
+          bool decoded = false;
+          if (read_ok && buf.size() >= 2) {
+            if (buf[0] == 0xFF && buf[1] == 0xD8) {
+              decoded = tnn::jpeg_decode_rgb(buf.data(), buf.size(), img.rgb,
+                                             img.w, img.h);
+            } else {
+              decoded = decode_png(buf.data(), buf.size(), img);
+            }
+          }
+          if (!decoded) {
             nfail++;
             continue;
           }
@@ -213,13 +229,9 @@ TNN_API int64_t tnn_decode_png_batch(const char* const* paths, int64_t n,
   return nfail.load();
 }
 
-#else  // !TNN_HAVE_ZLIB — stub: every decode fails, Python falls back to PIL
-
-TNN_API int64_t tnn_decode_png_batch(const char* const*, int64_t n, int out_h,
-                                     int out_w, uint8_t* out, uint8_t* ok) {
-  memset(out, 0, size_t(n) * out_h * out_w * 3);
-  memset(ok, 0, size_t(n));
-  return n;
+// Back-compat alias for the original PNG-only entry point name.
+TNN_API int64_t tnn_decode_png_batch(const char* const* paths, int64_t n,
+                                     int out_h, int out_w, uint8_t* out,
+                                     uint8_t* ok) {
+  return tnn_decode_image_batch(paths, n, out_h, out_w, out, ok);
 }
-
-#endif

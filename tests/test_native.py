@@ -310,7 +310,7 @@ class TestNativePngDecode:
                 Image.fromarray(rng.integers(0, 255, (20, 20, 3),
                                              np.uint8)).save(str(d / f"{i}.png"))
         fast = ImageFolderDataLoader(str(tmp_path), image_size=(16, 16))
-        assert fast._native_png
+        assert fast._native_img
         a, la = fast.get_batch(8)
         # ground truth: PIL full-size decode (exact) + our python bilinear
         # (PIL's own BILINEAR downscale is a scaled triangle filter — a
@@ -324,3 +324,99 @@ class TestNativePngDecode:
             ref = _resize_bilinear(full[None], (16, 16))[0]
             got = (a[j] * 255.0 + 0.5).astype(np.uint8)
             assert np.abs(got.astype(int) - ref.astype(int)).max() <= 1
+
+
+@pytest.mark.skipif(not native.available(), reason="native runtime unavailable")
+class TestNativeJpegDecode:
+    """From-spec baseline JPEG decoder (native/src/jpeg.cpp) vs PIL/libjpeg.
+
+    JPEG decoders legitimately differ by a few counts (IDCT and chroma
+    upsampling variants are all spec-conformant); libjpeg agreement within
+    mean<1 / max<8 on these fixtures is far tighter than inter-decoder drift.
+    """
+
+    def _grad_image(self, h, w, rng):
+        y, x = np.mgrid[0:h, 0:w]
+        img = np.stack([x * 255 / w, y * 255 / h,
+                        (x + y) * 127 / (w + h) + rng.standard_normal((h, w)) * 8],
+                       -1)
+        return np.clip(img, 0, 255).astype(np.uint8)
+
+    @pytest.mark.parametrize("w,h,sub,mode,q", [
+        (64, 64, 0, "RGB", 95),    # 4:4:4
+        (128, 128, 1, "RGB", 85),  # 4:2:2
+        (97, 53, 2, "RGB", 90),    # 4:2:0, odd dims (partial edge MCUs)
+        (64, 64, 2, "L", 90),      # grayscale (PIL writes 2x2 factors)
+    ])
+    def test_matches_pil(self, tmp_path, w, h, sub, mode, q):
+        from PIL import Image
+
+        from tnn_tpu.native import api
+
+        rng = np.random.default_rng(0)
+        img = self._grad_image(h, w, rng)
+        pim = Image.fromarray(img if mode == "RGB" else img[:, :, 0], mode)
+        p = str(tmp_path / "t.jpg")
+        pim.save(p, "JPEG", quality=q, subsampling=sub)
+        ref = np.asarray(Image.open(p).convert("RGB"), np.uint8)
+        out, ok = api.decode_image_batch([p], h, w)
+        assert ok[0]
+        d = np.abs(out[0].astype(int) - ref.astype(int))
+        assert d.mean() < 1.0 and d.max() <= 8, (d.mean(), d.max())
+
+    def test_restart_markers(self, tmp_path):
+        from PIL import Image
+
+        from tnn_tpu.native import api
+
+        rng = np.random.default_rng(1)
+        img = self._grad_image(80, 96, rng)
+        p = str(tmp_path / "r.jpg")
+        Image.fromarray(img).save(p, "JPEG", quality=90, subsampling=2,
+                                  restart_marker_blocks=4)
+        assert b"\xff\xdd" in open(p, "rb").read()  # DRI present
+        ref = np.asarray(Image.open(p).convert("RGB"), np.uint8)
+        out, ok = api.decode_image_batch([p], 80, 96)
+        assert ok[0]
+        d = np.abs(out[0].astype(int) - ref.astype(int))
+        assert d.mean() < 1.0 and d.max() <= 8
+
+    def test_truncated_falls_back(self, tmp_path):
+        from PIL import Image
+
+        from tnn_tpu.native import api
+
+        img = np.zeros((32, 32, 3), np.uint8)
+        p = str(tmp_path / "t.jpg")
+        Image.fromarray(img).save(p, "JPEG")
+        data = open(p, "rb").read()
+        bad = str(tmp_path / "trunc.jpg")
+        with open(bad, "wb") as f:
+            f.write(data[:40])  # headers cut mid-way
+        out, ok = api.decode_image_batch([p, bad], 32, 32)
+        assert ok[0] and not ok[1]
+        assert out[1].sum() == 0
+
+    def test_loader_uses_native_jpeg(self, tmp_path):
+        from PIL import Image
+
+        from tnn_tpu.data.datasets import ImageFolderDataLoader
+
+        rng = np.random.default_rng(2)
+        for c in range(2):
+            d = tmp_path / f"class{c}"
+            d.mkdir()
+            for i in range(3):
+                Image.fromarray(
+                    self._grad_image(24, 24, rng)).save(
+                        str(d / f"{i}.JPEG"), "JPEG", quality=92)
+        fast = ImageFolderDataLoader(str(tmp_path), image_size=(24, 24))
+        assert fast._native_img
+        a, la = fast.get_batch(6)
+        order = fast._order if fast._order is not None else np.arange(6)
+        for j in range(6):
+            path = fast._items[int(order[j])][1]
+            ref = np.asarray(Image.open(path).convert("RGB"), np.uint8)
+            got = (a[j] * 255.0 + 0.5).astype(np.uint8)
+            d = np.abs(got.astype(int) - ref.astype(int))
+            assert d.mean() < 1.5, d.mean()

@@ -138,6 +138,7 @@ class CIFAR100DataLoader(ArrayDataLoader):
 
 
 _IMG_EXTS = (".png", ".jpg", ".jpeg", ".bmp")
+_NATIVE_IMG_EXTS = (".png", ".jpg", ".jpeg")  # native decoders (image.cpp, jpeg.cpp)
 
 
 class ImageFolderDataLoader(DataLoader):
@@ -169,7 +170,7 @@ class ImageFolderDataLoader(DataLoader):
 
         # native from-spec PNG decoder (zlib + threaded bilinear resize,
         # native/src/image.cpp); per-image PIL fallback covers everything else
-        self._native_png = _native.available() and resample == "bilinear"
+        self._native_img = _native.available() and resample == "bilinear"
         # user-pinned class order is preserved (it fixes the label mapping);
         # discovered classes are sorted for determinism
         if class_names is not None:
@@ -214,19 +215,20 @@ class ImageFolderDataLoader(DataLoader):
     def _decode(self, i: int) -> np.ndarray:
         """One sample as uint8 HWC at image_size.
 
-        PNGs decode natively whenever the native path is on — including
-        batches of one and eager preloading — so a file's pixels never depend
-        on which batch it lands in (native and PIL resize differ slightly)."""
+        PNGs and JPEGs decode natively whenever the native path is on —
+        including batches of one and eager preloading — so a file's pixels
+        never depend on which batch it lands in (native and PIL resize and
+        chroma upsampling differ slightly)."""
         kind, payload = self._items[i]
-        if kind == "img" and self._native_png \
-                and payload.lower().endswith(".png"):
+        if kind == "img" and self._native_img \
+                and payload.lower().endswith(_NATIVE_IMG_EXTS):
             from ..native import api as _api
 
-            out, ok = _api.decode_png_batch([payload], *self.image_size)
+            out, ok = _api.decode_image_batch([payload], *self.image_size)
             if ok[0]:
                 return out[0]
-            # unsupported variant (interlaced, 16-bit): deterministic per-file
-            # PIL fallback
+            # unsupported variant (interlaced/16-bit PNG, progressive JPEG):
+            # deterministic per-file PIL fallback
         if kind == "npy":
             path, row = payload
             if path not in self._npy_cache:
@@ -260,18 +262,18 @@ class ImageFolderDataLoader(DataLoader):
         else:
             idx = [int(i) for i in indices]
             slots: list = [None] * len(idx)
-            if self._native_png:
-                png_pos = [j for j, i in enumerate(idx)
+            if self._native_img:
+                nat_pos = [j for j, i in enumerate(idx)
                            if self._items[i][0] == "img"
-                           and self._items[i][1].lower().endswith(".png")]
-                if png_pos:
+                           and self._items[i][1].lower().endswith(_NATIVE_IMG_EXTS)]
+                if nat_pos:
                     from ..native import api as _api
 
-                    out, ok = _api.decode_png_batch(
-                        [self._items[idx[j]][1] for j in png_pos],
+                    out, ok = _api.decode_image_batch(
+                        [self._items[idx[j]][1] for j in nat_pos],
                         *self.image_size)
-                    for j, frame, good in zip(png_pos, out, ok):
-                        if good:  # unsupported PNG variants fall back to PIL
+                    for j, frame, good in zip(nat_pos, out, ok):
+                        if good:  # unsupported variants fall back to PIL
                             slots[j] = frame
             rest = [j for j in range(len(idx)) if slots[j] is None]
             pool = self._decode_pool()

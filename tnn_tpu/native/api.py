@@ -20,24 +20,28 @@ def _ptr(arr: np.ndarray, ctype):
     return arr.ctypes.data_as(_c.POINTER(ctype))
 
 
-def decode_png_batch(paths, out_h: int, out_w: int
-                     ) -> Tuple[np.ndarray, np.ndarray]:
-    """Decode + bilinear-resize a batch of PNG files across threads.
+def decode_image_batch(paths, out_h: int, out_w: int
+                       ) -> Tuple[np.ndarray, np.ndarray]:
+    """Decode + bilinear-resize a batch of image files across threads.
 
+    PNG (from-spec decoder over the system zlib) and baseline JPEG
+    (from-spec decoder, native/src/jpeg.cpp) dispatch on magic bytes.
     Returns (batch u8 [N, out_h, out_w, 3], ok bool [N]); failed entries
-    (non-PNG, interlaced, >8-bit) are zeroed with ok=False so the caller can
-    fall back per image. Parity: the reference's threaded stb_image decode
-    (src/data_loading/stb_image_impl.cpp) — here a from-spec PNG decoder over
-    the system zlib (native/src/image.cpp).
+    (progressive JPEG, interlaced/16-bit PNG, other formats) are zeroed with
+    ok=False so the caller can fall back per image. Parity: the reference's
+    threaded stb_image decode (src/data_loading/stb_image_impl.cpp).
     """
     lib = get_lib()
     n = len(paths)
     out = np.empty((n, out_h, out_w, 3), np.uint8)
     ok = np.zeros(n, np.uint8)
     arr = (_c.c_char_p * n)(*[p.encode() for p in paths])
-    lib.tnn_decode_png_batch(arr, n, int(out_h), int(out_w),
-                             _ptr(out, _c.c_uint8), _ptr(ok, _c.c_uint8))
+    lib.tnn_decode_image_batch(arr, n, int(out_h), int(out_w),
+                               _ptr(out, _c.c_uint8), _ptr(ok, _c.c_uint8))
     return out, ok.astype(bool)
+
+
+decode_png_batch = decode_image_batch  # back-compat name
 
 
 # -- parsers -----------------------------------------------------------------

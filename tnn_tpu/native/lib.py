@@ -95,6 +95,11 @@ def _configure(lib: ctypes.CDLL) -> None:
     lib.tnn_decode_png_batch.restype = i64
     lib.tnn_decode_png_batch.argtypes = [p(c.c_char_p), i64, c.c_int, c.c_int,
                                          p(u8), p(u8)]
+    # unified PNG+JPEG entry (declared here so a stale .so without the symbol
+    # raises AttributeError and triggers get_lib()'s force-rebuild path)
+    lib.tnn_decode_image_batch.restype = i64
+    lib.tnn_decode_image_batch.argtypes = [p(c.c_char_p), i64, c.c_int,
+                                           c.c_int, p(u8), p(u8)]
 
 
 def get_lib() -> Optional[ctypes.CDLL]:
