@@ -222,9 +222,40 @@ def main():
         if attempt < RUN_ATTEMPTS:
             time.sleep(RETRY_WAIT_S)
 
-    print(json.dumps({"metric": METRIC, "error": str(last_err)[:500],
-                      "backend": backend}))
+    out = {"metric": METRIC, "error": str(last_err)[:500], "backend": backend}
+    last = _last_committed()
+    if last is not None:
+        # the relay being down at gate time must not erase the evidence trail:
+        # point at the most recent persisted successful run (clearly labeled
+        # as such, value NOT surfaced in the "value" field)
+        out["last_committed"] = last
+    print(json.dumps(out))
     return 1
+
+
+def _last_committed():
+    """Newest persisted successful TPU result under benchmarks/results/, as
+    {"value", "unix_time", "file"} — evidence pointer for a down-relay gate."""
+    try:
+        d = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         "benchmarks", "results")
+        names = sorted(os.listdir(d), reverse=True)
+    except OSError:
+        return None
+    for name in names:
+        if not (name.startswith("bench_") and name.endswith(".json")):
+            continue
+        try:  # per-file: one truncated write must not erase the whole trail
+            with open(os.path.join(d, name)) as f:
+                data = json.load(f)
+        except (OSError, ValueError):
+            continue
+        # a persisted CPU-fallback run is labeled; never surface it as chip perf
+        if data.get("metric") == METRIC and "value" in data \
+                and data.get("backend") != "cpu":
+            return {"value": data["value"], "unix_time": data.get("unix_time"),
+                    "file": f"benchmarks/results/{name}"}
+    return None
 
 
 def _persist(result):
