@@ -3,12 +3,14 @@
 // Reference capability being matched (not ported): the reference decodes its
 // image-folder datasets (TinyImageNet/ImageNet100 are JFIF files) in C++ via
 // vendored stb_image (src/data_loading/stb_image_impl.cpp). This is an
-// independent from-spec implementation: baseline sequential DCT (SOF0/SOF1),
-// Huffman entropy coding with a fast 9-bit prefix table, restart markers,
-// 8-bit precision, 1- or 3-component scans with sampling factors 1 or 2
-// (4:4:4 / 4:2:2 / 4:4:0 / 4:2:0). Progressive (SOF2), arithmetic coding,
-// 12-bit precision and CMYK report failure and the Python caller falls back
-// to PIL per image — same contract as the PNG path in image.cpp.
+// independent from-spec implementation: baseline sequential DCT (SOF0/SOF1)
+// AND progressive DCT (SOF2, T.81 Annex G — spectral selection + successive
+// approximation with EOB runs), Huffman entropy coding with a fast 9-bit
+// prefix table, restart markers, 8-bit precision, 1- or 3-component scans
+// with sampling factors 1 or 2 (4:4:4 / 4:2:2 / 4:4:0 / 4:2:0). Arithmetic
+// coding, lossless/hierarchical modes, 12-bit precision and CMYK report
+// failure and the Python caller falls back to PIL per image — same contract
+// as the PNG path in image.cpp.
 //
 // Chroma is upsampled with the triangle (bilinear) filter so output stays
 // close to libjpeg's default "fancy upsampling" that PIL uses (measured
@@ -210,6 +212,10 @@ struct Component {
   int pred = 0;
   int pw = 0, ph = 0;  // plane dims (MCU-padded)
   std::vector<u8> plane;
+  // progressive: quantized coefficients accumulate across scans, IDCT at EOI
+  int bw = 0, bh = 0;      // block grid, MCU-padded (interleaved DC scans)
+  int bw_n = 0, bh_n = 0;  // non-interleaved grid = ceil(comp dims / 8)
+  std::vector<int16_t> coefs;  // bw * bh * 64, natural order within a block
 };
 
 struct Decoder {
@@ -218,6 +224,7 @@ struct Decoder {
   size_t off = 2;  // past SOI
   int W = 0, H = 0;
   int ncomp = 0, hmax = 1, vmax = 1, dri = 0;
+  bool progressive = false, saw_scan = false;
   u16 qt[4][64];  // natural order
   bool qt_present[4] = {};
   HuffTable dc[4], ac[4];
@@ -229,12 +236,29 @@ struct Decoder {
     return true;
   }
 
-  bool parse_headers(size_t& scan_off) {
+  void alloc_grids() {
+    int mcux = (W + 8 * hmax - 1) / (8 * hmax);
+    int mcuy = (H + 8 * vmax - 1) / (8 * vmax);
+    for (int c = 0; c < ncomp; ++c) {
+      Component& co = comp[c];
+      co.bw = mcux * co.h;
+      co.bh = mcuy * co.v;
+      co.bw_n = ((W * co.h + hmax - 1) / hmax + 7) / 8;
+      co.bh_n = ((H * co.v + vmax - 1) / vmax + 7) / 8;
+      if (progressive)
+        co.coefs.assign(size_t(co.bw) * co.bh * 64, 0);
+    }
+  }
+
+  // Driver: parse markers, decode scans; on success planes hold pixels.
+  bool decode() {
     while (off + 3 < len) {
       if (buf[off] != 0xFF) return false;
       u8 m = buf[off + 1];
       off += 2;
       if (m == 0xD8 || (m >= 0xD0 && m <= 0xD7) || m == 0x01) continue;
+      if (m == 0xD9)  // EOI is length-less: handle before any seglen read
+        return progressive && saw_scan && finish_progressive();
       int seglen;
       if (!u16_at(off, seglen) || seglen < 2 || off + seglen > len) return false;
       const u8* d = buf + off + 2;
@@ -265,8 +289,9 @@ struct Decoder {
           (tc ? ac : dc)[th].build(counts, d + i + 17, total);
           i += 17 + total;
         }
-      } else if (m == 0xC0 || m == 0xC1) {  // SOF0/1 baseline
+      } else if (m == 0xC0 || m == 0xC1 || m == 0xC2) {  // SOF0/1/2
         if (dlen < 6 || d[0] != 8) return false;
+        progressive = (m == 0xC2);
         H = (d[1] << 8) | d[2];
         W = (d[3] << 8) | d[4];
         ncomp = d[5];
@@ -284,21 +309,22 @@ struct Decoder {
           vmax = std::max(vmax, comp[c].v);
         }
         if (ncomp == 1) {
-          // A single-component scan is non-interleaved: the MCU is one 8x8
+          // A single-component image is non-interleaved: the MCU is one 8x8
           // block and the declared sampling factors do not subdivide it
           // (T.81 A.2.2; PIL writes 2x2 factors for grayscale)
           comp[0].h = comp[0].v = hmax = vmax = 1;
         }
-      } else if (m == 0xC2 || (m >= 0xC5 && m <= 0xCF && m != 0xC8)) {
-        return false;  // progressive/extended/arithmetic: PIL fallback
+        alloc_grids();
+      } else if (m >= 0xC3 && m <= 0xCF && m != 0xC4 && m != 0xC8) {
+        return false;  // lossless/extended/arithmetic: PIL fallback
       } else if (m == 0xDD) {  // DRI
         if (dlen < 2) return false;
         dri = (d[0] << 8) | d[1];
       } else if (m == 0xDA) {  // SOS
         if (ncomp == 0 || dlen < 1) return false;
-        if (dlen < 1 + 2 * d[0] + 3) return false;
         int ns = d[0];
-        if (ns != ncomp) return false;  // single interleaved scan only
+        if (ns < 1 || ns > ncomp || dlen < 1 + 2 * ns + 3) return false;
+        int sel[3] = {0, 0, 0};
         for (int s = 0; s < ns; ++s) {
           int cid = d[1 + 2 * s], tabs = d[2 + 2 * s];
           bool found = false;
@@ -306,18 +332,27 @@ struct Decoder {
             if (comp[c].id == cid) {
               comp[c].dc_tab = tabs >> 4;
               comp[c].ac_tab = tabs & 15;
+              sel[s] = c;
               found = true;
             }
           if (!found) return false;
         }
-        scan_off = off + seglen;
-        return true;
-      } else if (m == 0xD9) {
-        return false;  // EOI before SOS
+        if (!progressive) {
+          if (ns != ncomp) return false;  // baseline: one interleaved scan
+          return decode_scan(off + seglen);
+        }
+        int ss = d[1 + 2 * ns], se = d[2 + 2 * ns];
+        int ah = d[3 + 2 * ns] >> 4, al = d[3 + 2 * ns] & 15;
+        size_t next = decode_progressive_scan(off + seglen, sel, ns, ss, se,
+                                              ah, al);
+        if (!next) return false;
+        off = next;
+        continue;  // resume the marker loop at the scan's terminating marker
       }  // APPn/COM/others: skip
       off += seglen;
     }
-    return false;
+    // progressive stream missing an explicit EOI: finish with what we have
+    return progressive && saw_scan && finish_progressive();
   }
 
   // Returns the highest zigzag index written (0 = DC-only), or -1 on error.
@@ -387,6 +422,207 @@ struct Decoder {
         }
         if (until_restart > 0) --until_restart;
       }
+    }
+    return true;
+  }
+
+  // -- progressive (T.81 Annex G): scans accumulate quantized coefficients --
+
+  bool correction_bit(BitReader& br, int16_t& coef, int p1) {
+    // refine a known-nonzero coefficient by one appended magnitude bit
+    if (br.receive(1) && (coef & p1) == 0)
+      coef += (coef >= 0) ? int16_t(p1) : int16_t(-p1);
+    return true;
+  }
+
+  bool prog_dc_block(BitReader& br, Component& co, int16_t* blk, int ah,
+                     int al) {
+    if (ah == 0) {  // first DC scan
+      const HuffTable& t = dc[co.dc_tab];
+      if (!t.present) return false;
+      int s = decode_huff(br, t);
+      if (s < 0 || s > 15) return false;
+      co.pred += extend(br.receive(s), s);
+      blk[0] = int16_t(co.pred << al);
+    } else {  // refinement: one appended bit
+      if (br.receive(1)) blk[0] = int16_t(blk[0] | (1 << al));
+    }
+    return true;
+  }
+
+  bool prog_ac_first(BitReader& br, Component& co, int16_t* blk, int ss,
+                     int se, int al, int& eobrun) {
+    if (eobrun > 0) {
+      --eobrun;
+      return true;
+    }
+    const HuffTable& t = ac[co.ac_tab];
+    if (!t.present) return false;
+    for (int k = ss; k <= se;) {
+      int rs = decode_huff(br, t);
+      if (rs < 0) return false;
+      int r = rs >> 4, s = rs & 15;
+      if (s == 0) {
+        if (r < 15) {
+          eobrun = (1 << r) - 1;
+          if (r) eobrun += br.receive(r);
+          break;
+        }
+        k += 16;  // ZRL
+        continue;
+      }
+      k += r;
+      if (k > se) return false;
+      blk[kZigzag[k]] = int16_t(extend(br.receive(s), s) * (1 << al));
+      ++k;
+    }
+    return true;
+  }
+
+  bool prog_ac_refine(BitReader& br, Component& co, int16_t* blk, int ss,
+                      int se, int al, int& eobrun) {
+    const HuffTable& t = ac[co.ac_tab];
+    if (!t.present) return false;
+    int p1 = 1 << al;
+    int k = ss;
+    if (eobrun == 0) {
+      while (k <= se) {
+        int rs = decode_huff(br, t);
+        if (rs < 0) return false;
+        int r = rs >> 4, s = rs & 15;
+        int16_t newval = 0;
+        if (s == 0) {
+          if (r < 15) {
+            // the run INCLUDES this block: the correction tail below handles
+            // its remainder and decrements, leaving (1<<r)+bits-1 full blocks
+            eobrun = 1 << r;
+            if (r) eobrun += br.receive(r);
+            break;
+          }
+          // r == 15: skip 16 zero-history coefficients
+        } else {
+          if (s != 1) return false;  // refinement writes single bits only
+          newval = br.receive(1) ? int16_t(p1) : int16_t(-p1);
+        }
+        // advance past r zero-history coefficients, emitting correction bits
+        // for every nonzero-history coefficient crossed (G.1.2.3)
+        while (k <= se) {
+          int16_t& coef = blk[kZigzag[k]];
+          if (coef != 0) {
+            correction_bit(br, coef, p1);
+          } else {
+            if (r == 0) {
+              if (newval) coef = newval;
+              ++k;
+              break;
+            }
+            --r;
+          }
+          ++k;
+        }
+      }
+    }
+    if (eobrun > 0) {
+      while (k <= se) {  // EOB run still corrects known-nonzero coefficients
+        int16_t& coef = blk[kZigzag[k]];
+        if (coef != 0) correction_bit(br, coef, p1);
+        ++k;
+      }
+      --eobrun;
+    }
+    return true;
+  }
+
+  // Decode one progressive scan; returns the byte offset of the terminating
+  // marker (0 on failure) so the marker loop resumes there.
+  size_t decode_progressive_scan(size_t scan_off, const int* sel, int ns,
+                                 int ss, int se, int ah, int al) {
+    if (ss > se || se > 63 || al > 13) return 0;
+    if (ss == 0 && se != 0) return 0;   // DC and AC never share a scan
+    if (ss > 0 && ns != 1) return 0;    // AC scans are single-component
+    saw_scan = true;
+    BitReader br(buf + scan_off, buf + len);
+    int eobrun = 0;
+    for (int s = 0; s < ns; ++s) comp[sel[s]].pred = 0;
+    int until_restart = dri ? dri : -1;
+
+    auto restart_if_due = [&]() {
+      if (until_restart != 0) return true;
+      if (!br.restart()) return false;
+      for (int s = 0; s < ns; ++s) comp[sel[s]].pred = 0;
+      eobrun = 0;
+      until_restart = dri;
+      return true;
+    };
+
+    if (ss == 0 && ns > 1) {  // interleaved DC scan over MCUs
+      int mcux = (W + 8 * hmax - 1) / (8 * hmax);
+      int mcuy = (H + 8 * vmax - 1) / (8 * vmax);
+      for (int my = 0; my < mcuy; ++my)
+        for (int mx = 0; mx < mcux; ++mx) {
+          if (!restart_if_due()) return 0;
+          for (int s = 0; s < ns; ++s) {
+            Component& co = comp[sel[s]];
+            for (int by = 0; by < co.v; ++by)
+              for (int bx = 0; bx < co.h; ++bx) {
+                int16_t* blk = co.coefs.data() +
+                    (size_t(my * co.v + by) * co.bw + mx * co.h + bx) * 64;
+                if (!prog_dc_block(br, co, blk, ah, al)) return 0;
+              }
+          }
+          if (until_restart > 0) --until_restart;
+        }
+    } else {  // non-interleaved: one component, its own block grid
+      Component& co = comp[sel[0]];
+      for (int by = 0; by < co.bh_n; ++by)
+        for (int bx = 0; bx < co.bw_n; ++bx) {
+          if (!restart_if_due()) return 0;
+          int16_t* blk = co.coefs.data() + (size_t(by) * co.bw + bx) * 64;
+          bool ok;
+          if (ss == 0)
+            ok = prog_dc_block(br, co, blk, ah, al);
+          else if (ah == 0)
+            ok = prog_ac_first(br, co, blk, ss, se, al, eobrun);
+          else
+            ok = prog_ac_refine(br, co, blk, ss, se, al, eobrun);
+          if (!ok) return 0;
+          if (until_restart > 0) --until_restart;
+        }
+    }
+    // resume at the marker the bit reader stopped at (or end of data)
+    size_t pos = br.p - buf;
+    // a scan may end mid-byte before the marker; br.p already points at the
+    // 0xFF of the next marker when one was hit. If not (ran to end), bail to
+    // the end so the driver's final fallback fires.
+    return pos >= 2 ? pos : 0;
+  }
+
+  bool finish_progressive() {
+    int mcux = (W + 8 * hmax - 1) / (8 * hmax);
+    int mcuy = (H + 8 * vmax - 1) / (8 * vmax);
+    float block[64];
+    for (int c = 0; c < ncomp; ++c) {
+      Component& co = comp[c];
+      if (!qt_present[co.tq]) return false;
+      const u16* q = qt[co.tq];
+      co.pw = mcux * co.h * 8;
+      co.ph = mcuy * co.v * 8;
+      co.plane.assign(size_t(co.pw) * co.ph, 0);
+      for (int by = 0; by < co.bh; ++by)
+        for (int bx = 0; bx < co.bw; ++bx) {
+          const int16_t* blk = co.coefs.data() + (size_t(by) * co.bw + bx) * 64;
+          int nz = 0;
+          for (int k = 0; k < 64; ++k) {
+            block[k] = float(blk[k] * q[k]);
+            nz += blk[k] != 0;
+          }
+          u8* dst = co.plane.data() + size_t(by) * 8 * co.pw + bx * 8;
+          if (nz == 0 || (nz == 1 && blk[0] != 0)) {
+            fill_flat(int(block[0]), dst, co.pw);
+          } else {
+            idct8x8(block, dst, co.pw);
+          }
+        }
     }
     return true;
   }
@@ -473,9 +709,7 @@ bool jpeg_decode_rgb(const uint8_t* buf, size_t len, std::vector<uint8_t>& rgb,
   Decoder d;
   d.buf = buf;
   d.len = len;
-  size_t scan_off = 0;
-  if (!d.parse_headers(scan_off)) return false;
-  if (!d.decode_scan(scan_off)) return false;
+  if (!d.decode()) return false;
   d.to_rgb(rgb);
   w = d.W;
   h = d.H;

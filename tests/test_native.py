@@ -342,13 +342,14 @@ class TestNativeJpegDecode:
                        -1)
         return np.clip(img, 0, 255).astype(np.uint8)
 
+    @pytest.mark.parametrize("progressive", [False, True])
     @pytest.mark.parametrize("w,h,sub,mode,q", [
         (64, 64, 0, "RGB", 95),    # 4:4:4
         (128, 128, 1, "RGB", 85),  # 4:2:2
         (97, 53, 2, "RGB", 90),    # 4:2:0, odd dims (partial edge MCUs)
         (64, 64, 2, "L", 90),      # grayscale (PIL writes 2x2 factors)
     ])
-    def test_matches_pil(self, tmp_path, w, h, sub, mode, q):
+    def test_matches_pil(self, tmp_path, w, h, sub, mode, q, progressive):
         from PIL import Image
 
         from tnn_tpu.native import api
@@ -357,14 +358,18 @@ class TestNativeJpegDecode:
         img = self._grad_image(h, w, rng)
         pim = Image.fromarray(img if mode == "RGB" else img[:, :, 0], mode)
         p = str(tmp_path / "t.jpg")
-        pim.save(p, "JPEG", quality=q, subsampling=sub)
+        pim.save(p, "JPEG", quality=q, subsampling=sub,
+                 progressive=progressive)
+        if progressive:  # really SOF2 (T.81 Annex G multi-scan path)
+            assert b"\xff\xc2" in open(p, "rb").read()
         ref = np.asarray(Image.open(p).convert("RGB"), np.uint8)
         out, ok = api.decode_image_batch([p], h, w)
         assert ok[0]
         d = np.abs(out[0].astype(int) - ref.astype(int))
         assert d.mean() < 1.0 and d.max() <= 8, (d.mean(), d.max())
 
-    def test_restart_markers(self, tmp_path):
+    @pytest.mark.parametrize("progressive", [False, True])
+    def test_restart_markers(self, tmp_path, progressive):
         from PIL import Image
 
         from tnn_tpu.native import api
@@ -373,7 +378,8 @@ class TestNativeJpegDecode:
         img = self._grad_image(80, 96, rng)
         p = str(tmp_path / "r.jpg")
         Image.fromarray(img).save(p, "JPEG", quality=90, subsampling=2,
-                                  restart_marker_blocks=4)
+                                  restart_marker_blocks=4,
+                                  progressive=progressive)
         assert b"\xff\xdd" in open(p, "rb").read()  # DRI present
         ref = np.asarray(Image.open(p).convert("RGB"), np.uint8)
         out, ok = api.decode_image_batch([p], 80, 96)

@@ -222,12 +222,17 @@ class TestMeshTrainModel:
         with pytest.raises(ValueError, match="data/fsdp"):
             train_model(model, cfg, loader)
 
-    @pytest.mark.parametrize("method", ["ring", "ulysses"])
-    def test_config_driven_seq_parallel_gpt(self, tmp_path, method):
-        """mesh_axes={'data':2,'seq':4}: the model's attention is retargeted to
-        the configured context-parallel scheme and the train step runs dp x sp
-        from config alone (sequence parallelism is entirely beyond the
-        reference). Both schemes must match the single-device loss."""
+    @pytest.mark.parametrize("axes,method", [
+        ({"data": 2, "seq": 4}, "ring"),
+        ({"data": 2, "seq": 4}, "ulysses"),
+        ({"data": 2, "model": 2, "seq": 2}, "ring"),  # dp x tp x sp compose
+    ])
+    def test_config_driven_seq_parallel_gpt(self, tmp_path, axes, method):
+        """mesh_axes with a seq axis: the model's attention is retargeted to
+        the configured context-parallel scheme and the train step runs
+        dp x sp — and dp x tp x sp in ONE step (the reference offers one
+        parallelism mode per run) — from config alone, matching the
+        single-device loss."""
         import jax
         import jax.numpy as jnp
 
@@ -249,7 +254,7 @@ class TestMeshTrainModel:
         loader = ArrayDataLoader(tokens, labels, seed=0)
         cfg = TrainingConfig(epochs=1, batch_size=batch, shuffle=False,
                              snapshot_dir=str(tmp_path / "sp"),
-                             mesh_axes={"data": 2, "seq": 4},
+                             mesh_axes=axes,
                              seq_parallel_method=method,
                              optimizer={"type": "sgd", "lr": 0.1},
                              progress_print_interval=100)
