@@ -21,9 +21,15 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def _make_image_tree(root: str, classes: int, per_class: int, size: int,
-                     fmt: str) -> str:
-    """Synthetic on-disk dataset: real PNG/JPEG files (true decode cost)."""
+                     fmt: str, content: str = "noise") -> str:
+    """Synthetic on-disk dataset: real PNG/JPEG files (true decode cost).
+
+    ``content="noise"`` is the worst case for JPEG entropy decoding (every AC
+    coefficient survives quantization — no real dataset looks like this);
+    "photo" builds smooth structured images whose coefficient statistics are
+    closer to actual photographs."""
     rng = np.random.default_rng(0)
+    yy, xx = np.mgrid[0:size, 0:size]
     for c in range(classes):
         cdir = os.path.join(root, f"class{c:03d}")
         os.makedirs(cdir, exist_ok=True)
@@ -34,18 +40,32 @@ def _make_image_tree(root: str, classes: int, per_class: int, size: int,
             from PIL import Image
 
             for i in range(per_class):
-                arr = rng.integers(0, 255, (size, size, 3), np.uint8)
+                if content == "photo":
+                    f1, f2 = rng.uniform(4, 14, 2)
+                    arr = np.clip(np.stack(
+                        [np.sin(xx / f1 + i) * 80 + 120,
+                         np.cos(yy / f2 + c) * 80 + 120,
+                         (xx + yy) * (200.0 / (2 * size))
+                         + rng.standard_normal((size, size)) * 6], -1),
+                        0, 255).astype(np.uint8)
+                else:
+                    arr = rng.integers(0, 255, (size, size, 3), np.uint8)
                 Image.fromarray(arr).save(
                     os.path.join(cdir, f"img{i:04d}.{fmt}"))
     return root
 
 
 def bench_image_loader(fmt: str, workers, batch: int, iters: int,
-                       src_size: int = 96, out_size: int = 64):
+                       src_size: int = 96, out_size: int = 64,
+                       content: str = "noise"):
     from tnn_tpu.data.datasets import ImageFolderDataLoader
 
-    tmp = tempfile.mkdtemp(prefix=f"tnn_imgs_{fmt}_")
-    _make_image_tree(tmp, classes=4, per_class=64, size=src_size, fmt=fmt)
+    # label carries the content variant so noise/photo rows never mix in
+    # regression.csv
+    label = fmt if content == "noise" else f"{fmt}_{content}"
+    tmp = tempfile.mkdtemp(prefix=f"tnn_imgs_{label}_")
+    _make_image_tree(tmp, classes=4, per_class=64, size=src_size, fmt=fmt,
+                     content=content)
     results = []
     for nw in workers:
         loader = ImageFolderDataLoader(tmp, image_size=(out_size, out_size),
@@ -61,11 +81,11 @@ def bench_image_loader(fmt: str, workers, batch: int, iters: int,
             n += len(got[1])
         dt = time.perf_counter() - t0
         img_s = n / dt
-        results.append({"bench": f"image_decode_{fmt}", "workers": nw,
+        results.append({"bench": f"image_decode_{label}", "workers": nw,
                         "img_per_s": round(img_s, 1),
                         "ms_per_batch": round(dt / iters * 1e3, 2),
                         "host_cpus": os.cpu_count()})
-        print(f"  {fmt} decode x{nw} workers: {img_s:,.0f} img/s "
+        print(f"  {label} decode x{nw} workers: {img_s:,.0f} img/s "
               f"({dt / iters * 1e3:.1f} ms / batch of {batch})")
     return results
 
@@ -104,6 +124,7 @@ def main(argv=None):
     results = []
     results += bench_image_loader("png", workers, batch, iters)
     results += bench_image_loader("jpg", workers, batch, iters)
+    results += bench_image_loader("jpg", workers, batch, iters, content="photo")
     results += bench_image_loader("npy", workers, batch, iters)
     results += bench_token_stream(8, 1024, 8 if args.quick else 50)
     return results

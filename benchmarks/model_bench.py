@@ -135,7 +135,7 @@ def bench_gpt2_decode(batch: int, prompt: int, new: int, size="small"):
 def main(argv=None):
     ap = argparse.ArgumentParser()
     ap.add_argument("--quick", action="store_true")
-    ap.add_argument("--models", default="wrn,resnet9,gpt2,gpt2_flash,decode")
+    ap.add_argument("--models", default="wrn,resnet9,vit,gpt2,gpt2_flash,decode")
     args = ap.parse_args(argv)
     q = args.quick
     wanted = set(args.models.split(","))
@@ -149,6 +149,11 @@ def main(argv=None):
         results.append(bench_train(
             "cifar100_wrn16_8", (32, 32, 3), 100, 64 if q else 256,
             5 if q else 50, flops_per_sample=2.4e9, label="wrn16_8_cifar100"))
+    if "vit" in wanted:
+        # 10.8M params x 65 tokens => ~1.4 GFLOP fwd per 64x64 sample
+        results.append(bench_train(
+            "tiny_imagenet_vit", (64, 64, 3), 200, 32 if q else 256,
+            5 if q else 30, flops_per_sample=1.4e9, label="vit_tiny_imagenet"))
     if "gpt2" in wanted:
         results.append(bench_gpt2_train(2 if q else 8, 128 if q else 512,
                                         3 if q else 10))
