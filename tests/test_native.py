@@ -426,3 +426,44 @@ class TestNativeJpegDecode:
             got = (a[j] * 255.0 + 0.5).astype(np.uint8)
             d = np.abs(got.astype(int) - ref.astype(int))
             assert d.mean() < 1.5, d.mean()
+
+
+    def test_corrupt_input_fuzz_no_crash(self, tmp_path):
+        """Truncations, byte flips, and garbage tails of valid baseline and
+        progressive files must decode-or-fallback, never crash (verified
+        under ASan/UBSan with 240 cases; this keeps a deterministic slice in
+        the suite)."""
+        from PIL import Image
+
+        from tnn_tpu.native import api
+
+        rng = np.random.default_rng(5)
+        img = self._grad_image(40, 48, rng)
+        paths = []
+        for prog in (False, True):
+            base = str(tmp_path / f"s{prog}.jpg")
+            Image.fromarray(img).save(base, "JPEG", quality=85, subsampling=2,
+                                      progressive=prog)
+            data = open(base, "rb").read()
+            for i in range(12):
+                d = bytearray(data)
+                mode = i % 3
+                if mode == 0:
+                    d = d[:int(rng.integers(2, len(d)))]
+                elif mode == 1:
+                    for _ in range(4):
+                        d[int(rng.integers(len(d)))] = int(rng.integers(256))
+                else:
+                    d = d[:int(rng.integers(2, len(d)))] + bytes(
+                        rng.integers(0, 256, 30, dtype=np.uint8).tolist())
+                pth = str(tmp_path / f"f{prog}_{i}.jpg")
+                open(pth, "wb").write(bytes(d))
+                paths.append(pth)
+        out, ok = api.decode_image_batch(paths, 40, 48)  # must not crash
+        assert out.shape == (len(paths), 40, 48, 3)
+        # corruption this heavy must make SOME decodes fail (else the decoder
+        # is accepting garbage and the fallback contract goes untested)
+        assert not ok.all()
+        for frame, good in zip(out, ok):
+            if not good:
+                assert frame.sum() == 0  # failed slots zeroed for PIL fallback
