@@ -62,10 +62,14 @@ def main(argv=None):
     ap.add_argument("--num-classes", type=int, default=10,
                     help="classes for synthetic data")
     ap.add_argument("--mesh", default=None,
-                    help="parallel layout, e.g. data=2,pipe=4 or data=2,model=2 "
-                         "(axes: data fsdp model pipe)")
+                    help="parallel layout, e.g. data=2,pipe=4 or "
+                         "data=2,model=2,seq=2 "
+                         "(axes: data fsdp model pipe seq expert)")
     ap.add_argument("--num-microbatches", type=int, default=None,
                     help="pipeline microbatches per step (with --mesh pipe=N)")
+    ap.add_argument("--seq-parallel-method", default=None,
+                    choices=["ring", "ulysses"],
+                    help="context-parallel scheme for --mesh seq=N")
     args = ap.parse_args(argv)
 
     load_env_file()  # .env, as in the reference
@@ -87,6 +91,8 @@ def main(argv=None):
                          (kv.split("=") for kv in args.mesh.split(",") if kv)}
     if args.num_microbatches is not None:
         cfg.num_microbatches = args.num_microbatches
+    if args.seq_parallel_method is not None:
+        cfg.seq_parallel_method = args.seq_parallel_method
 
     model = models.create(cfg.model_name)
     train_loader, val_loader = build_loaders(cfg, args.num_classes)
