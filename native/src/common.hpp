@@ -46,8 +46,10 @@ void parallel_for(int64_t n, F&& body, int64_t grain = 1024) {
   for (auto& th : pool) th.join();
 }
 
-// From-spec baseline JPEG decoder (native/src/jpeg.cpp). Returns false on any
-// unsupported variant (progressive, 12-bit, CMYK) — caller falls back to PIL.
+// From-spec baseline+progressive JPEG decoder (native/src/jpeg.cpp). Returns
+// false on any unsupported variant (12-bit, CMYK, arithmetic-coded,
+// lossless/hierarchical, subsampled-luma, oversized) — caller falls back to
+// PIL.
 bool jpeg_decode_rgb(const uint8_t* buf, size_t len, std::vector<uint8_t>& rgb,
                      int& w, int& h);
 

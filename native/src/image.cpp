@@ -210,11 +210,19 @@ TNN_API int64_t tnn_decode_image_batch(const char* const* paths, int64_t n,
           Img img;
           bool decoded = false;
           if (read_ok && buf.size() >= 2) {
-            if (buf[0] == 0xFF && buf[1] == 0xD8) {
-              decoded = tnn::jpeg_decode_rgb(buf.data(), buf.size(), img.rgb,
-                                             img.w, img.h);
-            } else {
-              decoded = decode_png(buf.data(), buf.size(), img);
+            // Never let an exception (e.g. bad_alloc on a corrupt header's
+            // huge declared dims) escape a worker thread — that would
+            // std::terminate the process instead of honoring the
+            // decode-or-fallback contract.
+            try {
+              if (buf[0] == 0xFF && buf[1] == 0xD8) {
+                decoded = tnn::jpeg_decode_rgb(buf.data(), buf.size(), img.rgb,
+                                               img.w, img.h);
+              } else {
+                decoded = decode_png(buf.data(), buf.size(), img);
+              }
+            } catch (...) {
+              decoded = false;
             }
           }
           if (!decoded) {

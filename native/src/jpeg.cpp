@@ -308,6 +308,17 @@ struct Decoder {
           hmax = std::max(hmax, comp[c].h);
           vmax = std::max(vmax, comp[c].v);
         }
+        // to_rgb/upsample_plane treat the luma plane as full-resolution
+        // (W x H); spec-legal files with subsampled luma (Y at 1x1, chroma
+        // at 2x2) would make that an out-of-bounds read, so fall back to PIL
+        // for them (they are vanishingly rare in practice).
+        if (ncomp == 3 && (comp[0].h != hmax || comp[0].v != vmax))
+          return false;
+        // Bound decoder memory: a corrupt SOF can declare up to 65535x65535
+        // which would drive multi-GB plane/coefficient allocations.  64M
+        // pixels (e.g. 8192x8192) is far above any training image; beyond
+        // that, fall back to PIL rather than risk OOM on a worker thread.
+        if (size_t(W) * size_t(H) > (size_t(1) << 26)) return false;
         if (ncomp == 1) {
           // A single-component image is non-interleaved: the MCU is one 8x8
           // block and the declared sampling factors do not subdivide it

@@ -467,3 +467,62 @@ class TestNativeJpegDecode:
         for frame, good in zip(out, ok):
             if not good:
                 assert frame.sum() == 0  # failed slots zeroed for PIL fallback
+
+    @staticmethod
+    def _patch_sof(data, patch):
+        """Return data with `patch(payload bytearray)` applied to the first
+        SOF0/SOF2 payload (payload starts at the precision byte)."""
+        d = bytearray(data)
+        i = 2
+        while i + 4 <= len(d):
+            assert d[i] == 0xFF
+            m, seglen = d[i + 1], (d[i + 2] << 8) | d[i + 3]
+            if m in (0xC0, 0xC2):
+                patch(d, i + 4)
+                return bytes(d)
+            i += 2 + seglen
+        raise AssertionError("no SOF marker found")
+
+    def test_subsampled_luma_falls_back(self, tmp_path):
+        """Y at 1x1 with chroma at 2x2 is spec-legal but the fast decoder's
+        to_rgb assumes a full-resolution luma plane; such files must be
+        rejected (PIL fallback), not OOB-read."""
+        from PIL import Image
+
+        from tnn_tpu.native import api
+
+        img = self._grad_image(32, 32, np.random.default_rng(7))
+        p = str(tmp_path / "s.jpg")
+        Image.fromarray(img).save(p, "JPEG", quality=90, subsampling=0)
+
+        def bump_chroma(d, off):
+            # payload: prec, H(2), W(2), ncomp, then (id, hv, tq) per comp
+            assert d[off + 5] == 3
+            d[off + 7] = 0x11   # Y stays 1x1
+            d[off + 10] = 0x22  # Cb 2x2
+            d[off + 13] = 0x22  # Cr 2x2
+
+        bad = str(tmp_path / "subluma.jpg")
+        open(bad, "wb").write(self._patch_sof(open(p, "rb").read(),
+                                              bump_chroma))
+        out, ok = api.decode_image_batch([bad], 32, 32)
+        assert not ok[0] and out[0].sum() == 0
+
+    def test_oversized_dims_fall_back(self, tmp_path):
+        """A corrupt SOF declaring 65535x65535 must be rejected up front
+        (multi-GB allocations would otherwise abort a worker thread)."""
+        from PIL import Image
+
+        from tnn_tpu.native import api
+
+        img = self._grad_image(16, 16, np.random.default_rng(8))
+        p = str(tmp_path / "o.jpg")
+        Image.fromarray(img).save(p, "JPEG", quality=90)
+
+        def huge_dims(d, off):
+            d[off + 1] = d[off + 2] = d[off + 3] = d[off + 4] = 0xFF
+
+        bad = str(tmp_path / "huge.jpg")
+        open(bad, "wb").write(self._patch_sof(open(p, "rb").read(), huge_dims))
+        out, ok = api.decode_image_batch([bad], 16, 16)
+        assert not ok[0] and out[0].sum() == 0

@@ -227,8 +227,8 @@ class ImageFolderDataLoader(DataLoader):
             out, ok = _api.decode_image_batch([payload], *self.image_size)
             if ok[0]:
                 return out[0]
-            # unsupported variant (interlaced/16-bit PNG, progressive JPEG):
-            # deterministic per-file PIL fallback
+            # unsupported variant (interlaced/16-bit PNG; 12-bit/CMYK/
+            # arithmetic/lossless JPEG): deterministic per-file PIL fallback
         if kind == "npy":
             path, row = payload
             if path not in self._npy_cache:

@@ -24,11 +24,11 @@ def decode_image_batch(paths, out_h: int, out_w: int
                        ) -> Tuple[np.ndarray, np.ndarray]:
     """Decode + bilinear-resize a batch of image files across threads.
 
-    PNG (from-spec decoder over the system zlib) and baseline JPEG
-    (from-spec decoder, native/src/jpeg.cpp) dispatch on magic bytes.
+    PNG (from-spec decoder over the system zlib) and baseline+progressive
+    JPEG (from-spec decoder, native/src/jpeg.cpp) dispatch on magic bytes.
     Returns (batch u8 [N, out_h, out_w, 3], ok bool [N]); failed entries
-    (progressive JPEG, interlaced/16-bit PNG, other formats) are zeroed with
-    ok=False so the caller can fall back per image. Parity: the reference's
+    (12-bit/CMYK/arithmetic/lossless JPEG, interlaced/16-bit PNG, other
+    formats) are zeroed with ok=False so the caller can fall back per image. Parity: the reference's
     threaded stb_image decode (src/data_loading/stb_image_impl.cpp).
     """
     lib = get_lib()
