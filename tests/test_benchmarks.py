@@ -1,10 +1,5 @@
 """Benchmark harness smoke tests (quick shapes, CPU-safe): the verification gates
 must pass and each bench must produce a result dict."""
-import os
-import sys
-
-REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-sys.path.insert(0, REPO)
 
 
 def test_ops_bench_quick():
@@ -28,3 +23,63 @@ def test_model_bench_quick():
     assert "gpt2_small_decode" in names
     img = next(r for r in results if r["bench"] == "resnet9_cifar10_train")
     assert img["img_per_s"] > 0 and 0 < img["mfu"] < 2
+
+
+class TestBenchGateRetry:
+    """bench.py is the driver's official perf record; a relay outage must be
+    retried for the whole time budget, not abandoned after one probe (rounds
+    1-3 all shipped rc=1 gate JSONs for outages shorter than the gate window).
+    """
+
+    def _run(self, monkeypatch, capsys, probe_results):
+        import bench
+
+        calls = {"n": 0}
+
+        def fake_probe():
+            r = probe_results[min(calls["n"], len(probe_results) - 1)]
+            calls["n"] += 1
+            return r
+
+        monkeypatch.setattr(bench, "probe_backend", fake_probe)
+        monkeypatch.setattr(bench.time, "sleep", lambda s: None)
+        monkeypatch.setattr(bench, "TOTAL_BUDGET_S", 10_000)
+        rc = bench.main()
+        return rc, calls["n"], capsys.readouterr().out
+
+    def test_transient_probe_failure_retries_to_attempt_cap(
+            self, monkeypatch, capsys):
+        import json
+
+        rc, n_probes, out = self._run(
+            monkeypatch, capsys,
+            [(None, "backend init hung >60s (relay down?)")])
+        assert rc == 1
+        import bench
+        assert n_probes == bench.MAX_ATTEMPTS  # kept trying, not 1-2 probes
+        last = json.loads(out.strip().splitlines()[-1])
+        assert "error" in last and last["metric"] == bench.METRIC
+
+    def test_deterministic_probe_failure_fails_fast(self, monkeypatch, capsys):
+        rc, n_probes, _ = self._run(
+            monkeypatch, capsys,
+            [(None, "ModuleNotFoundError: no module named jax")])
+        assert rc == 1 and n_probes == 1
+
+    def test_budget_exhaustion_stops_retries(self, monkeypatch, capsys):
+        import bench
+
+        t = {"now": 0.0}
+        monkeypatch.setattr(bench.time, "monotonic", lambda: t["now"])
+
+        def fake_probe():
+            t["now"] += 120.0  # each probe burns 2 simulated minutes
+            return None, "backend init hung >60s (relay down?)"
+
+        monkeypatch.setattr(bench, "probe_backend", fake_probe)
+        monkeypatch.setattr(bench.time, "sleep", lambda s: None)
+        rc = bench.main()
+        assert rc == 1
+        # default budget is >=15 min of retrying (VERDICT r03 follow-up)
+        assert bench.TOTAL_BUDGET_S >= 900
+        assert "budget" in capsys.readouterr().out
