@@ -1,0 +1,9 @@
+"""Benchmark harness package (python -m benchmarks.run_all).
+
+Honors TNN_PLATFORM (e.g. =cpu for smoke runs on a box whose default JAX
+platform is the TPU relay) — the package __init__ runs before any bench module
+imports jax, which is what makes the override stick.
+"""
+from tnn_tpu.utils.platform import apply_env_platform
+
+apply_env_platform()

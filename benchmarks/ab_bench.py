@@ -11,20 +11,17 @@ Here three implementations of the SAME training workload are timed:
   torch  — an equivalent torch.nn model on CPU (only when torch importable and
            the JAX platform is CPU — apples stay apples)
 
-    python benchmarks/ab_bench.py [--quick]
+    python -m benchmarks.ab_bench [--quick]
 
 Prints one JSON line per framework with img/s; "vs_*" ratios fill the honesty
 gap the round-2 verdict flagged (no external-framework comparison harness).
 """
 import argparse
 import json
-import os
-import sys
 import time
 
 import numpy as np
 
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def _bench_loop(run_step, iters, sync):

@@ -6,18 +6,16 @@ stb_image decode, src/data_loading/stb_image_impl.cpp); this measures ours —
 threaded PIL/npy decode + bilinear resize — against the per-batch time of the
 train step consuming it, so "loader keeps up" is a measured claim.
 
-    python benchmarks/data_bench.py [--quick] [--workers N]
+    python -m benchmarks.data_bench [--quick] [--workers N]
 """
 import argparse
 import json
 import os
-import sys
 import tempfile
 import time
 
 import numpy as np
 
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def _make_image_tree(root: str, classes: int, per_class: int, size: int,

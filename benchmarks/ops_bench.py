@@ -5,13 +5,10 @@ Parity: the reference's benchmark programs (benchmarks/{gemm,conv2d,dense,
 attention}_benchmark.cpp), each cross-checked against a reference implementation
 before timing (gemm_benchmark.cpp:20-33).
 
-    python benchmarks/ops_bench.py [--quick]
+    python -m benchmarks.ops_bench [--quick]
 """
 import argparse
-import os
-import sys
 
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import jax
 import jax.numpy as jnp

@@ -1,14 +1,12 @@
 #!/usr/bin/env python
 """Run every benchmark; print one JSON line per result plus a summary table.
 
-    python benchmarks/run_all.py [--quick] [--json results.json]
+    python -m benchmarks.run_all [--quick] [--json results.json]
 """
 import argparse
 import json
 import os
-import sys
 
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from benchmarks import ab_bench, data_bench, model_bench, ops_bench  # noqa: E402
 

@@ -1,88 +1,11 @@
 #!/usr/bin/env python
-"""GPT-2 autoregressive generation (parity: examples/gpt2_inference.cpp:19-122).
+"""Thin launcher for `tnn_tpu.cli.gpt2_inference` (kept so the reference's examples/
+directory shape survives; the logic lives in the installable package).
 
-    python examples/gpt2_inference.py --vocab data/vocab.bin \
-        --model-file snapshots/gpt2.tnn --prompt "The meaning of life is" -n 50
-
-Differences from the reference loop: a jit-compiled KV-cache decode (the reference
-recomputes the full sequence per token) and sampling temperature. Without
---model-file it runs a randomly initialized gpt2_small — useful as a smoke test
-and a tokens/sec benchmark of the decode path itself.
+Run `pip install -e .` once, or invoke as `python -m tnn_tpu.cli.gpt2_inference` from
+the repo root. Installed console script: `tnn-gpt2-inference`.
 """
-import argparse
-import os
-import sys
-import time
-
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
-
-from tnn_tpu.utils.platform import apply_env_platform  # noqa: E402
-
-apply_env_platform()  # TNN_PLATFORM=cpu routes around the pinned TPU platform
-
-import jax  # noqa: E402
-import numpy as np  # noqa: E402
-
-from tnn_tpu import checkpoint as ckpt_lib  # noqa: E402
-from tnn_tpu import models  # noqa: E402
-from tnn_tpu.data.tokenizer import Tokenizer  # noqa: E402
-from tnn_tpu.models.gpt2 import generate  # noqa: E402
-
-
-def main(argv=None):
-    ap = argparse.ArgumentParser(description=__doc__)
-    ap.add_argument("--model", default="gpt2_small",
-                    help="zoo name (used when --model-file is absent)")
-    ap.add_argument("--model-file", default="", help=".tnn snapshot")
-    ap.add_argument("--vocab", default="", help="vocab.bin (reference format)")
-    ap.add_argument("--prompt", default="The meaning of life is")
-    ap.add_argument("-n", "--max-new-tokens", type=int, default=50)
-    ap.add_argument("--temperature", type=float, default=0.0)
-    ap.add_argument("--seed", type=int, default=0)
-    args = ap.parse_args(argv)
-
-    tokenizer = None
-    if args.vocab:
-        tokenizer = Tokenizer().load(args.vocab)
-
-    if args.model_file:
-        model, variables = ckpt_lib.load_model(args.model_file)
-        params = variables["params"]
-    else:
-        model = models.create(args.model)
-        print(f"no --model-file: random-weight {args.model} (smoke/benchmark mode)")
-        variables = model.init(jax.random.PRNGKey(args.seed), (1, 8))
-        params = variables["params"]
-
-    if tokenizer is not None:
-        prompt_ids = np.asarray(tokenizer.encode(args.prompt), np.int32)[None]
-    else:
-        print("no --vocab: using byte-level prompt ids")
-        prompt_ids = np.frombuffer(args.prompt.encode(), np.uint8).astype(
-            np.int32)[None] % model.vocab_size
-
-    # generate twice: first call compiles, second measures steady-state decode.
-    # np.asarray forces completion — without it the relay would still be running
-    # the first call when the timer starts.
-    out = generate(model, params, prompt_ids, args.max_new_tokens,
-                   temperature=args.temperature,
-                   rng=jax.random.PRNGKey(args.seed))
-    np.asarray(out)
-    t0 = time.perf_counter()
-    out = generate(model, params, prompt_ids, args.max_new_tokens,
-                   temperature=args.temperature,
-                   rng=jax.random.PRNGKey(args.seed))
-    new_tokens = np.asarray(out)[0]  # generate returns only the new tokens
-    dt = time.perf_counter() - t0
-
-    if tokenizer is not None:
-        full = prompt_ids[0].tolist() + new_tokens.tolist()
-        print("---\n" + tokenizer.decode(full) + "\n---")
-    else:
-        print("generated ids:", new_tokens[:16].tolist(), "...")
-    print(f"{len(new_tokens)} tokens in {dt * 1e3:.0f} ms "
-          f"({len(new_tokens) / dt:.1f} tok/s)")
-
+from tnn_tpu.cli.gpt2_inference import main
 
 if __name__ == "__main__":
     main()

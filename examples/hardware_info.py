@@ -1,68 +1,11 @@
 #!/usr/bin/env python
-"""Print the host + accelerator inventory (parity: the reference's
-hardware_info_example / device_manager_example executables).
+"""Thin launcher for `tnn_tpu.cli.hardware_info` (kept so the reference's examples/
+directory shape survives; the logic lives in the installable package).
 
-    python examples/hardware_info.py [--json]
+Run `pip install -e .` once, or invoke as `python -m tnn_tpu.cli.hardware_info` from
+the repo root. Installed console script: `tnn-hardware-info`.
 """
-import argparse
-import json
-import os
-import sys
-
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
-
-from tnn_tpu.utils.platform import apply_env_platform  # noqa: E402
-
-apply_env_platform()  # TNN_PLATFORM=cpu routes around the pinned TPU platform
-
-from tnn_tpu.utils import affinity  # noqa: E402
-from tnn_tpu.utils.hardware import (cpu_topology, device_info,  # noqa: E402
-                                    hbm_stats, memory_usage_kb)
-
-
-def main(argv=None):
-    ap = argparse.ArgumentParser(description=__doc__)
-    ap.add_argument("--json", action="store_true")
-    args = ap.parse_args(argv)
-
-    info = {
-        "cpu": cpu_topology(),
-        "io_cpu_set": affinity.io_cpu_set(),
-        "process_rss_kb": memory_usage_kb(),
-        "devices": device_info(),
-    }
-    for d in info["devices"]:
-        stats = hbm_stats()
-        if stats:
-            d["hbm"] = stats
-        break  # one probe is enough for the summary
-    if args.json:
-        print(json.dumps(info, indent=2))
-        return info
-    cpu = info["cpu"]
-    print(f"CPU: {cpu.get('model', '?')} — {cpu['logical_cores']} logical"
-          + (f" / {cpu['physical_cores']} physical" if "physical_cores" in cpu
-             else ""))
-    print(f"  P-cores: {cpu['p_cores']}  E-cores: {cpu['e_cores']}  "
-          f"IO cpu set: {info['io_cpu_set']}")
-    for c in cpu.get("caches", []):
-        print(f"  L{c.get('level', '?')} {c.get('type', ''):12s} "
-              f"{c.get('size', '?')}")
-    if "freq_khz" in cpu:
-        f = cpu["freq_khz"]
-        print(f"  freq: {f['min'] / 1e3:.0f}-{f['max'] / 1e3:.0f} MHz")
-    if "mem_total_kb" in cpu:
-        print(f"  RAM: {cpu['mem_total_kb'] / 1048576:.1f} GiB "
-              f"(process RSS {info['process_rss_kb'] / 1024:.0f} MiB)")
-    for d in info["devices"]:
-        line = f"device {d['id']}: {d['platform']} ({d['kind']})"
-        if "hbm" in d:
-            h = d["hbm"]
-            line += (f" — HBM {h.get('bytes_in_use', 0) / 1e9:.2f}"
-                     f"/{h.get('bytes_limit', 0) / 1e9:.1f} GB")
-        print(line)
-    return info
-
+from tnn_tpu.cli.hardware_info import main
 
 if __name__ == "__main__":
     main()

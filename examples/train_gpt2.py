@@ -1,136 +1,11 @@
 #!/usr/bin/env python
-"""Train a GPT-2 language model on a real token stream, end to end.
+"""Thin launcher for `tnn_tpu.cli.train_gpt2` (kept so the reference's examples/
+directory shape survives; the logic lives in the installable package).
 
-Parity-and-beyond: the reference trains its conv models but only INFERS with
-GPT-2 (examples/gpt2_inference.cpp); this drives the full LM training loop —
-mmap token stream -> (B, S) windows -> compiled train step (optionally the
-Pallas flash-attention backend) -> held-out perplexity -> KV-cache sampling.
-
-    python examples/prepare_corpus.py --out data/pytok --source /usr/lib/python3.12
-    python examples/train_gpt2.py --tokens data/pytok --steps 300 --backend xla
-
-Results (loss curve, final train/val perplexity, tok/s) are written as one
-JSON file under --results.
+Run `pip install -e .` once, or invoke as `python -m tnn_tpu.cli.train_gpt2` from
+the repo root. Installed console script: `tnn-train-gpt2`.
 """
-import argparse
-import json
-import os
-import sys
-import time
-
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
-
-from tnn_tpu.utils.platform import apply_env_platform  # noqa: E402
-
-apply_env_platform()  # TNN_PLATFORM=cpu routes around the pinned TPU platform
-
-import jax  # noqa: E402
-import jax.numpy as jnp  # noqa: E402
-import numpy as np  # noqa: E402
-
-from tnn_tpu import nn  # noqa: E402
-from tnn_tpu.data.token_stream import TokenStreamDataLoader  # noqa: E402
-from tnn_tpu.models.gpt2 import GPT2, generate  # noqa: E402
-from tnn_tpu.train import create_train_state, make_train_step  # noqa: E402
-
-
-def main(argv=None):
-    ap = argparse.ArgumentParser(description=__doc__)
-    ap.add_argument("--tokens", required=True,
-                    help="corpus dir from prepare_corpus.py (train.bin/val.bin)")
-    ap.add_argument("--steps", type=int, default=300)
-    ap.add_argument("--batch", type=int, default=16)
-    ap.add_argument("--seq", type=int, default=256)
-    ap.add_argument("--layers", type=int, default=4)
-    ap.add_argument("--d-model", type=int, default=256)
-    ap.add_argument("--heads", type=int, default=8)
-    ap.add_argument("--lr", type=float, default=3e-4)
-    ap.add_argument("--backend", default="xla", choices=["xla", "pallas"],
-                    help="attention backend (pallas = the flash kernel)")
-    ap.add_argument("--sample", type=int, default=128,
-                    help="tokens to sample after training (0 = skip)")
-    ap.add_argument("--results", default="benchmarks/results")
-    args = ap.parse_args(argv)
-
-    meta = json.load(open(os.path.join(args.tokens, "meta.json")))
-    vocab = int(meta["vocab_size"])
-    train_loader = TokenStreamDataLoader(
-        os.path.join(args.tokens, "train.bin"), args.seq)
-    val_path = os.path.join(args.tokens, "val.bin")
-    val_loader = TokenStreamDataLoader(val_path, args.seq) \
-        if os.path.exists(val_path) else None
-    print(f"corpus: {meta['train_tokens']} train tokens, vocab {vocab}")
-
-    model = GPT2(vocab_size=vocab, max_len=args.seq, num_layers=args.layers,
-                 d_model=args.d_model, num_heads=args.heads, dropout=0.0,
-                 backend=args.backend)
-    opt = nn.AdamW(lr=args.lr, weight_decay=0.01, grad_clip_norm=1.0)
-    sched = nn.WarmupCosineAnnealing(warmup=max(10, args.steps // 20),
-                                     t_max=args.steps)
-    state = create_train_state(model, opt, jax.random.PRNGKey(0),
-                               (args.batch, args.seq))
-    step = make_train_step(model, opt, scheduler=sched)
-
-    rng = np.random.default_rng(0)
-    curve = []
-    t0 = time.time()
-    for i in range(args.steps):
-        data, labels = train_loader.random_windows(args.batch, rng)
-        state, m = step(state, jnp.asarray(data, jnp.int32),
-                        jnp.asarray(labels, jnp.int32))
-        if i % 20 == 0 or i == args.steps - 1:
-            loss = float(m["loss"])
-            curve.append({"step": i, "loss": round(loss, 4),
-                          "ppl": round(float(np.exp(loss)), 3)})
-            print(f"step {i}: loss {loss:.4f} ppl {np.exp(loss):.2f}")
-    train_secs = time.time() - t0
-    tok_s = args.steps * args.batch * args.seq / train_secs
-
-    out = {"metric": "gpt2_bytes_lm", "backend": args.backend,
-           "model": {"layers": args.layers, "d_model": args.d_model,
-                     "heads": args.heads, "seq": args.seq, "vocab": vocab},
-           "steps": args.steps, "train_tok_per_s": round(tok_s, 1),
-           "final_train_loss": curve[-1]["loss"],
-           "final_train_ppl": curve[-1]["ppl"], "curve": curve,
-           "platform": jax.devices()[0].platform}
-
-    if val_loader is not None:
-        from tnn_tpu.train import make_eval_step
-
-        ev = make_eval_step(model, compute_accuracy=False)
-        losses = []
-        for _ in range(10):
-            d, l = val_loader.random_windows(args.batch, rng)
-            losses.append(float(ev(state, jnp.asarray(d, jnp.int32),
-                                   jnp.asarray(l, jnp.int32))["loss"]))
-        val_loss = float(np.mean(losses))
-        out["val_loss"] = round(val_loss, 4)
-        out["val_ppl"] = round(float(np.exp(val_loss)), 3)
-        print(f"held-out: loss {val_loss:.4f} ppl {np.exp(val_loss):.2f}")
-
-    if args.sample > 0 and meta["mode"] == "byte":
-        d, _ = val_loader.random_windows(1, rng) if val_loader is not None \
-            else train_loader.random_windows(1, rng)
-        prompt = jnp.asarray(d[:, :32], jnp.int32)
-        t0 = time.time()
-        toks = np.asarray(generate(model, state.params, prompt, args.sample,
-                                   temperature=0.8, max_len=args.seq))
-        decode_s = time.time() - t0
-        text = bytes(int(t) for t in toks[0] if t < 256).decode(
-            "utf-8", errors="replace")
-        out["decode_tok_per_s"] = round(args.sample / decode_s, 1)
-        out["sample"] = text[:200]
-        print(f"sample ({out['decode_tok_per_s']} tok/s incl compile):")
-        print(text[:200])
-
-    os.makedirs(args.results, exist_ok=True)
-    path = os.path.join(args.results,
-                        f"lm_gpt2_{meta['mode']}_{args.backend}.json")
-    with open(path, "w") as f:
-        json.dump(out, f, indent=2)
-    print("results ->", path)
-    return out
-
+from tnn_tpu.cli.train_gpt2 import main
 
 if __name__ == "__main__":
     main()

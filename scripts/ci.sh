@@ -1,0 +1,40 @@
+#!/bin/bash
+# One-command CI: editable install, native build, full CPU test suite.
+#   scripts/ci.sh              # install + release native + pytest
+#   scripts/ci.sh --sanitize   # additionally re-run the native-facing tests
+#                              # against ASan/UBSan and TSan builds of
+#                              # libtnn_host.so (threaded control plane, thread
+#                              # pool, decoders)
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+echo "== install (editable, offline-safe) =="
+pip install -e . --no-build-isolation -q
+
+echo "== native release build =="
+make -C native -j
+
+echo "== CPU test suite (virtual 8-device mesh) =="
+python -m pytest tests/ -q
+
+if [ "${1:-}" = "--sanitize" ]; then
+  # The sanitizer runtime must be loaded before python itself to instrument a
+  # dlopen'd library; leak detection is off because the interpreter is not
+  # ASan-built and its own allocations would drown the report.
+  NATIVE_TESTS="tests/test_native.py tests/test_multiprocess.py tests/test_distributed.py"
+
+  echo "== ASan/UBSan native build + native-facing tests =="
+  make -C native debug -j
+  ASAN_SO=$(g++ -print-file-name=libasan.so)
+  TNN_NATIVE_LIB="$PWD/native/build-debug/libtnn_host.so" \
+    LD_PRELOAD="$ASAN_SO" ASAN_OPTIONS=detect_leaks=0 \
+    python -m pytest $NATIVE_TESTS -q
+
+  echo "== TSan native build + native-facing tests =="
+  make -C native tsan -j
+  TSAN_SO=$(g++ -print-file-name=libtsan.so)
+  TNN_NATIVE_LIB="$PWD/native/build-tsan/libtnn_host.so" \
+    LD_PRELOAD="$TSAN_SO" TSAN_OPTIONS="report_thread_leaks=0" \
+    python -m pytest $NATIVE_TESTS -q
+fi
+echo "CI OK"

@@ -12,14 +12,14 @@ echo "== 1/4 headline bench (persists on success) =="
 python bench.py | tee "benchmarks/results/headline_${STAMP}.jsonl"
 
 echo "== 2/4 full microbench + model suite =="
-timeout 1800 python benchmarks/run_all.py --json "benchmarks/results/run_all_tpu_${STAMP}.json"
+timeout 1800 python -m benchmarks.run_all --json "benchmarks/results/run_all_tpu_${STAMP}.json"
 
 echo "== 3/4 GPT-2 LM on real tokens, Pallas flash attention backend =="
 if [ ! -f /tmp/pytok/meta.json ]; then
-  python examples/prepare_corpus.py --out /tmp/pytok \
+  python -m tnn_tpu.cli.prepare_corpus --out /tmp/pytok \
       --source /usr/local/lib/python3.12 --glob '*.py' --max-mb 24
 fi
-timeout 1800 python examples/train_gpt2.py --tokens /tmp/pytok --steps 200 \
+timeout 1800 python -m tnn_tpu.cli.train_gpt2 --tokens /tmp/pytok --steps 200 \
     --batch 16 --seq 512 --backend pallas --results benchmarks/results
 
 echo "== 4/4 commit the evidence =="

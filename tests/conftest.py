@@ -9,11 +9,10 @@ alone don't stick; the shared workaround lives in tnn_tpu.utils.platform.
 TNN_TEST_PLATFORM overrides for running the suite on hardware.
 """
 import os
-import sys
 
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
-
-from tnn_tpu.utils.platform import force_platform  # noqa: E402
+# repo root reaches sys.path via pyproject's `pythonpath = ["."]` (or an
+# editable install); no path munging needed here
+from tnn_tpu.utils.platform import force_platform
 
 jax = force_platform(os.environ.get("TNN_TEST_PLATFORM", "cpu"), n_devices=8)
 

@@ -2,21 +2,15 @@
 examples/CMakeLists.txt:2-27, exercised here as importable mains)."""
 import json
 import os
-import subprocess
-import sys
 import threading
-import time
 
 import numpy as np
-import pytest
 
-REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-sys.path.insert(0, os.path.join(REPO, "examples"))
+from tnn_tpu.cli import dist_worker, gpt2_inference, inferencer, trainer
 
 
 class TestTrainer:
     def test_synthetic_end_to_end(self, tmp_path, monkeypatch):
-        import trainer
 
         monkeypatch.chdir(tmp_path)  # .env isolation
         state, history = trainer.main([
@@ -29,7 +23,6 @@ class TestTrainer:
         assert (tmp_path / "snap").is_dir()
 
     def test_config_file_and_resume(self, tmp_path, monkeypatch):
-        import trainer
 
         monkeypatch.chdir(tmp_path)
         cfgf = tmp_path / "cfg.json"
@@ -49,7 +42,6 @@ class TestTrainer:
 
 class TestInferencer:
     def test_round_trip(self, tmp_path, monkeypatch, capsys):
-        import inferencer
 
         from tnn_tpu import checkpoint as ckpt_lib
         from tnn_tpu import models
@@ -69,7 +61,6 @@ class TestInferencer:
 
 class TestGpt2Inference:
     def test_smoke_generation(self, tmp_path, monkeypatch, capsys):
-        import gpt2_inference
 
         monkeypatch.chdir(tmp_path)
         # tiny model instead of gpt2_small to keep the test fast
@@ -89,8 +80,6 @@ class TestDistExamples:
     def test_coordinator_worker_pair(self, tmp_path):
         """Full orchestration: coordinator deploys a 1-epoch synthetic config to
         one worker, both barriers fire, shutdown completes."""
-        import dist_coordinator
-        import dist_worker
 
         port = 0
         # patch: run coordinator with ephemeral port, discover it for the worker

@@ -1,0 +1,14 @@
+"""Command-line entry points (parity: the reference's executables,
+/root/reference/examples/CMakeLists.txt:2-27). Each module exposes
+``main(argv=None)`` and is wired to a ``tnn-*`` console script in
+pyproject.toml; thin launchers remain under ``examples/`` for the
+reference's directory shape.
+"""
+
+def console_entry(main):
+    """Wrap a module's ``main(argv=None)`` for a console script: discard the
+    return value (library callers use it; the generated script wrapper does
+    ``sys.exit(cli())``, which would treat any non-None return as an error)."""
+    def cli():
+        main()
+    return cli

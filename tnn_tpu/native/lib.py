@@ -13,7 +13,11 @@ _tried = False
 
 _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 _NATIVE_DIR = os.path.join(_REPO_ROOT, "native")
-_SO_PATH = os.path.join(_NATIVE_DIR, "build", "libtnn_host.so")
+# TNN_NATIVE_LIB points at an alternative .so — used to run the suite against
+# the sanitizer builds (native/build-debug, native/build-tsan) or an installed
+# layout where native/ is not a sibling of the package
+_SO_PATH = os.environ.get("TNN_NATIVE_LIB") or os.path.join(
+    _NATIVE_DIR, "build", "libtnn_host.so")
 
 
 def build_native(force: bool = False) -> str:

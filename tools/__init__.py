@@ -1,0 +1,1 @@
+"""Dev tools (profiler visualizer)."""

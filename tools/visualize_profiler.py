@@ -7,7 +7,7 @@ Gantt with one row per source and COMPUTE/COMMUNICATION coloring
 JSON (``Profiler.to_dict()`` saved to a file — e.g. what a coordinator writes
 after ``collect_profiles``) or a Chrome trace from ``to_chrome_trace``.
 
-    python tools/visualize_profiler.py profile.json -o timeline.png
+    python -m tools.visualize_profiler profile.json -o timeline.png
 
 The Chrome-trace export (chrome://tracing / Perfetto) remains the richer
 viewer; this is the quick static picture.
@@ -15,9 +15,7 @@ viewer; this is the quick static picture.
 import argparse
 import json
 import os
-import sys
 
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 COLORS = {"COMPUTE": "#4878d0", "COMMUNICATION": "#ee854a", "OTHER": "#9a9a9a"}
 
