@@ -140,10 +140,7 @@ class TestFailureDetection:
             victim_rank = victim.rank
             victim._running = False
             victim._t.close()  # abrupt death (no SHUTDOWN_ACK)
-            deadline = time.monotonic() + 10
-            while victim_rank not in coord.failed_workers():
-                assert time.monotonic() < deadline, "failure not detected"
-                time.sleep(0.05)
+            coord.wait_failed(victim_rank, timeout=10)  # event-driven wake
             assert failed == [victim_rank]
             # broadcasts now skip the dead worker without raising
             coord.set_train_mode(False)
@@ -158,11 +155,9 @@ class TestFailureDetection:
             coord.wait_for_workers(timeout=15)
             w = _await_workers(res, 1)[0]
             # worker is connected but silent (stalled process): one initial
-            # heartbeat, then nothing -> flagged after the timeout
-            deadline = time.monotonic() + 10
-            while w.rank not in coord.failed_workers():
-                assert time.monotonic() < deadline, "stall not detected"
-                time.sleep(0.1)
+            # heartbeat, then nothing -> flagged after the timeout (staleness
+            # has no transport event; wait_failed re-checks on a short cadence)
+            coord.wait_failed(w.rank, timeout=10)
             coord.shutdown(timeout=2)
             t.join(timeout=10)
 
@@ -314,19 +309,13 @@ class TestRobustness:
             dead_rank = res["a"].rank
             res["a"]._running = False
             res["a"]._t.close()
-            deadline = time.monotonic() + 10
-            while dead_rank not in coord.failed_workers():
-                assert time.monotonic() < deadline
-                time.sleep(0.05)
+            coord.wait_failed(dead_rank, timeout=10)
             # restart with the same rank
             res2 = {}
             t3 = _spawn_worker(coord.port(), res2, "a2", rank=dead_rank)
             new = _await_workers(res2, 1)[0]
             assert new.rank == dead_rank
-            deadline = time.monotonic() + 10
-            while dead_rank in coord.failed_workers():
-                assert time.monotonic() < deadline, "rejoin not registered"
-                time.sleep(0.05)
+            coord.wait_alive(dead_rank, timeout=10)  # woken by the handshake
             coord.shutdown()
             for t in (t1, t2, t3):
                 t.join(timeout=10)

@@ -48,6 +48,11 @@ class Coordinator:
         self._lock = threading.Lock()
         self._barrier_ranks: Dict[str, set] = {}  # name -> ranks that arrived
         self._barrier_cv = threading.Condition()
+        # notified on every membership transition (disconnect, rejoin) and on
+        # every heartbeat, so wait_failed/wait_alive are event-driven — a
+        # SIGKILLed worker's disconnect wakes waiters immediately instead of
+        # being discovered by a polling loop's next lap
+        self._member_cv = threading.Condition()
         self._running = True
         self._pump = threading.Thread(target=self._pump_loop, daemon=True)
         self._pump.start()
@@ -78,6 +83,8 @@ class Coordinator:
                 h = self._by_conn.get(conn)
                 if h:
                     h.last_heartbeat = time.monotonic()
+            with self._member_cv:
+                self._member_cv.notify_all()
             return
         if command == Command.ERROR_REPORT:
             msg = unpack(payload)
@@ -133,6 +140,8 @@ class Coordinator:
             self._by_conn[conn] = h
         self._t.send(conn, Command.HANDSHAKE_ACK,
                      pack({"rank": rank, "world": self.num_workers}))
+        with self._member_cv:
+            self._member_cv.notify_all()
         self._log.info("worker %d rejoined", rank)
 
     def _mark_failed(self, conn: int):
@@ -143,8 +152,12 @@ class Coordinator:
             h.alive = False
             rank = h.rank
         self._log.warning("worker %d disconnected", rank)
+        # callback BEFORE waking wait_failed() — a waiter acting on the death
+        # must be able to assume the failure callback has already run
         if self.on_failure:
             self.on_failure(rank)
+        with self._member_cv:
+            self._member_cv.notify_all()
 
     # -- membership -----------------------------------------------------------
 
@@ -175,6 +188,8 @@ class Coordinator:
                 self._by_conn[conn] = h
             self._t.send(conn, Command.HANDSHAKE_ACK,
                          pack({"rank": rank, "world": self.num_workers}))
+            with self._member_cv:
+                self._member_cv.notify_all()  # wake wait_alive(initial join)
             self._log.info("worker %d joined (%s)", rank, info.get("host", "?"))
         return sorted(self._workers)
 
@@ -188,6 +203,38 @@ class Coordinator:
                 if not h.alive or now - h.last_heartbeat > self.heartbeat_timeout:
                     out.append(rank)
         return sorted(out)
+
+    def wait_failed(self, rank: int, timeout: float = 60.0) -> None:
+        """Block until ``rank`` is considered dead. Event-driven: a disconnect
+        wakes this immediately; only heartbeat *staleness* (which generates no
+        event by nature) is re-checked on a short cadence."""
+        self._wait_member(lambda: rank in self.failed_workers(), timeout,
+                          f"rank {rank} still alive after {timeout}s")
+
+    def wait_alive(self, rank: int, timeout: float = 60.0) -> None:
+        """Block until ``rank`` is alive (initial join or rejoin after a
+        failure); woken by the (re)join handshake, not a polling lap."""
+        def joined_and_live():
+            # a never-connected rank has no handle — "not failed" alone would
+            # be vacuously true before its first handshake
+            with self._lock:
+                if rank not in self._workers:
+                    return False
+            return rank not in self.failed_workers()
+
+        self._wait_member(joined_and_live, timeout,
+                          f"rank {rank} did not (re)join within {timeout}s")
+
+    def _wait_member(self, pred, timeout: float, msg: str) -> None:
+        deadline = time.monotonic() + timeout
+        with self._member_cv:
+            while not pred():
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise TimeoutError(msg)
+                # 0.5s cap only to notice heartbeat-age expiry, which no
+                # transport event announces; all real transitions notify
+                self._member_cv.wait(timeout=min(remaining, 0.5))
 
     # -- broadcast / join (parity: coordinator.hpp:100-157) --------------------
 
