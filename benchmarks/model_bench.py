@@ -5,14 +5,11 @@ Parity: the reference's pipeline_benchmark.cpp (whole-model throughput) and the
 north-star metrics in BASELINE.md — WRN-16-8 CIFAR-100 img/s/chip and GPT-2
 inference tokens/sec.
 
-    python benchmarks/model_bench.py [--quick] [--models wrn,resnet9,gpt2]
+    python -m benchmarks.model_bench [--quick] [--models wrn,resnet9,gpt2]
 """
 import argparse
-import os
-import sys
 import time
 
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import jax
 import jax.numpy as jnp
@@ -105,16 +102,40 @@ def bench_gpt2_long_train(batch: int = 1, seq: int = 8192, iters: int = 10):
                             extra={"seq": seq, "remat": True})
 
 
-def bench_gpt2_decode(batch: int, prompt: int, new: int, size="small"):
+def bench_gpt2_decode(batch: int, prompt: int, new: int, size="small",
+                      int8: bool = False):
     from tnn_tpu import models
     from tnn_tpu.models.gpt2 import generate
 
-    print(f"gpt2_{size} decode (bs={batch}, prompt={prompt}, new={new})")
+    tag = "_int8" if int8 else ""
+    print(f"gpt2_{size} decode{tag} (bs={batch}, prompt={prompt}, new={new})")
     model = models.create(f"gpt2_{size}")
     variables = model.init(jax.random.PRNGKey(0), (batch, 8))
     params = variables["params"]
+    extra = {"batch": batch}
+    if int8:
+        from tnn_tpu.nn.quant import quantize_for_decode, quantized_bytes
+
+        before = quantized_bytes(params)
+        params = jax.block_until_ready(quantize_for_decode(params))
+        extra["weight_bytes_ratio"] = round(quantized_bytes(params) / before, 3)
     rs = np.random.RandomState(0)
     ids = rs.randint(0, 50257, (batch, prompt)).astype(np.int32)
+    # verification gate (benchmark-with-verification discipline): quantized
+    # logits must stay close to the float model's on a full forward. (Token
+    # rollouts are NOT compared — greedy decode legitimately diverges forever
+    # after one near-tie flips within quantization error.)
+    if int8:
+        probe_ids = jnp.asarray(ids[:1, :16])
+        lf, _ = model.apply({"params": variables["params"], "state": {}},
+                            probe_ids)
+        lq, _ = model.apply({"params": params, "state": {}}, probe_ids)
+        lf, lq = np.asarray(lf, np.float32), np.asarray(lq, np.float32)
+        rel = float(np.max(np.abs(lq - lf)) / np.max(np.abs(lf)))
+        assert rel < 0.1, f"int8 logits off by {rel}"
+        extra["logits_rel_err"] = round(rel, 4)
+        extra["top1_agreement"] = round(
+            float((lq.argmax(-1) == lf.argmax(-1)).mean()), 3)
     # generate() sizes the KV cache to the request by default (see gpt2.py)
     out = generate(model, params, ids, new)  # compile
     sync(out)
@@ -128,14 +149,14 @@ def bench_gpt2_decode(batch: int, prompt: int, new: int, size="small"):
         return time.perf_counter() - t0
 
     dt = time_loop(run, 4, min_delta=0.3, cap=64)
-    return report(f"gpt2_{size}_decode", dt, items=batch * new, item_name="tok",
-                  extra={"batch": batch})
+    return report(f"gpt2_{size}_decode{tag}", dt, items=batch * new,
+                  item_name="tok", extra=extra)
 
 
 def main(argv=None):
     ap = argparse.ArgumentParser()
     ap.add_argument("--quick", action="store_true")
-    ap.add_argument("--models", default="wrn,resnet9,vit,gpt2,gpt2_flash,decode")
+    ap.add_argument("--models", default="wrn,resnet9,vit,gpt2,gpt2_flash,decode,decode_int8")
     args = ap.parse_args(argv)
     q = args.quick
     wanted = set(args.models.split(","))
@@ -169,6 +190,12 @@ def main(argv=None):
         results.append(bench_gpt2_decode(1, 16 if q else 64, 16 if q else 128))
         if not q:  # serving-shaped batched decode (throughput mode)
             results.append(bench_gpt2_decode(8, 64, 128))
+    if "decode_int8" in wanted:
+        # bs=1 latency mode is where int8 weights beat the bf16 HBM roofline
+        results.append(bench_gpt2_decode(1, 16 if q else 64, 16 if q else 128,
+                                         int8=True))
+        if not q:
+            results.append(bench_gpt2_decode(8, 64, 128, int8=True))
     return results
 
 

@@ -1,0 +1,105 @@
+"""Int8 weight-only quantization: kernel numerics, layer transparency, and
+end-to-end quantized GPT-2 decode (round-4 decode-roofline work; the
+reference declares CompressionType::QUANTIZATION but never implements it,
+include/distributed/packet.hpp:10-57)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tnn_tpu.ops.pallas.quant_matmul import (Int8Weight, int8_matmul, qmatmul,
+                                             quantize_int8)
+
+
+class TestKernel:
+    @pytest.mark.parametrize("m,k,n", [
+        (1, 768, 2304),   # bs=1 decode projection
+        (8, 768, 768),
+        (17, 300, 130),   # ragged, forces padding in every dim
+        (4, 1280, 5120),  # gpt2-large MLP width
+    ])
+    def test_matches_dequant_reference(self, m, k, n):
+        rs = np.random.RandomState(0)
+        w = rs.randn(k, n).astype(np.float32)
+        x = jnp.asarray(rs.randn(m, k), jnp.bfloat16)
+        iw = quantize_int8(w)
+        ref = x.astype(jnp.float32) @ iw.dequant(jnp.float32)
+        got = int8_matmul(x, iw.q, iw.scale)
+        assert got.dtype == x.dtype
+        rel = float(jnp.max(jnp.abs(got.astype(jnp.float32) - ref))
+                    / jnp.max(jnp.abs(ref)))
+        assert rel < 0.02, rel
+
+    def test_quantization_error_bounded(self):
+        rs = np.random.RandomState(1)
+        w = rs.randn(512, 256).astype(np.float32)
+        iw = quantize_int8(w)
+        # symmetric per-channel int8: max error is scale/2 = absmax/254
+        err = np.abs(np.asarray(iw.dequant()) - w)
+        bound = np.abs(w).max(0, keepdims=True) / 254 + 1e-7
+        assert (err <= bound).all()
+
+    def test_int8_weight_is_pytree(self):
+        iw = quantize_int8(np.eye(128, dtype=np.float32))
+        leaves = jax.tree_util.tree_leaves(iw)
+        assert len(leaves) == 2
+        out = jax.jit(lambda w, x: qmatmul(x, w))(
+            iw, jnp.ones((2, 128), jnp.bfloat16))
+        assert out.shape == (2, 128)
+
+    def test_qmatmul_float_path_unchanged(self):
+        rs = np.random.RandomState(2)
+        x = jnp.asarray(rs.randn(4, 64), jnp.float32)
+        w = jnp.asarray(rs.randn(64, 32), jnp.float32)
+        np.testing.assert_allclose(np.asarray(qmatmul(x, w)),
+                                   np.asarray(x @ w), rtol=1e-5)
+
+
+class TestQuantizedGPT2:
+    @pytest.fixture(scope="class")
+    def setup(self):
+        from tnn_tpu.models.gpt2 import GPT2
+        from tnn_tpu.nn.quant import quantize_for_decode
+
+        m = GPT2(vocab_size=512, max_len=96, num_layers=2, d_model=256,
+                 num_heads=4)
+        v = m.init(jax.random.PRNGKey(0), (2, 16))
+        return m, v["params"], quantize_for_decode(v["params"])
+
+    def test_selection_and_bytes(self, setup):
+        from tnn_tpu.nn.quant import quantized_bytes
+
+        _, params, qp = setup
+        q_leaves = [l for l in jax.tree_util.tree_leaves(
+            qp, is_leaf=lambda x: isinstance(x, Int8Weight))
+            if isinstance(l, Int8Weight)]
+        # 2 blocks x (qkv, out, 2 mlp kernels) + wte = 9
+        assert len(q_leaves) == 9
+        # positional table must stay float (it is sliced, not matmul'd)
+        assert not isinstance(qp["wpe"]["pos"], Int8Weight)
+        assert quantized_bytes(qp) < 0.45 * quantized_bytes(params)
+
+    def test_logits_close_and_top1_agrees(self, setup):
+        m, params, qp = setup
+        ids = jnp.asarray(np.random.RandomState(0).randint(0, 512, (2, 16)),
+                          jnp.int32)
+        lf, _ = m.apply({"params": params, "state": {}}, ids)
+        lq, _ = m.apply({"params": qp, "state": {}}, ids)
+        rel = float(jnp.max(jnp.abs(lq - lf)) / jnp.max(jnp.abs(lf)))
+        assert rel < 0.05, rel
+        agree = float(jnp.mean(
+            (jnp.argmax(lq, -1) == jnp.argmax(lf, -1)).astype(jnp.float32)))
+        assert agree > 0.9, agree
+
+    def test_generate_runs_quantized(self, setup):
+        from tnn_tpu.models.gpt2 import generate
+
+        m, params, qp = setup
+        ids = jnp.asarray(np.random.RandomState(1).randint(0, 512, (1, 8)),
+                          jnp.int32)
+        toks = generate(m, qp, ids, 6)
+        assert toks.shape == (1, 6)
+        # greedy decode from the same random model: float and int8 agree on
+        # the first token (later tokens may legitimately diverge)
+        tf = generate(m, params, ids, 6)
+        assert int(toks[0, 0]) == int(tf[0, 0])

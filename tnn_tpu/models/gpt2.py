@@ -81,9 +81,10 @@ class GPT2(Module):
         if self.tie_embeddings:
             logits = self.wte.attend(params["wte"], x)
         else:
+            from ..ops.pallas.quant_matmul import qmatmul
+
             w = self.policy.cast_param(params["head"]["kernel"])
-            logits = jax.lax.dot_general(x, w, (((x.ndim - 1,), (0,)), ((), ())),
-                                         preferred_element_type=jnp.float32)
+            logits = qmatmul(x, w, out_dtype=jnp.float32)
         return logits  # f32 logits for a stable softmax/loss
 
     def _apply(self, params, state, ids, *, train, rng):

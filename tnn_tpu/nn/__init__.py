@@ -68,3 +68,16 @@ __all__ = [
     "CosineAnnealingLR", "CosineAnnealingWarmRestarts", "ExponentialLR", "LinearWarmup",
     "MultiStepLR", "NoOp", "ReduceLROnPlateau", "StepLR", "WarmupCosineAnnealing",
 ]
+
+
+def __getattr__(name):
+    # `quant` (and its quantize_for_decode) imports jax.experimental.pallas;
+    # load it lazily so plain training/inference imports stay light
+    if name in ("quant", "quantize_for_decode"):
+        import importlib
+
+        mod = importlib.import_module(".quant", __name__)
+        globals()["quant"] = mod
+        globals()["quantize_for_decode"] = mod.quantize_for_decode
+        return globals()[name]
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -30,12 +30,27 @@ class Embedding(Module):
         return {"table": init(rng, (self.vocab_size, self.dim), self.policy.param_dtype)}, {}
 
     def _apply(self, params, state, ids, *, train, rng):
+        from ..ops.pallas.quant_matmul import Int8Weight
+
         table = self.policy.cast_param(params["table"])
+        if isinstance(table, Int8Weight):
+            # int8 storage is (vocab, dim) with a per-row scale — exactly the
+            # gather layout; dequantize just the looked-up rows
+            rows = jnp.take(table.q, ids, axis=0).astype(jnp.float32)
+            rows = rows * jnp.take(table.scale, ids)[..., None]
+            return rows.astype(self.policy.compute_dtype), state
         return jnp.take(table, ids, axis=0), state
 
     def attend(self, params, x):
         """Tied-softmax logits: x @ table.T (used by GPT-2 output head)."""
+        from ..ops.pallas.quant_matmul import Int8Weight, int8_matmul
+
         table = self.policy.cast_param(params["table"])
+        if isinstance(table, Int8Weight):
+            # (vocab, dim) int8 is already the kernel's (N, K) layout;
+            # out_dtype=f32 keeps logits from rounding through bf16
+            return int8_matmul(x, table.q, table.scale,
+                               out_dtype=jnp.float32)
         return jax.lax.dot_general(
             x, table, (((x.ndim - 1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)

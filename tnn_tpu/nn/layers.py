@@ -78,15 +78,14 @@ class Dense(Module):
         return params, {}
 
     def _apply(self, params, state, x, *, train, rng):
+        from ..ops.pallas.quant_matmul import qmatmul
+
         x = self.policy.cast_in(x)
         kernel = self.policy.cast_param(params["kernel"])
-        # f32 accumulation on the MXU even in bf16 (preferred_element_type).
-        y = lax.dot_general(
-            x,
-            kernel,
-            (((x.ndim - 1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
+        # f32 accumulation on the MXU even in bf16; qmatmul additionally
+        # routes Int8Weight params (decode quantization, nn/quant.py) through
+        # the in-VMEM-dequant Pallas kernel
+        y = qmatmul(x, kernel)
         if self.use_bias:
             y = y + params["bias"].astype(jnp.float32)
         if self.activation:

@@ -1,0 +1,177 @@
+"""Int8 weight-only matmul with in-VMEM dequantization (Pallas TPU kernel).
+
+Why this exists: bs=1 GPT-2 decode is HBM-bandwidth-bound on the WEIGHTS —
+docs/perf.md measured bf16 decode at ~91% of the bf16 HBM roofline, so the only
+route to faster tokens/sec is moving fewer bytes. Storing weights as int8 +
+per-output-channel f32 scales halves the bytes; the dequantize happens in VMEM
+inside the kernel (XLA cannot fuse a dequant into a dot operand — it
+materializes the bf16 weight matrix back to HBM, erasing the saving, which is
+why this is a Pallas kernel and not `(q * s) @ x`).
+
+Reference anchor: the never-implemented `CompressionType::QUANTIZATION`
+(/root/reference/include/distributed/packet.hpp:10-57) and the fp32-only
+inference loop (/root/reference/examples/gpt2_inference.cpp:71-122) — this
+exceeds the reference, which ships no quantization at all.
+
+Layout convention: a logical (K, N) matmul weight is stored TRANSPOSED as
+``q: (N, K) int8`` with ``scale: (N,) f32`` (absmax/127 per output channel).
+That makes the quantization axis the leading one (natural for per-channel
+gather/dequant — e.g. the GPT-2 tied embedding (vocab, d) is already in this
+layout) and the kernel contracts K on both operands (an "nt" gemm, which the
+MXU handles natively). Because the scale is per-N, it factors out of the K
+accumulation: out = (x @ q^T) * scale — one multiply per output element, after
+the loop.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+# Block sizes sized for decode/prefill matmuls (K, N up to a few thousand;
+# VMEM: x 256x512x2 + q 512x512x1 + acc 256x512x4 < 1 MB).
+DEFAULT_BLOCK_M = 256
+DEFAULT_BLOCK_N = 512
+DEFAULT_BLOCK_K = 512
+
+
+class Int8Weight:
+    """A quantized (K, N) matmul weight: ``q`` (N, K) int8, ``scale`` (N,) f32.
+
+    Registered as a jax pytree so it can live inside a params tree and cross
+    jit boundaries. Decode-time representation only — checkpoints store the
+    original float params and quantize after load (tnn_tpu.nn.quant)."""
+
+    def __init__(self, q, scale):
+        self.q = q
+        self.scale = scale
+
+    @property
+    def shape(self):  # logical (K, N), matching the float kernel it replaces
+        return (self.q.shape[1], self.q.shape[0])
+
+    @property
+    def dtype(self):
+        return self.q.dtype
+
+    def dequant(self, dtype=jnp.float32):
+        """(K, N) float materialization — reference path for tests/fallback."""
+        return (self.q.astype(jnp.float32) * self.scale[:, None]).T.astype(dtype)
+
+    def tree_flatten(self):
+        return (self.q, self.scale), None
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+    def __repr__(self):
+        return f"Int8Weight(K={self.q.shape[1]}, N={self.q.shape[0]})"
+
+
+jax.tree_util.register_pytree_node_class(Int8Weight)
+
+
+def quantize_int8(w) -> Int8Weight:
+    """Symmetric per-output-channel quantization of a (K, N) weight.
+
+    scale[n] = absmax(w[:, n]) / 127; q[n, k] = round(w[k, n] / scale[n]).
+    """
+    w = jnp.asarray(w, jnp.float32)
+    absmax = jnp.max(jnp.abs(w), axis=0)          # (N,)
+    scale = jnp.where(absmax == 0, 1.0, absmax / 127.0)
+    q = jnp.clip(jnp.round(w / scale[None, :]), -127, 127).astype(jnp.int8)
+    return Int8Weight(q.T, scale)
+
+
+def _kernel(x_ref, q_ref, s_ref, o_ref, acc, *, nk: int):
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        acc[:] = jnp.zeros_like(acc)
+
+    x = x_ref[...]                      # (bm, bk) compute dtype
+    w = q_ref[...].astype(x.dtype)      # (bn, bk) int8 -> dequant IN VMEM
+    acc[:] += jax.lax.dot_general(x, w, (((1,), (1,)), ((), ())),
+                                  preferred_element_type=jnp.float32)
+
+    @pl.when(ki == nk - 1)
+    def _final():
+        # per-N scale factors out of the K loop: one multiply at the end
+        o_ref[...] = (acc[:] * s_ref[...]).astype(o_ref.dtype)
+
+
+def _pad_axis(x, size, axis):
+    pad = size - x.shape[axis]
+    if pad == 0:
+        return x
+    widths = [(0, 0)] * x.ndim
+    widths[axis] = (0, pad)
+    return jnp.pad(x, widths)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("block_m", "block_n", "block_k",
+                                    "out_dtype"))
+def int8_matmul(x, q, scale, *, block_m: int = DEFAULT_BLOCK_M,
+                block_n: int = DEFAULT_BLOCK_N, block_k: int = DEFAULT_BLOCK_K,
+                out_dtype=None):
+    """``x @ W`` where W is int8-quantized: x (..., K), q (N, K), scale (N,).
+
+    Returns (..., N) in ``out_dtype`` (default x.dtype) with f32 accumulation
+    in between. Heads pass out_dtype=f32 so logits never round-trip through
+    bf16 (greedy argmax is sensitive to bf16's 8-bit mantissa). The int8
+    block is dequantized to the compute dtype in VMEM — HBM traffic for the
+    weight is K*N bytes instead of bf16's 2*K*N.
+    """
+    out_dtype = out_dtype or x.dtype
+    *lead, k_dim = x.shape
+    n_dim = q.shape[0]
+    m = 1
+    for d in lead:
+        m *= d
+    xf = x.reshape(m, k_dim)
+
+    bm = min(block_m, max(m, 8))
+    bn = min(block_n, max(n_dim, 128))
+    bk = min(block_k, max(k_dim, 128))
+    mp, np_, kp = (pl.cdiv(m, bm) * bm, pl.cdiv(n_dim, bn) * bn,
+                   pl.cdiv(k_dim, bk) * bk)
+
+    xf = _pad_axis(_pad_axis(xf, mp, 0), kp, 1)
+    qp = _pad_axis(_pad_axis(q, np_, 0), kp, 1)      # zero-padded K adds 0
+    sp = _pad_axis(scale.reshape(1, n_dim), np_, 1)
+
+    out = pl.pallas_call(
+        functools.partial(_kernel, nk=kp // bk),
+        grid=(mp // bm, np_ // bn, kp // bk),
+        in_specs=[
+            pl.BlockSpec((bm, bk), lambda mi, ni, ki: (mi, ki),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((bn, bk), lambda mi, ni, ki: (ni, ki),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bn), lambda mi, ni, ki: (0, ni),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((bm, bn), lambda mi, ni, ki: (mi, ni),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((mp, np_), out_dtype),
+        scratch_shapes=[pltpu.VMEM((bm, bn), jnp.float32)],
+        interpret=jax.default_backend() != "tpu",
+    )(xf, qp, sp)
+    return out[:m, :n_dim].reshape(*lead, n_dim)
+
+
+def qmatmul(x, w, out_dtype=None):
+    """Dispatch ``x @ w``: Int8Weight -> the in-VMEM-dequant kernel; anything
+    else -> plain dot_general with f32 accumulation. The single call-site hook
+    for layers that want to be quantization-transparent."""
+    if isinstance(w, Int8Weight):
+        return int8_matmul(x, w.q, w.scale, out_dtype=out_dtype)
+    out = jax.lax.dot_general(x, w, (((x.ndim - 1,), (0,)), ((), ())),
+                              preferred_element_type=jnp.float32)
+    return out.astype(out_dtype) if out_dtype is not None else out
