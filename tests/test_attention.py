@@ -189,3 +189,99 @@ def test_gpt_block_roundtrip_and_forward(rng):
     v = blk.init(rng, (2, 6, 32))
     y = blk(v, jnp.asarray(np.random.RandomState(5).randn(2, 6, 32), jnp.float32))
     assert y.shape == (2, 6, 32)
+
+
+class TestFlashMaskAndOffset:
+    """mask/kv_offset support in the Pallas kernel (round-4: cached decode and
+    masked attention no longer fall back to XLA)."""
+
+    def _qkv(self, b=2, h=2, sq=64, skv=None, d=32, seed=0):
+        rs = np.random.RandomState(seed)
+        skv = skv or sq
+        return (jnp.asarray(rs.randn(b, h, sq, d), jnp.float32),
+                jnp.asarray(rs.randn(b, h, skv, d), jnp.float32),
+                jnp.asarray(rs.randn(b, h, skv, d), jnp.float32))
+
+    @pytest.mark.parametrize("causal,mask_shape", [
+        (False, (2, 1, 64, 64)),   # padding mask, broadcast over heads
+        (True, (2, 2, 64, 64)),    # per-head mask composed with causal
+        (False, (64, 64)),         # shared 2-D mask
+    ])
+    def test_masked_forward_matches_xla(self, causal, mask_shape):
+        from tnn_tpu.nn.attention import local_xla_attention
+        from tnn_tpu.ops.pallas.flash_attention import flash_attention
+
+        q, k, v = self._qkv()
+        mask = jnp.asarray(np.random.RandomState(1).rand(*mask_shape) > 0.25)
+        ref = local_xla_attention(q, k, v, causal=causal, mask=mask)
+        got = flash_attention(q, k, v, causal, None, 32, 32, mask=mask)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_fully_masked_rows_are_zero(self):
+        """Convention check: a row that attends to nothing outputs 0 (the XLA
+        path's bare softmax would silently give uniform attention)."""
+        from tnn_tpu.nn.attention import local_xla_attention
+        from tnn_tpu.ops.pallas.flash_attention import flash_attention
+
+        q, k, v = self._qkv()
+        mask = np.ones((64, 64), bool)
+        mask[7, :] = False  # row 7 attends to nothing
+        mask = jnp.asarray(mask)
+        for fn in (lambda: flash_attention(q, k, v, False, None, 32, 32,
+                                           mask=mask),
+                   lambda: local_xla_attention(q, k, v, mask=mask)):
+            out = np.asarray(fn())
+            assert np.all(out[:, :, 7] == 0)
+            assert np.isfinite(out).all()
+
+    def test_masked_grads_match_xla(self):
+        from tnn_tpu.nn.attention import local_xla_attention
+        from tnn_tpu.ops.pallas.flash_attention import flash_attention
+
+        q, k, v = self._qkv()
+        mask = jnp.asarray(np.random.RandomState(2).rand(2, 2, 64, 64) > 0.2)
+
+        def g(fn):
+            return jax.grad(lambda q, k, v: jnp.sum(fn(q, k, v) ** 2),
+                            argnums=(0, 1, 2))(q, k, v)
+
+        gf = g(lambda q, k, v: flash_attention(q, k, v, True, None, 32, 32,
+                                               32, 32, mask=mask))
+        gx = g(lambda q, k, v: local_xla_attention(q, k, v, causal=True,
+                                                   mask=mask))
+        for a, b in zip(gf, gx):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-4, atol=1e-4)
+
+    def test_kv_offset_decode_matches_xla(self):
+        """S_q=4 new tokens attending into a 64-slot cache at offset 60 — the
+        cached-decode geometry, including a TRACED offset."""
+        from tnn_tpu.nn.attention import local_xla_attention
+        from tnn_tpu.ops.pallas.flash_attention import flash_attention
+
+        q, k, v = self._qkv(sq=4, skv=64)
+        off = jnp.asarray(60, jnp.int32)
+        ref = local_xla_attention(q, k, v, causal=True, kv_offset=off)
+        got = jax.jit(lambda q, k, v, off: flash_attention(
+            q, k, v, True, None, 32, 32, kv_offset=off))(q, k, v, off)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_cached_decode_uses_pallas_backend(self, rng):
+        """A backend='pallas' MHA decodes through the flash kernel (no
+        NotImplementedError) and matches the full forward."""
+        mha = nn.MultiHeadAttention(num_heads=4, causal=True,
+                                    backend="pallas", policy=F32)
+        x = jnp.asarray(np.random.RandomState(3).randn(2, 8, 32), jnp.float32)
+        v = mha.init(rng, x.shape)
+        full = mha(v, x)
+        cache = mha.init_cache(2, 8, 32)
+        out, cache = mha.apply_cached(v, x[:, :5], cache, 0)
+        outs = [out]
+        for t in range(5, 8):
+            o, cache = mha.apply_cached(v, x[:, t:t + 1], cache, t)
+            outs.append(o)
+        stitched = jnp.concatenate(outs, axis=1)
+        np.testing.assert_allclose(np.asarray(stitched), np.asarray(full),
+                                   rtol=1e-4, atol=1e-5)

@@ -148,12 +148,10 @@ def sdpa(q, k, v, *, causal: bool = False, mask: Optional[jax.Array] = None,
             "ring attention does not support mask/kv_offset (cached decode); "
             "run decode outside the ring context with backend='xla'")
     if backend == "pallas":
-        if mask is not None or kv_offset is not None:
-            raise NotImplementedError(
-                "backend='pallas' does not support mask/kv_offset yet; use backend='xla'")
         from ..ops.pallas.flash_attention import flash_attention
 
-        return flash_attention(q, k, v, causal=causal, scale=scale)
+        return flash_attention(q, k, v, causal=causal, scale=scale,
+                               mask=mask, kv_offset=kv_offset)
     return local_xla_attention(q, k, v, causal=causal, mask=mask, scale=scale,
                                kv_offset=kv_offset)
 
@@ -172,16 +170,25 @@ def local_xla_attention(q, k, v, *, causal: bool = False,
     # QK^T with f32 accumulation on the MXU.
     logits = jnp.einsum("bhqd,bhkd->bhqk", q, k,
                         preferred_element_type=jnp.float32) * scale
+    live = None
     if causal:
         qpos = jnp.arange(sq)[:, None]
         if kv_offset is not None:
             qpos = qpos + kv_offset
         kpos = jnp.arange(skv)[None, :]
-        causal_mask = qpos >= kpos
-        logits = jnp.where(causal_mask, logits, dt.neg_inf(logits.dtype))
+        live = qpos >= kpos
+        logits = jnp.where(live, logits, dt.neg_inf(logits.dtype))
     if mask is not None:
+        live = mask if live is None else jnp.logical_and(mask, live)
         logits = jnp.where(mask, logits, dt.neg_inf(logits.dtype))
     probs = jax.nn.softmax(logits, axis=-1)
+    if mask is not None:
+        # a fully-masked row attends to NOTHING (output 0) — softmax alone
+        # would silently return uniform attention over the masked keys; the
+        # flash kernel's online-softmax (l=0 -> 0) already behaves this way
+        row_live = jnp.any(jnp.broadcast_to(live, logits.shape), axis=-1,
+                           keepdims=True)
+        probs = jnp.where(row_live, probs, 0.0)
     out = jnp.einsum("bhqk,bhkd->bhqd", probs.astype(v.dtype), v,
                      preferred_element_type=jnp.float32)
     return out.astype(v.dtype)
@@ -234,16 +241,20 @@ class MultiHeadAttention(Module):
         return x.transpose(0, 2, 1, 3).reshape(n, s, h * dh)
 
     def _project_qkv(self, params, x):
+        from ..ops.pallas.quant_matmul import qmatmul
+
         x = self.policy.cast_in(x)
         w = self.policy.cast_param(params["qkv_kernel"])
-        qkv = (x @ w + params["qkv_bias"].astype(x.dtype))
+        qkv = qmatmul(x, w).astype(x.dtype) + params["qkv_bias"].astype(x.dtype)
         q, k, v = jnp.split(qkv, 3, axis=-1)
         return self._split_heads(q), self._split_heads(k), self._split_heads(v)
 
     def _project_out(self, params, attn, train, rng):
+        from ..ops.pallas.quant_matmul import qmatmul
+
         y = self._merge_heads(attn)
         w = self.policy.cast_param(params["out_kernel"])
-        y = y @ w + params["out_bias"].astype(y.dtype)
+        y = qmatmul(y, w).astype(y.dtype) + params["out_bias"].astype(y.dtype)
         y, _ = self._drop.apply({}, y, train=train, rng=rng)
         return self.policy.cast_out(y)
 
@@ -274,7 +285,10 @@ class MultiHeadAttention(Module):
         q, k_new, v_new = self._project_qkv(params, x)
         k = jax.lax.dynamic_update_slice_in_dim(cache["k"], k_new, offset, axis=2)
         v = jax.lax.dynamic_update_slice_in_dim(cache["v"], v_new, offset, axis=2)
-        out = sdpa(q, k, v, causal=True, kv_offset=offset)
+        # decode follows the model's configured backend — a "pallas" model
+        # runs the flash kernel with kv_offset instead of falling back to XLA
+        out = sdpa(q, k, v, causal=True, kv_offset=offset,
+                   backend=self.backend if self.backend != "ring" else "xla")
         y = self._project_out(params, out, False, None)
         return y, {"k": k, "v": v}
 

@@ -41,8 +41,12 @@ DEFAULT_BLOCK_K_BWD = 1024
 _NEG_INF = -1e30
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr, *,
-                scale: float, causal: bool, bq: int, bk: int, kv_len: int):
+def _fwd_kernel(off_ref, q_ref, k_ref, v_ref, *rest, scale: float, causal: bool,
+                bq: int, bk: int, kv_len: int, has_mask: bool):
+    if has_mask:
+        mask_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr = rest
+    else:
+        mask_ref, (o_ref, lse_ref, m_scr, l_scr, acc_scr) = None, rest
     qi = pl.program_id(1)
     ki = pl.program_id(2)
     nk = pl.num_programs(2)
@@ -55,8 +59,9 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr, *,
 
     q_start = qi * bq
     k_start = ki * bk
+    off = off_ref[0]  # absolute position of q row 0 in the kv sequence
     # Causal: a key block strictly above the diagonal contributes nothing.
-    live = (k_start <= q_start + bq - 1) if causal else True
+    live = (k_start <= q_start + off + bq - 1) if causal else True
 
     @pl.when(live)
     def _block():
@@ -68,8 +73,11 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr, *,
         kpos = k_start + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
         mask = kpos < kv_len  # padded keys
         if causal:
-            qpos = q_start + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+            qpos = off + q_start + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, bk), 0)
             mask = jnp.logical_and(mask, qpos >= kpos)
+        if has_mask:
+            mask = jnp.logical_and(mask, mask_ref[0] != 0)
         s = jnp.where(mask, s, _NEG_INF)
 
         m_prev = m_scr[:]                              # (bq, 1)
@@ -77,6 +85,10 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr, *,
         m_cur = jnp.max(s, axis=1, keepdims=True)      # (bq, 1)
         m_new = jnp.maximum(m_prev, m_cur)
         p = jnp.exp(s - m_new)                         # (bq, bk) f32
+        # rows with NO live key so far have m_new == _NEG_INF, which would
+        # give the masked entries exp(0) = 1; zero them explicitly so fully
+        # masked rows end with l == 0 (-> output 0, lse +inf)
+        p = jnp.where(mask, p, 0.0)
         l_cur = jnp.sum(p, axis=1, keepdims=True)
         alpha = jnp.exp(m_prev - m_new)
         l_new = alpha * l_prev + l_cur
@@ -118,19 +130,91 @@ def _pad_to(x, size, axis, value=0):
     return jnp.pad(x, widths, constant_values=value)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def _norm_mask(mask, b, h, sq, skv):
+    """Normalize a boolean mask broadcastable to (B, H, Sq, Skv) into the
+    kernel's grouped (G, Sq, Skv) int8 layout, G in {1, B, B*H} — the block
+    index map selects the right group per (batch*head), so a (B, 1, Sq, Skv)
+    padding mask is NOT materialized H times."""
+    if mask.ndim == 2:
+        mask = mask[None, None]
+    elif mask.ndim == 3:
+        # ambiguous: numpy broadcasting would align the leading axis with H,
+        # but a (B, Sq, Skv) padding mask is the likelier intent — demand 4-D
+        # so the two sdpa backends can never silently disagree
+        if mask.shape[0] != 1:
+            raise ValueError(
+                f"3-D mask with leading dim {mask.shape[0]} is ambiguous "
+                "(B or H?); pass a 4-D mask shaped (B, 1, Sq, Skv) or "
+                "(1, H, Sq, Skv)")
+        mask = mask[None]
+    mb, mh = mask.shape[0], mask.shape[1]
+    if (mb, mh) == (1, 1):
+        g = mask.reshape(1, *mask.shape[2:])
+    elif mh == 1:
+        g = mask.reshape(mb, *mask.shape[2:])
+    else:  # per-head masks: materialize (b*h) groups
+        g = jnp.broadcast_to(mask, (b, h) + mask.shape[2:]).reshape(
+            b * h, *mask.shape[2:])
+    g = jnp.broadcast_to(g, (g.shape[0], sq, skv))
+    return g.astype(jnp.int8)
+
+
+def _mask_pick(groups: int, b: int, h: int):
+    """Flattened batch*head grid index -> mask group index."""
+    if groups == 1:
+        return lambda bh: 0
+    if groups == b:
+        return lambda bh: bh // h
+    return lambda bh: bh  # groups == b*h
+
+
+def _mask_spec(mask, b, h, bq, bk, transposed):
+    pick = _mask_pick(mask.shape[0], b, h)
+    if transposed:  # dK/dV grid order is (bh, k block j, q block i)
+        return pl.BlockSpec((1, bq, bk), lambda bh, j, i: (pick(bh), i, j),
+                            memory_space=pltpu.VMEM)
+    return pl.BlockSpec((1, bq, bk), lambda bh, i, j: (pick(bh), i, j),
+                        memory_space=pltpu.VMEM)
+
+
+_OFF_SPEC = pl.BlockSpec(memory_space=pltpu.SMEM)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9, 10))
+def _flash(q, k, v, mask, off, causal, scale, block_q, block_k,
+           block_q_bwd, block_k_bwd):
+    return _flash_fwd(q, k, v, mask, off, causal, scale, block_q, block_k)[0]
+
+
 def flash_attention(q, k, v, causal: bool = False, scale: Optional[float] = None,
                     block_q: int = DEFAULT_BLOCK_Q, block_k: int = DEFAULT_BLOCK_K,
                     block_q_bwd: Optional[int] = None,
-                    block_k_bwd: Optional[int] = None):
+                    block_k_bwd: Optional[int] = None,
+                    mask: Optional[jax.Array] = None,
+                    kv_offset=None):
     """Fused attention over (B, H, S, Dh) tensors. Differentiable; O(block) fwd memory.
 
     Forward and backward take independent block geometry (the backward's three
     matmul chain prefers smaller q blocks — see the tuning note above).
     ``block_*_bwd=None`` resolves to min(caller's fwd block, tuned bwd
     default): a caller shrinking blocks to fit VMEM shrinks the backward too,
-    while the stock defaults give the tuned (512, 1024) backward."""
-    return _flash_fwd(q, k, v, causal, scale, block_q, block_k)[0]
+    while the stock defaults give the tuned (512, 1024) backward.
+
+    ``mask``: boolean, broadcastable to (B, H, Sq, Skv); True = attend. Kept
+    in its broadcast-group form ((B,1,..) padding masks are never tiled per
+    head). ``kv_offset``: absolute position of q[0] in the kv sequence
+    (cached decode with S_q != S_kv); may be a traced scalar. Both compose
+    with ``causal``."""
+    b, h, sq, d = q.shape
+    skv = k.shape[2]
+    if mask is not None:
+        mask = _norm_mask(jnp.asarray(mask), b, h, sq, skv)
+    if kv_offset is None:
+        off = jnp.zeros((1,), jnp.int32)
+    else:
+        off = jnp.asarray(kv_offset, jnp.int32).reshape(1)
+    return _flash(q, k, v, mask, off, causal, scale, block_q, block_k,
+                  block_q_bwd, block_k_bwd)
 
 
 def _bwd_blocks(block_q, block_k, block_q_bwd, block_k_bwd):
@@ -139,7 +223,7 @@ def _bwd_blocks(block_q, block_k, block_q_bwd, block_k_bwd):
     return bq, bk
 
 
-def _flash_fwd(q, k, v, causal, scale, block_q, block_k,
+def _flash_fwd(q, k, v, mask, off, causal, scale, block_q, block_k,
                block_q_bwd=None, block_k_bwd=None):
     b, h, sq, d = q.shape
     skv = k.shape[2]
@@ -153,18 +237,26 @@ def _flash_fwd(q, k, v, causal, scale, block_q, block_k,
 
     grid = (b * h, sq_p // bq, skv_p // bk)
     kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal,
-                               bq=bq, bk=bk, kv_len=skv)
+                               bq=bq, bk=bk, kv_len=skv,
+                               has_mask=mask is not None)
+    in_specs = [
+        _OFF_SPEC,
+        pl.BlockSpec((1, bq, d), lambda bh, qi, ki: (bh, qi, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, bk, d), lambda bh, qi, ki: (bh, ki, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, bk, d), lambda bh, qi, ki: (bh, ki, 0),
+                     memory_space=pltpu.VMEM),
+    ]
+    inputs = [off, qf, kf, vf]
+    if mask is not None:
+        mp = _pad_to(_pad_to(mask, sq_p, 1), skv_p, 2)  # pad = masked out
+        in_specs.append(_mask_spec(mp, b, h, bq, bk, transposed=False))
+        inputs.append(mp)
     out, lse = pl.pallas_call(
         kernel,
         grid=grid,
-        in_specs=[
-            pl.BlockSpec((1, bq, d), lambda bh, qi, ki: (bh, qi, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, bk, d), lambda bh, qi, ki: (bh, ki, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, bk, d), lambda bh, qi, ki: (bh, ki, 0),
-                         memory_space=pltpu.VMEM),
-        ],
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, bq, d), lambda bh, qi, ki: (bh, qi, 0),
                          memory_space=pltpu.VMEM),
@@ -181,29 +273,36 @@ def _flash_fwd(q, k, v, causal, scale, block_q, block_k,
             pltpu.VMEM((bq, d), jnp.float32),  # output accumulator
         ],
         interpret=jax.default_backend() != "tpu",
-    )(qf, kf, vf)
+    )(*inputs)
     out = out[:, :sq].reshape(b, h, sq, d)
     # residual is the compact UNPADDED (b*h, sq) row vector — the backward may
     # use different block geometry and re-pads with +inf itself
-    return out, (q, k, v, out, lse[:, :sq, 0])
+    return out, (q, k, v, mask, off, out, lse[:, :sq, 0])
 
 
-def _attn_probs(q, k, lse_col, k_start, q_start, *, scale, causal, bq, bk, kv_len):
+def _attn_probs(q, k, lse_col, k_start, q_start, off, mask_blk, *, scale,
+                causal, bq, bk, kv_len):
     """Recompute P_ij = exp(S_ij - L_i) for one (q block, k block) tile, masked."""
     s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                             preferred_element_type=jnp.float32) * scale
     kpos = k_start + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
     mask = kpos < kv_len
     if causal:
-        qpos = q_start + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+        qpos = off + q_start + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
         mask = jnp.logical_and(mask, qpos >= kpos)
+    if mask_blk is not None:
+        mask = jnp.logical_and(mask, mask_blk != 0)
     s = jnp.where(mask, s, _NEG_INF)
     # L = +inf on fully-masked/padded rows -> p = 0 there (see _fwd_kernel)
     return jnp.exp(s - lse_col)
 
 
-def _bwd_dq_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, dq_ref,
-                   dq_scr, *, scale, causal, bq, bk, kv_len):
+def _bwd_dq_kernel(off_ref, q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
+                   *rest, scale, causal, bq, bk, kv_len, has_mask):
+    if has_mask:
+        mask_ref, dq_ref, dq_scr = rest
+    else:
+        mask_ref, (dq_ref, dq_scr) = None, rest
     qi, ki, nk = pl.program_id(1), pl.program_id(2), pl.num_programs(2)
 
     @pl.when(ki == 0)
@@ -211,7 +310,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, dq_ref,
         dq_scr[:] = jnp.zeros_like(dq_scr)
 
     q_start, k_start = qi * bq, ki * bk
-    live = (k_start <= q_start + bq - 1) if causal else True
+    off = off_ref[0]
+    live = (k_start <= q_start + off + bq - 1) if causal else True
 
     @pl.when(live)
     def _block():
@@ -221,7 +321,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, dq_ref,
         # delta_i = rowsum(dO_i * O_i), recomputed per block (elementwise, cheap)
         delta = jnp.sum(do32 * o_ref[0].astype(jnp.float32), axis=1,
                         keepdims=True)
-        p = _attn_probs(q, k, lse_col, k_start, q_start, scale=scale,
+        p = _attn_probs(q, k, lse_col, k_start, q_start, off,
+                        mask_ref[0] if has_mask else None, scale=scale,
                         causal=causal, bq=bq, bk=bk, kv_len=kv_len)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
@@ -235,9 +336,12 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, dq_ref,
         dq_ref[0] = dq_scr[:].astype(dq_ref.dtype)
 
 
-def _bwd_dkv_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
-                    dk_ref, dv_ref, dk_scr, dv_scr, *, scale, causal, bq, bk,
-                    kv_len):
+def _bwd_dkv_kernel(off_ref, q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
+                    *rest, scale, causal, bq, bk, kv_len, has_mask):
+    if has_mask:
+        mask_ref, dk_ref, dv_ref, dk_scr, dv_scr = rest
+    else:
+        mask_ref, (dk_ref, dv_ref, dk_scr, dv_scr) = None, rest
     # grid: (bh, k_blocks, q_blocks) — accumulate over q for one k/v block
     ki, qi, nq = pl.program_id(1), pl.program_id(2), pl.num_programs(2)
 
@@ -247,7 +351,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
         dv_scr[:] = jnp.zeros_like(dv_scr)
 
     q_start, k_start = qi * bq, ki * bk
-    live = (k_start <= q_start + bq - 1) if causal else True
+    off = off_ref[0]
+    live = (k_start <= q_start + off + bq - 1) if causal else True
 
     @pl.when(live)
     def _block():
@@ -255,7 +360,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
         lse_col = lse_ref[0]                           # (bq, 1), compact
         delta = jnp.sum(do.astype(jnp.float32) * o_ref[0].astype(jnp.float32),
                         axis=1, keepdims=True)
-        p = _attn_probs(q, k, lse_col, k_start, q_start, scale=scale,
+        p = _attn_probs(q, k, lse_col, k_start, q_start, off,
+                        mask_ref[0] if has_mask else None, scale=scale,
                         causal=causal, bq=bq, bk=bk, kv_len=kv_len)
         pt = p.astype(do.dtype)
         dv_scr[:] += jax.lax.dot_general(pt, do, (((0,), (0,)), ((), ())),
@@ -275,7 +381,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
 def _flash_bwd(causal, scale, block_q, block_k, block_q_bwd, block_k_bwd,
                residuals, g):
     """Blockwise Pallas backward: never materializes the (S, S) matrix."""
-    q, k, v, o, lse_row = residuals
+    q, k, v, mask, off, o, lse_row = residuals
     b, h, sq, d = q.shape
     skv = k.shape[2]
     if scale is None:
@@ -292,8 +398,12 @@ def _flash_bwd(causal, scale, block_q, block_k, block_q_bwd, block_k_bwd,
     # nothing to dK/dV (their dQ rows are sliced off anyway)
     lse = _pad_to(lse_row, sq_p, 1, value=jnp.inf)[:, :, None]
 
+    has_mask = mask is not None
+    maskp = (_pad_to(_pad_to(mask, sq_p, 1), skv_p, 2) if has_mask else None)
+
     interpret = jax.default_backend() != "tpu"
-    common = dict(scale=scale, causal=causal, bq=bq, bk=bk, kv_len=skv)
+    common = dict(scale=scale, causal=causal, bq=bq, bk=bk, kv_len=skv,
+                  has_mask=has_mask)
     q_spec = pl.BlockSpec((1, bq, d), lambda bh, i, j: (bh, i, 0),
                           memory_space=pltpu.VMEM)
     lse_spec = pl.BlockSpec((1, bq, 1), lambda bh, i, j: (bh, i, 0),
@@ -301,15 +411,20 @@ def _flash_bwd(causal, scale, block_q, block_k, block_q_bwd, block_k_bwd,
     kv_spec = pl.BlockSpec((1, bk, d), lambda bh, i, j: (bh, j, 0),
                            memory_space=pltpu.VMEM)
 
+    in_specs = [_OFF_SPEC, q_spec, kv_spec, kv_spec, q_spec, q_spec, lse_spec]
+    inputs = [off, qf, kf, vf, of, dof, lse]
+    if has_mask:
+        in_specs.append(_mask_spec(maskp, b, h, bq, bk, transposed=False))
+        inputs.append(maskp)
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, **common),
         grid=(b * h, sq_p // bq, skv_p // bk),
-        in_specs=[q_spec, kv_spec, kv_spec, q_spec, q_spec, lse_spec],
+        in_specs=in_specs,
         out_specs=q_spec,
         out_shape=jax.ShapeDtypeStruct((b * h, sq_p, d), q.dtype),
         scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
         interpret=interpret,
-    )(qf, kf, vf, of, dof, lse)
+    )(*inputs)
 
     # transposed grid: blocks indexed (bh, k block, q block)
     qT_spec = pl.BlockSpec((1, bq, d), lambda bh, j, i: (bh, i, 0),
@@ -318,22 +433,37 @@ def _flash_bwd(causal, scale, block_q, block_k, block_q_bwd, block_k_bwd,
                              memory_space=pltpu.VMEM)
     kvT_spec = pl.BlockSpec((1, bk, d), lambda bh, j, i: (bh, j, 0),
                             memory_space=pltpu.VMEM)
+    in_specsT = [_OFF_SPEC, qT_spec, kvT_spec, kvT_spec, qT_spec, qT_spec,
+                 lseT_spec]
+    inputsT = [off, qf, kf, vf, of, dof, lse]
+    if has_mask:
+        in_specsT.append(_mask_spec(maskp, b, h, bq, bk, transposed=True))
+        inputsT.append(maskp)
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, **common),
         grid=(b * h, skv_p // bk, sq_p // bq),
-        in_specs=[qT_spec, kvT_spec, kvT_spec, qT_spec, qT_spec, lseT_spec],
+        in_specs=in_specsT,
         out_specs=[kvT_spec, kvT_spec],
         out_shape=[jax.ShapeDtypeStruct((b * h, skv_p, d), k.dtype),
                    jax.ShapeDtypeStruct((b * h, skv_p, d), v.dtype)],
         scratch_shapes=[pltpu.VMEM((bk, d), jnp.float32),
                         pltpu.VMEM((bk, d), jnp.float32)],
         interpret=interpret,
-    )(qf, kf, vf, of, dof, lse)
+    )(*inputsT)
 
     dq = dq[:, :sq].reshape(b, h, sq, d)
     dk = dk[:, :skv].reshape(b, h, skv, d)
     dv = dv[:, :skv].reshape(b, h, skv, d)
-    return dq, dk, dv
+    import numpy as _np
+
+    from jax import dtypes as _jdt
+
+    # mask (bool/int8) and kv_offset (int32) have no gradient; their cotangent
+    # type is float0
+    dmask = (None if mask is None
+             else _np.zeros(mask.shape, _jdt.float0))
+    doff = _np.zeros(off.shape, _jdt.float0)
+    return dq, dk, dv, dmask, doff
 
 
-flash_attention.defvjp(_flash_fwd, _flash_bwd)
+_flash.defvjp(_flash_fwd, _flash_bwd)
