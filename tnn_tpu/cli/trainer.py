@@ -67,6 +67,9 @@ def main(argv=None):
                          "(axes: data fsdp model pipe seq expert)")
     ap.add_argument("--num-microbatches", type=int, default=None,
                     help="pipeline microbatches per step (with --mesh pipe=N)")
+    ap.add_argument("--pipeline-virtual", type=int, default=None,
+                    help="interleaved virtual stages per pipe device (v>1 "
+                         "splits the model into v*pp stages; bubble/v)")
     ap.add_argument("--seq-parallel-method", default=None,
                     choices=["ring", "ulysses"],
                     help="context-parallel scheme for --mesh seq=N")
@@ -91,6 +94,8 @@ def main(argv=None):
                          (kv.split("=") for kv in args.mesh.split(",") if kv)}
     if args.num_microbatches is not None:
         cfg.num_microbatches = args.num_microbatches
+    if args.pipeline_virtual is not None:
+        cfg.pipeline_virtual = args.pipeline_virtual
     if args.seq_parallel_method is not None:
         cfg.seq_parallel_method = args.seq_parallel_method
 

@@ -267,13 +267,14 @@ class HeteroPipeline:
                  input_dtype=jnp.bfloat16, num_microbatches: int = 4,
                  axis: str = "pipe", loss_fn: Optional[Callable] = None,
                  compute_accuracy: bool = True, data_axis: Optional[str] = None,
-                 remat: bool = False):
+                 remat: bool = False, virtual: int = 1):
         from ..nn import losses as losses_lib
 
         self.stages = list(stages)
         self.mesh = mesh
         self.axis = axis
         self.pp = mesh_lib.axis_size(mesh, axis)
+        self.v = int(virtual)
         # dp x pp in ONE program: the microbatch batch dim shards over the data
         # axis (each data rank pipelines its slice; grads auto-psum because the
         # params are replicated over data in the shard_map in_specs). The
@@ -288,23 +289,37 @@ class HeteroPipeline:
                 raise ValueError(f"microbatch size {input_shape[0]} not "
                                  f"divisible by data axis {self.dp}")
             input_shape = (input_shape[0] // self.dp,) + tuple(input_shape[1:])
-        if self.pp != len(self.stages):
-            raise ValueError(f"{len(self.stages)} stages need mesh {axis} size "
-                             f"{len(self.stages)}, got {self.pp}")
+        if self.v * self.pp != len(self.stages):
+            raise ValueError(f"{len(self.stages)} stages != virtual {self.v} "
+                             f"x mesh {axis} size {self.pp}")
+        self.L = len(self.stages)  # global stage count (v chunks per device)
         self.num_mb = int(num_microbatches)
+        if self.v > 1 and self.num_mb % self.pp:
+            raise ValueError(f"interleaved schedule needs num_microbatches "
+                             f"({self.num_mb}) divisible by pipe ({self.pp})")
+        # device-order row layout: row r = d*v + c holds global stage c*pp + d,
+        # so sharding the leading axis over pipe gives device d its v chunks
+        # contiguously (identity when v == 1)
+        self._stage_of_row = [(r % self.v) * self.pp + r // self.v
+                              for r in range(self.L)]
         if isinstance(loss_fn, str) or loss_fn is None:
             loss_fn = losses_lib.get(loss_fn or "softmax_cross_entropy")
         self.loss_fn = loss_fn
         self.compute_accuracy = bool(compute_accuracy)
-        # Schedule note: this is compiled lockstep GPipe — bubble fraction is
+        # Schedule note: v == 1 is compiled lockstep GPipe — bubble fraction
         # (pp-1)/(num_mb+pp-1). Event-driven 1F1B (the reference's semi-async
         # schedule, coordinator.hpp:165-223) has the SAME bubble as GPipe; its
         # memory benefit comes here from ``remat=True`` (saved activations per
         # tick shrink to the hop buffers), and hops cost ~0 (ICI ppermute
         # inside one XLA program vs per-hop TCP/RDMA serialization), so
-        # num_mb can be raised until the bubble vanishes. The schedule that
-        # genuinely beats both — interleaved virtual stages, bubble/v — is
-        # implemented for homogeneous stacks as ``spmd_pipeline_interleaved``.
+        # num_mb can be raised until the bubble vanishes. ``virtual=v > 1``
+        # runs the interleaved (Megatron-style) schedule — device d holds the
+        # v chunks c*pp+d, and the bubble drops to (pp-1)/v stage-times: with
+        # sub-tick tau(s=c*pp+d, m) = d + (m%%pp) + pp*(c + v*(m//pp)) every
+        # hop (in-chunk d->d+1 AND chunk-boundary pp-1->0) has slack exactly
+        # 1, so one ppermute per sub-tick suffices and the whole schedule
+        # stays a single compiled scan (same tightness argument as
+        # ``spmd_pipeline_interleaved``, here with heterogeneous stages).
         self.remat = bool(remat)
 
         # shape propagation (parity: deploy_stages shape chain,
@@ -354,25 +369,24 @@ class HeteroPipeline:
     # -- state management -----------------------------------------------------
 
     def init_packed(self, rng: jax.Array) -> Tuple[jax.Array, jax.Array]:
-        """Initialize every stage and pack into ((pp, p_len), (pp, s_len)) rows,
-        placed sharded over the pipe axis."""
-        keys = jax.random.split(rng, self.pp)
-        p_rows, s_rows = [], []
-        for i, stage in enumerate(self.stages):
-            v = stage.init(keys[i], self.in_shapes[i])
-            p_rows.append(self.p_codecs[i].pack(v["params"], self.p_len))
-            s_rows.append(self.s_codecs[i].pack(v["state"], self.s_len))
-        sharding = NamedSharding(self.mesh, P(self.axis))
-        return (jax.device_put(jnp.stack(p_rows), sharding),
-                jax.device_put(jnp.stack(s_rows), sharding))
+        """Initialize every stage and pack into ((L, p_len), (L, s_len)) rows
+        in DEVICE order (row d*v + c = stage c*pp + d), placed sharded over
+        the pipe axis."""
+        keys = jax.random.split(rng, self.L)
+        vars_by_stage = [stage.init(keys[i], self.in_shapes[i])
+                         for i, stage in enumerate(self.stages)]
+        return self.pack_stage_variables(vars_by_stage)
 
     def unpack_stage_variables(self, packed_params, packed_state) -> List[dict]:
-        """Back to per-stage {"params", "state"} pytrees (checkpoint/export)."""
+        """Back to per-stage {"params", "state"} pytrees in GLOBAL stage order
+        (checkpoint/export)."""
         pr = np.asarray(packed_params)
         sr = np.asarray(packed_state)
-        return [{"params": self.p_codecs[i].unpack(jnp.asarray(pr[i])),
-                 "state": self.s_codecs[i].unpack(jnp.asarray(sr[i]))}
-                for i in range(self.pp)]
+        out = [None] * self.L
+        for r, s in enumerate(self._stage_of_row):
+            out[s] = {"params": self.p_codecs[s].unpack(jnp.asarray(pr[r])),
+                      "state": self.s_codecs[s].unpack(jnp.asarray(sr[r]))}
+        return out
 
     def place_train_state(self, state):
         """Re-apply the pipe-axis sharding to a TrainState whose leaves lost
@@ -389,13 +403,14 @@ class HeteroPipeline:
             net_state=jax.device_put(state.net_state, rows))
 
     def pack_stage_variables(self, variables: Sequence[dict]):
-        """Inverse of unpack (restore from a per-stage checkpoint)."""
+        """Inverse of unpack: per-stage variables (global order) -> device-order
+        packed rows (restore from a per-stage checkpoint)."""
         sharding = NamedSharding(self.mesh, P(self.axis))
-        p = jnp.stack([self.p_codecs[i].pack(v["params"], self.p_len)
-                       for i, v in enumerate(variables)])
-        s = jnp.stack([self.s_codecs[i].pack(v["state"], self.s_len)
-                       for i, v in enumerate(variables)])
-        return jax.device_put(p, sharding), jax.device_put(s, sharding)
+        p = jnp.stack([self.p_codecs[s].pack(variables[s]["params"], self.p_len)
+                       for s in self._stage_of_row])
+        s_ = jnp.stack([self.s_codecs[s].pack(variables[s]["state"], self.s_len)
+                        for s in self._stage_of_row])
+        return jax.device_put(p, sharding), jax.device_put(s_, sharding)
 
     # -- the compiled schedule ------------------------------------------------
 
@@ -410,22 +425,29 @@ class HeteroPipeline:
         stage = self.stages[i]
         in_shape, in_dtype = self.in_shapes[i], self.in_dtypes[i]
         p_codec, s_codec = self.p_codecs[i], self.s_codecs[i]
-        is_last = i == self.pp - 1
+        is_last = i == self.L - 1
 
         def run_stage(p_vec, s_vec, x, key):
+            from ..train.step import aux_loss_sum
+
             variables = {"params": p_codec.unpack(p_vec),
                          "state": s_codec.unpack(s_vec)}
             out, new_state = stage.apply(variables, x, train=train, rng=key)
-            return out, s_codec.pack(new_state, self.s_len)
+            # every stage reports its own aux losses (MoE load balancing,
+            # nn/moe.py) — the schedule adds them to the training loss per
+            # active microbatch, matching make_train_step's aux_loss_sum
+            aux = aux_loss_sum(new_state) if train else jnp.zeros(
+                (), jnp.float32)
+            return out, s_codec.pack(new_state, self.s_len), aux
 
         if self.remat and train:
             run_stage = jax.checkpoint(run_stage)
 
         def branch(p_vec, s_vec, buf, labels_mb, key):
             x = buf[:int(np.prod(in_shape))].reshape(in_shape).astype(in_dtype)
-            out, new_s_vec = run_stage(p_vec, s_vec, x, key)
+            out, new_s_vec, aux = run_stage(p_vec, s_vec, x, key)
             if is_last:
-                loss = self.loss_fn(out, labels_mb).astype(jnp.float32)
+                loss = self.loss_fn(out, labels_mb).astype(jnp.float32) + aux
                 if self.compute_accuracy:
                     from ..nn import metrics as metrics_lib
 
@@ -434,7 +456,7 @@ class HeteroPipeline:
                 else:
                     corr = jnp.zeros((), jnp.float32)
             else:
-                loss = jnp.zeros((), jnp.float32)
+                loss = aux
                 corr = jnp.zeros((), jnp.float32)
             return self._encode(out), new_s_vec, loss, corr
 
@@ -447,7 +469,7 @@ class HeteroPipeline:
         ``data``: (num_mb * mb, ...) or (num_mb, mb, ...); labels likewise.
         Differentiable w.r.t. packed_params. Run under ``self.mesh``.
         """
-        num_mb, pp, axis = self.num_mb, self.pp, self.axis
+        num_mb, pp, axis, v = self.num_mb, self.pp, self.axis, self.v
         mb = self.in_shapes[0][0]  # LOCAL microbatch size (per data shard)
         mb_global = mb * self.dp
         if data.shape[0] != num_mb:
@@ -456,42 +478,67 @@ class HeteroPipeline:
                                  f"{num_mb} x microbatch {mb_global}")
             data = data.reshape((num_mb, mb_global) + data.shape[1:])
             labels = labels.reshape((num_mb, mb_global) + labels.shape[1:])
-        branches = [self._make_branch(i, train) for i in range(pp)]
-        n_ticks = num_mb + pp - 1
+        branches = [self._make_branch(i, train) for i in range(self.L)]
+        if v == 1:
+            n_ticks = num_mb + pp - 1
+        else:
+            # last sub-tick: stage L-1 = (c=v-1, d=pp-1) on microbatch num_mb-1
+            n_ticks = ((pp - 1) + ((num_mb - 1) % pp)
+                       + pp * ((v - 1) + v * ((num_mb - 1) // pp)) + 1)
 
         def per_device(p_rows, s_rows, data_mb, labels_mb, key):
-            p_vec = p_rows[0]   # local (1, p_len) row -> (p_len,)
-            stage = jax.lax.axis_index(axis)
+            d = jax.lax.axis_index(axis)
             if self.data_axis is not None:
                 # distinct dropout masks per data shard — without this every
                 # shard would reuse the replicated key on different samples
                 key = jax.random.fold_in(key, jax.lax.axis_index(self.data_axis))
-            # encode all injected microbatches once (stage 0 consumes them)
+            # encode all injected microbatches once (stage c=0, d=0 consumes)
             inject = jax.vmap(self._encode)(data_mb)
 
             def tick(carry, t):
-                recv, s_vec, loss_acc, corr_acc = carry
-                inp = jnp.where(stage == 0, inject[jnp.minimum(t, num_mb - 1)],
-                                recv)
-                m_idx = jnp.clip(t - (pp - 1), 0, num_mb - 1)
-                key_t = jax.random.fold_in(jax.random.fold_in(key, t), stage)
+                recv, s_rows_l, loss_acc, corr_acc = carry
+                if v == 1:
+                    c = jnp.zeros((), jnp.int32)
+                    m = t - d
+                    active = jnp.logical_and(d <= t, m < num_mb)
+                else:
+                    # invert tau: which (chunk c, microbatch m) runs now?
+                    w = t - d
+                    q, j = w // pp, jnp.mod(w, pp)
+                    c = jnp.mod(q, v)
+                    m = (q // v) * pp + j
+                    active = jnp.logical_and(w >= 0, m < num_mb)
+                m_idx = jnp.clip(m, 0, num_mb - 1)
+                inject_here = jnp.logical_and(c == 0, d == 0)
+                inp = jnp.where(inject_here, inject[m_idx], recv)
+                s_vec = jax.lax.dynamic_index_in_dim(s_rows_l, c, 0,
+                                                     keepdims=False)
+                p_vec = jax.lax.dynamic_index_in_dim(p_rows, c, 0,
+                                                     keepdims=False)
+                gstage = c * pp + d
+                key_t = jax.random.fold_in(jax.random.fold_in(key, t), gstage)
                 out_buf, new_s, loss, corr = jax.lax.switch(
-                    stage, branches, p_vec, s_vec, inp, labels_mb[m_idx], key_t)
+                    gstage, branches, p_vec, s_vec, inp, labels_mb[m_idx],
+                    key_t)
                 # a stage holds a real microbatch only during its active window;
                 # outside it the input is schedule garbage — state/loss must not
                 # absorb it (this is what keeps BatchNorm statistics exact)
-                active = jnp.logical_and(stage <= t, t - stage < num_mb)
-                s_vec = jnp.where(active, new_s, s_vec)
-                emit = jnp.logical_and(active, stage == pp - 1)
-                loss_acc = loss_acc + jnp.where(emit, loss, 0.0)
+                s_rows_l = jax.lax.dynamic_update_index_in_dim(
+                    s_rows_l, jnp.where(active, new_s, s_vec), c, 0)
+                # every ACTIVE stage contributes (non-last stages return their
+                # aux losses only — 0 unless the stage carries MoE routing);
+                # accuracy still comes from the emitting last stage alone
+                emit = jnp.logical_and(
+                    active, jnp.logical_and(d == pp - 1, c == v - 1))
+                loss_acc = loss_acc + jnp.where(active, loss, 0.0)
                 corr_acc = corr_acc + jnp.where(emit, corr, 0.0)
                 perm = [(i, (i + 1) % pp) for i in range(pp)]
                 recv = jax.lax.ppermute(out_buf, axis, perm)
-                return (recv, s_vec, loss_acc, corr_acc), None
+                return (recv, s_rows_l, loss_acc, corr_acc), None
 
             zero_buf = jnp.zeros((self.buf_elems,), self.buf_dtype)
-            (recv, s_vec, loss_acc, corr_acc), _ = jax.lax.scan(
-                tick, (zero_buf, s_rows[0], jnp.zeros((), jnp.float32),
+            (recv, s_rows_l, loss_acc, corr_acc), _ = jax.lax.scan(
+                tick, (zero_buf, s_rows, jnp.zeros((), jnp.float32),
                        jnp.zeros((), jnp.float32)),
                 jnp.arange(n_ticks))
             if self.data_axis is not None:
@@ -499,10 +546,11 @@ class HeteroPipeline:
                 # updates (sync-BN-style state merge; normalization itself used
                 # per-shard batch stats — standard "ghost BN" dp semantics) and
                 # reduce loss/corrects so outputs are data-axis invariant
-                s_vec = jax.lax.pmean(s_vec, self.data_axis)
+                s_rows_l = jax.lax.pmean(s_rows_l, self.data_axis)
                 loss_acc = jax.lax.pmean(loss_acc, self.data_axis)
                 corr_acc = jax.lax.psum(corr_acc, self.data_axis)
-            return s_vec[None], loss_acc[None], corr_acc[None]
+            # local (v, s_len) rows concatenate over pipe to (L, s_len)
+            return s_rows_l, loss_acc[None], corr_acc[None]
 
         dp_ax = self.data_axis
         in_specs = (P(axis), P(axis), P(None, dp_ax), P(None, dp_ax), P())
@@ -511,7 +559,9 @@ class HeteroPipeline:
                            out_specs=out_specs, check_vma=False)
         new_state, losses, corrects = fn(packed_params, packed_state, data,
                                          labels, rng)
-        # only the last stage's accumulators are nonzero; sum is exact
+        # summing over devices collects the last stage's data losses AND every
+        # stage's aux losses, averaged per microbatch — the same total
+        # make_train_step's loss_fn + aux_loss_sum produces under grad accum
         loss = jnp.sum(losses) / num_mb
         metrics = {"loss": loss}
         if self.compute_accuracy:
@@ -526,11 +576,13 @@ def make_pipeline_train_step(stages: Sequence, optimizer, mesh: Mesh,
                              donate: bool = True, compute_accuracy: bool = True,
                              data_axis: Optional[str] = None,
                              augment: Optional[Callable] = None,
-                             remat: bool = False):
+                             remat: bool = False, virtual: int = 1):
     """Config-to-running-pipeline in one call (parity: the reference's
     coordinator deploy + async_train_batch + UPDATE_PARAMETERS cycle,
     coordinator.hpp:165-223, as ONE jitted program).
 
+    ``virtual=v > 1`` selects the interleaved schedule: pass v*pp stages and
+    the GPipe bubble shrinks to (pp-1)/v stage-times.
     ``input_shape`` is the per-MICROBATCH input shape (mb, H, W, C).
     Returns ``(pipe, step_fn, init_fn)``:
       * ``init_fn(rng) -> TrainState`` — packed params/state sharded over pipe,
@@ -547,7 +599,7 @@ def make_pipeline_train_step(stages: Sequence, optimizer, mesh: Mesh,
     pipe = HeteroPipeline(stages, mesh, input_shape, input_dtype=input_dtype,
                           num_microbatches=num_microbatches, axis=axis,
                           loss_fn=loss_fn, compute_accuracy=compute_accuracy,
-                          data_axis=data_axis, remat=remat)
+                          data_axis=data_axis, remat=remat, virtual=virtual)
     scheduler = scheduler or NoOp()
     host_driven = getattr(scheduler, "host_driven", False)
 
@@ -702,6 +754,10 @@ class StagePipeline:
         threads through the microbatches (mb k normalizes with mb k's batch
         stats and updates the running stats mb k-1 left), matching
         single-device gradient accumulation.
+
+        Returns the mean microbatch loss as a DEVICE scalar — fetching it
+        (float()) is the caller's sync point; doing it here would serialize
+        every step boundary on the host.
         """
         n = len(self.stages)
         mbs = jnp.split(data, num_microbatches)
@@ -755,4 +811,7 @@ class StagePipeline:
             new_params, self.opt_states[i] = self.optimizer.update(
                 grads[i], self.opt_states[i], self.variables[i]["params"])
             self.variables[i] = {"params": new_params, "state": self.variables[i]["state"]}
-        return float(sum(float(l) for l in losses) * scale)
+        # device scalar: a float() here would sync the host every step and
+        # serialize step boundaries; callers fetch when they actually log.
+        # (All losses are computed on devices[-1] already — no transfers.)
+        return sum(losses[1:], losses[0]) * scale

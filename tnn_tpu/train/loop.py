@@ -154,20 +154,22 @@ def train_model(
                              f"num_microbatches*data = {num_mb}*{dp}")
         mb_global = batch_size // num_mb
         mesh = parallel.make_mesh(data=dp, pipe=pp)
+        virtual = max(1, int(getattr(config, "pipeline_virtual", 1)))
         stages = partitioner.partition_model(
-            model, pp, (mb_global,) + sample_shape, strategy="balanced")
+            model, virtual * pp, (mb_global,) + sample_shape,
+            strategy="balanced")
         io_dtype = jax.numpy.dtype(config.io_dtype)
         pipe, step_fn, init_fn = make_pipeline_train_step(
             stages, optimizer, mesh, (mb_global,) + sample_shape,
             loss_fn=config.loss, num_microbatches=num_mb,
             input_dtype=io_dtype, scheduler=scheduler,
             data_axis="data" if dp > 1 else None, augment=augment,
-            remat=bool(config.remat))
+            remat=bool(config.remat), virtual=virtual)
         if state is None:
             state = init_fn(rng)
         eval_fn = make_pipeline_eval_step(pipe)
-        log.info("pipeline mesh %s: %d stages x %d microbatches (dp=%d)",
-                 dict(mesh.shape), pp, num_mb, dp)
+        log.info("pipeline mesh %s: %d stages x %d microbatches (dp=%d, v=%d)",
+                 dict(mesh.shape), virtual * pp, num_mb, dp, virtual)
     else:
         if state is None:
             state = create_train_state(model, optimizer, rng, input_shape)

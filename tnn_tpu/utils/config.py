@@ -40,6 +40,9 @@ class TrainingConfig:
     num_microbatches: int = 2
     mesh_axes: Dict[str, int] = dataclasses.field(default_factory=dict)  # e.g. {"data": 8}
     remat: bool = False  # rematerialize forward in backward (memory for FLOPs)
+    # pipeline runs: virtual (interleaved) stages per device — v>1 splits the
+    # model into v*pp stages and shrinks the GPipe bubble to (pp-1)/v
+    pipeline_virtual: int = 1
     seq_parallel_method: str = "ring"  # "ring" (K/V rotation) | "ulysses" (all-to-all)
 
     # beyond-reference params
