@@ -13,8 +13,11 @@ keeps every tensor static-shaped for XLA. The Switch-style load-balancing
 auxiliary loss travels through the layer's mutable state under "aux_loss";
 ``make_train_step`` sums every such leaf into the training loss
 (train/step.py:aux_loss_sum), so MoE models get load balancing through the
-normal training path. (The compiled pipeline packs state opaquely and does not
-consume aux losses — noted limitation.)
+normal training path — and the compiled pipeline collects each stage's
+aux_loss leaves per active microbatch into its loss accumulator
+(parallel/pipeline.py), so an MoE stage inside a pipeline trains balanced
+too (round-4; verified against single-device grad accumulation in
+tests/test_parallel.py).
 """
 from __future__ import annotations
 
