@@ -41,8 +41,14 @@ class BatchNorm(Module):
         reduce_axes = tuple(range(x.ndim - 1))
         xf = x.astype(jnp.float32)
         if train:
+            # E[x^2] - mean^2 instead of jnp.var: the two reductions have no
+            # data dependence, so XLA fuses them into ONE pass over the
+            # activation (jnp.var's (x - mean)^2 needs mean first — a second
+            # full read). f32 accumulation; clamp absorbs the cancellation
+            # residue. This is the BN-bandwidth lever on a HBM-bound step.
             mean = jnp.mean(xf, axis=reduce_axes)
-            var = jnp.var(xf, axis=reduce_axes)
+            mean2 = jnp.mean(jnp.square(xf), axis=reduce_axes)
+            var = jnp.maximum(mean2 - jnp.square(mean), 0.0)
             m = self.momentum
             new_state = {"mean": m * state["mean"] + (1 - m) * mean,
                          "var": m * state["var"] + (1 - m) * var}
@@ -81,8 +87,10 @@ class LayerNorm(Module):
 
     def _apply(self, params, state, x, *, train, rng):
         xf = x.astype(jnp.float32)
+        # single-pass stats (see BatchNorm): mean and E[x^2] fuse into one read
         mean = jnp.mean(xf, axis=-1, keepdims=True)
-        var = jnp.var(xf, axis=-1, keepdims=True)
+        mean2 = jnp.mean(jnp.square(xf), axis=-1, keepdims=True)
+        var = jnp.maximum(mean2 - jnp.square(mean), 0.0)
         y = (xf - mean) * jnp.reciprocal(jnp.sqrt(var + self.eps))
         if self.affine:
             y = y * params["scale"].astype(jnp.float32) + params["bias"].astype(jnp.float32)
@@ -122,7 +130,9 @@ class GroupNorm(Module):
         xf = x.astype(jnp.float32).reshape(x.shape[:-1] + (g, c // g))
         axes = tuple(range(1, xf.ndim - 2)) + (xf.ndim - 1,)
         mean = jnp.mean(xf, axis=axes, keepdims=True)
-        var = jnp.var(xf, axis=axes, keepdims=True)
+        # single-pass stats (see BatchNorm)
+        mean2 = jnp.mean(jnp.square(xf), axis=axes, keepdims=True)
+        var = jnp.maximum(mean2 - jnp.square(mean), 0.0)
         y = ((xf - mean) * jnp.reciprocal(jnp.sqrt(var + self.eps))).reshape(x.shape)
         if self.affine:
             y = y * params["scale"].astype(jnp.float32) + params["bias"].astype(jnp.float32)
