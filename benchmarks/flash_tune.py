@@ -1,0 +1,110 @@
+#!/usr/bin/env python
+"""Flash-attention block-geometry sweep (short-S retune, VERDICT r03 #10).
+
+Round 3 left S=1024 forward at 30.3 TFLOP/s (~31% of the D=64-contraction
+cap) while S=4096 reaches ~78% of it; the suspect is block geometry tuned for
+long sequences. This sweep times the Pallas forward (and optionally fwd+bwd)
+over a (block_q, block_k) grid at short S so the winner can be promoted into
+``flash_attention``'s defaults per-S — run on the chip:
+
+    python -m benchmarks.flash_tune --seq 1024 --seq 512
+    python -m benchmarks.flash_tune --seq 1024 --bwd
+
+Numerics are verified against the XLA reference before any timing (standard
+benchmark-with-verification discipline).
+"""
+import argparse
+import itertools
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from benchmarks.common import sync, time_loop
+
+BLOCKS = [128, 256, 512, 1024]
+
+
+def sweep(b, h, s, d, bwd=False, causal=True):
+    from tnn_tpu.nn.attention import local_xla_attention
+    from tnn_tpu.ops.pallas.flash_attention import flash_attention
+
+    rs = np.random.RandomState(0)
+    q = jnp.asarray(rs.randn(b, h, s, d), jnp.bfloat16)
+    k = jnp.asarray(rs.randn(b, h, s, d), jnp.bfloat16)
+    v = jnp.asarray(rs.randn(b, h, s, d), jnp.bfloat16)
+    if bwd:
+        ref = jax.grad(lambda q, k, v: jnp.sum(local_xla_attention(
+            q, k, v, causal=causal).astype(jnp.float32)))(
+                q.astype(jnp.float32), k.astype(jnp.float32),
+                v.astype(jnp.float32))
+    else:
+        ref = local_xla_attention(q.astype(jnp.float32),
+                                  k.astype(jnp.float32),
+                                  v.astype(jnp.float32), causal=causal)
+    ref_scale = float(jnp.max(jnp.abs(ref))) or 1.0
+    # fwd FLOPs: 2 matmuls x 2*S^2*D, halved by causal; x3.5 for fwd+bwd
+    flops = b * h * 2 * 2 * s * s * d * (0.5 if causal else 1.0)
+    if bwd:
+        flops *= 3.5
+    results = []
+    for bq, bk in itertools.product(BLOCKS, BLOCKS):
+        if bq > s or bk > s:
+            continue
+        try:
+            if bwd:
+                fn = jax.jit(jax.grad(lambda q, k, v: jnp.sum(
+                    flash_attention(q, k, v, causal, None, bq, bk, bq, bk)
+                    .astype(jnp.float32))))
+            else:
+                fn = jax.jit(lambda q, k, v: flash_attention(
+                    q, k, v, causal, None, bq, bk))
+            out = fn(q, k, v)
+            # a wrong-but-silent geometry must never win the sweep: every
+            # combo verifies (dQ in bwd mode) against the XLA reference
+            err = float(jnp.max(jnp.abs(out.astype(jnp.float32) - ref)))
+            assert err < 0.05 * ref_scale, \
+                f"numerics off by {err} at ({bq},{bk})"
+            sync(out)
+
+            def run(n, fn=fn):
+                t0 = time.perf_counter()
+                o = None
+                for _ in range(n):
+                    o = fn(q, k, v)
+                sync(o)
+                return time.perf_counter() - t0
+
+            dt = time_loop(run, 8, min_delta=0.25, pairs=3)
+            tflops = flops / dt / 1e12
+            results.append(((bq, bk), dt * 1e3, tflops))
+            print(f"  S={s} blocks=({bq:4d},{bk:4d}): {dt*1e3:7.2f} ms "
+                  f"{tflops:6.1f} TFLOP/s")
+        except Exception as e:  # noqa: BLE001 — a VMEM-overflow combo just skips
+            print(f"  S={s} blocks=({bq},{bk}): failed ({type(e).__name__})")
+    results.sort(key=lambda r: r[1])
+    if results:
+        (bq, bk), ms, tf = results[0]
+        print(f"BEST S={s}{' fwd+bwd' if bwd else ''}: blocks=({bq},{bk}) "
+              f"{ms:.2f} ms {tf:.1f} TFLOP/s")
+    return results
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--seq", type=int, action="append", default=None)
+    ap.add_argument("--batch", type=int, default=8)
+    ap.add_argument("--heads", type=int, default=12)
+    ap.add_argument("--dhead", type=int, default=64)
+    ap.add_argument("--bwd", action="store_true")
+    args = ap.parse_args(argv)
+    print(f"devices: {jax.devices()}")
+    out = {}
+    for s in (args.seq or [512, 1024, 2048]):
+        out[s] = sweep(args.batch, args.heads, s, args.dhead, bwd=args.bwd)
+    return out
+
+
+if __name__ == "__main__":
+    main()

@@ -64,11 +64,12 @@ def bench_train(model_name: str, input_shape, num_classes: int, batch: int,
 
 def bench_gpt2_train(batch: int, seq: int, iters: int, size="small", flash=False,
                      max_len=None, remat=False, attn_flops=False, label=None,
-                     extra=None):
+                     extra=None, moe=False):
     from tnn_tpu import models, nn
     from tnn_tpu.train import create_train_state, make_train_step
 
-    name = f"flash_gpt2_{size}" if flash else f"gpt2_{size}"
+    name = ("moe_" if moe else "") + \
+        (f"flash_gpt2_{size}" if flash else f"gpt2_{size}")
     print(f"{name} train step (bs={batch}, S={seq}"
           + (", remat" if remat else "") + ")")
     model = models.create(name, **({"max_len": max_len} if max_len else {}))
@@ -79,6 +80,21 @@ def bench_gpt2_train(batch: int, seq: int, iters: int, size="small", flash=False
     ids = jnp.asarray(rs.randint(0, 50257, (batch, seq)), np.int32)
     dt = _time_steps(step, state, ids, ids, iters)
     n_params = _count_params(state.params)
+    if moe:
+        # MFU counts ACTIVE params: a top-k router touches k of E experts per
+        # token, so the (E - k)/E share of every expert-stacked MoE param
+        # contributes no FLOPs. Read k and the expert leaves from the model's
+        # own MoE modules — no shape heuristics
+        blk_moe = model.blocks[0].moe
+        e, k = blk_moe.num_experts, blk_moe.top_k
+        expert_keys = ("w_in", "b_in", "w_out", "b_out")
+        inactive = sum(
+            int(np.prod(leaf.shape)) * (e - k) // e
+            for path, leaf in jax.tree_util.tree_flatten_with_path(
+                state.params)[0]
+            if getattr(path[-1], "key", None) in expert_keys)
+        n_params -= inactive
+        extra = dict(extra or {}, experts=e, top_k=k, active_params=n_params)
     # 6ND fwd+bwd (Kaplan approximation)
     flops = 6.0 * n_params * batch * seq
     if attn_flops:
@@ -156,7 +172,7 @@ def bench_gpt2_decode(batch: int, prompt: int, new: int, size="small",
 def main(argv=None):
     ap = argparse.ArgumentParser()
     ap.add_argument("--quick", action="store_true")
-    ap.add_argument("--models", default="wrn,resnet9,vit,gpt2,gpt2_flash,decode,decode_int8")
+    ap.add_argument("--models", default="wrn,resnet9,vit,gpt2,gpt2_flash,moe,decode,decode_int8")
     args = ap.parse_args(argv)
     q = args.quick
     wanted = set(args.models.split(","))
@@ -186,6 +202,28 @@ def main(argv=None):
         # attention matters (reference ships gpt2 + flash_gpt2 side by side)
         results.append(bench_gpt2_train(2 if q else 8, 128 if q else 1024,
                                         3 if q else 10, flash=True))
+    if "moe" in wanted:
+        # expert-routed FFN variant; MFU on active params (VERDICT r03 #4)
+        results.append(bench_gpt2_train(2 if q else 8, 128 if q else 512,
+                                        3 if q else 10, moe=True))
+    if "gpt2_medium" in wanted:
+        # 355M params: flash attention + remat to fit train on one chip
+        results.append(bench_gpt2_train(1 if q else 4, 128 if q else 512,
+                                        3 if q else 8, size="medium",
+                                        flash=not q, remat=True,
+                                        extra={"remat": True}))
+        results.append(bench_gpt2_decode(1, 16 if q else 64, 8 if q else 64,
+                                         size="medium"))
+        if not q:
+            results.append(bench_gpt2_decode(1, 64, 64, size="medium",
+                                             int8=True))
+    if "gpt2_large" in wanted:
+        # 774M params: bs=1 + remat; decode int8 halves the weight stream
+        results.append(bench_gpt2_train(1, 128 if q else 512, 3 if q else 6,
+                                        size="large", flash=not q, remat=True,
+                                        extra={"remat": True}))
+        results.append(bench_gpt2_decode(1, 16 if q else 64, 8 if q else 64,
+                                         size="large", int8=not q))
     if "decode" in wanted:
         results.append(bench_gpt2_decode(1, 16 if q else 64, 16 if q else 128))
         if not q:  # serving-shaped batched decode (throughput mode)

@@ -8,13 +8,13 @@ cd "$(dirname "$0")/.."
 mkdir -p benchmarks/results
 STAMP=$(date +%Y%m%d_%H%M%S)
 
-echo "== 1/4 headline bench (persists on success) =="
+echo "== 1/6 headline bench (persists on success) =="
 python bench.py | tee "benchmarks/results/headline_${STAMP}.jsonl"
 
-echo "== 2/4 full microbench + model suite =="
-timeout 1800 python -m benchmarks.run_all --json "benchmarks/results/run_all_tpu_${STAMP}.json"
+echo "== 2/6 full microbench + model suite (incl. moe + int8 decode rows) =="
+timeout 2400 python -m benchmarks.run_all --json "benchmarks/results/run_all_tpu_${STAMP}.json"
 
-echo "== 3/4 GPT-2 LM on real tokens, Pallas flash attention backend =="
+echo "== 3/6 GPT-2 LM on real tokens, Pallas flash attention backend =="
 if [ ! -f /tmp/pytok/meta.json ]; then
   python -m tnn_tpu.cli.prepare_corpus --out /tmp/pytok \
       --source /usr/local/lib/python3.12 --glob '*.py' --max-mb 24
@@ -22,7 +22,20 @@ fi
 timeout 1800 python -m tnn_tpu.cli.train_gpt2 --tokens /tmp/pytok --steps 200 \
     --batch 16 --seq 512 --backend pallas --results benchmarks/results
 
-echo "== 4/4 commit the evidence =="
+echo "== 4/6 GPT-2 medium + large chip rows (train w/ remat, decode, int8) =="
+# stage to /tmp first: a failed/partial log must never be swept into the
+# evidence dir by the final git add -A
+if timeout 2400 python -m benchmarks.model_bench \
+    --models gpt2_medium,gpt2_large > "/tmp/gpt2_ml_${STAMP}.log" 2>&1; then
+  cp "/tmp/gpt2_ml_${STAMP}.log" "benchmarks/results/gpt2_ml_${STAMP}.log"
+else
+  echo "gpt2 m/l bench failed; log kept at /tmp/gpt2_ml_${STAMP}.log"
+fi
+
+echo "== 5/6 HBM-fit table (exact state bytes via eval_shape) =="
+python -m tools.hbm_fit | tee "benchmarks/results/hbm_fit_${STAMP}.txt"
+
+echo "== 6/6 commit the evidence =="
 git add -A benchmarks/results/
-git commit -m "TPU benchmark evidence: headline, microbench suite, Pallas LM run" || true
+git commit -m "TPU benchmark evidence: headline, microbench suite, LM curve, gpt2 m/l rows" || true
 echo "done"

@@ -39,6 +39,9 @@ def main(argv=None):
     ap.add_argument("-n", "--max-new-tokens", type=int, default=50)
     ap.add_argument("--temperature", type=float, default=0.0)
     ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--int8", action="store_true",
+                    help="weight-only int8 decode (in-VMEM-dequant Pallas "
+                         "matmul; ~2x fewer weight bytes per token)")
     args = ap.parse_args(argv)
 
     tokenizer = None
@@ -53,6 +56,14 @@ def main(argv=None):
         print(f"no --model-file: random-weight {args.model} (smoke/benchmark mode)")
         variables = model.init(jax.random.PRNGKey(args.seed), (1, 8))
         params = variables["params"]
+
+    if args.int8:
+        from tnn_tpu.nn.quant import quantize_for_decode, quantized_bytes
+
+        before = quantized_bytes(params)
+        params = quantize_for_decode(params)
+        print(f"int8 weights: {before / 2**20:.0f} MB -> "
+              f"{quantized_bytes(params) / 2**20:.0f} MB")
 
     if tokenizer is not None:
         prompt_ids = np.asarray(tokenizer.encode(args.prompt), np.int32)[None]
