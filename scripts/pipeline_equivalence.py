@@ -46,6 +46,10 @@ def main(argv=None):
     ap.add_argument("--mb", type=int, default=8, help="microbatch size")
     ap.add_argument("--steps", type=int, default=3)
     ap.add_argument("--tol", type=float, default=1e-4)
+    ap.add_argument("--f32", action="store_true",
+                    help="f32 compute: isolates schedule exactness from bf16 "
+                         "reduction-order noise (step>=1 under bf16 compounds "
+                         "one optimizer update's worth of rounding drift)")
     ap.add_argument("--out", default=None)
     args = ap.parse_args(argv)
 
@@ -56,14 +60,20 @@ def main(argv=None):
     v, pp, num_mb, mb = args.virtual, args.pp, args.num_mb, args.mb
     B = num_mb * mb
     mesh = parallel.make_mesh(pipe=pp)
-    model = models.create("cifar100_wrn16_8")
+    policy = None
+    if args.f32:
+        from tnn_tpu.core import dtypes as dt
+
+        policy = dt.FP32
+    model = models.create("cifar100_wrn16_8", policy=policy)
     parts = parallel.partitioner.balanced_partitions(model, v * pp,
                                                      (mb, 32, 32, 3))
     stages = parallel.partitioner.split(model, parts)
     opt = nn.SGD(lr=0.1, momentum=0.9)
+    in_dt = jnp.float32 if args.f32 else jnp.bfloat16
     pipe, step_fn, init_fn = parallel.make_pipeline_train_step(
         stages, opt, mesh, (mb, 32, 32, 3), num_microbatches=num_mb,
-        virtual=v)
+        virtual=v, input_dtype=in_dt)
     pstate = init_fn(jax.random.PRNGKey(0))
 
     # single-device reference from the pipeline's exact init
@@ -91,7 +101,7 @@ def main(argv=None):
     rs = np.random.RandomState(0)
     rows, worst = [], 0.0
     for step in range(args.steps):
-        data = jnp.asarray(rs.randn(B, 32, 32, 3), jnp.bfloat16)
+        data = jnp.asarray(rs.randn(B, 32, 32, 3), in_dt)
         labels = jnp.asarray(rs.randint(0, 100, B), jnp.int32)
         t0 = time.time()
         pstate, pm = step_fn(pstate, data, labels)
@@ -112,6 +122,7 @@ def main(argv=None):
         "layout": layout + f", {jax.device_count()}-device "
                   f"{jax.devices()[0].platform} mesh",
         "schedule": "interleaved" if v > 1 else "gpipe",
+        "compute": "f32" if args.f32 else "bf16",
         "ideal_bubble_fraction": round((pp - 1) / v / (num_mb + (pp - 1) / v), 4),
         "stage_layers": [len(s.children) for s in stages],
         "steps": rows,
@@ -122,7 +133,8 @@ def main(argv=None):
     path = args.out or os.path.join(
         os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
         "benchmarks", "results",
-        f"wrn16_8_pipeline_equivalence_v{v}.json")
+        f"wrn16_8_pipeline_equivalence_v{v}_pp{pp}"
+        + ("_f32" if args.f32 else "") + ".json")
     with open(path, "w") as f:
         json.dump(out, f, indent=2)
     print(f"wrote {path}; max rel diff {worst:.2e} "

@@ -33,7 +33,12 @@ else
 fi
 
 echo "== 5/6 HBM-fit table (exact state bytes via eval_shape) =="
-python -m tools.hbm_fit | tee "benchmarks/results/hbm_fit_${STAMP}.txt"
+if python -m tools.hbm_fit > "/tmp/hbm_fit_${STAMP}.txt" 2>&1; then
+  cp "/tmp/hbm_fit_${STAMP}.txt" "benchmarks/results/hbm_fit_${STAMP}.txt"
+  cat "benchmarks/results/hbm_fit_${STAMP}.txt"
+else
+  echo "hbm_fit failed; log kept at /tmp/hbm_fit_${STAMP}.txt"
+fi
 
 echo "== 6/6 commit the evidence =="
 git add -A benchmarks/results/

@@ -25,7 +25,7 @@ def _spawn_worker(port, results, name="w", **kw):
     return t
 
 
-def _await_workers(results, n, timeout=10.0):
+def _await_workers(results, n, timeout=60.0):
     """wait_for_workers returns when the coordinator saw the handshake, which can
     be before the worker thread stores its Worker object — wait for both."""
     deadline = time.monotonic() + timeout
@@ -41,27 +41,27 @@ class TestProtocol:
             res = {}
             t1 = _spawn_worker(coord.port(), res, "a", heartbeat_interval=0.2)
             t2 = _spawn_worker(coord.port(), res, "b", heartbeat_interval=0.2)
-            ranks = coord.wait_for_workers(timeout=15)
+            ranks = coord.wait_for_workers(timeout=60)
             assert ranks == [0, 1]
             _await_workers(res, 2)
             coord.deploy_config({"model": "x", "ranks": {"0": {}, "1": {}}},
-                                timeout=15)
+                                timeout=60)
             assert all(w.config["model"] == "x" for w in res.values())
 
             # barrier: workers block until coordinator releases
             done = []
 
             def at_barrier(w):
-                w.barrier("sync1", timeout=15)
+                w.barrier("sync1", timeout=60)
                 done.append(w.rank)
 
             bts = [threading.Thread(target=at_barrier, args=(w,))
                    for w in res.values()]
             for t in bts:
                 t.start()
-            coord.barrier("sync1", timeout=15)
+            coord.barrier("sync1", timeout=60)
             for t in bts:
-                t.join(timeout=15)
+                t.join(timeout=60)
             assert sorted(done) == [0, 1]
 
             coord.set_train_mode(False)
@@ -69,62 +69,62 @@ class TestProtocol:
             assert all(not w.training for w in res.values())
 
             coord.shutdown()
-            t1.join(timeout=10)
-            t2.join(timeout=10)
+            t1.join(timeout=60)
+            t2.join(timeout=60)
             assert not any(w.running for w in res.values())
 
     def test_explicit_rank_request(self):
         with Coordinator(num_workers=1) as coord:
             res = {}
             t = _spawn_worker(coord.port(), res, rank=5)
-            coord.wait_for_workers(timeout=15)
+            coord.wait_for_workers(timeout=60)
             _await_workers(res, 1)
             assert list(res.values())[0].rank == 5
             coord.shutdown()
-            t.join(timeout=10)
+            t.join(timeout=60)
 
     def test_profiling_rpc_merges_workers(self):
         with Coordinator(num_workers=1) as coord:
             res = {}
             t = _spawn_worker(coord.port(), res)
-            coord.wait_for_workers(timeout=15)
+            coord.wait_for_workers(timeout=60)
             _await_workers(res, 1)
             GlobalProfiler.clear()
             GlobalProfiler.add_event(EventType.COMPUTE, 0.0, 1.0, "span-x")
-            merged = coord.collect_profiles(timeout=15)
+            merged = coord.collect_profiles(timeout=60)
             assert any(e.name == "span-x" for e in merged.events)
             coord.clear_profiling()
             time.sleep(0.3)
             assert GlobalProfiler.events == []
             coord.shutdown()
-            t.join(timeout=10)
+            t.join(timeout=60)
 
     def test_custom_rpc(self):
         with Coordinator(num_workers=1) as coord:
             res = {}
             t = _spawn_worker(coord.port(), res)
-            coord.wait_for_workers(timeout=15)
+            coord.wait_for_workers(timeout=60)
             w = _await_workers(res, 1)[0]
             w.on("add", lambda obj: {"sum": obj["a"] + obj["b"]})
             assert coord.send_custom(w.rank, {"name": "add", "a": 2, "b": 3})
-            assert coord.recv_custom(timeout=15)["sum"] == 5
+            assert coord.recv_custom(timeout=60)["sum"] == 5
             # worker -> coordinator direction
             w.send_custom({"name": "status", "ok": True})
-            assert coord.recv_custom(timeout=15)["ok"] is True
+            assert coord.recv_custom(timeout=60)["ok"] is True
             coord.shutdown()
-            t.join(timeout=10)
+            t.join(timeout=60)
 
     def test_save_rpc(self, tmp_path):
         with Coordinator(num_workers=1) as coord:
             res = {}
             t = _spawn_worker(coord.port(), res)
-            coord.wait_for_workers(timeout=15)
+            coord.wait_for_workers(timeout=60)
             saved = []
             _await_workers(res, 1)[0].on_save = saved.append
-            coord.save_all(str(tmp_path / "snap"), timeout=15)
+            coord.save_all(str(tmp_path / "snap"), timeout=60)
             assert saved == [str(tmp_path / "snap")]
             coord.shutdown()
-            t.join(timeout=10)
+            t.join(timeout=60)
 
 
 class TestFailureDetection:
@@ -134,32 +134,32 @@ class TestFailureDetection:
             res = {}
             t1 = _spawn_worker(coord.port(), res, "a")
             t2 = _spawn_worker(coord.port(), res, "b")
-            coord.wait_for_workers(timeout=15)
+            coord.wait_for_workers(timeout=60)
             _await_workers(res, 2)
             victim = res["a"]
             victim_rank = victim.rank
             victim._running = False
             victim._t.close()  # abrupt death (no SHUTDOWN_ACK)
-            coord.wait_failed(victim_rank, timeout=10)  # event-driven wake
+            coord.wait_failed(victim_rank, timeout=60)  # event-driven wake
             assert failed == [victim_rank]
             # broadcasts now skip the dead worker without raising
             coord.set_train_mode(False)
             coord.shutdown()
-            t1.join(timeout=10)
-            t2.join(timeout=10)
+            t1.join(timeout=60)
+            t2.join(timeout=60)
 
     def test_heartbeat_timeout_detected(self):
         with Coordinator(num_workers=1, heartbeat_timeout=0.6) as coord:
             res = {}
             t = _spawn_worker(coord.port(), res, heartbeat_interval=60.0)
-            coord.wait_for_workers(timeout=15)
+            coord.wait_for_workers(timeout=60)
             w = _await_workers(res, 1)[0]
             # worker is connected but silent (stalled process): one initial
             # heartbeat, then nothing -> flagged after the timeout (staleness
             # has no transport event; wait_failed re-checks on a short cadence)
-            coord.wait_failed(w.rank, timeout=10)
+            coord.wait_failed(w.rank, timeout=60)
             coord.shutdown(timeout=2)
-            t.join(timeout=10)
+            t.join(timeout=60)
 
 
 class TestRobustness:
@@ -169,13 +169,13 @@ class TestRobustness:
             t1 = _spawn_worker(coord.port(), res, "a", rank=1)
             time.sleep(0.3)  # ensure a registers first
             t2 = _spawn_worker(coord.port(), res, "b")  # auto-rank
-            ranks = coord.wait_for_workers(timeout=15)
+            ranks = coord.wait_for_workers(timeout=60)
             assert ranks == [0, 1]
             _await_workers(res, 2)
             assert res["a"].rank == 1 and res["b"].rank == 0
             coord.shutdown()
-            t1.join(timeout=10)
-            t2.join(timeout=10)
+            t1.join(timeout=60)
+            t2.join(timeout=60)
 
     def test_barrier_releases_when_worker_dies(self):
         """A crash mid-wait shrinks the barrier target instead of hanging."""
@@ -183,7 +183,7 @@ class TestRobustness:
             res = {}
             t1 = _spawn_worker(coord.port(), res, "a")
             t2 = _spawn_worker(coord.port(), res, "b")
-            coord.wait_for_workers(timeout=15)
+            coord.wait_for_workers(timeout=60)
             _await_workers(res, 2)
             res["a"]._running = False
             res["a"]._t.close()  # dies before reaching the barrier
@@ -191,39 +191,39 @@ class TestRobustness:
             done = []
 
             def arrive():
-                survivor.barrier("b", timeout=20)
+                survivor.barrier("b", timeout=60)
                 done.append(True)
 
             bt = threading.Thread(target=arrive, daemon=True)
             bt.start()
-            coord.barrier("b", timeout=20)  # must not wait for the dead worker
-            bt.join(timeout=10)
+            coord.barrier("b", timeout=60)  # must not wait for the dead worker
+            bt.join(timeout=60)
             assert done
             coord.shutdown(timeout=2)
-            t1.join(timeout=10)
-            t2.join(timeout=10)
+            t1.join(timeout=60)
+            t2.join(timeout=60)
 
     def test_mismatched_barrier_arrivals_not_lost(self):
         """An early arrival for barrier B survives the collection of barrier A."""
         with Coordinator(num_workers=1) as coord:
             res = {}
             t = _spawn_worker(coord.port(), res)
-            coord.wait_for_workers(timeout=15)
+            coord.wait_for_workers(timeout=60)
             w = _await_workers(res, 1)[0]
             order = []
 
             def go():
-                w.barrier("second", timeout=20)  # arrives "early"
+                w.barrier("second", timeout=60)  # arrives "early"
                 order.append("released")
 
             bt = threading.Thread(target=go, daemon=True)
             bt.start()
             time.sleep(0.3)  # let the "second" arrival land first
-            coord.barrier("second", timeout=15)
-            bt.join(timeout=10)
+            coord.barrier("second", timeout=60)
+            bt.join(timeout=60)
             assert order == ["released"]
             coord.shutdown()
-            t.join(timeout=10)
+            t.join(timeout=60)
 
     def test_dead_arrival_cannot_release_barrier_for_absent_worker(self):
         """A arrives, B arrives then dies, C never arrives: the barrier must NOT
@@ -232,7 +232,7 @@ class TestRobustness:
         with Coordinator(num_workers=3, heartbeat_timeout=600) as coord:
             res = {}
             ts = [_spawn_worker(coord.port(), res, n) for n in ("a", "b", "c")]
-            coord.wait_for_workers(timeout=15)
+            coord.wait_for_workers(timeout=60)
             _await_workers(res, 3)
             wa, wb, wc = res["a"], res["b"], res["c"]
             released = []
@@ -261,19 +261,19 @@ class TestRobustness:
             # once C arrives, the barrier completes for the live set {A, C}
             tc = threading.Thread(target=arrive, args=(wc,), daemon=True)
             tc.start()
-            coord.barrier("gate", timeout=15)
-            ta.join(timeout=10)
-            tc.join(timeout=10)
+            coord.barrier("gate", timeout=60)
+            ta.join(timeout=60)
+            tc.join(timeout=60)
             assert sorted(released) == sorted([wa.rank, wc.rank])
             coord.shutdown(timeout=2)
             for t in ts:
-                t.join(timeout=10)
+                t.join(timeout=60)
 
     def test_unknown_command_does_not_kill_pump(self):
         with Coordinator(num_workers=1) as coord:
             res = {}
             t = _spawn_worker(coord.port(), res)
-            coord.wait_for_workers(timeout=15)
+            coord.wait_for_workers(timeout=60)
             w = _await_workers(res, 1)[0]
             # send a raw frame with an out-of-enum command straight at the pump
             w._t.send(w._conn, 999, b'{"x": 1}')
@@ -282,20 +282,20 @@ class TestRobustness:
             # protocol still functional afterwards
             w.on("ping", lambda obj: {"pong": 1})
             coord.send_custom(w.rank, {"name": "ping"})
-            assert coord.recv_custom(timeout=15)["pong"] == 1
+            assert coord.recv_custom(timeout=60)["pong"] == 1
             coord.shutdown()
-            t.join(timeout=10)
+            t.join(timeout=60)
 
     def test_save_all_without_handler_raises(self):
         with Coordinator(num_workers=1) as coord:
             res = {}
             t = _spawn_worker(coord.port(), res)
-            coord.wait_for_workers(timeout=15)
+            coord.wait_for_workers(timeout=60)
             _await_workers(res, 1)
             with pytest.raises(RuntimeError, match="did not save"):
-                coord.save_all("/tmp/nowhere", timeout=15)
+                coord.save_all("/tmp/nowhere", timeout=60)
             coord.shutdown()
-            t.join(timeout=10)
+            t.join(timeout=60)
 
     def test_failed_worker_can_rejoin(self):
         """Restarting a dead rank re-admits it (reference leaves this a stub)."""
@@ -304,21 +304,21 @@ class TestRobustness:
             res = {}
             t1 = _spawn_worker(coord.port(), res, "a")
             t2 = _spawn_worker(coord.port(), res, "b")
-            coord.wait_for_workers(timeout=15)
+            coord.wait_for_workers(timeout=60)
             _await_workers(res, 2)
             dead_rank = res["a"].rank
             res["a"]._running = False
             res["a"]._t.close()
-            coord.wait_failed(dead_rank, timeout=10)
+            coord.wait_failed(dead_rank, timeout=60)
             # restart with the same rank
             res2 = {}
             t3 = _spawn_worker(coord.port(), res2, "a2", rank=dead_rank)
             new = _await_workers(res2, 1)[0]
             assert new.rank == dead_rank
-            coord.wait_alive(dead_rank, timeout=10)  # woken by the handshake
+            coord.wait_alive(dead_rank, timeout=60)  # woken by the handshake
             coord.shutdown()
             for t in (t1, t2, t3):
-                t.join(timeout=10)
+                t.join(timeout=60)
 
 
 class TestTransportInterop:
@@ -332,15 +332,15 @@ class TestTransportInterop:
                 w = Worker("127.0.0.1", coord.port(),
                            transport=PyTransport(listen_port=None)).start()
                 res["w"] = w
-                w.barrier("x", timeout=15)
-                w.join(timeout=20)
+                w.barrier("x", timeout=60)
+                w.join(timeout=60)
 
             t = threading.Thread(target=run, daemon=True)
             t.start()
-            coord.wait_for_workers(timeout=15)
-            coord.barrier("x", timeout=15)
+            coord.wait_for_workers(timeout=60)
+            coord.barrier("x", timeout=60)
             coord.shutdown()
-            t.join(timeout=10)
+            t.join(timeout=60)
             assert "w" in res
         finally:
             coord.close()
@@ -378,7 +378,7 @@ class TestTransportInterop:
                     f"frame for tag {cmd} corrupted"
                 got += 1
             for t in threads:
-                t.join(timeout=10)
+                t.join(timeout=60)
         finally:
             send.close()
             recv.close()
@@ -388,11 +388,11 @@ class TestTransportInterop:
         with Coordinator(num_workers=1) as coord:
             res = {}
             t = _spawn_worker(coord.port(), res)
-            coord.wait_for_workers(timeout=15)
+            coord.wait_for_workers(timeout=60)
             big = "x" * 300_000
             w = _await_workers(res, 1)[0]
             w.on("echo", lambda obj: {"blob": obj["blob"]})
             coord.send_custom(w.rank, {"name": "echo", "blob": big})
-            assert coord.recv_custom(timeout=15)["blob"] == big
+            assert coord.recv_custom(timeout=60)["blob"] == big
             coord.shutdown()
-            t.join(timeout=10)
+            t.join(timeout=60)

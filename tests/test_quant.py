@@ -103,3 +103,12 @@ class TestQuantizedGPT2:
         # the first token (later tokens may legitimately diverge)
         tf = generate(m, params, ids, 6)
         assert int(toks[0, 0]) == int(tf[0, 0])
+
+    def test_checkpoint_rejects_quantized_params(self, setup, tmp_path):
+        """Int8Weight must not silently round-trip through checkpoints as a
+        plain dict (quantize AFTER load; float params are the stored form)."""
+        from tnn_tpu import checkpoint as ck
+
+        m, _, qp = setup
+        with pytest.raises(ValueError, match="Int8Weight"):
+            ck.save_model(str(tmp_path / "q.tnn"), m, qp, {})

@@ -54,6 +54,18 @@ def _flatten_with_keys(tree) -> Dict[str, Any]:
 def save_tensors(path: str, trees: Dict[str, Any], meta: Optional[Dict] = None) -> None:
     """Write named pytrees of arrays to one binary file. ``trees`` maps a section name
     ("params", "opt_state", ...) to a pytree; keys become "section/leaf/path"."""
+    from .ops.pallas.quant_matmul import Int8Weight
+
+    for section, tree in trees.items():
+        for leaf in jax.tree_util.tree_leaves(
+                tree, is_leaf=lambda x: isinstance(x, Int8Weight)):
+            if isinstance(leaf, Int8Weight):
+                # the custom pytree would silently reload as a plain dict and
+                # break layers downstream; quantization is a decode-time view
+                raise ValueError(
+                    f"section {section!r} contains Int8Weight leaves — "
+                    "checkpoints store float params; quantize AFTER load "
+                    "(nn.quantize_for_decode)")
     entries = []
     arrays = []
     offset = 0

@@ -107,16 +107,20 @@ class MoE(Module):
         in_cap = pos < cap
         weight = top_p * in_cap                                   # (T, k)
 
-        # dispatch/combine tensors (T, E, C)
+        # dispatch/combine tensors (T, E, C). dispatch holds exact 0/1 values,
+        # so it is built directly in the compute dtype — at real scale the
+        # (T, E, C) tensors dominate MoE memory and bf16 halves the bigger
+        # one (combine stays f32: its routing weights need the precision)
         pos_oh = jax.nn.one_hot(jnp.where(in_cap, pos, cap), cap + 1,
                                 dtype=jnp.float32)[..., :cap]     # (T, k, C)
-        dispatch = jnp.einsum("tke,tkc->tec", onehot * in_cap[..., None],
-                              pos_oh)
+        dispatch = jnp.einsum("tke,tkc->tec",
+                              (onehot * in_cap[..., None]).astype(compute),
+                              pos_oh.astype(compute))
         combine = jnp.einsum("tke,tkc,tk->tec", onehot, pos_oh, weight)
 
         # -- expert computation (batched over the expert dim; the leading E of
         # every parameter shards over the "expert" mesh axis) -----------------
-        xe = jnp.einsum("tec,td->ecd", dispatch.astype(compute),
+        xe = jnp.einsum("tec,td->ecd", dispatch,
                         xt.astype(compute))               # (E, C, D)
         w_in = self.policy.cast_param(params["w_in"])
         w_out = self.policy.cast_param(params["w_out"])
