@@ -7,7 +7,7 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
-from tnn_tpu.ops.pallas.quant_matmul import (Int8Weight, int8_matmul, qmatmul,
+from tnn_tpu.ops.pallas.quant_matmul import (Int8Weight, qmatmul,
                                              quantize_int8)
 
 
@@ -24,7 +24,7 @@ class TestKernel:
         x = jnp.asarray(rs.randn(m, k), jnp.bfloat16)
         iw = quantize_int8(w)
         ref = x.astype(jnp.float32) @ iw.dequant(jnp.float32)
-        got = int8_matmul(x, iw.q, iw.scale)
+        got = qmatmul(x, iw)
         assert got.dtype == x.dtype
         rel = float(jnp.max(jnp.abs(got.astype(jnp.float32) - ref))
                     / jnp.max(jnp.abs(ref)))
@@ -46,6 +46,34 @@ class TestKernel:
         out = jax.jit(lambda w, x: qmatmul(x, w))(
             iw, jnp.ones((2, 128), jnp.bfloat16))
         assert out.shape == (2, 128)
+
+    @pytest.mark.parametrize("k,n", [(768, 2304), (300, 130)])
+    def test_w8a8_matches_float_reference(self, k, n):
+        from tnn_tpu.ops.pallas.quant_matmul import w8a8_matmul
+
+        rs = np.random.RandomState(3)
+        w = rs.randn(k, n).astype(np.float32)
+        iw = quantize_int8(w)
+        x = jnp.asarray(rs.randn(4, k), jnp.bfloat16)
+        ref = np.asarray(x.astype(jnp.float32) @ jnp.asarray(w))
+        got = np.asarray(w8a8_matmul(x, iw, out_dtype=jnp.float32))
+        # weight + per-row activation int8 error: a couple percent relative
+        rel = np.max(np.abs(got - ref)) / np.max(np.abs(ref))
+        assert rel < 0.05, rel
+
+    def test_qmatmul_rank_stable_across_paths(self):
+        # the row-count dispatch (w8a8 vs pallas kernel) must not change the
+        # output rank: 1-D in -> 1-D out, 3-D in -> 3-D out on both routes
+        from tnn_tpu.ops.pallas import quant_matmul as qm
+
+        iw = quantize_int8(np.random.RandomState(4)
+                           .randn(256, 128).astype(np.float32))
+        x1 = jnp.ones((256,), jnp.bfloat16)
+        x3 = jnp.ones((2, 3, 256), jnp.bfloat16)
+        assert qmatmul(x1, iw).shape == (128,)          # w8a8 route
+        assert qmatmul(x3, iw).shape == (2, 3, 128)
+        big = jnp.ones((qm.W8A8_MAX_ROWS + 1, 256), jnp.bfloat16)
+        assert qmatmul(big, iw).shape == (qm.W8A8_MAX_ROWS + 1, 128)  # pallas
 
     def test_qmatmul_float_path_unchanged(self):
         rs = np.random.RandomState(2)
