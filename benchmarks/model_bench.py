@@ -119,11 +119,12 @@ def bench_gpt2_long_train(batch: int = 1, seq: int = 8192, iters: int = 10):
 
 
 def bench_gpt2_decode(batch: int, prompt: int, new: int, size="small",
-                      int8: bool = False):
+                      int8: bool = False, fused: bool = False):
     from tnn_tpu import models
     from tnn_tpu.models.gpt2 import generate
 
-    tag = "_int8" if int8 else ""
+    tag = "_fused" if fused else ("_int8" if int8 else "")
+    int8 = int8 or fused  # the fused kernel is int8-only
     print(f"gpt2_{size} decode{tag} (bs={batch}, prompt={prompt}, new={new})")
     model = models.create(f"gpt2_{size}")
     variables = model.init(jax.random.PRNGKey(0), (batch, 8))
@@ -152,15 +153,19 @@ def bench_gpt2_decode(batch: int, prompt: int, new: int, size="small",
         extra["logits_rel_err"] = round(rel, 4)
         extra["top1_agreement"] = round(
             float((lq.argmax(-1) == lf.argmax(-1)).mean()), 3)
+    if fused:
+        from tnn_tpu.models.fused_decode import fused_generate as gen_fn
+    else:
+        gen_fn = generate
     # generate() sizes the KV cache to the request by default (see gpt2.py)
-    out = generate(model, params, ids, new)  # compile
+    out = gen_fn(model, params, ids, new)  # compile
     sync(out)
 
     def run(n):
         t0 = time.perf_counter()
         o = None
         for _ in range(n):
-            o = generate(model, params, ids, new)
+            o = gen_fn(model, params, ids, new)
         sync(o)
         return time.perf_counter() - t0
 
@@ -172,7 +177,8 @@ def bench_gpt2_decode(batch: int, prompt: int, new: int, size="small",
 def main(argv=None):
     ap = argparse.ArgumentParser()
     ap.add_argument("--quick", action="store_true")
-    ap.add_argument("--models", default="wrn,resnet9,vit,gpt2,gpt2_flash,moe,decode,decode_int8")
+    ap.add_argument("--models", default="wrn,resnet9,vit,gpt2,gpt2_flash,moe,"
+                                        "decode,decode_int8,decode_fused")
     args = ap.parse_args(argv)
     q = args.quick
     wanted = set(args.models.split(","))
@@ -234,6 +240,12 @@ def main(argv=None):
                                          int8=True))
         if not q:
             results.append(bench_gpt2_decode(8, 64, 128, int8=True))
+    if "decode_fused" in wanted:
+        # whole-stack-in-one-Pallas-launch decode (ops/pallas/decode_stack.py)
+        results.append(bench_gpt2_decode(1, 16 if q else 64, 16 if q else 128,
+                                         fused=True))
+        if not q:
+            results.append(bench_gpt2_decode(2, 64, 128, fused=True))
     return results
 
 

@@ -104,6 +104,28 @@ def test_mnist_csv_roundtrip(tmp_path):
     assert np.allclose(d.reshape(5, -1), pixels / 255.0, atol=1e-6)
 
 
+def test_digits_loader_real_data():
+    """Bundled sklearn digits: real images, disjoint deterministic split,
+    zoo-compatible 32x32x3 shape (the offline convergence-artifact dataset)."""
+    pytest.importorskip("sklearn")
+    tr = tdata.DigitsDataLoader(train=True, image_size=(32, 32))
+    va = tdata.DigitsDataLoader(train=False, image_size=(32, 32))
+    assert tr.data_shape == (32, 32, 3) and tr.num_classes == 10
+    assert len(tr) + len(va) == 1797 and len(va) == pytest.approx(360, abs=1)
+    d, l = tr.get_batch(16)
+    assert d.dtype == np.float32 and 0.0 <= d.min() and d.max() <= 1.0
+    assert ((l >= 0) & (l < 10)).all()
+    # split is a partition: the two loaders' images never overlap
+    tr_keys = {bytes(x) for x in (tr.data[:50] * 255).astype(np.uint8)
+               .reshape(50, -1)}
+    va_keys = {bytes(x) for x in (va.data * 255).astype(np.uint8)
+               .reshape(len(va), -1)}
+    assert not (tr_keys & va_keys)
+    # determinism across constructions
+    tr2 = tdata.DigitsDataLoader(train=True, image_size=(32, 32))
+    np.testing.assert_array_equal(tr.labels, tr2.labels)
+
+
 def test_cifar10_bin_format(tmp_path):
     rs = np.random.RandomState(1)
     n = 7

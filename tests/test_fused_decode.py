@@ -1,0 +1,133 @@
+"""Fused decode-stack kernel: equivalence with the unfused quantized decode
+path, cache update correctness, and the end-to-end fused_generate loop
+(interpret mode — the real-chip rows live in benchmarks/model_bench.py)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tnn_tpu.models.fused_decode import (caches_to_stacked, fused_generate,
+                                         pick_chunks, stack_decode_weights)
+from tnn_tpu.models.gpt2 import GPT2, generate
+from tnn_tpu.nn.quant import quantize_for_decode
+from tnn_tpu.ops.pallas.decode_stack import fused_decode_stack
+
+
+@pytest.fixture(scope="module")
+def small():
+    model = GPT2(vocab_size=512, max_len=64, num_layers=2, d_model=256,
+                 num_heads=4)
+    v = model.init(jax.random.PRNGKey(0), (2, 16))
+    return model, quantize_for_decode(v["params"])
+
+
+def test_stack_shapes(small):
+    model, qp = small
+    s = stack_decode_weights(model, qp)
+    d, f, L = 256, 1024, 2
+    assert s["qkv_q"].shape == (L, 3 * d, d) and s["qkv_q"].dtype == jnp.int8
+    assert s["fc_q"].shape == (L, f, d)
+    assert s["proj_q"].shape == (L, d, f)
+    assert s["ln1_s"].shape == (L, d) and s["ln1_s"].dtype == jnp.float32
+    assert s["qkv_s"].shape == (L, 3 * d)
+
+
+@pytest.mark.parametrize("chunks", [1, 2])
+def test_fused_step_matches_unfused(small, chunks):
+    model, qp = small
+    B, P, T = 2, 8, 32
+    rs = np.random.RandomState(0)
+    prompt = jnp.asarray(rs.randint(0, 512, (B, P)).astype(np.int32))
+    tok = jnp.asarray(rs.randint(0, 512, (B,)).astype(np.int32))
+
+    caches = model.init_cache(B, T)
+    _, caches = model.apply_cached(qp, prompt, caches, 0)
+
+    # unfused reference step
+    logits_u, caches_u = model.apply_cached(qp, tok[:, None], caches, P)
+    logits_u = np.asarray(logits_u[:, -1], np.float32)
+
+    # fused step (mirrors fused_generate's scan body)
+    stacks = stack_decode_weights(model, qp)
+    kc, vc = caches_to_stacked(caches)
+    x, _ = model.wte.apply({"params": qp["wte"], "state": {}}, tok[:, None])
+    x, _ = model.wpe.apply({"params": qp["wpe"], "state": {}}, x, offset=P)
+    x_out, kc, vc = fused_decode_stack(
+        x[:, 0, :], jnp.asarray(P, jnp.int32), kc, vc, stacks,
+        num_heads=model.num_heads, chunks=chunks, interpret=True)
+    xf, _ = model.ln_f.apply({"params": qp["ln_f"], "state": {}},
+                             x_out[:, None, :])
+    logits_f = np.asarray(model._head(qp, xf)[:, -1], np.float32)
+
+    rel = np.max(np.abs(logits_f - logits_u)) / np.max(np.abs(logits_u))
+    assert rel < 0.05, rel
+
+    # the appended cache row matches the unfused path's row
+    kc_u, vc_u = caches_to_stacked(caches_u)
+    for got, want in ((kc, kc_u), (vc, vc_u)):
+        got, want = np.asarray(got, np.float32), np.asarray(want, np.float32)
+        row_err = (np.max(np.abs(got[:, :, P] - want[:, :, P]))
+                   / (np.max(np.abs(want[:, :, P])) + 1e-9))
+        assert row_err < 0.05, row_err
+        # rows beyond P untouched (still zero-initialized)
+        assert np.abs(got[:, :, P + 1:]).max() == 0.0
+        # prefix rows bit-identical (the kernel never rewrites them)
+        np.testing.assert_array_equal(got[:, :, :P], want[:, :, :P])
+
+
+def test_fused_generate_matches_logits_teacher_forced(small):
+    """Drive fused and unfused decode in lockstep on the SAME token stream and
+    compare per-step logits — token-level compare would be flaky (greedy ties
+    under quantization noise)."""
+    model, qp = small
+    B, P, steps, T = 1, 6, 4, 16
+    rs = np.random.RandomState(1)
+    stream = jnp.asarray(rs.randint(0, 512, (B, P + steps)).astype(np.int32))
+
+    caches = model.init_cache(B, T)
+    logits_u, caches = model.apply_cached(qp, stream[:, :P], caches, 0)
+
+    stacks = stack_decode_weights(model, qp)
+    kc, vc = caches_to_stacked(caches)
+    for i in range(steps):
+        tok = stream[:, P + i]
+        logits_u, caches = model.apply_cached(qp, tok[:, None], caches, P + i)
+        x, _ = model.wte.apply({"params": qp["wte"], "state": {}}, tok[:, None])
+        x, _ = model.wpe.apply({"params": qp["wpe"], "state": {}}, x,
+                               offset=P + i)
+        x_out, kc, vc = fused_decode_stack(
+            x[:, 0, :], jnp.asarray(P + i, jnp.int32), kc, vc, stacks,
+            num_heads=model.num_heads, chunks=2, interpret=True)
+        xf, _ = model.ln_f.apply({"params": qp["ln_f"], "state": {}},
+                                 x_out[:, None, :])
+        lf = np.asarray(model._head(qp, xf)[:, -1], np.float32)
+        lu = np.asarray(logits_u[:, -1], np.float32)
+        rel = np.max(np.abs(lf - lu)) / np.max(np.abs(lu))
+        assert rel < 0.05, (i, rel)
+
+
+def test_fused_generate_end_to_end(small):
+    model, qp = small
+    rs = np.random.RandomState(2)
+    prompt = jnp.asarray(rs.randint(0, 512, (2, 8)).astype(np.int32))
+    toks = fused_generate(model, qp, prompt, 5, interpret=True)
+    assert toks.shape == (2, 5)
+    assert ((np.asarray(toks) >= 0) & (np.asarray(toks) < 512)).all()
+    # deterministic across calls (greedy, same rng)
+    toks2 = fused_generate(model, qp, prompt, 5, interpret=True)
+    np.testing.assert_array_equal(np.asarray(toks), np.asarray(toks2))
+
+
+def test_fused_generate_rejects_float_params(small):
+    model, _ = small
+    v = model.init(jax.random.PRNGKey(3), (1, 8))
+    with pytest.raises(ValueError, match="int8"):
+        fused_generate(model, v["params"], jnp.zeros((1, 4), jnp.int32), 2,
+                       interpret=True)
+
+
+def test_pick_chunks():
+    # gpt2-small at request-sized cache fits with 2 chunks
+    assert pick_chunks(768, 3072, 1, 192) in (1, 2)
+    # gpt2-large's qkv block alone busts the budget -> caller must fall back
+    assert pick_chunks(1280, 5120, 1, 192) is None

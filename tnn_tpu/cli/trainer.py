@@ -73,6 +73,10 @@ def main(argv=None):
     ap.add_argument("--seq-parallel-method", default=None,
                     choices=["ring", "ulysses"],
                     help="context-parallel scheme for --mesh seq=N")
+    ap.add_argument("--history-out", default=None,
+                    help="write the per-epoch metrics history (loss/accuracy "
+                         "curves) to this JSON file — the committable "
+                         "convergence artifact")
     args = ap.parse_args(argv)
 
     load_env_file()  # .env, as in the reference
@@ -102,6 +106,18 @@ def main(argv=None):
     model = models.create(cfg.model_name)
     train_loader, val_loader = build_loaders(cfg, args.num_classes)
     state, history = train_model(model, cfg, train_loader, val_loader)
+    if args.history_out:
+        import json
+        import platform as _platform
+
+        import jax
+
+        with open(args.history_out, "w") as f:
+            json.dump({"model": cfg.model_name, "dataset": cfg.dataset_name,
+                       "batch_size": cfg.batch_size, "epochs": cfg.epochs,
+                       "device": str(jax.devices()[0]),
+                       "host": _platform.platform(),
+                       "history": history}, f, indent=2, default=float)
     final = history[-1] if history else {}
     print(f"done: {len(history)} epochs, final train loss "
           f"{final.get('train_loss', float('nan')):.4f}, "

@@ -21,6 +21,7 @@ from .augmentation import (
 from .datasets import (
     CIFAR10DataLoader,
     CIFAR100DataLoader,
+    DigitsDataLoader,
     ImageFolderDataLoader,
     MNISTDataLoader,
     load_cifar10_bin,
@@ -42,7 +43,8 @@ __all__ = [
     "Augmentation", "AugmentationBuilder", "AugmentationPipeline", "Brightness",
     "Contrast", "Cutout", "GaussianNoise", "HorizontalFlip", "Normalization",
     "RandomCrop", "Rotation", "VerticalFlip", "cifar_train_pipeline",
-    "CIFAR10DataLoader", "CIFAR100DataLoader", "ImageFolderDataLoader",
+    "CIFAR10DataLoader", "CIFAR100DataLoader", "DigitsDataLoader",
+    "ImageFolderDataLoader",
     "MNISTDataLoader", "load_cifar10_bin", "load_cifar100_bin", "load_mnist_csv",
     "available", "create", "register_loader",
     "ArrayDataLoader", "DataLoader", "SyntheticDataLoader", "prefetch",

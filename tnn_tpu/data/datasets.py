@@ -338,6 +338,43 @@ def _decode_image_pil(path: str, image_size, resample: str = "bilinear") -> np.n
     return np.asarray(img, np.uint8)
 
 
+# -- sklearn digits (real handwritten images, bundled offline) ----------------
+
+
+class DigitsDataLoader(ArrayDataLoader):
+    """Real handwritten-digit images from scikit-learn's bundled `digits` set
+    (1797 samples of 8x8 grayscale, a subset of UCI Optical Recognition of
+    Handwritten Digits — REAL pen strokes, not synthetic).
+
+    Why it exists: the reference's convergence evidence is CIFAR-100 accuracy
+    curves (sample_logs/cifar100_wrn16_8), but CIFAR binaries cannot be
+    downloaded in an offline environment. This is the one real labeled image
+    dataset shipped inside the baked-in python packages, so it anchors the
+    on-chip convergence artifacts (docs/perf.md). Images are bilinear-upscaled
+    to `image_size` and replicated to 3 channels so the unmodified 32x32x3
+    model zoo (wrn16_8, resnet9...) trains on it.
+
+    Deterministic 80/20 train/val split by a seeded permutation — train=True
+    and train=False partition the same shuffle, never overlapping.
+    """
+
+    def __init__(self, path: str = "", train: bool = True, seed: int = 0,
+                 image_size=(32, 32), split: float = 0.8):
+        from sklearn.datasets import load_digits
+
+        d = load_digits()
+        imgs = (d.images * (255.0 / 16.0)).clip(0, 255).astype(np.uint8)
+        imgs = imgs[..., None].repeat(3, axis=-1)              # (N, 8, 8, 3)
+        imgs = _resize_bilinear(imgs, image_size)
+        data = imgs.astype(np.float32) / 255.0
+        labels = d.target.astype(np.int32)
+        order = np.random.default_rng(0).permutation(len(data))  # split rng
+        cut = int(len(data) * split)
+        part = order[:cut] if train else order[cut:]
+        self.num_classes = 10
+        super().__init__(np.ascontiguousarray(data[part]), labels[part], seed)
+
+
 # -- Regression CSVs (WiFi RSSI localisation etc.) ----------------------------
 
 

@@ -6,6 +6,7 @@ from typing import Callable, Dict
 from .datasets import (
     CIFAR10DataLoader,
     CIFAR100DataLoader,
+    DigitsDataLoader,
     ImageFolderDataLoader,
     MNISTDataLoader,
     RegressionCSVDataLoader,
@@ -33,6 +34,7 @@ def available() -> list:
 
 
 register_loader("mnist", lambda path, **kw: MNISTDataLoader(path, **kw))
+register_loader("digits", lambda path, **kw: DigitsDataLoader(path, **kw))
 register_loader("cifar10", lambda path, **kw: CIFAR10DataLoader(path, **kw))
 register_loader("cifar100", lambda path, **kw: CIFAR100DataLoader(path, **kw))
 register_loader("tiny_imagenet",

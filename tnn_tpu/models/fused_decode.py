@@ -1,0 +1,186 @@
+"""Fused-stack GPT-2 decode: glue between the GPT2 module tree and
+ops.pallas.decode_stack (the one-launch-per-token kernel).
+
+Separation of concerns: decode_stack.py is pure kernel (stacked arrays in,
+arrays out); this module stacks per-layer Int8Weight params into (L, ...)
+arrays, converts the per-layer KV-cache dicts that prefill produces into the
+kernel's (L, B, T, D) layout, and runs the generate loop (prefill via the
+normal XLA path — it is compute-bound and already efficient — then
+lax.scan over fused single-token steps).
+
+Requires decode-quantized params (nn.quant.quantize_for_decode): the kernel's
+matmuls are int8 x int8. Models the kernel cannot run — MoE blocks, or dims
+whose weight blocks cannot fit the ~16MB VMEM core at any MLP chunking (e.g.
+gpt2_large's qkv) — raise ValueError; catch it and use models.gpt2.generate.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.pallas.decode_stack import fused_decode_stack
+from ..ops.pallas.quant_matmul import Int8Weight
+
+
+def _iw(p, what):
+    w = p[what]
+    if not isinstance(w, Int8Weight):
+        raise ValueError(
+            f"fused decode needs int8 params ({what} is {type(w).__name__}); "
+            "run nn.quant.quantize_for_decode(params) first")
+    if w.q.shape != (w.n, w.k):
+        raise ValueError(f"{what}: stored shape {w.q.shape} carries padding "
+                         f"(logical {(w.n, w.k)}) — dims must be multiples "
+                         "of 128 for the fused kernel")
+    return w
+
+
+def stack_decode_weights(model, params):
+    """Stack every block's weights into (L, ...) arrays for the fused kernel."""
+    f32 = jnp.float32
+    blocks = [params[f"h{i}"] for i in range(model.num_layers)]
+    for b in blocks:
+        if "moe" in b:
+            raise ValueError("fused decode does not support MoE blocks")
+
+    def stack(get):
+        return jnp.stack([jnp.asarray(get(b), f32) for b in blocks])
+
+    def stack_q(get):
+        return jnp.stack([get(b).q for b in blocks])
+
+    return {
+        "ln1_s": stack(lambda b: b["ln1"]["scale"]),
+        "ln1_b": stack(lambda b: b["ln1"]["bias"]),
+        "ln2_s": stack(lambda b: b["ln2"]["scale"]),
+        "ln2_b": stack(lambda b: b["ln2"]["bias"]),
+        "qkv_q": stack_q(lambda b: _iw(b["attn"], "qkv_kernel")),
+        "qkv_s": stack(lambda b: b["attn"]["qkv_kernel"].scale),
+        "qkv_b": stack(lambda b: b["attn"]["qkv_bias"]),
+        "out_q": stack_q(lambda b: _iw(b["attn"], "out_kernel")),
+        "out_s": stack(lambda b: b["attn"]["out_kernel"].scale),
+        "out_b": stack(lambda b: b["attn"]["out_bias"]),
+        "fc_q": stack_q(lambda b: _iw(b["fc"], "kernel")),
+        "fc_s": stack(lambda b: b["fc"]["kernel"].scale),
+        "fc_b": stack(lambda b: b["fc"]["bias"]),
+        "proj_q": stack_q(lambda b: _iw(b["proj"], "kernel")),
+        "proj_s": stack(lambda b: b["proj"]["kernel"].scale),
+        "proj_b": stack(lambda b: b["proj"]["bias"]),
+    }
+
+
+def caches_to_stacked(caches):
+    """Per-layer {"k": (B, H, T, Dh), "v": ...} dicts -> (L, B, T, D) pair."""
+    def flat(x):  # (B, H, T, Dh) -> (B, T, H*Dh)
+        b, h, t, dh = x.shape
+        return x.transpose(0, 2, 1, 3).reshape(b, t, h * dh)
+
+    kc = jnp.stack([flat(c["k"]) for c in caches])
+    vc = jnp.stack([flat(c["v"]) for c in caches])
+    return kc, vc
+
+
+def pick_chunks(d_model: int, mlp_hidden: int, batch: int, max_len: int,
+                cache_bytes: int = 2, budget: int = 15 * 2 ** 20):
+    """Smallest MLP chunk count whose VMEM footprint fits the ~16MB core.
+
+    Accounting: double-buffered int8 weight blocks (qkv + out + fc/C + proj/C)
+    + KV VMEM staging (2*B*T*D) + ~2MB of attention/activation temps.
+    Returns None when even C=8 does not fit (caller falls back to unfused).
+    """
+    fixed = 2 * batch * max_len * d_model * cache_bytes + 2 * 2 ** 20
+    for c in (1, 2, 4, 8):
+        if mlp_hidden % c:
+            continue
+        w = 4 * d_model * d_model + 2 * (mlp_hidden // c) * d_model
+        if 2 * w + fixed <= budget:
+            return c
+    return None
+
+
+def fused_generate(model, params, prompt_ids, max_new_tokens: int,
+                   temperature: float = 0.0, rng: Optional[jax.Array] = None,
+                   max_len: Optional[int] = None,
+                   chunks: Optional[int] = None, interpret: bool = False):
+    """generate() with the fused decode-stack kernel on the per-token path.
+
+    Same contract as models.gpt2.generate (returns (B, max_new_tokens) new
+    tokens; greedy when temperature<=0) but requires quantize_for_decode
+    params. Prefill runs the normal path; each generated token is one
+    fused_decode_stack launch + ln_f + tied head.
+    """
+    prompt_ids = jnp.asarray(prompt_ids)
+    if prompt_ids.ndim == 1:
+        prompt_ids = prompt_ids[None]
+    batch, prompt_len = prompt_ids.shape
+    max_len = max_len or min(model.max_len, prompt_len + max_new_tokens)
+    if prompt_len + max_new_tokens > max_len:
+        raise ValueError("prompt + new tokens exceed max_len")
+    if rng is None:
+        rng = jax.random.PRNGKey(0)
+    if chunks is None:
+        chunks = pick_chunks(model.d_model, 4 * model.d_model, batch, max_len)
+        if chunks is None:
+            raise ValueError("model too large for the fused kernel's VMEM "
+                             "budget; use models.gpt2.generate")
+
+    # stacking copies every layer's weights — do it once per params tree, not
+    # per call (the benchmark loop calls fused_generate per iteration)
+    stack_cache = getattr(model, "_fused_stack_cache", None)
+    params_key = id(params)
+    if stack_cache is None or stack_cache[0] != params_key:
+        stacks = stack_decode_weights(model, params)
+        stacks = jax.block_until_ready(stacks)
+        model._fused_stack_cache = stack_cache = (params_key, stacks)
+    stacks = stack_cache[1]
+
+    cache_key = ("fused", batch, prompt_len, max_new_tokens,
+                 float(temperature), max_len, chunks, interpret)
+    jit_cache = getattr(model, "_generate_jit_cache", None)
+    if jit_cache is None:
+        jit_cache = model._generate_jit_cache = {}
+    run = jit_cache.get(cache_key)
+    if run is None:
+
+        @jax.jit
+        def run(params, stacks, prompt_ids, rng):
+            caches = model.init_cache(batch, max_len)
+            logits, caches = model.apply_cached(params, prompt_ids, caches, 0)
+            kc, vc = caches_to_stacked(caches)
+            last_logits = logits[:, -1]
+
+            def sample(logits, key):
+                if temperature > 0.0:
+                    return jax.random.categorical(key, logits / temperature,
+                                                  axis=-1)
+                return jnp.argmax(logits, axis=-1)
+
+            def step(carry, key):
+                kc, vc, last_logits, offset = carry
+                tok = sample(last_logits, key)
+                x, _ = model.wte.apply({"params": params["wte"], "state": {}},
+                                       tok[:, None])          # (B, 1, D)
+                x, _ = model.wpe.apply({"params": params["wpe"], "state": {}},
+                                       x, offset=offset)
+                x = x[:, 0, :]
+                x_out, kc, vc = fused_decode_stack(
+                    x, offset, kc, vc, stacks,
+                    num_heads=model.num_heads, chunks=chunks,
+                    interpret=interpret)
+                xf, _ = model.ln_f.apply(
+                    {"params": params["ln_f"], "state": {}},
+                    x_out[:, None, :])
+                logits = model._head(params, xf)[:, -1]
+                return (kc, vc, logits, offset + 1), tok
+
+            keys = jax.random.split(rng, max_new_tokens)
+            (_, _, _, _), toks = jax.lax.scan(
+                step, (kc, vc, last_logits,
+                       jnp.asarray(prompt_len, jnp.int32)), keys)
+            return toks.T
+
+        jit_cache[cache_key] = run
+
+    return run(params, stacks, prompt_ids, rng)

@@ -40,6 +40,10 @@ register("cifar10_vgg")(lambda **kw: resnet.vgg11(num_classes=10, **kw))
 register("cifar10_resnet9")(lambda **kw: resnet.resnet9(num_classes=10, **kw))
 register("cifar100_resnet18")(lambda **kw: resnet.resnet18(num_classes=100, **kw))
 register("cifar100_wrn16_8")(lambda **kw: resnet.wrn16_8(num_classes=100, **kw))
+# 10-class WRN-16-8 for the bundled real handwritten-digits set — the offline
+# stand-in for the reference's CIFAR-100 convergence logs (data/datasets.py
+# DigitsDataLoader; CIFAR binaries are not downloadable in this environment)
+register("digits_wrn16_8")(lambda **kw: resnet.wrn16_8(num_classes=10, **kw))
 register("tiny_imagenet_resnet18")(
     lambda **kw: resnet.resnet18(num_classes=200, **kw))
 register("tiny_imagenet_wrn16_8")(
