@@ -64,18 +64,24 @@ def bench_train(model_name: str, input_shape, num_classes: int, batch: int,
 
 def bench_gpt2_train(batch: int, seq: int, iters: int, size="small", flash=False,
                      max_len=None, remat=False, attn_flops=False, label=None,
-                     extra=None, moe=False):
+                     extra=None, moe=False, fused_head=False):
     from tnn_tpu import models, nn
     from tnn_tpu.train import create_train_state, make_train_step
 
     name = ("moe_" if moe else "") + \
         (f"flash_gpt2_{size}" if flash else f"gpt2_{size}")
     print(f"{name} train step (bs={batch}, S={seq}"
-          + (", remat" if remat else "") + ")")
+          + (", remat" if remat else "")
+          + (", fused head loss" if fused_head else "") + ")")
     model = models.create(name, **({"max_len": max_len} if max_len else {}))
     opt = nn.AdamW(lr=1e-4)
     state = create_train_state(model, opt, jax.random.PRNGKey(0), (batch, seq))
-    step = make_train_step(model, opt, remat=remat)
+    step = make_train_step(model, opt, remat=remat,
+                           compute_accuracy=not fused_head,
+                           lm_head_chunk=8192 if fused_head else None)
+    if fused_head:
+        label = label or f"{name}_train_fused_head"
+        extra = dict(extra or {}, lm_head_chunk=8192)
     rs = np.random.RandomState(0)
     ids = jnp.asarray(rs.randint(0, 50257, (batch, seq)), np.int32)
     dt = _time_steps(step, state, ids, ids, iters)
@@ -200,6 +206,8 @@ def main(argv=None):
     if "gpt2" in wanted:
         results.append(bench_gpt2_train(2 if q else 8, 128 if q else 512,
                                         3 if q else 10))
+        if not q:  # chunked LM-head loss: no (tokens, vocab) f32 logits
+            results.append(bench_gpt2_train(8, 512, 10, fused_head=True))
     if "gpt2_long" in wanted:
         results.append(bench_gpt2_long_train(1, 2048, 3) if q
                        else bench_gpt2_long_train())

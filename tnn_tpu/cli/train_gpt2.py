@@ -50,6 +50,9 @@ def main(argv=None):
                     help="attention backend (pallas = the flash kernel)")
     ap.add_argument("--sample", type=int, default=128,
                     help="tokens to sample after training (0 = skip)")
+    ap.add_argument("--fused-head-loss", type=int, default=0, metavar="CHUNK",
+                    help="vocab chunk for the streaming LM-head loss "
+                         "(nn.lm_loss) — 0 uses the materialized-logits path")
     ap.add_argument("--results", default="benchmarks/results")
     args = ap.parse_args(argv)
 
@@ -70,7 +73,9 @@ def main(argv=None):
                                      t_max=args.steps)
     state = create_train_state(model, opt, jax.random.PRNGKey(0),
                                (args.batch, args.seq))
-    step = make_train_step(model, opt, scheduler=sched)
+    step = make_train_step(model, opt, scheduler=sched,
+                           compute_accuracy=not args.fused_head_loss,
+                           lm_head_chunk=args.fused_head_loss or None)
 
     rng = np.random.default_rng(0)
     curve = []

@@ -103,6 +103,14 @@ def main(argv=None):
     if args.seq_parallel_method is not None:
         cfg.seq_parallel_method = args.seq_parallel_method
 
+    if args.history_out:
+        # fail on an unwritable path BEFORE the (possibly hours-long) run
+        import os
+
+        d = os.path.dirname(os.path.abspath(args.history_out))
+        os.makedirs(d, exist_ok=True)
+        open(args.history_out, "a").close()
+
     model = models.create(cfg.model_name)
     train_loader, val_loader = build_loaders(cfg, args.num_classes)
     state, history = train_model(model, cfg, train_loader, val_loader)

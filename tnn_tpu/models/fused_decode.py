@@ -127,13 +127,14 @@ def fused_generate(model, params, prompt_ids, max_new_tokens: int,
                              "budget; use models.gpt2.generate")
 
     # stacking copies every layer's weights — do it once per params tree, not
-    # per call (the benchmark loop calls fused_generate per iteration)
+    # per call (the benchmark loop calls fused_generate per iteration). The
+    # cache RETAINS the params object and compares with `is`: an id()-keyed
+    # cache could silently match a new tree allocated at a freed tree's
+    # address and serve stale weights
     stack_cache = getattr(model, "_fused_stack_cache", None)
-    params_key = id(params)
-    if stack_cache is None or stack_cache[0] != params_key:
-        stacks = stack_decode_weights(model, params)
-        stacks = jax.block_until_ready(stacks)
-        model._fused_stack_cache = stack_cache = (params_key, stacks)
+    if stack_cache is None or stack_cache[0] is not params:
+        stacks = jax.block_until_ready(stack_decode_weights(model, params))
+        model._fused_stack_cache = stack_cache = (params, stacks)
     stacks = stack_cache[1]
 
     cache_key = ("fused", batch, prompt_len, max_new_tokens,

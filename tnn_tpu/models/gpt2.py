@@ -88,6 +88,10 @@ class GPT2(Module):
         return logits  # f32 logits for a stable softmax/loss
 
     def _apply(self, params, state, ids, *, train, rng):
+        x, new_state = self._hidden(params, state, ids, train, rng)
+        return self._head(params, x), new_state
+
+    def _hidden(self, params, state, ids, train, rng):
         x, keys = self._trunk(params, ids, train, rng)
         new_state = {}
         for i, block in enumerate(self.blocks):
@@ -97,7 +101,25 @@ class GPT2(Module):
             if st:
                 new_state[f"h{i}"] = st
         x, _ = self.ln_f.apply({"params": params["ln_f"], "state": {}}, x)
-        return self._head(params, x), new_state
+        return x, new_state
+
+    def apply_hidden(self, variables, ids, *, train=False, rng=None):
+        """(N, S) ids -> post-ln_f hidden (N, S, D), WITHOUT the head matmul.
+
+        The entry point for fused LM-head losses (nn.lm_loss.lm_head_loss):
+        the loss contracts hidden against the head table in vocab chunks
+        instead of materializing (N*S, vocab) f32 logits."""
+        x, new_state = self._hidden(variables["params"],
+                                    variables.get("state", {}) or {},
+                                    ids, train, rng)
+        return x, new_state
+
+    def head_table(self, params):
+        """The (V, D) matrix the head contracts against (tied or untied) —
+        what lm_head_loss needs alongside apply_hidden's output."""
+        if self.tie_embeddings:
+            return self.policy.cast_param(params["wte"]["table"])
+        return self.policy.cast_param(params["head"]["kernel"]).T
 
     def output_shape(self, input_shape):
         return tuple(input_shape[:2]) + (self.vocab_size,)

@@ -70,6 +70,7 @@ def make_train_step(
     grad_accum: int = 1,
     augment: Optional[Callable] = None,
     remat: bool = False,
+    lm_head_chunk: Optional[int] = None,
 ) -> Callable[[TrainState, jax.Array, jax.Array], Tuple[TrainState, Dict[str, jax.Array]]]:
     """Build a jitted (state, data, labels) -> (state, metrics) step.
 
@@ -90,23 +91,48 @@ def make_train_step(
     around model.apply): activations are recomputed instead of stored, trading
     ~1/3 more FLOPs for a large cut in peak HBM — the knob that lets long-
     context/large-batch configs fit (numerically identical, tested).
+
+    ``lm_head_chunk``: for LM models exposing ``apply_hidden``/``head_table``
+    (GPT-2), compute the loss with nn.lm_loss.lm_head_loss — the streaming
+    logsumexp over vocab chunks that never materializes (tokens, vocab) f32
+    logits (the largest tensor in LM training). Replaces ``loss_fn``; logits
+    do not exist, so requires compute_accuracy=False.
     """
+    if lm_head_chunk is not None:
+        if compute_accuracy:
+            raise ValueError("lm_head_chunk computes no logits; pass "
+                             "compute_accuracy=False")
+        if not (hasattr(model, "apply_hidden") and hasattr(model, "head_table")):
+            raise ValueError(f"{type(model).__name__} lacks apply_hidden/"
+                             "head_table; lm_head_chunk needs an LM model")
     if isinstance(loss_fn, str):
         loss_fn = losses_lib.get(loss_fn)
     scheduler = scheduler or NoOp()
     host_driven = getattr(scheduler, "host_driven", False)
     grad_accum = int(grad_accum)
 
-    def apply_model(params, net_state, data, sub):
-        return model.apply({"params": params, "state": net_state}, data,
-                           train=True, rng=sub)
+    if lm_head_chunk is None:
+        def apply_model(params, net_state, data, sub):
+            return model.apply({"params": params, "state": net_state}, data,
+                               train=True, rng=sub)
+    else:
+        def apply_model(params, net_state, data, sub):
+            return model.apply_hidden({"params": params, "state": net_state},
+                                      data, train=True, rng=sub)
 
     if remat:
         apply_model = jax.checkpoint(apply_model)
 
     def compute_loss(params, net_state, data, labels, sub):
         out, new_net_state = apply_model(params, net_state, data, sub)
-        loss = loss_fn(out, labels) + aux_loss_sum(new_net_state)
+        if lm_head_chunk is not None:
+            from ..nn.lm_loss import lm_head_loss
+
+            loss = lm_head_loss(out, model.head_table(params), labels,
+                                lm_head_chunk)
+        else:
+            loss = loss_fn(out, labels)
+        loss = loss + aux_loss_sum(new_net_state)
         return loss, (out, new_net_state)
 
     def step(state: TrainState, data, labels, lr_scale):
