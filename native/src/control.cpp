@@ -54,6 +54,10 @@ struct Endpoint {
   std::mutex q_mu;
   std::condition_variable q_cv;
   std::deque<Frame> inbox;
+  // threads currently blocked in tnn_ctl_recv — destroy must drain them
+  // before deleting the endpoint (destroying a condvar with waiters is UB;
+  // found by the TSan lane)
+  std::atomic<int> recv_waiters{0};
 
   void enqueue(Frame f) {
     {
@@ -110,9 +114,19 @@ struct Endpoint {
     c->open.store(true);
     int64_t id = next_conn.fetch_add(1);
     Conn* raw = c.get();
-    raw->reader = std::thread([this, id, raw] { reader_loop(id, raw); });
-    std::lock_guard<std::mutex> g(mu);
-    conns[id] = std::move(c);
+    // Map insert AND reader-thread start both under `mu`, in that order:
+    //  * insert must come first — the reader can deliver this peer's first
+    //    frame immediately, and a reply sent before the insert would miss
+    //    tnn_ctl_send's lookup and vanish (TSan lane: coordinator
+    //    HANDSHAKE_ACKs lost under two simultaneous connects);
+    //  * the thread assignment must be inside the same critical section —
+    //    otherwise a fast disconnect lets close_conn find+destroy the Conn
+    //    while `reader` is still being move-assigned here (use-after-free).
+    {
+      std::lock_guard<std::mutex> g(mu);
+      conns[id] = std::move(c);
+      raw->reader = std::thread([this, id, raw] { reader_loop(id, raw); });
+    }
     return id;
   }
 
@@ -223,10 +237,17 @@ TNN_API int tnn_ctl_send(void* h, int64_t conn, int32_t command,
 TNN_API int64_t tnn_ctl_recv(void* h, double timeout_s, int64_t* conn_out,
                              int32_t* cmd_out, uint8_t* buf, int64_t buf_len) {
   auto* ep = static_cast<Endpoint*>(h);
+  ep->recv_waiters.fetch_add(1);
+  struct Guard {  // decrement on EVERY exit path
+    std::atomic<int>& n;
+    ~Guard() { n.fetch_sub(1); }
+  } guard{ep->recv_waiters};
   std::unique_lock<std::mutex> lk(ep->q_mu);
-  if (!ep->q_cv.wait_for(lk, std::chrono::duration<double>(timeout_s),
-                         [&] { return !ep->inbox.empty(); }))
-    return -1;
+  bool got = ep->q_cv.wait_for(
+      lk, std::chrono::duration<double>(timeout_s),
+      [&] { return !ep->running.load() || !ep->inbox.empty(); });
+  if (!got || ep->inbox.empty())
+    return -1;  // timeout, or woken by shutdown
   Frame& f = ep->inbox.front();
   *conn_out = f.conn;
   *cmd_out = f.command;
@@ -256,6 +277,16 @@ TNN_API void tnn_ctl_close_conn(void* h, int64_t conn) {
 TNN_API void tnn_ctl_destroy(void* h) {
   auto* ep = static_cast<Endpoint*>(h);
   ep->running.store(false);
+  // wake every blocked tnn_ctl_recv and wait for them to leave the condvar
+  // before tearing the endpoint down
+  {
+    std::lock_guard<std::mutex> g(ep->q_mu);
+  }
+  ep->q_cv.notify_all();
+  while (ep->recv_waiters.load() > 0) {
+    ep->q_cv.notify_all();
+    std::this_thread::sleep_for(std::chrono::milliseconds(1));
+  }
   if (ep->listen_fd >= 0) {
     ::shutdown(ep->listen_fd, SHUT_RDWR);
     ::close(ep->listen_fd);

@@ -23,6 +23,17 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 def _spawn_worker(port: int, rank=None, log=None):
     env = dict(os.environ, TNN_PLATFORM="cpu", TNN_NUM_DEVICES="1")
+    # Sanitizer lanes (scripts/ci.sh --sanitize) LD_PRELOAD lib{a,t}san into
+    # pytest. Do NOT propagate that into worker subprocesses: ASan's
+    # __cxa_throw interceptor hard-aborts ("real___cxa_throw != 0" CHECK)
+    # when jaxlib's bundled MLIR bindings throw C++ exceptions during the
+    # worker's jit compile — an ASan-runtime/jaxlib incompatibility, nothing
+    # of ours. The parent keeps full instrumentation (coordinator side of the
+    # native control plane + decoders); workers run the release lib.
+    preload = env.get("LD_PRELOAD", "")
+    if "asan" in preload or "tsan" in preload:
+        env.pop("LD_PRELOAD", None)
+        env.pop("TNN_NATIVE_LIB", None)  # sanitized .so needs the preload
     # -m with cwd=REPO resolves tnn_tpu from the clone even when the package
     # is not pip-installed (a bare `python examples/dist_worker.py` would not)
     cmd = [sys.executable, "-m", "tnn_tpu.cli.dist_worker",

@@ -138,8 +138,12 @@ class Coordinator:
             h.alive = True
             h.last_heartbeat = time.monotonic()
             self._by_conn[conn] = h
-        self._t.send(conn, Command.HANDSHAKE_ACK,
-                     pack({"rank": rank, "world": self.num_workers}))
+        if not self._t.send(conn, Command.HANDSHAKE_ACK,
+                            pack({"rank": rank, "world": self.num_workers})):
+            # a dropped ack strands the worker in its 30s handshake wait —
+            # surface it instead of silently timing out later
+            self._log.error("HANDSHAKE_ACK send failed for rank %s conn %d",
+                            rank, conn)
         with self._member_cv:
             self._member_cv.notify_all()
         self._log.info("worker %d rejoined", rank)
@@ -186,8 +190,11 @@ class Coordinator:
                 h = WorkerHandle(conn, rank, info)
                 self._workers[rank] = h
                 self._by_conn[conn] = h
-            self._t.send(conn, Command.HANDSHAKE_ACK,
-                         pack({"rank": rank, "world": self.num_workers}))
+            if not self._t.send(conn, Command.HANDSHAKE_ACK,
+                                pack({"rank": rank,
+                                      "world": self.num_workers})):
+                self._log.error("HANDSHAKE_ACK send failed for rank %s "
+                                "conn %d", rank, conn)
             with self._member_cv:
                 self._member_cv.notify_all()  # wake wait_alive(initial join)
             self._log.info("worker %d joined (%s)", rank, info.get("host", "?"))

@@ -178,6 +178,14 @@ class PyTransport(Transport):
                 self._send_locks.pop(conn, None)
             if alive and self._running:
                 self._inbox.put(("disconnect", conn, 0, b""))
+        finally:
+            # the reader OWNS the close: close()/close_conn() only shutdown()
+            # to wake this recv — closing the fd from another thread while
+            # recv is in flight races on the descriptor (fd reuse hazard)
+            try:
+                sock.close()
+            except OSError:
+                pass
 
     def port(self) -> int:
         return self._listener.getsockname()[1] if self._listener else 0
@@ -216,10 +224,9 @@ class PyTransport(Transport):
             self._send_locks.pop(conn, None)
         if sock is not None:
             try:
-                sock.shutdown(socket.SHUT_RDWR)
+                sock.shutdown(socket.SHUT_RDWR)  # reader wakes and closes
             except OSError:
                 pass
-            sock.close()
 
     def close(self) -> None:
         self._running = False
@@ -233,10 +240,9 @@ class PyTransport(Transport):
             self._conns.clear()
         for s in socks:
             try:
-                s.shutdown(socket.SHUT_RDWR)
+                s.shutdown(socket.SHUT_RDWR)  # readers wake and close
             except OSError:
                 pass
-            s.close()
 
 
 def make_transport(bind: str = "", listen_port: Optional[int] = 0,

@@ -48,7 +48,9 @@ class Worker:
         self._t.send(self._conn, Command.HANDSHAKE, pack(info))
         ev = self._t.recv(timeout=30.0)
         if ev is None or Command(ev[2]) != Command.HANDSHAKE_ACK:
-            raise ConnectionError("no HANDSHAKE_ACK from coordinator")
+            got = "timeout" if ev is None else Command(ev[2]).name
+            raise ConnectionError(
+                f"no HANDSHAKE_ACK from coordinator (got: {got})")
         ack = unpack(ev[3])
         self.rank = int(ack["rank"])
         self.world = int(ack["world"])

@@ -21,7 +21,11 @@ import re
 
 
 def aggregate(trace_dir: str, top: int = 30):
-    path = sorted(glob.glob(f"{trace_dir}/plugins/profile/*/*.trace.json.gz"))[-1]
+    paths = sorted(glob.glob(f"{trace_dir}/plugins/profile/*/*.trace.json.gz"))
+    if not paths:
+        raise SystemExit(f"no trace captured under {trace_dir} — the profiler "
+                         "wrote nothing (is this backend supported?)")
+    path = paths[-1]
     with gzip.open(path) as f:
         tr = json.load(f)
     pids = {e["pid"]: e["args"]["name"] for e in tr["traceEvents"]
