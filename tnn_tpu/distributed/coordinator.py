@@ -140,10 +140,13 @@ class Coordinator:
             self._by_conn[conn] = h
         if not self._t.send(conn, Command.HANDSHAKE_ACK,
                             pack({"rank": rank, "world": self.num_workers})):
-            # a dropped ack strands the worker in its 30s handshake wait —
-            # surface it instead of silently timing out later
+            # the worker never learns its rank and will give up — mark the
+            # handle dead NOW so wait_alive/failed_workers tell the truth
+            # instead of the heartbeat timeout discovering it minutes later
             self._log.error("HANDSHAKE_ACK send failed for rank %s conn %d",
                             rank, conn)
+            with self._lock:
+                h.alive = False
         with self._member_cv:
             self._member_cv.notify_all()
         self._log.info("worker %d rejoined", rank)
@@ -195,6 +198,8 @@ class Coordinator:
                                       "world": self.num_workers})):
                 self._log.error("HANDSHAKE_ACK send failed for rank %s "
                                 "conn %d", rank, conn)
+                with self._lock:
+                    h.alive = False
             with self._member_cv:
                 self._member_cv.notify_all()  # wake wait_alive(initial join)
             self._log.info("worker %d joined (%s)", rank, info.get("host", "?"))

@@ -164,6 +164,7 @@ class PyTransport(Transport):
                 data += chunk
             return data
 
+        broke = False
         try:
             while self._running:
                 magic, cmd, ln = struct.unpack("<IIQ", read_exact(16))
@@ -172,18 +173,26 @@ class PyTransport(Transport):
                 payload = read_exact(ln) if ln else b""
                 self._inbox.put(("msg", conn, cmd, payload))
         except (ConnectionError, OSError):
+            broke = True
+        finally:
             with self._lock:
                 alive = conn in self._conns
                 self._conns.pop(conn, None)
-                self._send_locks.pop(conn, None)
-            if alive and self._running:
+                send_lock = self._send_locks.pop(conn, None)
+            if broke and alive and self._running:
                 self._inbox.put(("disconnect", conn, 0, b""))
-        finally:
             # the reader OWNS the close: close()/close_conn() only shutdown()
-            # to wake this recv — closing the fd from another thread while
-            # recv is in flight races on the descriptor (fd reuse hazard)
+            # to wake this recv — closing the fd from another thread while a
+            # recv/send is in the syscall races on the descriptor (fd reuse
+            # hazard). Taking the send lock first waits out any in-flight
+            # sendall on this socket (it errors promptly once the peer is
+            # gone and the shutdown has landed).
             try:
-                sock.close()
+                if send_lock is not None:
+                    with send_lock:
+                        sock.close()
+                else:
+                    sock.close()
             except OSError:
                 pass
 

@@ -44,7 +44,8 @@ class MoE(Module):
     def __init__(self, num_experts: int, hidden: Optional[int] = None,
                  top_k: int = 2, capacity_factor: float = 2.0,
                  activation: str = "gelu", aux_weight: float = 0.01,
-                 hidden_ratio: int = 4, name=None, policy=None):
+                 hidden_ratio: int = 4, dispatch: str = "einsum",
+                 name=None, policy=None):
         super().__init__(name=name, policy=policy)
         self.num_experts = int(num_experts)
         self.hidden = hidden if hidden is None else int(hidden)
@@ -55,6 +56,15 @@ class MoE(Module):
         self.capacity_factor = float(capacity_factor)
         self.activation = activation
         self.aux_weight = float(aux_weight)
+        if dispatch not in ("einsum", "sort"):
+            raise ValueError(f"dispatch {dispatch!r} not in (einsum, sort)")
+        # "einsum": GShard/Switch-style (T, E, C) one-hot dispatch/combine —
+        #   GSPMD lowers it to all-to-alls over the expert mesh axis; the
+        #   multi-chip path. "sort": argsort tokens by expert and gather into
+        #   the (E, C, D) buffers directly — no (T, E, C) tensor ever exists
+        #   (that tensor is THE memory hog at scale: T=8192 E=64 C=256 makes
+        #   it 537 MB even in bf16). Single-device/memory-optimized path.
+        self.dispatch = dispatch
 
     def _init(self, rng, input_shape):
         d = input_shape[-1]
@@ -73,6 +83,75 @@ class MoE(Module):
         # state structure must match _apply's exactly — a {} here would crash
         # lax.scan carries (grad accumulation) on the first step
         return params, {"aux_loss": jnp.zeros((), jnp.float32)}
+
+    def _dispatch_einsum(self, xt, top_e, top_p, t, e, cap, compute):
+        """GShard/Switch (T, E, C) one-hot dispatch — the GSPMD/multi-chip
+        path (all-to-alls are inserted from the einsums). Returns the (E, C,
+        D) expert inputs and a combine(ye) closure."""
+        k = self.top_k
+        # per-expert positions via cumsum over (k-slot, token) order; tokens
+        # beyond an expert's capacity get weight zero (static shapes for XLA)
+        onehot = jax.nn.one_hot(top_e, e, dtype=jnp.float32)      # (T, k, E)
+        flat = onehot.transpose(1, 0, 2).reshape(k * t, e)
+        pos = jnp.cumsum(flat, axis=0) - flat                     # (k*T, E)
+        pos = pos.reshape(k, t, e).transpose(1, 0, 2)             # (T, k, E)
+        pos = jnp.sum(pos * onehot, axis=-1).astype(jnp.int32)    # (T, k)
+        in_cap = pos < cap
+        weight = top_p * in_cap                                   # (T, k)
+
+        # dispatch/combine tensors (T, E, C). dispatch holds exact 0/1 values,
+        # so it is built directly in the compute dtype — the (T, E, C) pair
+        # dominates MoE memory (bf16 halves the bigger one; combine stays
+        # f32: its routing weights need the precision). dispatch="sort"
+        # avoids these tensors entirely on one device.
+        pos_oh = jax.nn.one_hot(jnp.where(in_cap, pos, cap), cap + 1,
+                                dtype=jnp.float32)[..., :cap]     # (T, k, C)
+        dispatch = jnp.einsum("tke,tkc->tec",
+                              (onehot * in_cap[..., None]).astype(compute),
+                              pos_oh.astype(compute))
+        combine = jnp.einsum("tke,tkc,tk->tec", onehot, pos_oh, weight)
+        xe = jnp.einsum("tec,td->ecd", dispatch,
+                        xt.astype(compute))                       # (E, C, D)
+
+        def combine_fn(ye):
+            return jnp.einsum("tec,ecd->td", combine,
+                              ye.astype(jnp.float32))
+        return xe, combine_fn
+
+    def _dispatch_sort(self, xt, top_e, top_p, t, e, cap, compute):
+        """Sort-based dispatch: argsort (token, k-slot) assignments by expert,
+        rank each within its expert, scatter into the (E, C, D) buffer.
+        Peak extra memory is O(T*k*D) + O(E*C*D) — the O(T*E*C) one-hot
+        tensors never exist. Same capacity-drop semantics as the einsum path
+        up to WHICH tokens drop when an expert overflows (einsum drops by
+        token order, sort by sorted order); with no overflow they agree
+        exactly (tested)."""
+        k = self.top_k
+        d = xt.shape[-1]
+        e_flat = top_e.reshape(-1)                                # (T*k,)
+        w_flat = top_p.reshape(-1)
+        tok = jnp.repeat(jnp.arange(t, dtype=jnp.int32), k)       # (T*k,)
+        order = jnp.argsort(e_flat, stable=True)
+        e_sorted = e_flat[order]
+        # rank within expert = index - first index of that expert id
+        start = jnp.searchsorted(e_sorted, e_sorted, side="left")
+        rank = jnp.arange(t * k, dtype=jnp.int32) - start.astype(jnp.int32)
+        valid = rank < cap
+        slot = jnp.where(valid, e_sorted * cap + rank, e * cap)   # drop slot
+        xe_flat = (jnp.zeros((e * cap, d), compute)
+                   .at[slot].set(xt[tok[order]].astype(compute), mode="drop"))
+        xe = xe_flat.reshape(e, cap, d)
+
+        def combine_fn(ye):
+            back = ye.reshape(e * cap, -1).astype(jnp.float32)
+            # mode="fill" handles the out-of-range drop slot; the weight
+            # multiply (zero for dropped assignments) is the single mask that
+            # enforces capacity semantics
+            rows = back.at[slot, :].get(mode="fill", fill_value=0.0)
+            rows = rows * (w_flat[order] * valid)[:, None]        # (T*k, D)
+            return (jnp.zeros((t, rows.shape[-1]), jnp.float32)
+                    .at[tok[order]].add(rows))
+        return xe, combine_fn
 
     def _capacity(self, tokens: int) -> int:
         cap = math.ceil(self.top_k * tokens / self.num_experts
@@ -97,31 +176,15 @@ class MoE(Module):
         top_p = top_p / jnp.maximum(
             jnp.sum(top_p, axis=-1, keepdims=True), 1e-9)  # renormalize
 
-        # per-expert positions via cumsum over (k-slot, token) order; tokens
-        # beyond an expert's capacity get weight zero (static shapes for XLA)
-        onehot = jax.nn.one_hot(top_e, e, dtype=jnp.float32)      # (T, k, E)
-        flat = onehot.transpose(1, 0, 2).reshape(self.top_k * t, e)
-        pos = jnp.cumsum(flat, axis=0) - flat                     # (k*T, E)
-        pos = pos.reshape(self.top_k, t, e).transpose(1, 0, 2)    # (T, k, E)
-        pos = jnp.sum(pos * onehot, axis=-1).astype(jnp.int32)    # (T, k)
-        in_cap = pos < cap
-        weight = top_p * in_cap                                   # (T, k)
-
-        # dispatch/combine tensors (T, E, C). dispatch holds exact 0/1 values,
-        # so it is built directly in the compute dtype — at real scale the
-        # (T, E, C) tensors dominate MoE memory and bf16 halves the bigger
-        # one (combine stays f32: its routing weights need the precision)
-        pos_oh = jax.nn.one_hot(jnp.where(in_cap, pos, cap), cap + 1,
-                                dtype=jnp.float32)[..., :cap]     # (T, k, C)
-        dispatch = jnp.einsum("tke,tkc->tec",
-                              (onehot * in_cap[..., None]).astype(compute),
-                              pos_oh.astype(compute))
-        combine = jnp.einsum("tke,tkc,tk->tec", onehot, pos_oh, weight)
+        if self.dispatch == "sort":
+            xe, combine_fn = self._dispatch_sort(xt, top_e, top_p, t, e, cap,
+                                                 compute)
+        else:
+            xe, combine_fn = self._dispatch_einsum(xt, top_e, top_p, t, e,
+                                                   cap, compute)
 
         # -- expert computation (batched over the expert dim; the leading E of
         # every parameter shards over the "expert" mesh axis) -----------------
-        xe = jnp.einsum("tec,td->ecd", dispatch,
-                        xt.astype(compute))               # (E, C, D)
         w_in = self.policy.cast_param(params["w_in"])
         w_out = self.policy.cast_param(params["w_out"])
         hmid = jnp.einsum("ecd,edh->ech", xe, w_in,
@@ -132,11 +195,13 @@ class MoE(Module):
                         preferred_element_type=jnp.float32)
         ye = ye + self.policy.cast_param(params["b_out"])[:, None, :]
 
-        out = jnp.einsum("tec,ecd->td", combine, ye.astype(jnp.float32))
+        out = combine_fn(ye)
         out = out.astype(x.dtype).reshape(n, s, d)
 
         # Switch-style load-balance aux loss: E * sum_e fraction_e * prob_e
-        frac_e = jnp.sum(onehot.sum(1), axis=0) / (t * self.top_k)   # (E,)
+        # (expert counts via scatter-add — no (T, k, E) one-hot needed)
+        frac_e = (jnp.zeros((e,), jnp.float32).at[top_e.reshape(-1)].add(1.0)
+                  / (t * self.top_k))                                # (E,)
         prob_e = jnp.mean(probs, axis=0)                             # (E,)
         aux = self.aux_weight * e * jnp.sum(frac_e * prob_e)
         return out, {"aux_loss": aux}
@@ -148,7 +213,7 @@ class MoE(Module):
         return {"num_experts": self.num_experts, "hidden": self.hidden,
                 "top_k": self.top_k, "capacity_factor": self.capacity_factor,
                 "activation": self.activation, "aux_weight": self.aux_weight,
-                "hidden_ratio": self.hidden_ratio}
+                "hidden_ratio": self.hidden_ratio, "dispatch": self.dispatch}
 
 
 def ep_rules(axis: str = "expert"):

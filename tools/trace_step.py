@@ -73,6 +73,30 @@ def trace_wrn(out: str, batch: int = 256, steps: int = 3):
         print("loss fetch:", float(m["loss"]))  # real sync on the relay
 
 
+def trace_gpt2_train(out: str, batch: int = 8, seq: int = 512, steps: int = 2,
+                     fused_head: bool = False):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tnn_tpu import models, nn
+    from tnn_tpu.train import create_train_state, make_train_step
+
+    model = models.create("gpt2_small")
+    opt = nn.AdamW(lr=1e-4)
+    state = create_train_state(model, opt, jax.random.PRNGKey(0), (batch, seq))
+    step = make_train_step(model, opt, compute_accuracy=not fused_head,
+                           lm_head_chunk=8192 if fused_head else None)
+    ids = jnp.asarray(np.arange(batch * seq, dtype=np.int32)
+                      .reshape(batch, seq) % 50257)
+    state, m = step(state, ids, ids)
+    jax.block_until_ready(m["loss"])
+    with jax.profiler.trace(out):
+        for _ in range(steps):
+            state, m = step(state, ids, ids)
+        print("loss fetch:", float(m["loss"]))
+
+
 def trace_gpt2_decode(out: str, new: int = 32):
     import jax
     import jax.numpy as jnp
@@ -95,14 +119,18 @@ def trace_gpt2_decode(out: str, new: int = 32):
 def main(argv=None):
     ap = argparse.ArgumentParser()
     ap.add_argument("--what", default="wrn",
-                    choices=["wrn", "gpt2_decode"])
+                    choices=["wrn", "gpt2_decode", "gpt2_train",
+                             "gpt2_train_fused_head"])
     ap.add_argument("--out", default="/tmp/tnn_trace")
     ap.add_argument("--top", type=int, default=30)
     args = ap.parse_args(argv)
     if args.what == "wrn":
         trace_wrn(args.out)
-    else:
+    elif args.what == "gpt2_decode":
         trace_gpt2_decode(args.out)
+    else:
+        trace_gpt2_train(args.out,
+                         fused_head=args.what.endswith("fused_head"))
     aggregate(args.out, args.top)
 
 
