@@ -184,9 +184,14 @@ class PyTransport(Transport):
             # the reader OWNS the close: close()/close_conn() only shutdown()
             # to wake this recv — closing the fd from another thread while a
             # recv/send is in the syscall races on the descriptor (fd reuse
-            # hazard). Taking the send lock first waits out any in-flight
-            # sendall on this socket (it errors promptly once the peer is
-            # gone and the shutdown has landed).
+            # hazard). Shutting down BOTH directions first kicks any stalled
+            # in-flight sendall out of its syscall (a peer FIN alone does
+            # not error the send side); then taking the send lock waits for
+            # it to release before the fd goes away.
+            try:
+                sock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
             try:
                 if send_lock is not None:
                     with send_lock:
@@ -229,8 +234,9 @@ class PyTransport(Transport):
 
     def close_conn(self, conn: int) -> None:
         with self._lock:
+            # the send lock stays for the READER to pop: it must be able to
+            # wait out an in-flight sendall before closing the fd
             sock = self._conns.pop(conn, None)
-            self._send_locks.pop(conn, None)
         if sock is not None:
             try:
                 sock.shutdown(socket.SHUT_RDWR)  # reader wakes and closes

@@ -113,8 +113,10 @@ def _decode_kernel(t_ref, x_ref, kc, vc,
         mask_hd = (did // dh == hid).astype(jnp.float32)    # (Hp, D)
         live = (jax.lax.broadcasted_iota(jnp.int32, (1, T), 1) <= t)
 
-        ctx_rows = []
-        for b in range(B):  # B is tiny (decode); unrolled
+        # per-batch-row attention, accumulated into h_ln2's buffer reused as
+        # ctx scratch via static row slices (Mosaic's concatenate support is
+        # limited; indexed stores are not). B is tiny (decode); unrolled.
+        for b in range(B):
             qmask = mask_hd * q[b:b + 1, :]                  # (Hp, D)
             kb = kbuf[b].astype(jnp.float32)                 # (T, D)
             scores = jax.lax.dot_general(
@@ -128,9 +130,9 @@ def _decode_kernel(t_ref, x_ref, kc, vc,
             ctx_full = jax.lax.dot_general(
                 p, vb, (((1,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32)          # (Hp, D)
-            ctx_rows.append(jnp.sum(ctx_full * mask_hd, axis=0,
-                                    keepdims=True))          # (1, D)
-        ctx = jnp.concatenate(ctx_rows, axis=0) if B > 1 else ctx_rows[0]
+            h_ln2[b:b + 1, :] = jnp.sum(ctx_full * mask_hd, axis=0,
+                                        keepdims=True)       # (1, D)
+        ctx = h_ln2[...]
 
         ci, cs = _quant_rows(ctx)
         attn_out = (_i8dot_nt(ci, out_q[0]).astype(jnp.float32)
