@@ -249,11 +249,16 @@ def main(argv=None):
         if not q:
             results.append(bench_gpt2_decode(8, 64, 128, int8=True))
     if "decode_fused" in wanted:
-        # whole-stack-in-one-Pallas-launch decode (ops/pallas/decode_stack.py)
-        results.append(bench_gpt2_decode(1, 16 if q else 64, 16 if q else 128,
-                                         fused=True))
-        if not q:
-            results.append(bench_gpt2_decode(2, 64, 128, fused=True))
+        # whole-stack-in-one-Pallas-launch decode (ops/pallas/decode_stack.py);
+        # Mosaic-only — interpret-mode timing off-TPU is meaningless and takes
+        # minutes per token (correctness off-TPU lives in tests/)
+        if jax.default_backend() == "tpu":
+            results.append(bench_gpt2_decode(1, 16 if q else 64,
+                                             16 if q else 128, fused=True))
+            if not q:
+                results.append(bench_gpt2_decode(2, 64, 128, fused=True))
+        else:
+            print("decode_fused: skipped (TPU-only Pallas kernel)")
     return results
 
 

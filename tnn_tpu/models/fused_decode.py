@@ -103,7 +103,8 @@ def pick_chunks(d_model: int, mlp_hidden: int, batch: int, max_len: int,
 def fused_generate(model, params, prompt_ids, max_new_tokens: int,
                    temperature: float = 0.0, rng: Optional[jax.Array] = None,
                    max_len: Optional[int] = None,
-                   chunks: Optional[int] = None, interpret: bool = False):
+                   chunks: Optional[int] = None,
+                   interpret: Optional[bool] = None):
     """generate() with the fused decode-stack kernel on the per-token path.
 
     Same contract as models.gpt2.generate (returns (B, max_new_tokens) new
@@ -120,6 +121,8 @@ def fused_generate(model, params, prompt_ids, max_new_tokens: int,
         raise ValueError("prompt + new tokens exceed max_len")
     if rng is None:
         rng = jax.random.PRNGKey(0)
+    if interpret is None:  # Mosaic path on TPU; emulated elsewhere
+        interpret = jax.default_backend() != "tpu"
     if chunks is None:
         chunks = pick_chunks(model.d_model, 4 * model.d_model, batch, max_len)
         if chunks is None:
