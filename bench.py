@@ -165,6 +165,7 @@ def main():
         return measure()
 
     last_err = "no attempt ran"
+    last_transient = False  # recorded at each classification; reused for rc
     backend = os.environ.get("TNN_BENCH_PLATFORM") \
         or os.environ.get("JAX_PLATFORMS", "default")
     t_start = time.monotonic()
@@ -190,7 +191,8 @@ def main():
         info, err = probe_backend()
         if info is None:
             last_err = err
-            if not _is_transient(err):
+            last_transient = _is_transient(err)
+            if not last_transient:
                 break  # ImportError/config errors are deterministic: fail fast
             backoff(attempt)
             continue
@@ -202,6 +204,7 @@ def main():
                                  timeout=run_timeout, env=env)
         except subprocess.TimeoutExpired:
             last_err = f"bench run hung >{run_timeout}s (relay died mid-run?)"
+            last_transient = True
             backoff(attempt)
             continue
         sys.stderr.write(out.stderr or "")
@@ -220,7 +223,9 @@ def main():
             # signal-killed or silent deaths (relay dying mid-run, OOM kill)
             # are transient and worth the retry; only a clean-exit crash with
             # a non-transient message (ImportError, ...) is deterministic
-            if out.returncode >= 0 and tail and not _is_transient(last_err):
+            last_transient = not (out.returncode >= 0 and tail
+                                  and not _is_transient(last_err))
+            if not last_transient:
                 break
         elif "value" in result:
             print(json.dumps(result))
@@ -228,7 +233,8 @@ def main():
             return 0
         else:
             last_err = result.get("error", "unknown error")
-            if not _is_transient(last_err):
+            last_transient = _is_transient(last_err)
+            if not last_transient:
                 print(json.dumps(result))  # deterministic failure: report as-is
                 return 1
         backoff(attempt)
@@ -245,8 +251,9 @@ def main():
     # intact — the gate record parses and points at real numbers (VERDICT r03
     # #7). Deterministic failures (broken import, crash) stay rc=1 even with
     # old evidence on disk: a pointer at stale numbers must not mask a real
-    # regression.
-    return 0 if last is not None and _is_transient(last_err) else 1
+    # regression. Transience is recorded where each failure is classified
+    # (a signal-killed subprocess is transient but carries no marker text).
+    return 0 if last is not None and last_transient else 1
 
 
 def _last_committed():

@@ -152,3 +152,34 @@ def report(name: str, seconds: float, flops: Optional[float] = None,
         bits.append(f"{out[f'{item_name}_per_s']:.0f} {item_name}/s")
     print("  " + ", ".join(bits))
     return out
+
+
+class RowRunner:
+    """Per-row failure isolation for benchmark suites: one broken kernel or
+    model must not cost an (often unattended) evidence pass its other rows.
+    Failures become labeled ``row_failed:<fn>`` result entries AND count in
+    ``.failed`` so __main__ blocks can exit nonzero — scripts that gate on the
+    exit code (scripts/tpu_evidence.sh) still see the failure."""
+
+    def __init__(self):
+        self.results = []
+        self.failed = 0
+
+    def add(self, thunk, many: bool = False):
+        # label = the bench function the thunk calls (first global it names)
+        label = next(iter(getattr(thunk, "__code__", None) and
+                          thunk.__code__.co_names or ()), "?")
+        try:
+            r = thunk()
+            if many:
+                self.results.extend(r or [])
+            elif r:
+                self.results.append(r)
+        except Exception as e:  # noqa: BLE001 — report and continue
+            import traceback
+
+            traceback.print_exc()
+            self.failed += 1
+            self.results.append({"bench": f"row_failed:{label}",
+                                 "error": f"{type(e).__name__}: "
+                                          f"{str(e)[:300]}"})

@@ -15,7 +15,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from benchmarks.common import report, sync, time_loop
+from benchmarks.common import RowRunner, report, sync, time_loop
 
 
 def _count_params(params) -> int:
@@ -189,78 +189,83 @@ def main(argv=None):
     q = args.quick
     wanted = set(args.models.split(","))
     print(f"devices: {jax.devices()}")
-    results = []
+    runner = RowRunner()
+    results, add = runner.results, runner.add
+    main.last_runner = runner  # __main__ exit-code hook
     if "resnet9" in wanted:
-        results.append(bench_train(
+        add(lambda: bench_train(
             "cifar10_resnet9", (32, 32, 3), 10, 64 if q else 256,
             5 if q else 50, flops_per_sample=0.93e9, label="resnet9_cifar10"))
     if "wrn" in wanted:
-        results.append(bench_train(
+        add(lambda: bench_train(
             "cifar100_wrn16_8", (32, 32, 3), 100, 64 if q else 256,
             5 if q else 50, flops_per_sample=2.4e9, label="wrn16_8_cifar100"))
     if "vit" in wanted:
         # 10.8M params x 65 tokens => ~1.4 GFLOP fwd per 64x64 sample
-        results.append(bench_train(
+        add(lambda: bench_train(
             "tiny_imagenet_vit", (64, 64, 3), 200, 32 if q else 256,
             5 if q else 30, flops_per_sample=1.4e9, label="vit_tiny_imagenet"))
     if "gpt2" in wanted:
-        results.append(bench_gpt2_train(2 if q else 8, 128 if q else 512,
+        add(lambda: bench_gpt2_train(2 if q else 8, 128 if q else 512,
                                         3 if q else 10))
         if not q:  # chunked LM-head loss: no (tokens, vocab) f32 logits
-            results.append(bench_gpt2_train(8, 512, 10, fused_head=True))
+            add(lambda: bench_gpt2_train(8, 512, 10, fused_head=True))
     if "gpt2_long" in wanted:
-        results.append(bench_gpt2_long_train(1, 2048, 3) if q
+        add(lambda: bench_gpt2_long_train(1, 2048, 3) if q
                        else bench_gpt2_long_train())
     if "gpt2_flash" in wanted:
         # the pallas-attention variant, at the context length where fused
         # attention matters (reference ships gpt2 + flash_gpt2 side by side)
-        results.append(bench_gpt2_train(2 if q else 8, 128 if q else 1024,
+        add(lambda: bench_gpt2_train(2 if q else 8, 128 if q else 1024,
                                         3 if q else 10, flash=True))
     if "moe" in wanted:
         # expert-routed FFN variant; MFU on active params (VERDICT r03 #4)
-        results.append(bench_gpt2_train(2 if q else 8, 128 if q else 512,
+        add(lambda: bench_gpt2_train(2 if q else 8, 128 if q else 512,
                                         3 if q else 10, moe=True))
     if "gpt2_medium" in wanted:
         # 355M params: flash attention + remat to fit train on one chip
-        results.append(bench_gpt2_train(1 if q else 4, 128 if q else 512,
+        add(lambda: bench_gpt2_train(1 if q else 4, 128 if q else 512,
                                         3 if q else 8, size="medium",
                                         flash=not q, remat=True,
                                         extra={"remat": True}))
-        results.append(bench_gpt2_decode(1, 16 if q else 64, 8 if q else 64,
+        add(lambda: bench_gpt2_decode(1, 16 if q else 64, 8 if q else 64,
                                          size="medium"))
         if not q:
-            results.append(bench_gpt2_decode(1, 64, 64, size="medium",
+            add(lambda: bench_gpt2_decode(1, 64, 64, size="medium",
                                              int8=True))
     if "gpt2_large" in wanted:
         # 774M params: bs=1 + remat; decode int8 halves the weight stream
-        results.append(bench_gpt2_train(1, 128 if q else 512, 3 if q else 6,
+        add(lambda: bench_gpt2_train(1, 128 if q else 512, 3 if q else 6,
                                         size="large", flash=not q, remat=True,
                                         extra={"remat": True}))
-        results.append(bench_gpt2_decode(1, 16 if q else 64, 8 if q else 64,
+        add(lambda: bench_gpt2_decode(1, 16 if q else 64, 8 if q else 64,
                                          size="large", int8=not q))
     if "decode" in wanted:
-        results.append(bench_gpt2_decode(1, 16 if q else 64, 16 if q else 128))
+        add(lambda: bench_gpt2_decode(1, 16 if q else 64, 16 if q else 128))
         if not q:  # serving-shaped batched decode (throughput mode)
-            results.append(bench_gpt2_decode(8, 64, 128))
+            add(lambda: bench_gpt2_decode(8, 64, 128))
     if "decode_int8" in wanted:
         # bs=1 latency mode is where int8 weights beat the bf16 HBM roofline
-        results.append(bench_gpt2_decode(1, 16 if q else 64, 16 if q else 128,
+        add(lambda: bench_gpt2_decode(1, 16 if q else 64, 16 if q else 128,
                                          int8=True))
         if not q:
-            results.append(bench_gpt2_decode(8, 64, 128, int8=True))
+            add(lambda: bench_gpt2_decode(8, 64, 128, int8=True))
     if "decode_fused" in wanted:
         # whole-stack-in-one-Pallas-launch decode (ops/pallas/decode_stack.py);
         # Mosaic-only — interpret-mode timing off-TPU is meaningless and takes
         # minutes per token (correctness off-TPU lives in tests/)
         if jax.default_backend() == "tpu":
-            results.append(bench_gpt2_decode(1, 16 if q else 64,
+            add(lambda: bench_gpt2_decode(1, 16 if q else 64,
                                              16 if q else 128, fused=True))
             if not q:
-                results.append(bench_gpt2_decode(2, 64, 128, fused=True))
+                add(lambda: bench_gpt2_decode(2, 64, 128, fused=True))
         else:
             print("decode_fused: skipped (TPU-only Pallas kernel)")
     return results
 
 
 if __name__ == "__main__":
+    import sys
+
     main()
+    sys.exit(1 if main.last_runner.failed else 0)

@@ -14,7 +14,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from benchmarks.common import report, time_fn, verify
+from benchmarks.common import RowRunner, report, time_fn, verify
 
 
 def bench_gemm(quick=False):
@@ -163,14 +163,20 @@ def main(argv=None):
     ap.add_argument("--quick", action="store_true", help="small shapes (CI/CPU)")
     args = ap.parse_args(argv)
     print(f"devices: {jax.devices()}")
-    results = []
-    results.append(bench_gemm(args.quick))
-    results.append(bench_conv2d(args.quick))
-    results.append(bench_dense_train(args.quick))
-    results.extend(bench_attention(args.quick))
-    results.extend(bench_long_context(args.quick))
-    return results
+    runner = RowRunner()
+    # per-row isolation: one failing kernel/bench must not cost the whole
+    # evidence pass its other rows (same policy as model_bench.main)
+    runner.add(lambda: bench_gemm(args.quick))
+    runner.add(lambda: bench_conv2d(args.quick))
+    runner.add(lambda: bench_dense_train(args.quick))
+    runner.add(lambda: bench_attention(args.quick), many=True)
+    runner.add(lambda: bench_long_context(args.quick), many=True)
+    main.last_runner = runner
+    return runner.results
 
 
 if __name__ == "__main__":
+    import sys
+
     main()
+    sys.exit(1 if main.last_runner.failed else 0)

@@ -66,4 +66,10 @@ def _append_regression_csv(path, results, quick):
 
 
 if __name__ == "__main__":
-    main()
+    import sys
+
+    rs = main()
+    # artifacts are already written above; the nonzero rc records that some
+    # rows failed without sacrificing the rows that succeeded
+    sys.exit(1 if any(str(r.get("bench", "")).startswith("row_failed")
+                      for r in rs) else 0)

@@ -42,7 +42,13 @@ def main(argv=None):
     ap.add_argument("--int8", action="store_true",
                     help="weight-only int8 decode (in-VMEM-dequant Pallas "
                          "matmul; ~2x fewer weight bytes per token)")
+    ap.add_argument("--fused", action="store_true",
+                    help="whole-stack fused decode kernel (one Pallas launch "
+                         "per token, ops/pallas/decode_stack.py); implies "
+                         "--int8")
     args = ap.parse_args(argv)
+    if args.fused:
+        args.int8 = True
 
     tokenizer = None
     if args.vocab:
@@ -72,17 +78,21 @@ def main(argv=None):
         prompt_ids = np.frombuffer(args.prompt.encode(), np.uint8).astype(
             np.int32)[None] % model.vocab_size
 
+    gen_fn = generate
+    if args.fused:
+        from tnn_tpu.models.fused_decode import fused_generate as gen_fn
+
     # generate twice: first call compiles, second measures steady-state decode.
     # np.asarray forces completion — without it the relay would still be running
     # the first call when the timer starts.
-    out = generate(model, params, prompt_ids, args.max_new_tokens,
-                   temperature=args.temperature,
-                   rng=jax.random.PRNGKey(args.seed))
+    out = gen_fn(model, params, prompt_ids, args.max_new_tokens,
+                 temperature=args.temperature,
+                 rng=jax.random.PRNGKey(args.seed))
     np.asarray(out)
     t0 = time.perf_counter()
-    out = generate(model, params, prompt_ids, args.max_new_tokens,
-                   temperature=args.temperature,
-                   rng=jax.random.PRNGKey(args.seed))
+    out = gen_fn(model, params, prompt_ids, args.max_new_tokens,
+                 temperature=args.temperature,
+                 rng=jax.random.PRNGKey(args.seed))
     new_tokens = np.asarray(out)[0]  # generate returns only the new tokens
     dt = time.perf_counter() - t0
 
