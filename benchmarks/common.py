@@ -154,6 +154,9 @@ def report(name: str, seconds: float, flops: Optional[float] = None,
     return out
 
 
+ROW_FAILED = "row_failed"  # label prefix shared with run_all's rc scan
+
+
 class RowRunner:
     """Per-row failure isolation for benchmark suites: one broken kernel or
     model must not cost an (often unattended) evidence pass its other rows.
@@ -165,10 +168,11 @@ class RowRunner:
         self.results = []
         self.failed = 0
 
-    def add(self, thunk, many: bool = False):
-        # label = the bench function the thunk calls (first global it names)
-        label = next(iter(getattr(thunk, "__code__", None) and
-                          thunk.__code__.co_names or ()), "?")
+    def add(self, thunk, many: bool = False, label: str = ""):
+        # default label = the bench function the thunk calls (first global it
+        # names); pass label= when the thunk is not a direct bench_* call
+        label = label or next(iter(getattr(thunk, "__code__", None) and
+                                   thunk.__code__.co_names or ()), "?")
         try:
             r = thunk()
             if many:
@@ -180,6 +184,6 @@ class RowRunner:
 
             traceback.print_exc()
             self.failed += 1
-            self.results.append({"bench": f"row_failed:{label}",
+            self.results.append({"bench": f"{ROW_FAILED}:{label}",
                                  "error": f"{type(e).__name__}: "
                                           f"{str(e)[:300]}"})

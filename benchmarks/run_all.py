@@ -68,8 +68,10 @@ def _append_regression_csv(path, results, quick):
 if __name__ == "__main__":
     import sys
 
+    from benchmarks.common import ROW_FAILED
+
     rs = main()
     # artifacts are already written above; the nonzero rc records that some
     # rows failed without sacrificing the rows that succeeded
-    sys.exit(1 if any(str(r.get("bench", "")).startswith("row_failed")
+    sys.exit(1 if any(str(r.get("bench", "")).startswith(ROW_FAILED)
                       for r in rs) else 0)

@@ -1,0 +1,66 @@
+"""Sampling strategies: greedy/temperature/top-k/top-p semantics and their
+wiring through generate() (the reference's loop is greedy-only,
+examples/gpt2_inference.cpp:107-119)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tnn_tpu.models.sampling import make_sampler
+
+
+def test_greedy_is_argmax():
+    logits = jnp.asarray([[0.1, 2.0, -1.0], [3.0, 0.0, 0.0]])
+    s = make_sampler(0.0)
+    toks = s(logits, jax.random.PRNGKey(0))
+    np.testing.assert_array_equal(np.asarray(toks), [1, 0])
+
+
+def test_top_k_restricts_support():
+    rs = np.random.RandomState(0)
+    logits = jnp.asarray(rs.randn(1, 50) * 3)
+    top3 = set(np.asarray(jnp.argsort(logits[0])[-3:]).tolist())
+    s = make_sampler(1.0, top_k=3)
+    seen = {int(s(logits, jax.random.PRNGKey(i))[0]) for i in range(64)}
+    assert seen <= top3 and len(seen) >= 2
+
+
+def test_top_k_1_equals_greedy():
+    logits = jnp.asarray(np.random.RandomState(1).randn(4, 20))
+    s = make_sampler(0.7, top_k=1)
+    toks = np.asarray(s(logits, jax.random.PRNGKey(0)))
+    np.testing.assert_array_equal(toks, np.asarray(jnp.argmax(logits, -1)))
+
+
+def test_top_p_nucleus_mass():
+    # crafted distribution: probs ~ [0.5, 0.3, 0.1, 0.1]; top_p=0.7 keeps
+    # exactly the first two (0.5 < 0.7, 0.8-0.3=0.5 < 0.7, 0.9-0.1=0.8 >= 0.7)
+    probs = np.asarray([0.5, 0.3, 0.1, 0.1])
+    logits = jnp.asarray(np.log(probs))[None]
+    s = make_sampler(1.0, top_p=0.7)
+    seen = {int(s(logits, jax.random.PRNGKey(i))[0]) for i in range(128)}
+    assert seen == {0, 1}, seen
+
+
+def test_top_p_always_keeps_best():
+    logits = jnp.asarray([[10.0, 0.0, 0.0]])
+    s = make_sampler(1.0, top_p=1e-6)
+    for i in range(8):
+        assert int(s(logits, jax.random.PRNGKey(i))[0]) == 0
+
+
+def test_generate_with_sampling_runs():
+    from tnn_tpu.models.gpt2 import GPT2, generate
+
+    model = GPT2(vocab_size=128, max_len=32, num_layers=1, d_model=64,
+                 num_heads=2)
+    v = model.init(jax.random.PRNGKey(0), (1, 8))
+    prompt = jnp.zeros((1, 4), jnp.int32)
+    toks = generate(model, v["params"], prompt, 4, temperature=0.8,
+                    top_k=10, top_p=0.9)
+    assert toks.shape == (1, 4)
+    assert ((np.asarray(toks) >= 0) & (np.asarray(toks) < 128)).all()
+    # deterministic given the same rng
+    toks2 = generate(model, v["params"], prompt, 4, temperature=0.8,
+                     top_k=10, top_p=0.9)
+    np.testing.assert_array_equal(np.asarray(toks), np.asarray(toks2))

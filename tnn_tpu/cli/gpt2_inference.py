@@ -38,6 +38,11 @@ def main(argv=None):
     ap.add_argument("--prompt", default="The meaning of life is")
     ap.add_argument("-n", "--max-new-tokens", type=int, default=50)
     ap.add_argument("--temperature", type=float, default=0.0)
+    ap.add_argument("--top-k", type=int, default=0,
+                    help="sample only from the k highest logits (0 = off)")
+    ap.add_argument("--top-p", type=float, default=0.0,
+                    help="nucleus sampling: smallest token set with "
+                         "cumulative prob >= p (0 = off)")
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--int8", action="store_true",
                     help="weight-only int8 decode (in-VMEM-dequant Pallas "
@@ -49,6 +54,11 @@ def main(argv=None):
     args = ap.parse_args(argv)
     if args.fused:
         args.int8 = True
+    if (args.top_k or args.top_p) and args.temperature <= 0:
+        # top-k/top-p only shape a STOCHASTIC distribution; under greedy
+        # (temperature 0) they would be silently ignored
+        print("--top-k/--top-p need sampling: defaulting --temperature 1.0")
+        args.temperature = 1.0
 
     tokenizer = None
     if args.vocab:
@@ -85,14 +95,12 @@ def main(argv=None):
     # generate twice: first call compiles, second measures steady-state decode.
     # np.asarray forces completion — without it the relay would still be running
     # the first call when the timer starts.
-    out = gen_fn(model, params, prompt_ids, args.max_new_tokens,
-                 temperature=args.temperature,
-                 rng=jax.random.PRNGKey(args.seed))
+    kw = dict(temperature=args.temperature, top_k=args.top_k,
+              top_p=args.top_p, rng=jax.random.PRNGKey(args.seed))
+    out = gen_fn(model, params, prompt_ids, args.max_new_tokens, **kw)
     np.asarray(out)
     t0 = time.perf_counter()
-    out = gen_fn(model, params, prompt_ids, args.max_new_tokens,
-                 temperature=args.temperature,
-                 rng=jax.random.PRNGKey(args.seed))
+    out = gen_fn(model, params, prompt_ids, args.max_new_tokens, **kw)
     new_tokens = np.asarray(out)[0]  # generate returns only the new tokens
     dt = time.perf_counter() - t0
 

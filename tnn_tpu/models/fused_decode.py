@@ -104,7 +104,8 @@ def fused_generate(model, params, prompt_ids, max_new_tokens: int,
                    temperature: float = 0.0, rng: Optional[jax.Array] = None,
                    max_len: Optional[int] = None,
                    chunks: Optional[int] = None,
-                   interpret: Optional[bool] = None):
+                   interpret: Optional[bool] = None, top_k: int = 0,
+                   top_p: float = 0.0):
     """generate() with the fused decode-stack kernel on the per-token path.
 
     Same contract as models.gpt2.generate (returns (B, max_new_tokens) new
@@ -141,7 +142,8 @@ def fused_generate(model, params, prompt_ids, max_new_tokens: int,
     stacks = stack_cache[1]
 
     cache_key = ("fused", batch, prompt_len, max_new_tokens,
-                 float(temperature), max_len, chunks, interpret)
+                 float(temperature), max_len, chunks, interpret,
+                 int(top_k), float(top_p))
     jit_cache = getattr(model, "_generate_jit_cache", None)
     if jit_cache is None:
         jit_cache = model._generate_jit_cache = {}
@@ -155,11 +157,9 @@ def fused_generate(model, params, prompt_ids, max_new_tokens: int,
             kc, vc = caches_to_stacked(caches)
             last_logits = logits[:, -1]
 
-            def sample(logits, key):
-                if temperature > 0.0:
-                    return jax.random.categorical(key, logits / temperature,
-                                                  axis=-1)
-                return jnp.argmax(logits, axis=-1)
+            from .sampling import make_sampler
+
+            sample = make_sampler(temperature, top_k, top_p)
 
             def step(carry, key):
                 kc, vc, last_logits, offset = carry

@@ -155,7 +155,8 @@ class GPT2(Module):
 
 def generate(model: GPT2, params, prompt_ids, max_new_tokens: int,
              temperature: float = 0.0, rng: Optional[jax.Array] = None,
-             max_len: Optional[int] = None):
+             max_len: Optional[int] = None, top_k: int = 0,
+             top_p: float = 0.0):
     """Autoregressive generation with a KV cache, fully jit-compiled.
 
     Prefill processes the whole prompt in one pass; decode generates one token per step
@@ -181,7 +182,8 @@ def generate(model: GPT2, params, prompt_ids, max_new_tokens: int,
 
     # jit cache lives on the model instance — repeat calls with the same geometry reuse
     # the compiled prefill+scan program instead of retracing.
-    cache_key = (batch, prompt_len, max_new_tokens, float(temperature), max_len)
+    cache_key = (batch, prompt_len, max_new_tokens, float(temperature),
+                 max_len, int(top_k), float(top_p))
     jit_cache = getattr(model, "_generate_jit_cache", None)
     if jit_cache is None:
         jit_cache = model._generate_jit_cache = {}
@@ -194,10 +196,9 @@ def generate(model: GPT2, params, prompt_ids, max_new_tokens: int,
             logits, caches = model.apply_cached(params, prompt_ids, caches, 0)
             last_logits = logits[:, -1]
 
-            def sample(logits, key):
-                if temperature > 0.0:
-                    return jax.random.categorical(key, logits / temperature, axis=-1)
-                return jnp.argmax(logits, axis=-1)
+            from .sampling import make_sampler
+
+            sample = make_sampler(temperature, top_k, top_p)
 
             def step(carry, key):
                 caches, last_logits, offset = carry

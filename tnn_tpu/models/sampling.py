@@ -1,0 +1,55 @@
+"""Token-sampling strategies for autoregressive generation.
+
+Greedy, temperature, top-k, and nucleus (top-p) sampling behind one factory —
+shared by models.gpt2.generate and models.fused_decode.fused_generate.
+Exceeds the reference, whose inference loop is greedy argmax only
+(examples/gpt2_inference.cpp:107-119).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = jnp.float32(-1e30)  # large-negative beats -inf: 0*inf NaN hazards
+
+
+def make_sampler(temperature: float = 0.0, top_k: int = 0,
+                 top_p: float = 0.0):
+    """Build a ``(logits (..., V), key) -> (...,) int32`` sampler.
+
+    temperature<=0 -> greedy argmax (top_k/top_p ignored). Otherwise scale by
+    temperature, then optionally keep only the k highest logits (top_k>0)
+    and/or the smallest set of tokens whose cumulative probability reaches
+    top_p (0<top_p<1, "nucleus"); sample categorically from what is left.
+    The filters compose (top-k first, then top-p over the survivors).
+    """
+    temperature = float(temperature)
+    top_k = int(top_k)
+    top_p = float(top_p)
+    if top_p >= 1.0:
+        top_p = 0.0  # keep-everything is a no-op
+
+    if temperature <= 0.0:
+        def greedy(logits, key):
+            return jnp.argmax(logits, axis=-1)
+        return greedy
+
+    def sample(logits, key):
+        logits = logits.astype(jnp.float32) / temperature
+        if top_k > 0:
+            k = min(top_k, logits.shape[-1])  # k > V degrades to keep-all
+            kth = jax.lax.top_k(logits, k)[0][..., -1:]
+            logits = jnp.where(logits < kth, NEG_INF, logits)
+        if top_p > 0.0:
+            down = jnp.flip(jnp.sort(logits, axis=-1), axis=-1)
+            probs = jax.nn.softmax(down, axis=-1)
+            csum = jnp.cumsum(probs, axis=-1)
+            # a token survives if the mass BEFORE it is still below top_p —
+            # the highest-probability token always survives
+            keep = (csum - probs) < top_p
+            cutoff = jnp.min(jnp.where(keep, down, jnp.inf), axis=-1,
+                             keepdims=True)
+            logits = jnp.where(logits < cutoff, NEG_INF, logits)
+        return jax.random.categorical(key, logits, axis=-1)
+
+    return sample
