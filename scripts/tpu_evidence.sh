@@ -45,8 +45,8 @@ echo "== 6/8 on-chip convergence curve: WRN-16-8 on REAL handwritten digits =="
 # (sample_logs/cifar100_wrn16_8; CIFAR binaries are not downloadable here).
 # Staged to /tmp: trainer pre-creates the history file, so a crashed run
 # would otherwise leave an empty artifact for the final git add to sweep up.
-if timeout 1800 python -m tnn_tpu.cli.trainer --model digits_wrn16_8 \
-    --dataset digits --epochs 30 --batch-size 128 \
+if timeout 1800 python -m tnn_tpu.cli.trainer \
+    --config configs/digits_wrn16_8.json \
     --history-out "/tmp/digits_curve_${STAMP}.json"; then
   cp "/tmp/digits_curve_${STAMP}.json" \
      "benchmarks/results/digits_wrn16_8_curve_${STAMP}.json"
