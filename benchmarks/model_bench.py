@@ -236,6 +236,8 @@ def main(argv=None):
             if jax.default_backend() == "tpu":  # Mosaic-only fused kernel
                 add(lambda: bench_gpt2_decode(1, 64, 64, size="medium",
                                               fused=True))
+            else:
+                print("gpt2_medium decode_fused: skipped (TPU-only)")
     if "gpt2_large" in wanted:
         # 774M params: bs=1 + remat; decode int8 halves the weight stream
         add(lambda: bench_gpt2_train(1, 128 if q else 512, 3 if q else 6,

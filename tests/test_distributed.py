@@ -321,6 +321,41 @@ class TestRobustness:
                 t.join(timeout=60)
 
 
+class TestHandshakeStorm:
+    def test_simultaneous_connects_all_get_acks(self):
+        """16 workers connect at once and every one must receive its
+        HANDSHAKE_ACK. Regression for the add_conn race (native/src/
+        control.cpp): the reader thread could deliver a peer's HANDSHAKE
+        before the conn was registered, so the coordinator's ack send
+        silently missed — workers stranded in their handshake wait.
+        Found by the TSan lane; this pins it at the protocol level."""
+        n = 16
+        with Coordinator(num_workers=n) as coord:
+            res = {}
+            errs = []
+
+            def run(i):
+                try:
+                    w = Worker("127.0.0.1", coord.port(), rank=i,
+                               heartbeat_interval=5.0).start()
+                    res[i] = w
+                except Exception as e:  # noqa: BLE001 — collected for assert
+                    errs.append((i, repr(e)))
+
+            threads = [threading.Thread(target=run, args=(i,), daemon=True)
+                       for i in range(n)]
+            for t in threads:  # start as close to simultaneously as possible
+                t.start()
+            ranks = coord.wait_for_workers(timeout=90)
+            for t in threads:
+                t.join(timeout=60)
+            assert not errs, errs
+            assert ranks == list(range(n))
+            assert sorted(res) == list(range(n))
+            assert all(res[i].rank == i for i in res)
+            coord.shutdown()
+
+
 class TestTransportInterop:
     def test_python_worker_native_coordinator(self):
         """Wire-format compatibility: both transports speak identical frames."""
