@@ -93,6 +93,8 @@ def main(argv=None):
     tok_s = args.steps * args.batch * args.seq / train_secs
 
     out = {"metric": "gpt2_bytes_lm", "backend": args.backend,
+           # a CPU curve must never masquerade as chip numbers
+           "platform": jax.devices()[0].platform,
            "model": {"layers": args.layers, "d_model": args.d_model,
                      "heads": args.heads, "seq": args.seq, "vocab": vocab},
            "steps": args.steps, "train_tok_per_s": round(tok_s, 1),
