@@ -1,5 +1,6 @@
 """Benchmark harness smoke tests (quick shapes, CPU-safe): the verification gates
 must pass and each bench must produce a result dict."""
+import pytest
 
 
 def test_ops_bench_quick():
@@ -47,18 +48,29 @@ class TestBenchGateRetry:
         rc = bench.main()
         return rc, calls["n"], capsys.readouterr().out
 
+    @pytest.mark.parametrize("has_evidence,want_rc", [(True, 0), (False, 1)])
     def test_transient_probe_failure_retries_to_attempt_cap(
-            self, monkeypatch, capsys):
+            self, monkeypatch, capsys, has_evidence, want_rc):
+        """A relay outage retries to the attempt cap, then exits 0 IF a
+        committed evidence pointer exists (the gate record parses and points
+        at real numbers) and 1 otherwise — stale-or-no evidence must not
+        read as success."""
         import json
 
+        import bench
+
+        monkeypatch.setattr(
+            bench, "_last_committed",
+            lambda: {"value": 1.0, "unix_time": 0, "file": "x.json"}
+            if has_evidence else None)
         rc, n_probes, out = self._run(
             monkeypatch, capsys,
             [(None, "backend init hung >60s (relay down?)")])
-        assert rc == 1
-        import bench
+        assert rc == want_rc
         assert n_probes == bench.MAX_ATTEMPTS  # kept trying, not 1-2 probes
         last = json.loads(out.strip().splitlines()[-1])
         assert "error" in last and last["metric"] == bench.METRIC
+        assert ("last_committed" in last) == has_evidence
 
     def test_deterministic_probe_failure_fails_fast(self, monkeypatch, capsys):
         rc, n_probes, _ = self._run(
@@ -78,8 +90,9 @@ class TestBenchGateRetry:
 
         monkeypatch.setattr(bench, "probe_backend", fake_probe)
         monkeypatch.setattr(bench.time, "sleep", lambda s: None)
+        monkeypatch.setattr(bench, "_last_committed", lambda: None)
         rc = bench.main()
-        assert rc == 1
+        assert rc == 1  # transient, but no evidence pointer -> failure rc
         # default budget is >=15 min of retrying (VERDICT r03 follow-up)
         assert bench.TOTAL_BUDGET_S >= 900
         assert "budget" in capsys.readouterr().out
