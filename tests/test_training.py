@@ -36,6 +36,40 @@ def test_losses_basic():
     np.testing.assert_allclose(h, (0.5 * 0.25 + 0.5) / 4, rtol=1e-6)
 
 
+def test_label_smoothing_matches_manual_mix():
+    rs = np.random.RandomState(3)
+    logits = rs.randn(6, 4).astype(np.float32)
+    labels = np.array([0, 1, 2, 3, 0, 1])
+    a = 0.1
+    got = float(losses.softmax_cross_entropy(
+        jnp.asarray(logits), jnp.asarray(labels), label_smoothing=a))
+    logp = logits - logits.max(-1, keepdims=True)
+    logp = logp - np.log(np.exp(logp).sum(-1, keepdims=True))
+    target = np.eye(4)[labels] * (1 - a) + a / 4
+    ref = float(np.mean(-(target * logp).sum(-1)))
+    np.testing.assert_allclose(got, ref, rtol=1e-5)
+    # a=0 is exactly the unsmoothed loss
+    np.testing.assert_allclose(
+        float(losses.softmax_cross_entropy(
+            jnp.asarray(logits), jnp.asarray(labels), label_smoothing=0.0)),
+        float(losses.softmax_cross_entropy(jnp.asarray(logits),
+                                           jnp.asarray(labels))), rtol=1e-7)
+
+
+def test_loss_config_dict_reaches_kwargs():
+    """{"type": name, **kwargs} configs bind loss options — the path a JSON
+    TrainingConfig takes (config.loss -> make_train_step -> losses.get)."""
+    rs = np.random.RandomState(5)
+    logits = jnp.asarray(rs.randn(4, 3), jnp.float32)
+    labels = jnp.asarray([0, 1, 2, 0], jnp.int32)
+    fn = losses.get({"type": "softmax_cross_entropy", "label_smoothing": 0.2})
+    np.testing.assert_allclose(
+        float(fn(logits, labels)),
+        float(losses.softmax_cross_entropy(logits, labels,
+                                           label_smoothing=0.2)), rtol=1e-7)
+    assert losses.get("mse") is losses.mse
+
+
 def test_onehot_and_int_labels_agree():
     logits = jnp.asarray(np.random.randn(4, 3), jnp.float32)
     ints = jnp.asarray([0, 2, 1, 0], jnp.int32)

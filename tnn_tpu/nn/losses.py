@@ -25,11 +25,27 @@ def register(name: str):
     return wrap
 
 
-def get(name: str) -> Callable:
-    """Parity: LossFactory (include/nn/loss.hpp:464)."""
+def get(name) -> Callable:
+    """Parity: LossFactory (include/nn/loss.hpp:464).
+
+    Accepts a name string or a config dict ``{"type": name, **kwargs}`` —
+    extra keys bind as keyword arguments (e.g. ``{"type":
+    "softmax_cross_entropy", "label_smoothing": 0.1}``), so loss options are
+    reachable from TrainingConfig/JSON like optimizer/scheduler options.
+    """
+    kwargs = {}
+    if isinstance(name, dict):
+        cfg = dict(name)
+        name = cfg.pop("type")
+        kwargs = cfg
     if name not in _REGISTRY:
         raise KeyError(f"unknown loss {name!r}; known: {sorted(_REGISTRY)}")
-    return _REGISTRY[name]
+    fn = _REGISTRY[name]
+    if kwargs:
+        import functools
+
+        return functools.partial(fn, **kwargs)
+    return fn
 
 
 def names():
@@ -43,12 +59,15 @@ def _to_onehot(labels, num_classes):
 
 
 @register("softmax_cross_entropy")
-def softmax_cross_entropy(logits, labels, weight: Optional[jax.Array] = None):
+def softmax_cross_entropy(logits, labels, weight: Optional[jax.Array] = None,
+                          label_smoothing: float = 0.0):
     """Fused log-softmax + NLL on logits (parity: create_logsoftmax_crossentropy,
     loss.hpp:464 — the numerically-stable mode). ``labels``: int class ids or one-hot/soft.
     Integer labels < 0 are ignored (masked out of the mean) — used by the token-stream
     loader to mask padding, vs the reference's zeroed one-hot rows
-    (open_webtext_data_loader.hpp:41-44).
+    (open_webtext_data_loader.hpp:41-44). ``label_smoothing`` in [0, 1) mixes
+    the target with the uniform distribution (beyond the reference, which has
+    no smoothing): target = (1-a)*onehot + a/num_classes.
     """
     logits = logits.astype(jnp.float32)
     mask = None
@@ -56,6 +75,9 @@ def softmax_cross_entropy(logits, labels, weight: Optional[jax.Array] = None):
         mask = (labels >= 0).astype(jnp.float32)
         labels = jnp.maximum(labels, 0)
     onehot = _to_onehot(labels, logits.shape[-1])
+    if label_smoothing:
+        a = float(label_smoothing)
+        onehot = onehot * (1.0 - a) + a / logits.shape[-1]
     logp = jax.nn.log_softmax(logits, axis=-1)
     nll = -jnp.sum(onehot * logp, axis=-1)
     if weight is not None:

@@ -302,7 +302,7 @@ class HeteroPipeline:
         # contiguously (identity when v == 1)
         self._stage_of_row = [(r % self.v) * self.pp + r // self.v
                               for r in range(self.L)]
-        if isinstance(loss_fn, str) or loss_fn is None:
+        if isinstance(loss_fn, (str, dict)) or loss_fn is None:
             loss_fn = losses_lib.get(loss_fn or "softmax_cross_entropy")
         self.loss_fn = loss_fn
         self.compute_accuracy = bool(compute_accuracy)

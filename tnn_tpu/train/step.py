@@ -105,7 +105,7 @@ def make_train_step(
         if not (hasattr(model, "apply_hidden") and hasattr(model, "head_table")):
             raise ValueError(f"{type(model).__name__} lacks apply_hidden/"
                              "head_table; lm_head_chunk needs an LM model")
-    if isinstance(loss_fn, str):
+    if isinstance(loss_fn, (str, dict)):
         loss_fn = losses_lib.get(loss_fn)
     scheduler = scheduler or NoOp()
     host_driven = getattr(scheduler, "host_driven", False)
@@ -206,7 +206,7 @@ def make_train_step(
 def make_eval_step(model, loss_fn: Callable | str = "softmax_cross_entropy",
                    compute_accuracy: bool = True):
     """Jitted (state, data, labels) -> metrics (no state mutation; BN uses running stats)."""
-    if isinstance(loss_fn, str):
+    if isinstance(loss_fn, (str, dict)):
         loss_fn = losses_lib.get(loss_fn)
 
     @jax.jit

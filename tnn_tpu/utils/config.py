@@ -50,7 +50,8 @@ class TrainingConfig:
     optimizer: Dict[str, Any] = dataclasses.field(
         default_factory=lambda: {"type": "sgd", "lr": 0.001})
     scheduler: Optional[Dict[str, Any]] = None
-    loss: str = "softmax_cross_entropy"
+    # name, or {"type": name, **kwargs} (e.g. label_smoothing) — nn.losses.get
+    loss: Any = "softmax_cross_entropy"
     seed: int = 0
     snapshot_dir: str = "model_snapshots"
     resume: str = ""  # checkpoint dir to resume full training state from
