@@ -20,7 +20,7 @@ ENV PATH=/opt/venv/bin:$PATH
 #   docker build --build-arg JAX_EXTRA=tpu -t tnn-tpu .
 ARG JAX_EXTRA=cpu
 RUN pip install --no-cache-dir "jax[${JAX_EXTRA}]" flax optax orbax-checkpoint \
-        chex einops numpy pytest pillow
+        chex einops numpy pytest pillow scikit-learn
 
 WORKDIR /app
 COPY . .
