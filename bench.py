@@ -47,6 +47,10 @@ RETRY_WAIT_MAX_S = int(os.environ.get("TNN_BENCH_RETRY_WAIT_MAX", "90"))
 # of rc=1 gate JSONs (r01-r03) were all relay outages that a longer retry
 # window would have ridden out, so the default is a full 15 minutes.
 TOTAL_BUDGET_S = int(os.environ.get("TNN_BENCH_TOTAL_BUDGET", "900"))
+# A transient-outage gate may vouch for the last persisted run only while that
+# run is recent (~ one round of wall clock); older evidence forces rc=1 so a
+# multi-round outage can't ride a single old success forever.
+EVIDENCE_MAX_AGE_S = int(os.environ.get("TNN_BENCH_EVIDENCE_MAX_AGE", str(48 * 3600)))
 
 _PROBE_SRC = """
 import json, os, jax
@@ -241,19 +245,31 @@ def main():
 
     out = {"metric": METRIC, "error": str(last_err)[:500], "backend": backend}
     last = _last_committed()
+    fresh = False
     if last is not None:
         # the relay being down at gate time must not erase the evidence trail:
         # point at the most recent persisted successful run (clearly labeled
         # as such, value NOT surfaced in the "value" field)
+        if last.get("unix_time"):
+            last["evidence_age_s"] = round(time.time() - last["unix_time"], 1)
+            fresh = last["evidence_age_s"] <= EVIDENCE_MAX_AGE_S
+            if not fresh:
+                out["evidence_stale"] = (
+                    f"last committed run older than {EVIDENCE_MAX_AGE_S}s; "
+                    "rc=1 so a prolonged outage cannot vouch indefinitely")
+        else:
+            out["evidence_untimestamped"] = (
+                "last committed run carries no unix_time; treated as stale")
         out["last_committed"] = last
     print(json.dumps(out))
-    # rc=0 only for TRANSIENT failure (relay outage) with the evidence chain
-    # intact — the gate record parses and points at real numbers (VERDICT r03
-    # #7). Deterministic failures (broken import, crash) stay rc=1 even with
-    # old evidence on disk: a pointer at stale numbers must not mask a real
-    # regression. Transience is recorded where each failure is classified
-    # (a signal-killed subprocess is transient but carries no marker text).
-    return 0 if last is not None and last_transient else 1
+    # rc=0 only for TRANSIENT failure (relay outage) with a FRESH evidence
+    # chain — the gate record parses and points at real, recent numbers
+    # (VERDICT r03 #7; staleness cap per VERDICT r04 weak #6). Deterministic
+    # failures (broken import, crash) stay rc=1 even with evidence on disk:
+    # a pointer at old numbers must not mask a real regression. Transience is
+    # recorded where each failure is classified (a signal-killed subprocess
+    # is transient but carries no marker text).
+    return 0 if fresh and last_transient else 1
 
 
 def _last_committed():

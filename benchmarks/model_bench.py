@@ -218,6 +218,12 @@ def main(argv=None):
         # attention matters (reference ships gpt2 + flash_gpt2 side by side)
         add(lambda: bench_gpt2_train(2 if q else 8, 128 if q else 1024,
                                         3 if q else 10, flash=True))
+        if not q:
+            # same-config XLA twin (B=8, S=1024) so the flash-vs-xla model
+            # A/B is apples-to-apples in every run_all (VERDICT r04 weak #4)
+            add(lambda: bench_gpt2_train(8, 1024, 10,
+                                         label="gpt2_small_train_S1024_xla",
+                                         extra={"seq": 1024}))
     if "moe" in wanted:
         # expert-routed FFN variant; MFU on active params (VERDICT r03 #4)
         add(lambda: bench_gpt2_train(2 if q else 8, 128 if q else 512,

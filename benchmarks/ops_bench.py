@@ -161,16 +161,34 @@ def bench_long_context(quick=False):
 def main(argv=None):
     ap = argparse.ArgumentParser()
     ap.add_argument("--quick", action="store_true", help="small shapes (CI/CPU)")
+    ap.add_argument("--only", default=None,
+                    help="comma list of benches to run "
+                         "(gemm,conv2d,dense,attention,long_context)")
     args = ap.parse_args(argv)
+    known = {"gemm", "conv2d", "dense", "attention", "long_context"}
+    only = set(args.only.split(",")) if args.only else None
+    if only is not None and only - known:
+        # a typo must not produce an empty-but-rc=0 "evidence" log
+        ap.error(f"unknown bench name(s) {sorted(only - known)}; "
+                 f"choose from {sorted(known)}")
     print(f"devices: {jax.devices()}")
     runner = RowRunner()
+
+    def want(name):
+        return only is None or name in only
+
     # per-row isolation: one failing kernel/bench must not cost the whole
     # evidence pass its other rows (same policy as model_bench.main)
-    runner.add(lambda: bench_gemm(args.quick))
-    runner.add(lambda: bench_conv2d(args.quick))
-    runner.add(lambda: bench_dense_train(args.quick))
-    runner.add(lambda: bench_attention(args.quick), many=True)
-    runner.add(lambda: bench_long_context(args.quick), many=True)
+    if want("gemm"):
+        runner.add(lambda: bench_gemm(args.quick))
+    if want("conv2d"):
+        runner.add(lambda: bench_conv2d(args.quick))
+    if want("dense"):
+        runner.add(lambda: bench_dense_train(args.quick))
+    if want("attention"):
+        runner.add(lambda: bench_attention(args.quick), many=True)
+    if want("long_context"):
+        runner.add(lambda: bench_long_context(args.quick), many=True)
     main.last_runner = runner
     return runner.results
 

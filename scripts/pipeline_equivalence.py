@@ -45,13 +45,26 @@ def main(argv=None):
     ap.add_argument("--num-mb", type=int, default=4)
     ap.add_argument("--mb", type=int, default=8, help="microbatch size")
     ap.add_argument("--steps", type=int, default=3)
-    ap.add_argument("--tol", type=float, default=1e-4)
+    # Tolerance is dtype-aware (None -> 1e-4 f32, 2e-3 bf16). Justification:
+    # at f32 the pipeline and the grad-accum reference are bit-identical
+    # (committed artifact: rel_diff 0.0 at every step), so the schedule itself
+    # is exact. Under bf16 compute the two paths sum microbatch partials in
+    # different orders through activations with 8-bit mantissas; one rounding
+    # step is up to 2^-9 ~= 2e-3 relative, and after one SGD update the drift
+    # feeds back through the weights. 2e-3 (one bf16 ulp of headroom) is the
+    # tight bound that is still schedule-independent; the observed bf16 diff
+    # is ~1.8e-4, an order of magnitude inside it. A genuine schedule bug
+    # (dropped microbatch, stale weights) shifts the loss by >1e-2 at these
+    # scales, so the gate still catches real failures.
+    ap.add_argument("--tol", type=float, default=None)
     ap.add_argument("--f32", action="store_true",
                     help="f32 compute: isolates schedule exactness from bf16 "
                          "reduction-order noise (step>=1 under bf16 compounds "
                          "one optimizer update's worth of rounding drift)")
     ap.add_argument("--out", default=None)
     args = ap.parse_args(argv)
+    if args.tol is None:
+        args.tol = 1e-4 if args.f32 else 2e-3
 
     from tnn_tpu import models, nn, parallel
     from tnn_tpu.train import make_train_step
@@ -127,6 +140,12 @@ def main(argv=None):
         "stage_layers": [len(s.children) for s in stages],
         "steps": rows,
         "max_rel_diff": worst,
+        "tol": args.tol,
+        "tol_rationale": ("f32: schedule is bit-exact (observed 0.0)" if args.f32
+                          else "bf16: one 8-bit-mantissa rounding is 2^-9~=2e-3 "
+                               "relative; reduction order differs between the "
+                               "pipeline and grad-accum paths, so diffs up to "
+                               "one bf16 ulp are numerics, not schedule bugs"),
         "pass": worst <= args.tol,
         "unix_time": time.time(),
     }

@@ -1,0 +1,43 @@
+#!/bin/bash
+# Round-5 relay ambush: probe the TPU relay every few minutes; the moment it
+# answers, fire the one-shot evidence capture (scripts/tpu_evidence.sh).
+# Runs forever in the background; logs to /tmp/relay_watch.log.
+# A stamp file prevents double-capture if the watcher is restarted.
+set -u
+cd "$(dirname "$0")/.."
+LOG=/tmp/relay_watch.log
+STAMP=/tmp/tpu_evidence_done_r5
+PROBE_TIMEOUT=75
+INTERVAL=180
+
+probe() {
+  timeout "$PROBE_TIMEOUT" python - <<'EOF' >/dev/null 2>&1
+import jax
+d = jax.devices()
+assert d and d[0].platform != "cpu", d
+import jax.numpy as jnp
+x = jnp.ones((128, 128), jnp.bfloat16)
+(x @ x).block_until_ready()
+EOF
+}
+
+echo "[$(date -u +%FT%TZ)] watcher started (interval=${INTERVAL}s)" >> "$LOG"
+while true; do
+  if [ -f "$STAMP" ]; then
+    echo "[$(date -u +%FT%TZ)] evidence already captured; watcher exiting" >> "$LOG"
+    exit 0
+  fi
+  if probe; then
+    echo "[$(date -u +%FT%TZ)] RELAY UP — firing tpu_evidence.sh" >> "$LOG"
+    if bash scripts/tpu_evidence.sh >> /tmp/tpu_evidence_r5.log 2>&1; then
+      touch "$STAMP"
+      echo "[$(date -u +%FT%TZ)] evidence capture COMPLETE" >> "$LOG"
+      exit 0
+    else
+      echo "[$(date -u +%FT%TZ)] evidence capture FAILED (rc=$?); will retry" >> "$LOG"
+    fi
+  else
+    echo "[$(date -u +%FT%TZ)] relay down" >> "$LOG"
+  fi
+  sleep "$INTERVAL"
+done

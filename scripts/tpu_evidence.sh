@@ -22,6 +22,36 @@ fi
 timeout 1800 python -m tnn_tpu.cli.train_gpt2 --tokens /tmp/pytok --steps 200 \
     --batch 16 --seq 512 --backend pallas --results benchmarks/results
 
+echo "== 3b/8 real-token cliff A/B: 1 dispatch/step vs 16 steps/dispatch =="
+# round-4 weak #3: tiny-model real-token training ran 4x slower than the
+# synthetic bench; hypothesis = per-dispatch relay round trip. The pair of
+# runs below is the controlled experiment (same model/data, only dispatch
+# granularity differs).
+timeout 900 python -m tnn_tpu.cli.train_gpt2 --tokens /tmp/pytok --steps 96 \
+    --batch 16 --seq 256 --sample 0 --steps-per-call 1 \
+    --results /tmp/spc1_out && \
+  cp /tmp/spc1_out/lm_gpt2_byte_xla.json \
+     "benchmarks/results/lm_spc1_${STAMP}.json"
+timeout 900 python -m tnn_tpu.cli.train_gpt2 --tokens /tmp/pytok --steps 96 \
+    --batch 16 --seq 256 --sample 0 --steps-per-call 16 \
+    --results /tmp/spc16_out && \
+  cp /tmp/spc16_out/lm_gpt2_byte_xla.json \
+     "benchmarks/results/lm_spc16_${STAMP}.json"
+
+echo "== 3c/8 fused-vs-split flash backward A/B at S=8192/16384 =="
+# round-5 kernel: single-pass backward (5 matmuls/tile vs 7). Same harness,
+# env-gated, so the pair is apples-to-apples.
+timeout 1200 python -m benchmarks.ops_bench --only long_context \
+    > "/tmp/flash_fused_${STAMP}.log" 2>&1 \
+  && cp "/tmp/flash_fused_${STAMP}.log" \
+        "benchmarks/results/flash_fused_bwd_${STAMP}.log" \
+  || echo "fused flash bench failed; log at /tmp/flash_fused_${STAMP}.log"
+TNN_FLASH_FUSED_BWD=0 timeout 1200 python -m benchmarks.ops_bench \
+    --only long_context > "/tmp/flash_split_${STAMP}.log" 2>&1 \
+  && cp "/tmp/flash_split_${STAMP}.log" \
+        "benchmarks/results/flash_split_bwd_${STAMP}.log" \
+  || echo "split flash bench failed; log at /tmp/flash_split_${STAMP}.log"
+
 echo "== 4/8 GPT-2 medium + large chip rows (train w/ remat, decode, int8) =="
 # stage to /tmp first: a failed/partial log must never be swept into the
 # evidence dir by the final git add -A

@@ -283,6 +283,22 @@ class TestNativePngDecode:
         ref = _resize_bilinear(arr[None], (16, 16))[0]
         assert np.abs(out[0].astype(int) - ref.astype(int)).max() <= 1
 
+    def test_resize_bilinear_batch_matches_numpy(self):
+        """The standalone threaded resize (npy loader path) agrees with the
+        numpy reference to within 1 lsb of rounding."""
+        from tnn_tpu.data.datasets import _resize_bilinear
+        from tnn_tpu.native import api
+
+        rng = np.random.default_rng(3)
+        frames = rng.integers(0, 255, (7, 41, 29, 3), np.uint8)
+        out = api.resize_bilinear_batch(frames, 24, 16)
+        ref = _resize_bilinear(frames, (24, 16))
+        assert out.shape == (7, 24, 16, 3)
+        assert np.abs(out.astype(int) - ref.astype(int)).max() <= 1
+        # identity size: pure memcpy
+        same = api.resize_bilinear_batch(frames, 41, 29)
+        np.testing.assert_array_equal(same, frames)
+
     def test_bad_file_falls_back_flag(self, tmp_path):
         from PIL import Image
 

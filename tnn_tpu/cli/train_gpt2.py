@@ -53,6 +53,11 @@ def main(argv=None):
     ap.add_argument("--fused-head-loss", type=int, default=0, metavar="CHUNK",
                     help="vocab chunk for the streaming LM-head loss "
                          "(nn.lm_loss) — 0 uses the materialized-logits path")
+    ap.add_argument("--steps-per-call", type=int, default=16,
+                    help="optimizer steps per compiled dispatch (lax.scan); "
+                         ">1 amortizes the host->device round trip that "
+                         "dominates small models over the relay (1 = the "
+                         "old one-dispatch-per-step loop)")
     ap.add_argument("--results", default="benchmarks/results")
     args = ap.parse_args(argv)
 
@@ -65,42 +70,56 @@ def main(argv=None):
         if os.path.exists(val_path) else None
     print(f"corpus: {meta['train_tokens']} train tokens, vocab {vocab}")
 
+    # dispatch granularity first: total_steps feeds the scheduler horizon
+    spc = max(1, min(args.steps_per_call, args.steps))
+    n_calls = args.steps // spc
+    total_steps = n_calls * spc
+    if total_steps != args.steps:
+        print(f"note: --steps {args.steps} rounded down to {total_steps} "
+              f"({n_calls} dispatches x {spc} steps); pass --steps-per-call 1 "
+              "or a divisor of --steps for the exact count")
+
     model = GPT2(vocab_size=vocab, max_len=args.seq, num_layers=args.layers,
                  d_model=args.d_model, num_heads=args.heads, dropout=0.0,
                  backend=args.backend)
     opt = nn.AdamW(lr=args.lr, weight_decay=0.01, grad_clip_norm=1.0)
-    sched = nn.WarmupCosineAnnealing(warmup=max(10, args.steps // 20),
-                                     t_max=args.steps)
+    sched = nn.WarmupCosineAnnealing(warmup=max(10, total_steps // 20),
+                                     t_max=total_steps)
     state = create_train_state(model, opt, jax.random.PRNGKey(0),
                                (args.batch, args.seq))
     step = make_train_step(model, opt, scheduler=sched,
                            compute_accuracy=not args.fused_head_loss,
-                           lm_head_chunk=args.fused_head_loss or None)
+                           lm_head_chunk=args.fused_head_loss or None,
+                           steps_per_call=spc)
 
     rng = np.random.default_rng(0)
     curve = []
     t0 = time.time()
-    for i in range(args.steps):
-        data, labels = train_loader.random_windows(args.batch, rng)
+    for c in range(n_calls):
+        data, labels = train_loader.random_windows(args.batch * spc, rng)
+        if spc > 1:
+            data = data.reshape(spc, args.batch, args.seq)
+            labels = labels.reshape(spc, args.batch, args.seq)
         state, m = step(state, jnp.asarray(data, jnp.int32),
                         jnp.asarray(labels, jnp.int32))
-        if i % 20 == 0 or i == args.steps - 1:
-            loss = float(m["loss"])
+        i = (c + 1) * spc - 1
+        if c % max(1, 20 // spc) == 0 or c == n_calls - 1:
+            loss = float(m["loss_trace"][-1]) if spc > 1 else float(m["loss"])
             curve.append({"step": i, "loss": round(loss, 4),
                           "ppl": round(float(np.exp(loss)), 3)})
             print(f"step {i}: loss {loss:.4f} ppl {np.exp(loss):.2f}")
     train_secs = time.time() - t0
-    tok_s = args.steps * args.batch * args.seq / train_secs
+    tok_s = total_steps * args.batch * args.seq / train_secs
 
     out = {"metric": "gpt2_bytes_lm", "backend": args.backend,
            # a CPU curve must never masquerade as chip numbers
            "platform": jax.devices()[0].platform,
            "model": {"layers": args.layers, "d_model": args.d_model,
                      "heads": args.heads, "seq": args.seq, "vocab": vocab},
-           "steps": args.steps, "train_tok_per_s": round(tok_s, 1),
+           "steps": total_steps, "steps_per_call": spc,
+           "train_tok_per_s": round(tok_s, 1),
            "final_train_loss": curve[-1]["loss"],
-           "final_train_ppl": curve[-1]["ppl"], "curve": curve,
-           "platform": jax.devices()[0].platform}
+           "final_train_ppl": curve[-1]["ppl"], "curve": curve}
 
     if val_loader is not None:
         from tnn_tpu.train import make_eval_step

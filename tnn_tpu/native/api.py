@@ -44,6 +44,24 @@ def decode_image_batch(paths, out_h: int, out_w: int
 decode_png_batch = decode_image_batch  # back-compat name
 
 
+def resize_bilinear_batch(frames: np.ndarray, out_h: int, out_w: int
+                          ) -> np.ndarray:
+    """Threaded bilinear resize of a (N, H, W, 3) uint8 batch — the raw-array
+    (.npy) loader path, where there is no decode for the threaded decoder to
+    hide the resize in. Same sampling convention as datasets._resize_bilinear
+    (align-corners=False, +0.5 round), so native and numpy paths agree."""
+    frames = np.ascontiguousarray(frames, np.uint8)
+    n, in_h, in_w, c = frames.shape
+    if c != 3:
+        raise ValueError(f"expected RGB frames, got {frames.shape}")
+    lib = get_lib()
+    out = np.empty((n, out_h, out_w, 3), np.uint8)
+    lib.tnn_resize_bilinear_batch(
+        _ptr(frames, _c.c_uint8), _c.c_int64(n), int(in_h), int(in_w),
+        int(out_h), int(out_w), _ptr(out, _c.c_uint8))
+    return out
+
+
 # -- parsers -----------------------------------------------------------------
 
 

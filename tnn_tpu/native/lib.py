@@ -104,6 +104,9 @@ def _configure(lib: ctypes.CDLL) -> None:
     lib.tnn_decode_image_batch.restype = i64
     lib.tnn_decode_image_batch.argtypes = [p(c.c_char_p), i64, c.c_int,
                                            c.c_int, p(u8), p(u8)]
+    lib.tnn_resize_bilinear_batch.restype = None
+    lib.tnn_resize_bilinear_batch.argtypes = [p(u8), i64, c.c_int, c.c_int,
+                                              c.c_int, c.c_int, p(u8)]
 
 
 def get_lib() -> Optional[ctypes.CDLL]:

@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import functools
 import math
+import os
 from typing import Optional
 
 import jax
@@ -378,6 +379,80 @@ def _bwd_dkv_kernel(off_ref, q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
         dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
 
 
+def _bwd_fused_kernel(off_ref, q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
+                      *rest, scale, causal, bq, bk, kv_len, has_mask):
+    """Single-pass backward: dQ, dK, dV in ONE sweep, 5 matmuls per live tile
+    (the FlashAttention-2 ideal) vs 7 across the split dq/dkv kernels (S and
+    dO@V^T were each computed twice). Grid (bh, k block j, q block i): dK/dV
+    accumulate in per-block scratch over the inner i loop; dQ accumulates in a
+    FULL-SEQUENCE f32 VMEM scratch (sq x d = 2 MB at S=8192/D=64 — the cheap
+    side; dK+dV would need twice that) and is written out once per bh. The
+    TPU grid is sequential per core, which is what makes the whole-sweep
+    scratch accumulation sound."""
+    if has_mask:
+        mask_ref, dq_ref, dk_ref, dv_ref, dq_scr, dk_scr, dv_scr = rest
+    else:
+        mask_ref, (dq_ref, dk_ref, dv_ref, dq_scr, dk_scr, dv_scr) = None, rest
+    ki, qi = pl.program_id(1), pl.program_id(2)
+    nk, nq = pl.num_programs(1), pl.num_programs(2)
+
+    @pl.when(jnp.logical_and(ki == 0, qi == 0))
+    def _init_dq():
+        dq_scr[:] = jnp.zeros_like(dq_scr)
+
+    @pl.when(qi == 0)
+    def _init_dkv():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    q_start, k_start = qi * bq, ki * bk
+    off = off_ref[0]
+    live = (k_start <= q_start + off + bq - 1) if causal else True
+
+    @pl.when(live)
+    def _block():
+        q, k, v, do = q_ref[0], k_ref[0], v_ref[0], do_ref[0]
+        lse_col = lse_ref[0]                           # (bq, 1), compact
+        delta = jnp.sum(do.astype(jnp.float32) * o_ref[0].astype(jnp.float32),
+                        axis=1, keepdims=True)
+        p = _attn_probs(q, k, lse_col, k_start, q_start, off,
+                        mask_ref[0] if has_mask else None, scale=scale,
+                        causal=causal, bq=bq, bk=bk, kv_len=kv_len)
+        pt = p.astype(do.dtype)
+        dv_scr[:] += jax.lax.dot_general(pt, do, (((0,), (0,)), ((), ())),
+                                         preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = (p * (dp - delta) * scale).astype(q.dtype)
+        dk_scr[:] += jax.lax.dot_general(ds, q, (((0,), (0,)), ((), ())),
+                                         preferred_element_type=jnp.float32)
+        dq_scr[pl.ds(q_start, bq), :] += jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(qi == nq - 1)
+    def _final_dkv():
+        dk_ref[0] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
+
+    @pl.when(jnp.logical_and(ki == nk - 1, qi == nq - 1))
+    def _final_dq():
+        dq_ref[0] = dq_scr[:].astype(dq_ref.dtype)
+
+
+# Full-seq f32 dQ scratch cap for the fused backward; above it (sq*d*4 bytes)
+# the split two-kernel path runs instead. 4 MB = S=16384 at D=64 inside the
+# ~16 MB/core VMEM envelope alongside blocks and intermediates.
+_FUSED_BWD_MAX_DQ_BYTES = int(
+    os.environ.get("TNN_FLASH_FUSED_BWD_MAX_BYTES", 4 * 2**20))
+
+
+def _fused_bwd_applicable(sq_p: int, d: int) -> bool:
+    if os.environ.get("TNN_FLASH_FUSED_BWD", "1") == "0":
+        return False
+    return sq_p * d * 4 <= _FUSED_BWD_MAX_DQ_BYTES
+
+
 def _flash_bwd(causal, scale, block_q, block_k, block_q_bwd, block_k_bwd,
                residuals, g):
     """Blockwise Pallas backward: never materializes the (S, S) matrix."""
@@ -386,6 +461,14 @@ def _flash_bwd(causal, scale, block_q, block_k, block_q_bwd, block_k_bwd,
     skv = k.shape[2]
     if scale is None:
         scale = 1.0 / math.sqrt(d)
+    # Fused single-pass backward when the full-seq dQ scratch fits VMEM;
+    # its own block default (512, 512) keeps the bq x bk f32 intermediates
+    # ~1 MB so blocks + dq scratch + outputs stay inside ~16 MB at S=16384.
+    bq_f = block_q_bwd if block_q_bwd is not None else 512
+    bk_f = block_k_bwd if block_k_bwd is not None else 512
+    bqp, bkp, sq_pf, _ = _block_geometry(sq, skv, bq_f, bk_f)
+    if _fused_bwd_applicable(sq_pf, d):
+        return _flash_bwd_fused(causal, scale, bqp, bkp, residuals, g)
     bq_bwd, bk_bwd = _bwd_blocks(block_q, block_k, block_q_bwd, block_k_bwd)
     bq, bk, sq_p, skv_p = _block_geometry(sq, skv, bq_bwd, bk_bwd)
 
@@ -454,6 +537,11 @@ def _flash_bwd(causal, scale, block_q, block_k, block_q_bwd, block_k_bwd,
     dq = dq[:, :sq].reshape(b, h, sq, d)
     dk = dk[:, :skv].reshape(b, h, skv, d)
     dv = dv[:, :skv].reshape(b, h, skv, d)
+    dmask, doff = _zero_cotangents(mask, off)
+    return dq, dk, dv, dmask, doff
+
+
+def _zero_cotangents(mask, off):
     import numpy as _np
 
     from jax import dtypes as _jdt
@@ -462,7 +550,71 @@ def _flash_bwd(causal, scale, block_q, block_k, block_q_bwd, block_k_bwd,
     # type is float0
     dmask = (None if mask is None
              else _np.zeros(mask.shape, _jdt.float0))
-    doff = _np.zeros(off.shape, _jdt.float0)
+    return dmask, _np.zeros(off.shape, _jdt.float0)
+
+
+def _flash_bwd_fused(causal, scale, bq, bk, residuals, g):
+    """One-sweep backward (see _bwd_fused_kernel). Grid (bh, j, i): k/v blocks
+    stay VMEM-resident across the inner q loop (constant index map), dK/dV
+    write once per j, dQ once per bh from the full-seq scratch."""
+    q, k, v, mask, off, o, lse_row = residuals
+    b, h, sq, d = q.shape
+    skv = k.shape[2]
+    _, _, sq_p, skv_p = _block_geometry(sq, skv, bq, bk)
+    bq = min(bq, sq_p)
+    bk = min(bk, skv_p)
+
+    qf = _pad_to(q.reshape(b * h, sq, d), sq_p, 1)
+    kf = _pad_to(k.reshape(b * h, skv, d), skv_p, 1)
+    vf = _pad_to(v.reshape(b * h, skv, d), skv_p, 1)
+    of = _pad_to(o.reshape(b * h, sq, d), sq_p, 1)
+    dof = _pad_to(g.reshape(b * h, sq, d), sq_p, 1)
+    lse = _pad_to(lse_row, sq_p, 1, value=jnp.inf)[:, :, None]
+    has_mask = mask is not None
+    maskp = (_pad_to(_pad_to(mask, sq_p, 1), skv_p, 2) if has_mask else None)
+
+    # grid (bh, k block j, q block i) — q-side blocks indexed by i (pos 2)
+    q_spec = pl.BlockSpec((1, bq, d), lambda bh, j, i: (bh, i, 0),
+                          memory_space=pltpu.VMEM)
+    lse_spec = pl.BlockSpec((1, bq, 1), lambda bh, j, i: (bh, i, 0),
+                            memory_space=pltpu.VMEM)
+    kv_spec = pl.BlockSpec((1, bk, d), lambda bh, j, i: (bh, j, 0),
+                           memory_space=pltpu.VMEM)
+    in_specs = [_OFF_SPEC, q_spec, kv_spec, kv_spec, q_spec, q_spec, lse_spec]
+    inputs = [off, qf, kf, vf, of, dof, lse]
+    if has_mask:
+        in_specs.append(_mask_spec(maskp, b, h, bq, bk, transposed=True))
+        inputs.append(maskp)
+    dq, dk, dv = pl.pallas_call(
+        functools.partial(_bwd_fused_kernel, scale=scale, causal=causal,
+                          bq=bq, bk=bk, kv_len=skv, has_mask=has_mask),
+        grid=(b * h, skv_p // bk, sq_p // bq),
+        in_specs=in_specs,
+        out_specs=[
+            pl.BlockSpec((1, sq_p, d), lambda bh, j, i: (bh, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bk, d), lambda bh, j, i: (bh, j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bk, d), lambda bh, j, i: (bh, j, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, sq_p, d), q.dtype),
+            jax.ShapeDtypeStruct((b * h, skv_p, d), k.dtype),
+            jax.ShapeDtypeStruct((b * h, skv_p, d), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((sq_p, d), jnp.float32),  # full-seq dQ accumulator
+            pltpu.VMEM((bk, d), jnp.float32),    # dK block accumulator
+            pltpu.VMEM((bk, d), jnp.float32),    # dV block accumulator
+        ],
+        interpret=jax.default_backend() != "tpu",
+    )(*inputs)
+
+    dq = dq[:, :sq].reshape(b, h, sq, d)
+    dk = dk[:, :skv].reshape(b, h, skv, d)
+    dv = dv[:, :skv].reshape(b, h, skv, d)
+    dmask, doff = _zero_cotangents(mask, off)
     return dq, dk, dv, dmask, doff
 
 
