@@ -224,6 +224,10 @@ def main(argv=None):
             add(lambda: bench_gpt2_train(8, 1024, 10,
                                          label="gpt2_small_train_S1024_xla",
                                          extra={"seq": 1024}))
+            # wide-head twin: same d_model/params, 6 heads of D=128 — the
+            # geometry that lifts the D=64 half-MXU cap (docs/perf.md)
+            add(lambda: bench_gpt2_train(8, 1024, 10, size="small_hd128",
+                                         flash=True, extra={"head_dim": 128}))
     if "moe" in wanted:
         # expert-routed FFN variant; MFU on active params (VERDICT r03 #4)
         add(lambda: bench_gpt2_train(2 if q else 8, 128 if q else 512,

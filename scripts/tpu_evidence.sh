@@ -84,12 +84,19 @@ else
   echo "digits convergence run failed; log at /tmp/digits_curve_${STAMP}.json"
 fi
 
-echo "== 7/8 flash-attention short-S block sweep (promote winners if any) =="
+echo "== 7/8 flash-attention block sweeps (promote winners if any) =="
 timeout 1200 python -m benchmarks.flash_tune --seq 1024 --seq 512 \
     > "/tmp/flash_tune_${STAMP}.log" 2>&1 \
   && cp "/tmp/flash_tune_${STAMP}.log" \
         "benchmarks/results/flash_tune_${STAMP}.log" \
   || echo "flash sweep failed; log at /tmp/flash_tune_${STAMP}.log"
+# fused-backward geometry at long S (the round-5 kernel; bs=1 keeps the
+# XLA verification reference inside HBM at S=8192)
+timeout 1800 python -m benchmarks.flash_tune --seq 8192 --batch 1 --bwd \
+    > "/tmp/flash_tune_bwd_${STAMP}.log" 2>&1 \
+  && cp "/tmp/flash_tune_bwd_${STAMP}.log" \
+        "benchmarks/results/flash_tune_bwd_${STAMP}.log" \
+  || echo "bwd sweep failed; log at /tmp/flash_tune_bwd_${STAMP}.log"
 
 echo "== 8/8 commit the evidence =="
 git add -A benchmarks/results/

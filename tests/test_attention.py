@@ -317,3 +317,31 @@ def test_fused_bwd_matches_split_bwd(monkeypatch):
         assert np.all(np.isfinite(np.asarray(a))), name
         np.testing.assert_allclose(np.asarray(a), np.asarray(b_), rtol=1e-5,
                                    atol=1e-5, err_msg=name)
+
+
+def test_fused_bwd_causal_short_query_no_offset(monkeypatch):
+    """causal + sq < skv + kv_offset=None: trailing k blocks' first live q row
+    lands past the last q block; the fused backward's clamped fetch index must
+    stay in range (regression: unguarded max() overflowed the q BlockSpec)."""
+    from tnn_tpu.ops.pallas import flash_attention as fa
+
+    rs = np.random.RandomState(13)
+    q = jnp.asarray(rs.randn(1, 2, 100, 64), jnp.float32)
+    k = jnp.asarray(rs.randn(1, 2, 256, 64), jnp.float32)
+    v = jnp.asarray(rs.randn(1, 2, 256, 64), jnp.float32)
+    g = jnp.asarray(rs.randn(1, 2, 100, 64), jnp.float32)
+
+    def grads(q, k, v):
+        def loss(q, k, v):
+            return jnp.vdot(fa.flash_attention(
+                q, k, v, True, None, 64, 64, 64, 64), g)
+        return jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+
+    monkeypatch.setenv("TNN_FLASH_FUSED_BWD", "0")
+    split = grads(q, k, v)
+    monkeypatch.setenv("TNN_FLASH_FUSED_BWD", "1")
+    fused = grads(q, k, v)
+    for name, a, b_ in zip("dq dk dv".split(), fused, split):
+        assert np.all(np.isfinite(np.asarray(a))), name
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_), rtol=1e-5,
+                                   atol=1e-5, err_msg=name)

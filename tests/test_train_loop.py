@@ -132,6 +132,39 @@ class TestGradAccum:
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                        rtol=1e-5, atol=1e-6)
 
+    def test_steps_per_call_matches_sequential_steps(self):
+        """W scanned steps in one dispatch must equal W sequential dispatches
+        exactly (same data order, same rng stream consumption)."""
+        from tnn_tpu.core.dtypes import DTypePolicy
+
+        model = nn.Dense(4, activation=None,
+                         policy=DTypePolicy(io="float32", param="float32",
+                                            compute="float32"))
+        opt = nn.SGD(lr=0.1)
+        rng = jax.random.PRNGKey(0)
+        W, B = 3, 4
+        data = jax.random.normal(rng, (W, B, 6), jnp.float32)
+        labels = jax.random.randint(rng, (W, B), 0, 4)
+
+        s1 = create_train_state(model, opt, rng, (B, 6))
+        s2 = create_train_state(model, opt, rng, (B, 6))
+        step1 = make_train_step(model, opt, donate=False)
+        stepW = make_train_step(model, opt, donate=False, steps_per_call=W)
+        losses = []
+        for w in range(W):
+            s1, m1 = step1(s1, data[w], labels[w])
+            losses.append(float(m1["loss"]))
+        s2, m2 = stepW(s2, data, labels)
+        assert int(s2.step) == W
+        np.testing.assert_allclose(np.asarray(m2["loss_trace"]), losses,
+                                   rtol=1e-6)
+        np.testing.assert_allclose(float(m2["loss"]), np.mean(losses),
+                                   rtol=1e-6)
+        for a, b in zip(jax.tree_util.tree_leaves(s1.params),
+                        jax.tree_util.tree_leaves(s2.params)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-5, atol=1e-6)
+
     def test_augment_in_step(self):
         model = models.create("cifar10_resnet9")
         opt = nn.SGD(lr=0.01)

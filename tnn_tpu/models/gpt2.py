@@ -221,6 +221,18 @@ def gpt2_small(**kw):
     return GPT2(num_layers=12, d_model=768, num_heads=12, **kw)
 
 
+def gpt2_small_hd128(**kw):
+    """12L/768d/6h — GPT-2 small geometry with 128-wide heads.
+
+    TPU-first variant: every attention matmul at head_dim 64 leaves half the
+    128-wide MXU idle (see docs/perf.md rooflines); 6 heads of D=128 keep the
+    same d_model/params but run the QK^T/PV contractions at full width. No
+    reference counterpart — the reference's head_dim is fixed by the GPT-2
+    checkpoint (example_models.cpp:384); this exists for from-scratch
+    training where the geometry is free."""
+    return GPT2(num_layers=12, d_model=768, num_heads=6, **kw)
+
+
 def gpt2_medium(**kw):
     """24L/1024d/16h (parity: example_models.cpp:432)."""
     return GPT2(num_layers=24, d_model=1024, num_heads=16, **kw)

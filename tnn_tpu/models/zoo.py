@@ -60,6 +60,9 @@ register("flash_vit")(
 # -- language (parity: example_models.cpp:384-504) ---------------------------
 
 register("gpt2_small")(lambda **kw: gpt2_lib.gpt2_small(**kw))
+register("gpt2_small_hd128")(lambda **kw: gpt2_lib.gpt2_small_hd128(**kw))
+register("flash_gpt2_small_hd128")(
+    lambda **kw: gpt2_lib.gpt2_small_hd128(backend="pallas", **kw))
 register("gpt2_medium")(lambda **kw: gpt2_lib.gpt2_medium(**kw))
 register("gpt2_large")(lambda **kw: gpt2_lib.gpt2_large(**kw))
 register("flash_gpt2_small")(lambda **kw: gpt2_lib.gpt2_small(backend="pallas", **kw))

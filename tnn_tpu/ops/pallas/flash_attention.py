@@ -181,10 +181,11 @@ def _mask_spec(mask, b, h, bq, bk, transposed):
 _OFF_SPEC = pl.BlockSpec(memory_space=pltpu.SMEM)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9, 10))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9, 10, 11))
 def _flash(q, k, v, mask, off, causal, scale, block_q, block_k,
-           block_q_bwd, block_k_bwd):
-    return _flash_fwd(q, k, v, mask, off, causal, scale, block_q, block_k)[0]
+           block_q_bwd, block_k_bwd, clamp_dead):
+    return _flash_fwd(q, k, v, mask, off, causal, scale, block_q, block_k,
+                      clamp_dead=clamp_dead)[0]
 
 
 def flash_attention(q, k, v, causal: bool = False, scale: Optional[float] = None,
@@ -205,17 +206,24 @@ def flash_attention(q, k, v, causal: bool = False, scale: Optional[float] = None
     in its broadcast-group form ((B,1,..) padding masks are never tiled per
     head). ``kv_offset``: absolute position of q[0] in the kv sequence
     (cached decode with S_q != S_kv); may be a traced scalar. Both compose
-    with ``causal``."""
+    with ``causal``.
+
+    When causal and kv_offset is statically absent (the self-attention
+    training case), blocks strictly above the diagonal are not just
+    compute-skipped but FETCH-skipped: their index maps clamp to the last
+    live block, and the Pallas pipeline elides the DMA when a block index
+    repeats — at S=8192 that removes ~40% of the K/V HBM traffic."""
     b, h, sq, d = q.shape
     skv = k.shape[2]
     if mask is not None:
         mask = _norm_mask(jnp.asarray(mask), b, h, sq, skv)
+    clamp_dead = causal and kv_offset is None
     if kv_offset is None:
         off = jnp.zeros((1,), jnp.int32)
     else:
         off = jnp.asarray(kv_offset, jnp.int32).reshape(1)
     return _flash(q, k, v, mask, off, causal, scale, block_q, block_k,
-                  block_q_bwd, block_k_bwd)
+                  block_q_bwd, block_k_bwd, clamp_dead)
 
 
 def _bwd_blocks(block_q, block_k, block_q_bwd, block_k_bwd):
@@ -225,7 +233,7 @@ def _bwd_blocks(block_q, block_k, block_q_bwd, block_k_bwd):
 
 
 def _flash_fwd(q, k, v, mask, off, causal, scale, block_q, block_k,
-               block_q_bwd=None, block_k_bwd=None):
+               block_q_bwd=None, block_k_bwd=None, clamp_dead=False):
     b, h, sq, d = q.shape
     skv = k.shape[2]
     if scale is None:
@@ -240,19 +248,30 @@ def _flash_fwd(q, k, v, mask, off, causal, scale, block_q, block_k,
     kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal,
                                bq=bq, bk=bk, kv_len=skv,
                                has_mask=mask is not None)
+    if clamp_dead and causal:
+        # causal + no kv_offset: a k block with ki > max_live is all-masked.
+        # Clamping its fetch index to the row's last live block repeats the
+        # previous step's index, so the pipeline elides the DMA entirely
+        # (the kernel's pl.when(live) already skips the compute).
+        def kv_idx(bh, qi, ki):
+            return (bh, jnp.minimum(ki, (qi * bq + bq - 1) // bk), 0)
+    else:
+        def kv_idx(bh, qi, ki):
+            return (bh, ki, 0)
     in_specs = [
         _OFF_SPEC,
         pl.BlockSpec((1, bq, d), lambda bh, qi, ki: (bh, qi, 0),
                      memory_space=pltpu.VMEM),
-        pl.BlockSpec((1, bk, d), lambda bh, qi, ki: (bh, ki, 0),
-                     memory_space=pltpu.VMEM),
-        pl.BlockSpec((1, bk, d), lambda bh, qi, ki: (bh, ki, 0),
-                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, bk, d), kv_idx, memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, bk, d), kv_idx, memory_space=pltpu.VMEM),
     ]
     inputs = [off, qf, kf, vf]
     if mask is not None:
         mp = _pad_to(_pad_to(mask, sq_p, 1), skv_p, 2)  # pad = masked out
-        in_specs.append(_mask_spec(mp, b, h, bq, bk, transposed=False))
+        pick = _mask_pick(mp.shape[0], b, h)
+        in_specs.append(pl.BlockSpec(
+            (1, bq, bk), lambda bh, qi, ki: (pick(bh), qi, kv_idx(bh, qi, ki)[1]),
+            memory_space=pltpu.VMEM))
         inputs.append(mp)
     out, lse = pl.pallas_call(
         kernel,
@@ -454,7 +473,7 @@ def _fused_bwd_applicable(sq_p: int, d: int) -> bool:
 
 
 def _flash_bwd(causal, scale, block_q, block_k, block_q_bwd, block_k_bwd,
-               residuals, g):
+               clamp_dead, residuals, g):
     """Blockwise Pallas backward: never materializes the (S, S) matrix."""
     q, k, v, mask, off, o, lse_row = residuals
     b, h, sq, d = q.shape
@@ -468,7 +487,8 @@ def _flash_bwd(causal, scale, block_q, block_k, block_q_bwd, block_k_bwd,
     bk_f = block_k_bwd if block_k_bwd is not None else 512
     bqp, bkp, sq_pf, _ = _block_geometry(sq, skv, bq_f, bk_f)
     if _fused_bwd_applicable(sq_pf, d):
-        return _flash_bwd_fused(causal, scale, bqp, bkp, residuals, g)
+        return _flash_bwd_fused(causal, scale, bqp, bkp, clamp_dead,
+                                residuals, g)
     bq_bwd, bk_bwd = _bwd_blocks(block_q, block_k, block_q_bwd, block_k_bwd)
     bq, bk, sq_p, skv_p = _block_geometry(sq, skv, bq_bwd, bk_bwd)
 
@@ -553,7 +573,7 @@ def _zero_cotangents(mask, off):
     return dmask, _np.zeros(off.shape, _jdt.float0)
 
 
-def _flash_bwd_fused(causal, scale, bq, bk, residuals, g):
+def _flash_bwd_fused(causal, scale, bq, bk, clamp_dead, residuals, g):
     """One-sweep backward (see _bwd_fused_kernel). Grid (bh, j, i): k/v blocks
     stay VMEM-resident across the inner q loop (constant index map), dK/dV
     write once per j, dQ once per bh from the full-seq scratch."""
@@ -573,17 +593,35 @@ def _flash_bwd_fused(causal, scale, bq, bk, residuals, g):
     has_mask = mask is not None
     maskp = (_pad_to(_pad_to(mask, sq_p, 1), skv_p, 2) if has_mask else None)
 
-    # grid (bh, k block j, q block i) — q-side blocks indexed by i (pos 2)
-    q_spec = pl.BlockSpec((1, bq, d), lambda bh, j, i: (bh, i, 0),
+    # grid (bh, k block j, q block i) — q-side blocks indexed by i (pos 2).
+    # Causal + no kv_offset: q blocks with i < first live row for this k
+    # block are all-masked; clamping their fetch index to the first live row
+    # repeats the block index so the pipeline elides the DMA (mirrors the
+    # forward's dead-block clamp, transposed).
+    if clamp_dead and causal:
+        # min() guard: with sq < skv a trailing k block's first live row can
+        # land past the last q block; those steps are fully dead and must
+        # keep fetching an in-range block
+        def q_idx(bh, j, i):
+            return jnp.minimum(jnp.maximum(i, (j * bk) // bq),
+                               sq_p // bq - 1)
+    else:
+        def q_idx(bh, j, i):
+            return i
+    q_spec = pl.BlockSpec((1, bq, d), lambda bh, j, i: (bh, q_idx(bh, j, i), 0),
                           memory_space=pltpu.VMEM)
-    lse_spec = pl.BlockSpec((1, bq, 1), lambda bh, j, i: (bh, i, 0),
+    lse_spec = pl.BlockSpec((1, bq, 1),
+                            lambda bh, j, i: (bh, q_idx(bh, j, i), 0),
                             memory_space=pltpu.VMEM)
     kv_spec = pl.BlockSpec((1, bk, d), lambda bh, j, i: (bh, j, 0),
                            memory_space=pltpu.VMEM)
     in_specs = [_OFF_SPEC, q_spec, kv_spec, kv_spec, q_spec, q_spec, lse_spec]
     inputs = [off, qf, kf, vf, of, dof, lse]
     if has_mask:
-        in_specs.append(_mask_spec(maskp, b, h, bq, bk, transposed=True))
+        pick = _mask_pick(maskp.shape[0], b, h)
+        in_specs.append(pl.BlockSpec(
+            (1, bq, bk), lambda bh, j, i: (pick(bh), q_idx(bh, j, i), j),
+            memory_space=pltpu.VMEM))
         inputs.append(maskp)
     dq, dk, dv = pl.pallas_call(
         functools.partial(_bwd_fused_kernel, scale=scale, causal=causal,
