@@ -114,6 +114,24 @@ def bench_attention(quick=False):
             # environment skip only — a verification failure must propagate,
             # never be reported as a skip
             print(f"  sdpa_{backend}: SKIPPED ({type(e).__name__}: {e})")
+
+    if not quick:
+        # D=128 twin at equal total model width (H*D const): the kernel-level
+        # demonstration that the half-MXU cap is the D=64 contraction, not
+        # the kernel (docs/perf.md roofline note) — same FLOPs, expect ~2x
+        q2 = jnp.asarray(rs.randn(B, H // 2, S, 2 * D), jnp.bfloat16)
+        k2 = jnp.asarray(rs.randn(B, H // 2, S, 2 * D), jnp.bfloat16)
+        v2 = jnp.asarray(rs.randn(B, H // 2, S, 2 * D), jnp.bfloat16)
+        try:
+            f = jax.jit(lambda q, k, v: sdpa(q, k, v, causal=True,
+                                             backend="pallas"))
+            verify("sdpa_pallas_hd128", f(q2[:1, :2], k2[:1, :2], v2[:1, :2]),
+                   _sdpa_ref(q2[:1, :2], k2[:1, :2], v2[:1, :2]),
+                   rtol=5e-2, atol=5e-2)
+            dt = time_fn(f, q2, k2, v2, iters=30)
+            out.append(report("sdpa_causal_pallas_hd128", dt, flops=flops))
+        except (NotImplementedError, ImportError) as e:
+            print(f"  sdpa_pallas_hd128: SKIPPED ({type(e).__name__}: {e})")
     return out
 
 

@@ -169,12 +169,13 @@ def _mask_pick(groups: int, b: int, h: int):
     return lambda bh: bh  # groups == b*h
 
 
-def _mask_spec(mask, b, h, bq, bk, transposed):
+def _mask_spec(mask, b, h, bq, bk, block_idx):
+    """BlockSpec for the grouped (G, Sq, Skv) int8 mask. ``block_idx`` maps
+    the kernel's grid indices -> (q block, k block), so each grid order (and
+    any dead-block fetch clamping) plugs in its own mapping."""
     pick = _mask_pick(mask.shape[0], b, h)
-    if transposed:  # dK/dV grid order is (bh, k block j, q block i)
-        return pl.BlockSpec((1, bq, bk), lambda bh, j, i: (pick(bh), i, j),
-                            memory_space=pltpu.VMEM)
-    return pl.BlockSpec((1, bq, bk), lambda bh, i, j: (pick(bh), i, j),
+    return pl.BlockSpec((1, bq, bk),
+                        lambda *g: (pick(g[0]),) + tuple(block_idx(*g)),
                         memory_space=pltpu.VMEM)
 
 
@@ -268,10 +269,9 @@ def _flash_fwd(q, k, v, mask, off, causal, scale, block_q, block_k,
     inputs = [off, qf, kf, vf]
     if mask is not None:
         mp = _pad_to(_pad_to(mask, sq_p, 1), skv_p, 2)  # pad = masked out
-        pick = _mask_pick(mp.shape[0], b, h)
-        in_specs.append(pl.BlockSpec(
-            (1, bq, bk), lambda bh, qi, ki: (pick(bh), qi, kv_idx(bh, qi, ki)[1]),
-            memory_space=pltpu.VMEM))
+        in_specs.append(_mask_spec(
+            mp, b, h, bq, bk,
+            lambda bh, qi, ki: (qi, kv_idx(bh, qi, ki)[1])))
         inputs.append(mp)
     out, lse = pl.pallas_call(
         kernel,
@@ -292,6 +292,10 @@ def _flash_fwd(q, k, v, mask, off, causal, scale, block_q, block_k,
             pltpu.VMEM((bq, 1), jnp.float32),  # running denominator
             pltpu.VMEM((bq, d), jnp.float32),  # output accumulator
         ],
+        # scratch carries only along the innermost (ki) sweep; bh and qi
+        # iterations are independent, which lets Mosaic pipeline them
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=jax.default_backend() != "tpu",
     )(*inputs)
     out = out[:, :sq].reshape(b, h, sq, d)
@@ -507,17 +511,27 @@ def _flash_bwd(causal, scale, block_q, block_k, block_q_bwd, block_k_bwd,
     interpret = jax.default_backend() != "tpu"
     common = dict(scale=scale, causal=causal, bq=bq, bk=bk, kv_len=skv,
                   has_mask=has_mask)
+    # dead-block DMA elision, same as forward/fused: dq grid (bh, i, j) has
+    # its dead k blocks at the END of each j sweep — clamp their fetch index
+    # to the row's last live block so the pipeline skips the copy
+    if clamp_dead and causal:
+        def j_idx(i, j):
+            return jnp.minimum(j, (i * bq + bq - 1) // bk)
+    else:
+        def j_idx(i, j):
+            return j
     q_spec = pl.BlockSpec((1, bq, d), lambda bh, i, j: (bh, i, 0),
                           memory_space=pltpu.VMEM)
     lse_spec = pl.BlockSpec((1, bq, 1), lambda bh, i, j: (bh, i, 0),
                             memory_space=pltpu.VMEM)
-    kv_spec = pl.BlockSpec((1, bk, d), lambda bh, i, j: (bh, j, 0),
+    kv_spec = pl.BlockSpec((1, bk, d), lambda bh, i, j: (bh, j_idx(i, j), 0),
                            memory_space=pltpu.VMEM)
 
     in_specs = [_OFF_SPEC, q_spec, kv_spec, kv_spec, q_spec, q_spec, lse_spec]
     inputs = [off, qf, kf, vf, of, dof, lse]
     if has_mask:
-        in_specs.append(_mask_spec(maskp, b, h, bq, bk, transposed=False))
+        in_specs.append(_mask_spec(maskp, b, h, bq, bk,
+                                   lambda bh, i, j: (i, j_idx(i, j))))
         inputs.append(maskp)
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, **common),
@@ -526,13 +540,25 @@ def _flash_bwd(causal, scale, block_q, block_k, block_q_bwd, block_k_bwd,
         out_specs=q_spec,
         out_shape=jax.ShapeDtypeStruct((b * h, sq_p, d), q.dtype),
         scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(*inputs)
 
-    # transposed grid: blocks indexed (bh, k block, q block)
-    qT_spec = pl.BlockSpec((1, bq, d), lambda bh, j, i: (bh, i, 0),
+    # transposed grid: blocks indexed (bh, k block, q block); dead q blocks
+    # sit at the START of each i sweep — clamp to the first live row (with
+    # the in-range guard for sq < skv)
+    if clamp_dead and causal:
+        def i_idx(j, i):
+            return jnp.minimum(jnp.maximum(i, (j * bk) // bq),
+                               sq_p // bq - 1)
+    else:
+        def i_idx(j, i):
+            return i
+    qT_spec = pl.BlockSpec((1, bq, d), lambda bh, j, i: (bh, i_idx(j, i), 0),
                            memory_space=pltpu.VMEM)
-    lseT_spec = pl.BlockSpec((1, bq, 1), lambda bh, j, i: (bh, i, 0),
+    lseT_spec = pl.BlockSpec((1, bq, 1),
+                             lambda bh, j, i: (bh, i_idx(j, i), 0),
                              memory_space=pltpu.VMEM)
     kvT_spec = pl.BlockSpec((1, bk, d), lambda bh, j, i: (bh, j, 0),
                             memory_space=pltpu.VMEM)
@@ -540,7 +566,8 @@ def _flash_bwd(causal, scale, block_q, block_k, block_q_bwd, block_k_bwd,
                  lseT_spec]
     inputsT = [off, qf, kf, vf, of, dof, lse]
     if has_mask:
-        in_specsT.append(_mask_spec(maskp, b, h, bq, bk, transposed=True))
+        in_specsT.append(_mask_spec(maskp, b, h, bq, bk,
+                                    lambda bh, j, i: (i_idx(j, i), j)))
         inputsT.append(maskp)
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, **common),
@@ -551,6 +578,8 @@ def _flash_bwd(causal, scale, block_q, block_k, block_q_bwd, block_k_bwd,
                    jax.ShapeDtypeStruct((b * h, skv_p, d), v.dtype)],
         scratch_shapes=[pltpu.VMEM((bk, d), jnp.float32),
                         pltpu.VMEM((bk, d), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(*inputsT)
 
@@ -618,10 +647,8 @@ def _flash_bwd_fused(causal, scale, bq, bk, clamp_dead, residuals, g):
     in_specs = [_OFF_SPEC, q_spec, kv_spec, kv_spec, q_spec, q_spec, lse_spec]
     inputs = [off, qf, kf, vf, of, dof, lse]
     if has_mask:
-        pick = _mask_pick(maskp.shape[0], b, h)
-        in_specs.append(pl.BlockSpec(
-            (1, bq, bk), lambda bh, j, i: (pick(bh), q_idx(bh, j, i), j),
-            memory_space=pltpu.VMEM))
+        in_specs.append(_mask_spec(maskp, b, h, bq, bk,
+                                   lambda bh, j, i: (q_idx(bh, j, i), j)))
         inputs.append(maskp)
     dq, dk, dv = pl.pallas_call(
         functools.partial(_bwd_fused_kernel, scale=scale, causal=causal,
@@ -646,6 +673,14 @@ def _flash_bwd_fused(causal, scale, bq, bk, clamp_dead, residuals, g):
             pltpu.VMEM((bk, d), jnp.float32),    # dK block accumulator
             pltpu.VMEM((bk, d), jnp.float32),    # dV block accumulator
         ],
+        # the dQ scratch carries across the whole (j, i) sweep of one bh, so
+        # both inner dims are "arbitrary"; bh segments are independent
+        # (re-initialized at (0, 0)). The explicit VMEM budget keeps the
+        # full-seq scratch from tripping Mosaic's conservative default check
+        # at S=16384.
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary", "arbitrary"),
+            vmem_limit_bytes=100 * 2**20),
         interpret=jax.default_backend() != "tpu",
     )(*inputs)
 
