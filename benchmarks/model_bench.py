@@ -184,7 +184,7 @@ def main(argv=None):
     ap = argparse.ArgumentParser()
     ap.add_argument("--quick", action="store_true")
     ap.add_argument("--models", default="wrn,resnet9,vit,gpt2,gpt2_flash,moe,"
-                                        "decode,decode_int8,decode_fused")
+                                        "gqa,decode,decode_int8,decode_fused")
     args = ap.parse_args(argv)
     q = args.quick
     wanted = set(args.models.split(","))
@@ -228,6 +228,14 @@ def main(argv=None):
             # geometry that lifts the D=64 half-MXU cap (docs/perf.md)
             add(lambda: bench_gpt2_train(8, 1024, 10, size="small_hd128",
                                          flash=True, extra={"head_dim": 128}))
+    if "gqa" in wanted:
+        # grouped-query attention: same model, 3x smaller KV cache — the
+        # decode bandwidth floor moves (beyond reference)
+        add(lambda: bench_gpt2_decode(1, 16 if q else 64, 8 if q else 64,
+                                      size="small_gqa4"))
+        if not q:
+            add(lambda: bench_gpt2_train(8, 512, 10, size="small_gqa4",
+                                         extra={"kv_heads": 4}))
     if "moe" in wanted:
         # expert-routed FFN variant; MFU on active params (VERDICT r03 #4)
         add(lambda: bench_gpt2_train(2 if q else 8, 128 if q else 512,

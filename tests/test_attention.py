@@ -345,3 +345,103 @@ def test_fused_bwd_causal_short_query_no_offset(monkeypatch):
         assert np.all(np.isfinite(np.asarray(a))), name
         np.testing.assert_allclose(np.asarray(a), np.asarray(b_), rtol=1e-5,
                                    atol=1e-5, err_msg=name)
+
+
+class TestGQA:
+    """Grouped-query attention (beyond reference): H_kv < H shares kv heads
+    across query groups; the pallas kernel maps q-head grid indices to kv
+    heads in its BlockSpecs (zero materialization)."""
+
+    def _qkv(self, hq=4, hkv=2, sq=128, skv=128, d=32):
+        rs = np.random.RandomState(21)
+        q = jnp.asarray(rs.randn(2, hq, sq, d), jnp.float32)
+        k = jnp.asarray(rs.randn(2, hkv, skv, d), jnp.float32)
+        v = jnp.asarray(rs.randn(2, hkv, skv, d), jnp.float32)
+        return q, k, v
+
+    def test_flash_gqa_matches_repeated_kv(self):
+        from tnn_tpu.ops.pallas.flash_attention import flash_attention
+
+        q, k, v = self._qkv()
+        out = flash_attention(q, k, v, True, None, 64, 64)
+        ref = flash_attention(q, jnp.repeat(k, 2, axis=1),
+                              jnp.repeat(v, 2, axis=1), True, None, 64, 64)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_xla_gqa_matches_repeated_kv(self):
+        q, k, v = self._qkv()
+        out = sdpa(q, k, v, causal=True, backend="xla")
+        ref = sdpa(q, jnp.repeat(k, 2, axis=1), jnp.repeat(v, 2, axis=1),
+                   causal=True, backend="xla")
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=1e-5, atol=1e-5)
+
+    @pytest.mark.parametrize("fused", ["1", "0"])
+    def test_gqa_grads_match_repeated_kv(self, monkeypatch, fused):
+        """dK/dV for a shared kv head must equal the SUM of its group's
+        per-head grads — both fused and split backward paths."""
+        from tnn_tpu.ops.pallas.flash_attention import flash_attention
+
+        monkeypatch.setenv("TNN_FLASH_FUSED_BWD", fused)
+        q, k, v = self._qkv()
+        g = jnp.asarray(np.random.RandomState(3).randn(*q.shape), jnp.float32)
+
+        def loss(q, k, v):
+            return jnp.vdot(flash_attention(q, k, v, True, None, 64, 64,
+                                            64, 64), g)
+
+        def loss_rep(q, k2, v2):
+            return jnp.vdot(flash_attention(q, jnp.repeat(k2, 2, axis=1),
+                                            jnp.repeat(v2, 2, axis=1),
+                                            True, None, 64, 64, 64, 64), g)
+
+        got = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+        want = jax.grad(loss_rep, argnums=(0, 1, 2))(q, k, v)
+        for name, a, b in zip("dq dk dv".split(), got, want):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-4, atol=1e-4, err_msg=name)
+
+    @pytest.mark.parametrize("backend", ["xla", "pallas"])
+    def test_mha_gqa_cached_decode_matches_full(self, rng, backend):
+        mha = nn.MultiHeadAttention(num_heads=4, num_kv_heads=2, causal=True,
+                                    backend=backend, policy=F32)
+        x = jnp.asarray(np.random.RandomState(5).randn(2, 8, 32), jnp.float32)
+        v = mha.init(rng, x.shape)
+        full = mha(v, x)
+        cache = mha.init_cache(2, 8, 32)
+        assert cache["k"].shape == (2, 2, 8, 8)  # H_kv=2 sized cache
+        out, cache = mha.apply_cached(v, x[:, :5], cache, 0)
+        outs = [out]
+        for t in range(5, 8):
+            o, cache = mha.apply_cached(v, x[:, t:t + 1], cache, t)
+            outs.append(o)
+        np.testing.assert_allclose(np.asarray(jnp.concatenate(outs, axis=1)),
+                                   np.asarray(full), rtol=1e-4, atol=1e-5)
+
+    def test_gqa_config_roundtrip(self, rng):
+        from tnn_tpu.core.module import module_from_config
+
+        mha = nn.MultiHeadAttention(num_heads=6, num_kv_heads=3, causal=True)
+        m2 = module_from_config(mha.get_config())
+        assert m2.num_kv_heads == 3 and m2.num_heads == 6
+
+    def test_bad_head_ratio_raises(self):
+        with pytest.raises(ValueError):
+            nn.MultiHeadAttention(num_heads=6, num_kv_heads=4)
+
+
+def test_gqa_inside_ring_context_raises():
+    """GQA + sequence-parallel ring context must fail loudly, not silently
+    attend within each seq shard (wrong math)."""
+    from tnn_tpu.nn import attention as attn_mod
+
+    rs = np.random.RandomState(0)
+    q = jnp.asarray(rs.randn(1, 4, 16, 8), jnp.float32)
+    k = jnp.asarray(rs.randn(1, 2, 16, 8), jnp.float32)
+    attn_mod._RING_CTX["mesh"] = object()
+    try:
+        with pytest.raises(NotImplementedError, match="grouped-query"):
+            sdpa(q, k, k, causal=True)
+    finally:
+        attn_mod._RING_CTX["mesh"] = None

@@ -117,6 +117,50 @@ class TestFullCheckpoint:
                         jax.tree_util.tree_leaves(state_cont.params)):
             np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
+    def test_async_save_matches_blocking(self, tmp_path):
+        """block=False must produce an identical checkpoint even when the
+        donated train state is immediately reused for more steps (the write
+        runs from a host snapshot taken before returning)."""
+        model, opt, state, step = self._state_and_step()
+        rs = np.random.RandomState(1)
+        data = jnp.asarray(rs.randn(8, 28, 28, 1), jnp.bfloat16)
+        labels = jnp.asarray(rs.randint(0, 10, 8), jnp.int32)
+        state, _ = step(state, data, labels)
+
+        ck_async = ckpt_lib.Checkpoint(str(tmp_path / "a"))
+        ck_sync = ckpt_lib.Checkpoint(str(tmp_path / "s"))
+        ck_sync.save(state, model=model)
+        ck_async.save(state, model=model, block=False)
+        # hammer the donated buffers while the write is in flight
+        for _ in range(3):
+            state, _ = step(state, data, labels)
+        ck_async.wait()
+
+        _, _, fresh_a, _ = self._state_and_step()
+        _, _, fresh_s, _ = self._state_and_step()
+        ra, _ = ck_async.restore(fresh_a)
+        rs_, _ = ck_sync.restore(fresh_s)
+        for a, b in zip(jax.tree_util.tree_leaves(ra.params),
+                        jax.tree_util.tree_leaves(rs_.params)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        for a, b in zip(jax.tree_util.tree_leaves(ra.opt_state),
+                        jax.tree_util.tree_leaves(rs_.opt_state)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_async_save_failure_surfaces_on_wait(self, tmp_path, monkeypatch):
+        """A failed background write must raise at wait(), not vanish."""
+        model, opt, state, step = self._state_and_step()
+        ck = ckpt_lib.Checkpoint(str(tmp_path / "x"))
+
+        def boom(*a, **kw):
+            raise OSError("disk full")
+
+        monkeypatch.setattr(ckpt_lib, "save_tensors", boom)
+        ck.save(state, block=False)
+        with pytest.raises(OSError, match="disk full"):
+            ck.wait()
+        ck.wait()  # error is consumed; a second wait is a clean no-op
+
     def test_retention_and_best(self, tmp_path):
         model, opt, state, step = self._state_and_step()
         ckpt = ckpt_lib.Checkpoint(str(tmp_path / "ck"), keep=2)

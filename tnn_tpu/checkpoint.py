@@ -199,11 +199,23 @@ class Checkpoint:
     def __init__(self, directory: str, keep: int = 3):
         self.directory = directory
         self.keep = int(keep)
+        self._pending = None  # in-flight async writer thread
 
     # -- write ---------------------------------------------------------------
 
     def save(self, train_state, model=None, scheduler=None, loader=None,
-             extra: Optional[Dict] = None, best: bool = False) -> str:
+             extra: Optional[Dict] = None, best: bool = False,
+             block: bool = True) -> str:
+        """Snapshot the full training state.
+
+        ``block=False`` overlaps the disk write with training (the orbax-style
+        async save): the state is fetched to HOST first — synchronously,
+        because the train step donates its input buffers and a background
+        read of device arrays would race the next step's donation — then the
+        serialization + file write + retention GC run on a daemon thread.
+        Writes are serialized (a new save joins the previous one); call
+        :meth:`wait` before reading the newest checkpoint back.
+        """
         from .train.step import TrainState
 
         assert isinstance(train_state, TrainState)
@@ -218,19 +230,55 @@ class Checkpoint:
                                  "state": getattr(scheduler, "state_dict", dict)()}
         if loader is not None:
             meta["loader"] = loader.state_dict()
-        os.makedirs(target, exist_ok=True)
-        save_tensors(os.path.join(target, "state.tnn"), {
+        trees = {
             "params": train_state.params,
             "opt_state": train_state.opt_state,
             "net_state": train_state.net_state,
             "step": train_state.step,
             "rng": train_state.rng,
-        }, meta=meta)
-        with open(os.path.join(target, "meta.json"), "w") as f:
-            json.dump(meta, f, indent=2, default=str)
-        if not best:
-            self._gc()
+        }
+        if not block:
+            # host copy BEFORE the writer thread exists and BEFORE this call
+            # returns: the caller's next donated train step invalidates the
+            # device buffers, so the thread must never see them
+            trees = jax.device_get(trees)
+
+        def write(trees=trees, meta=meta, target=target, best=best):
+            os.makedirs(target, exist_ok=True)
+            save_tensors(os.path.join(target, "state.tnn"), trees, meta=meta)
+            with open(os.path.join(target, "meta.json"), "w") as f:
+                json.dump(meta, f, indent=2, default=str)
+            if not best:
+                self._gc()
+
+        if block:
+            self.wait()  # keep writes ordered with any in-flight async save
+            write()
+        else:
+            import threading
+
+            self.wait()
+
+            def guarded():
+                try:
+                    write()
+                except BaseException as e:  # noqa: BLE001 — surfaced by wait()
+                    self._error = e
+
+            self._error = None
+            self._pending = threading.Thread(target=guarded, daemon=True)
+            self._pending.start()
         return target
+
+    def wait(self) -> None:
+        """Join an in-flight ``block=False`` save; re-raises its failure (a
+        silently missing checkpoint must not read as success)."""
+        if self._pending is not None:
+            self._pending.join()
+            self._pending = None
+            err, self._error = getattr(self, "_error", None), None
+            if err is not None:
+                raise err
 
     def _gc(self):
         steps = sorted(self._step_dirs())

@@ -30,7 +30,7 @@ class GPT2(Module):
     def __init__(self, vocab_size: int = 50257, max_len: int = 1024, num_layers: int = 12,
                  d_model: int = 768, num_heads: int = 12, dropout: float = 0.0,
                  backend: str = "xla", tie_embeddings: bool = True,
-                 moe_experts: int = 0, name=None, policy=None):
+                 moe_experts: int = 0, num_kv_heads=None, name=None, policy=None):
         super().__init__(name=name, policy=policy)
         self.vocab_size = int(vocab_size)
         self.max_len = int(max_len)
@@ -41,12 +41,14 @@ class GPT2(Module):
         self.backend = backend
         self.tie_embeddings = bool(tie_embeddings)
         self.moe_experts = int(moe_experts)  # >0: MoE FFN in every block
+        self.num_kv_heads = int(num_kv_heads) if num_kv_heads else self.num_heads
         p = self.policy
         self.wte = Embedding(vocab_size, d_model, policy=p)
         self.wpe = PositionalEmbedding(max_len, policy=p)
         self.drop = Dropout(dropout, policy=p)
         self.blocks = [GPTBlock(num_heads, dropout=dropout, backend=backend,
-                                moe_experts=moe_experts, policy=p)
+                                moe_experts=moe_experts,
+                                num_kv_heads=self.num_kv_heads, policy=p)
                        for _ in range(num_layers)]
         self.ln_f = LayerNorm(policy=p)
 
@@ -150,6 +152,8 @@ class GPT2(Module):
                "backend": self.backend, "tie_embeddings": self.tie_embeddings}
         if self.moe_experts:
             cfg["moe_experts"] = self.moe_experts
+        if self.num_kv_heads != self.num_heads:
+            cfg["num_kv_heads"] = self.num_kv_heads
         return cfg
 
 
@@ -231,6 +235,14 @@ def gpt2_small_hd128(**kw):
     checkpoint (example_models.cpp:384); this exists for from-scratch
     training where the geometry is free."""
     return GPT2(num_layers=12, d_model=768, num_heads=6, **kw)
+
+
+def gpt2_small_gqa4(**kw):
+    """12L/768d/12h with 4 KV heads (grouped-query attention, beyond
+    reference): the decode KV cache — the bandwidth floor of cached decode —
+    shrinks 3x, and the flash kernel shares each kv block across its query
+    group with zero materialization (ops/pallas/flash_attention.py)."""
+    return GPT2(num_layers=12, d_model=768, num_heads=12, num_kv_heads=4, **kw)
 
 
 def gpt2_medium(**kw):

@@ -63,6 +63,9 @@ register("gpt2_small")(lambda **kw: gpt2_lib.gpt2_small(**kw))
 register("gpt2_small_hd128")(lambda **kw: gpt2_lib.gpt2_small_hd128(**kw))
 register("flash_gpt2_small_hd128")(
     lambda **kw: gpt2_lib.gpt2_small_hd128(backend="pallas", **kw))
+register("gpt2_small_gqa4")(lambda **kw: gpt2_lib.gpt2_small_gqa4(**kw))
+register("flash_gpt2_small_gqa4")(
+    lambda **kw: gpt2_lib.gpt2_small_gqa4(backend="pallas", **kw))
 register("gpt2_medium")(lambda **kw: gpt2_lib.gpt2_medium(**kw))
 register("gpt2_large")(lambda **kw: gpt2_lib.gpt2_large(**kw))
 register("flash_gpt2_small")(lambda **kw: gpt2_lib.gpt2_small(backend="pallas", **kw))

@@ -124,6 +124,14 @@ def sdpa(q, k, v, *, causal: bool = False, mask: Optional[jax.Array] = None,
     sequence — builds the correct causal mask for S_q != S_kv.
     """
     ringable = mask is None and kv_offset is None
+    if ringable and q.shape[1] != k.shape[1] and _RING_CTX["mesh"] is not None:
+        # seq-parallel context + GQA: falling through to local attention
+        # would silently attend within each seq shard only — wrong math.
+        # Fail loudly until ring/ulysses grow a grouped-kv path.
+        raise NotImplementedError(
+            "grouped-query attention (H_kv != H) inside a sequence-parallel "
+            "ring/ulysses context is not supported; use equal heads or drop "
+            "the seq axis for this model")
     if _RING_CTX["mesh"] is not None and ringable:
         # context wins over the configured backend: inside a seq-parallel step
         # the activations are seq-sharded, so local/full attention would be
@@ -167,6 +175,13 @@ def local_xla_attention(q, k, v, *, causal: bool = False,
     sq, skv = q.shape[-2], k.shape[-2]
     if scale is None:
         scale = 1.0 / math.sqrt(q.shape[-1])
+    if k.shape[1] != q.shape[1]:
+        # grouped-query attention: materialize the shared kv heads for the
+        # reference path (XLA folds the broadcast); the pallas kernel is the
+        # zero-copy route (q-head grid index -> kv head in its index maps)
+        g = q.shape[1] // k.shape[1]
+        k = jnp.repeat(k, g, axis=1)
+        v = jnp.repeat(v, g, axis=1)
     # QK^T with f32 accumulation on the MXU.
     logits = jnp.einsum("bhqd,bhkd->bhqk", q, k,
                         preferred_element_type=jnp.float32) * scale
@@ -205,9 +220,16 @@ class MultiHeadAttention(Module):
 
     def __init__(self, num_heads: int, causal: bool = False, dropout: float = 0.0,
                  backend: str = "xla", kernel_init: str = "xavier_uniform",
-                 name=None, policy=None):
+                 num_kv_heads: Optional[int] = None, name=None, policy=None):
         super().__init__(name=name, policy=policy)
         self.num_heads = int(num_heads)
+        # grouped-query attention (beyond reference): H_kv < H shares each
+        # kv head across a group of query heads, shrinking the decode KV
+        # cache (the decode bandwidth floor) by H/H_kv
+        self.num_kv_heads = int(num_kv_heads) if num_kv_heads else self.num_heads
+        if self.num_heads % self.num_kv_heads:
+            raise ValueError(f"num_heads {self.num_heads} not divisible by "
+                             f"num_kv_heads {self.num_kv_heads}")
         self.causal = bool(causal)
         self.dropout = float(dropout)
         self.backend = backend
@@ -220,20 +242,21 @@ class MultiHeadAttention(Module):
         d = input_shape[-1]
         if d % self.num_heads:
             raise ValueError(f"model dim {d} not divisible by num_heads {self.num_heads}")
+        kv_d = (d // self.num_heads) * self.num_kv_heads
         init = initializers.get(self.kernel_init)
         k1, k2 = jax.random.split(rng)
         pd = self.policy.param_dtype
         params = {
-            "qkv_kernel": init(k1, (d, 3 * d), pd),
-            "qkv_bias": jnp.zeros((3 * d,), pd),
+            "qkv_kernel": init(k1, (d, d + 2 * kv_d), pd),
+            "qkv_bias": jnp.zeros((d + 2 * kv_d,), pd),
             "out_kernel": init(k2, (d, d), pd),
             "out_bias": jnp.zeros((d,), pd),
         }
         return params, {}
 
-    def _split_heads(self, x):
+    def _split_heads(self, x, h=None):
         n, s, d = x.shape
-        h = self.num_heads
+        h = h or self.num_heads
         return x.reshape(n, s, h, d // h).transpose(0, 2, 1, 3)
 
     def _merge_heads(self, x):
@@ -246,8 +269,11 @@ class MultiHeadAttention(Module):
         x = self.policy.cast_in(x)
         w = self.policy.cast_param(params["qkv_kernel"])
         qkv = qmatmul(x, w).astype(x.dtype) + params["qkv_bias"].astype(x.dtype)
-        q, k, v = jnp.split(qkv, 3, axis=-1)
-        return self._split_heads(q), self._split_heads(k), self._split_heads(v)
+        d = x.shape[-1]
+        kv_d = (d // self.num_heads) * self.num_kv_heads
+        q, k, v = jnp.split(qkv, [d, d + kv_d], axis=-1)
+        return (self._split_heads(q), self._split_heads(k, self.num_kv_heads),
+                self._split_heads(v, self.num_kv_heads))
 
     def _project_out(self, params, attn, train, rng):
         from ..ops.pallas.quant_matmul import qmatmul
@@ -266,9 +292,10 @@ class MultiHeadAttention(Module):
     # -- cached autoregressive decode (exceeds reference) ----------------------
 
     def init_cache(self, batch: int, max_len: int, d_model: int):
-        """Allocate a (k, v) ring cache for decode."""
-        h = self.num_heads
-        dh = d_model // h
+        """Allocate a (k, v) ring cache for decode — sized to the KV heads,
+        so GQA shrinks the cache (and the decode HBM floor) by H/H_kv."""
+        h = self.num_kv_heads
+        dh = d_model // self.num_heads
         dtype = self.policy.compute_dtype
         return {
             "k": jnp.zeros((batch, h, max_len, dh), dtype),
@@ -298,4 +325,5 @@ class MultiHeadAttention(Module):
     def _config(self):
         return {"num_heads": self.num_heads, "causal": self.causal,
                 "dropout": self.dropout, "backend": self.backend,
+                "num_kv_heads": self.num_kv_heads,
                 "kernel_init": initializers.name_of(self.kernel_init)}

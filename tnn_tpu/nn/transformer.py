@@ -30,9 +30,11 @@ class GPTBlock(Module):
 
     def __init__(self, num_heads: int, mlp_ratio: int = 4, dropout: float = 0.0,
                  causal: bool = True, backend: str = "xla", activation: str = "gelu",
-                 moe_experts: int = 0, moe_top_k: int = 2, name=None, policy=None):
+                 moe_experts: int = 0, moe_top_k: int = 2, num_kv_heads=None,
+                 name=None, policy=None):
         super().__init__(name=name, policy=policy)
         self.num_heads = int(num_heads)
+        self.num_kv_heads = int(num_kv_heads) if num_kv_heads else self.num_heads
         self.mlp_ratio = int(mlp_ratio)
         self.dropout = float(dropout)
         self.causal = bool(causal)
@@ -43,7 +45,8 @@ class GPTBlock(Module):
         p = self.policy
         self.ln1 = LayerNorm(policy=p)
         self.attn = MultiHeadAttention(num_heads, causal=causal, dropout=dropout,
-                                       backend=backend, policy=p)
+                                       backend=backend,
+                                       num_kv_heads=self.num_kv_heads, policy=p)
         self.ln2 = LayerNorm(policy=p)
         self.drop = Dropout(dropout, policy=p)
         self.moe = None
@@ -129,6 +132,8 @@ class GPTBlock(Module):
         cfg = {"num_heads": self.num_heads, "mlp_ratio": self.mlp_ratio,
                "dropout": self.dropout, "causal": self.causal,
                "backend": self.backend, "activation": self.activation}
+        if self.num_kv_heads != self.num_heads:
+            cfg["num_kv_heads"] = self.num_kv_heads
         if self.moe_experts:
             cfg["moe_experts"] = self.moe_experts
             cfg["moe_top_k"] = self.moe_top_k

@@ -213,9 +213,20 @@ def flash_attention(q, k, v, causal: bool = False, scale: Optional[float] = None
     training case), blocks strictly above the diagonal are not just
     compute-skipped but FETCH-skipped: their index maps clamp to the last
     live block, and the Pallas pipeline elides the DMA when a block index
-    repeats — at S=8192 that removes ~40% of the K/V HBM traffic."""
+    repeats — at S=8192 that removes ~40% of the K/V HBM traffic.
+
+    Grouped-query attention: ``k``/``v`` may carry H_kv heads with
+    H % H_kv == 0 (e.g. MQA at H_kv=1). The kernels never materialize the
+    repeated heads — each q head's grid index maps to its kv head inside the
+    BlockSpec index maps, so a shared kv block is fetched once and reused by
+    the whole group (consecutive grid steps repeat the index; the pipeline
+    elides the copy)."""
     b, h, sq, d = q.shape
     skv = k.shape[2]
+    hkv = k.shape[1]
+    if h % hkv or v.shape[1] != hkv:
+        raise ValueError(f"q has {h} heads but k/v have {k.shape[1]}/"
+                         f"{v.shape[1]}; need H % H_kv == 0 and k == v heads")
     if mask is not None:
         mask = _norm_mask(jnp.asarray(mask), b, h, sq, skv)
     clamp_dead = causal and kv_offset is None
@@ -233,17 +244,27 @@ def _bwd_blocks(block_q, block_k, block_q_bwd, block_k_bwd):
     return bq, bk
 
 
+def _kv_head_map(h: int, hkv: int):
+    """Flattened batch*q-head grid index -> flattened batch*kv-head index
+    (identity when h == hkv); the zero-copy GQA mapping."""
+    if h == hkv:
+        return lambda bh: bh
+    group = h // hkv
+    return lambda bh: (bh // h) * hkv + (bh % h) // group
+
+
 def _flash_fwd(q, k, v, mask, off, causal, scale, block_q, block_k,
                block_q_bwd=None, block_k_bwd=None, clamp_dead=False):
     b, h, sq, d = q.shape
-    skv = k.shape[2]
+    hkv, skv = k.shape[1], k.shape[2]
     if scale is None:
         scale = 1.0 / math.sqrt(d)
     bq, bk, sq_p, skv_p = _block_geometry(sq, skv, block_q, block_k)
 
     qf = _pad_to(q.reshape(b * h, sq, d), sq_p, 1)
-    kf = _pad_to(k.reshape(b * h, skv, d), skv_p, 1)
-    vf = _pad_to(v.reshape(b * h, skv, d), skv_p, 1)
+    kf = _pad_to(k.reshape(b * hkv, skv, d), skv_p, 1)
+    vf = _pad_to(v.reshape(b * hkv, skv, d), skv_p, 1)
+    kv_head = _kv_head_map(h, hkv)
 
     grid = (b * h, sq_p // bq, skv_p // bk)
     kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal,
@@ -255,10 +276,10 @@ def _flash_fwd(q, k, v, mask, off, causal, scale, block_q, block_k,
         # previous step's index, so the pipeline elides the DMA entirely
         # (the kernel's pl.when(live) already skips the compute).
         def kv_idx(bh, qi, ki):
-            return (bh, jnp.minimum(ki, (qi * bq + bq - 1) // bk), 0)
+            return (kv_head(bh), jnp.minimum(ki, (qi * bq + bq - 1) // bk), 0)
     else:
         def kv_idx(bh, qi, ki):
-            return (bh, ki, 0)
+            return (kv_head(bh), ki, 0)
     in_specs = [
         _OFF_SPEC,
         pl.BlockSpec((1, bq, d), lambda bh, qi, ki: (bh, qi, 0),
@@ -495,10 +516,12 @@ def _flash_bwd(causal, scale, block_q, block_k, block_q_bwd, block_k_bwd,
                                 residuals, g)
     bq_bwd, bk_bwd = _bwd_blocks(block_q, block_k, block_q_bwd, block_k_bwd)
     bq, bk, sq_p, skv_p = _block_geometry(sq, skv, bq_bwd, bk_bwd)
+    hkv = k.shape[1]
+    kv_head = _kv_head_map(h, hkv)
 
     qf = _pad_to(q.reshape(b * h, sq, d), sq_p, 1)
-    kf = _pad_to(k.reshape(b * h, skv, d), skv_p, 1)
-    vf = _pad_to(v.reshape(b * h, skv, d), skv_p, 1)
+    kf = _pad_to(k.reshape(b * hkv, skv, d), skv_p, 1)
+    vf = _pad_to(v.reshape(b * hkv, skv, d), skv_p, 1)
     of = _pad_to(o.reshape(b * h, sq, d), sq_p, 1)
     dof = _pad_to(g.reshape(b * h, sq, d), sq_p, 1)
     # +inf on padded q rows makes their recomputed p exactly 0, so they add
@@ -524,7 +547,8 @@ def _flash_bwd(causal, scale, block_q, block_k, block_q_bwd, block_k_bwd,
                           memory_space=pltpu.VMEM)
     lse_spec = pl.BlockSpec((1, bq, 1), lambda bh, i, j: (bh, i, 0),
                             memory_space=pltpu.VMEM)
-    kv_spec = pl.BlockSpec((1, bk, d), lambda bh, i, j: (bh, j_idx(i, j), 0),
+    kv_spec = pl.BlockSpec((1, bk, d),
+                           lambda bh, i, j: (kv_head(bh), j_idx(i, j), 0),
                            memory_space=pltpu.VMEM)
 
     in_specs = [_OFF_SPEC, q_spec, kv_spec, kv_spec, q_spec, q_spec, lse_spec]
@@ -560,9 +584,13 @@ def _flash_bwd(causal, scale, block_q, block_k, block_q_bwd, block_k_bwd,
     lseT_spec = pl.BlockSpec((1, bq, 1),
                              lambda bh, j, i: (bh, i_idx(j, i), 0),
                              memory_space=pltpu.VMEM)
+    kvT_fetch = pl.BlockSpec((1, bk, d),
+                             lambda bh, j, i: (kv_head(bh), j, 0),
+                             memory_space=pltpu.VMEM)
+    # dk/dv are written PER Q HEAD (grid bh), group-summed after the kernel
     kvT_spec = pl.BlockSpec((1, bk, d), lambda bh, j, i: (bh, j, 0),
                             memory_space=pltpu.VMEM)
-    in_specsT = [_OFF_SPEC, qT_spec, kvT_spec, kvT_spec, qT_spec, qT_spec,
+    in_specsT = [_OFF_SPEC, qT_spec, kvT_fetch, kvT_fetch, qT_spec, qT_spec,
                  lseT_spec]
     inputsT = [off, qf, kf, vf, of, dof, lse]
     if has_mask:
@@ -584,10 +612,25 @@ def _flash_bwd(causal, scale, block_q, block_k, block_q_bwd, block_k_bwd,
     )(*inputsT)
 
     dq = dq[:, :sq].reshape(b, h, sq, d)
-    dk = dk[:, :skv].reshape(b, h, skv, d)
-    dv = dv[:, :skv].reshape(b, h, skv, d)
+    dk, dv = _group_sum_kv_grads(dk, dv, b, h, hkv, skv, d)
     dmask, doff = _zero_cotangents(mask, off)
     return dq, dk, dv, dmask, doff
+
+
+def _group_sum_kv_grads(dk, dv, b, h, hkv, skv, d):
+    """Per-q-head dK/dV (b*h, skv_p, d) -> per-kv-head (b, hkv, skv, d):
+    the kernels emit each q head's contribution separately (a shared output
+    block would be revisited non-consecutively across the grid, which the
+    sequential pipeline cannot accumulate), and the group sum runs as one
+    XLA reduction here."""
+    dk_dt, dv_dt = dk.dtype, dv.dtype
+    dk = dk[:, :skv].reshape(b, h, skv, d)
+    dv = dv[:, :skv].reshape(b, h, skv, d)
+    if h != hkv:
+        g = h // hkv
+        dk = dk.reshape(b, hkv, g, skv, d).astype(jnp.float32).sum(2)
+        dv = dv.reshape(b, hkv, g, skv, d).astype(jnp.float32).sum(2)
+    return dk.astype(dk_dt), dv.astype(dv_dt)
 
 
 def _zero_cotangents(mask, off):
@@ -608,14 +651,15 @@ def _flash_bwd_fused(causal, scale, bq, bk, clamp_dead, residuals, g):
     write once per j, dQ once per bh from the full-seq scratch."""
     q, k, v, mask, off, o, lse_row = residuals
     b, h, sq, d = q.shape
-    skv = k.shape[2]
+    hkv, skv = k.shape[1], k.shape[2]
+    kv_head = _kv_head_map(h, hkv)
     _, _, sq_p, skv_p = _block_geometry(sq, skv, bq, bk)
     bq = min(bq, sq_p)
     bk = min(bk, skv_p)
 
     qf = _pad_to(q.reshape(b * h, sq, d), sq_p, 1)
-    kf = _pad_to(k.reshape(b * h, skv, d), skv_p, 1)
-    vf = _pad_to(v.reshape(b * h, skv, d), skv_p, 1)
+    kf = _pad_to(k.reshape(b * hkv, skv, d), skv_p, 1)
+    vf = _pad_to(v.reshape(b * hkv, skv, d), skv_p, 1)
     of = _pad_to(o.reshape(b * h, sq, d), sq_p, 1)
     dof = _pad_to(g.reshape(b * h, sq, d), sq_p, 1)
     lse = _pad_to(lse_row, sq_p, 1, value=jnp.inf)[:, :, None]
@@ -642,7 +686,7 @@ def _flash_bwd_fused(causal, scale, bq, bk, clamp_dead, residuals, g):
     lse_spec = pl.BlockSpec((1, bq, 1),
                             lambda bh, j, i: (bh, q_idx(bh, j, i), 0),
                             memory_space=pltpu.VMEM)
-    kv_spec = pl.BlockSpec((1, bk, d), lambda bh, j, i: (bh, j, 0),
+    kv_spec = pl.BlockSpec((1, bk, d), lambda bh, j, i: (kv_head(bh), j, 0),
                            memory_space=pltpu.VMEM)
     in_specs = [_OFF_SPEC, q_spec, kv_spec, kv_spec, q_spec, q_spec, lse_spec]
     inputs = [off, qf, kf, vf, of, dof, lse]
@@ -685,8 +729,7 @@ def _flash_bwd_fused(causal, scale, bq, bk, clamp_dead, residuals, g):
     )(*inputs)
 
     dq = dq[:, :sq].reshape(b, h, sq, d)
-    dk = dk[:, :skv].reshape(b, h, skv, d)
-    dv = dv[:, :skv].reshape(b, h, skv, d)
+    dk, dv = _group_sum_kv_grads(dk, dv, b, h, hkv, skv, d)
     dmask, doff = _zero_cotangents(mask, off)
     return dq, dk, dv, dmask, doff
 

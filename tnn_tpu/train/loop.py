@@ -359,8 +359,11 @@ def train_model(
                                      extra={"epoch": epoch, **val}, best=True)
                     log.info("new best val %.4f -> %s", score, path)
 
+            # per-epoch snapshot overlaps its disk write with the next epoch
+            # (block=False); best-val saves above stay blocking — their path
+            # is logged and may be read back immediately
             ckpt.save(state, model=model, scheduler=scheduler, loader=train_loader,
-                      extra={**epoch_metrics, "best_val": best_val})
+                      extra={**epoch_metrics, "best_val": best_val}, block=False)
             log.info(
                 "epoch %d done in %.1fs: train loss=%.4f acc=%.4f%s", epoch,
                 epoch_metrics["epoch_seconds"], epoch_metrics["train_loss"],
@@ -370,6 +373,7 @@ def train_model(
                 if val_loader is not None else "")
             history.append(epoch_metrics)
     finally:
+        ckpt.wait()  # the last epoch's async snapshot must land before return
         if profiling_on:
             for name, s in sorted(GlobalProfiler.summary().items()):
                 log.info("profile %s: n=%d total=%.3fs mean=%.1fms", name,
