@@ -113,15 +113,18 @@ def bench_gpt2_train(batch: int, seq: int, iters: int, size="small", flash=False
                   item_name="tok", extra=extra)
 
 
-def bench_gpt2_long_train(batch: int = 1, seq: int = 8192, iters: int = 10):
+def bench_gpt2_long_train(batch: int = 1, seq: int = 8192, iters: int = 10,
+                          remat=True, label="flash_gpt2_small_long_train"):
     """Long-context GPT-2 training on ONE chip: Pallas flash attention +
     remat. The reference's context ceiling is seq_len=1024
     (example_models.cpp:385); here the whole model TRAINS at 8x that. Not in
-    the default set (adds ~2 min) — select with --models gpt2_long."""
+    the default set (adds ~2 min) — select with --models gpt2_long. The
+    remat="dots" twin keeps matmul outputs (flash attention is a pallas
+    call, not a dot, so it recomputes either way and the S x S matrix never
+    exists) — less recompute if the saved dots still fit HBM."""
     return bench_gpt2_train(batch, seq, iters, flash=True, max_len=seq,
-                            remat=True, attn_flops=True,
-                            label="flash_gpt2_small_long_train",
-                            extra={"seq": seq, "remat": True})
+                            remat=remat, attn_flops=True, label=label,
+                            extra={"seq": seq, "remat": remat})
 
 
 def bench_gpt2_decode(batch: int, prompt: int, new: int, size="small",
@@ -213,6 +216,9 @@ def main(argv=None):
     if "gpt2_long" in wanted:
         add(lambda: bench_gpt2_long_train(1, 2048, 3) if q
                        else bench_gpt2_long_train())
+        if not q:  # remat-policy A/B at the same config
+            add(lambda: bench_gpt2_long_train(
+                remat="dots", label="flash_gpt2_small_long_train_dots"))
     if "gpt2_flash" in wanted:
         # the pallas-attention variant, at the context length where fused
         # attention matters (reference ships gpt2 + flash_gpt2 side by side)

@@ -62,6 +62,15 @@ else
   echo "gpt2 m/l bench failed; log kept at /tmp/gpt2_ml_${STAMP}.log"
 fi
 
+echo "== 4b/8 long-context S=8192 train rows (full remat vs dots policy) =="
+# own budget: a timeout here must not take the medium/large rows with it
+if timeout 1200 python -m benchmarks.model_bench \
+    --models gpt2_long > "/tmp/gpt2_long_${STAMP}.log" 2>&1; then
+  cp "/tmp/gpt2_long_${STAMP}.log" "benchmarks/results/gpt2_long_${STAMP}.log"
+else
+  echo "gpt2_long bench failed; log kept at /tmp/gpt2_long_${STAMP}.log"
+fi
+
 echo "== 5/8 HBM-fit table (exact state bytes via eval_shape) =="
 if python -m tools.hbm_fit > "/tmp/hbm_fit_${STAMP}.txt" 2>&1; then
   cp "/tmp/hbm_fit_${STAMP}.txt" "benchmarks/results/hbm_fit_${STAMP}.txt"

@@ -678,3 +678,22 @@ def test_tp_sharding_rules(rng):
     with mesh:
         out = model({"params": sharded, "state": {}}, ids)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-4, atol=1e-4)
+
+
+def test_pipeline_remat_policy_resolves_at_build():
+    """Policy-name remat reaches the pipeline (not silently bool()ed to full
+    remat), and a typo raises at BUILD time on this path like the
+    single-device path."""
+    mesh = parallel.make_mesh(pipe=2)
+    model = _conv_bn_net()
+    parts = parallel.partitioner.proportional_partitions(len(model.children),
+                                                         [1.0] * 2)
+    stages = parallel.split(model, parts)
+    opt = nn.SGD(lr=0.1)
+    pipe, _, _ = parallel.make_pipeline_train_step(
+        stages, opt, mesh, (4, 16, 16, 3), num_microbatches=2, remat="dots")
+    assert pipe.remat and pipe._remat_policy is not None
+    with pytest.raises(ValueError, match="unknown remat policy"):
+        parallel.make_pipeline_train_step(
+            stages, opt, mesh, (4, 16, 16, 3), num_microbatches=2,
+            remat="typo")

@@ -356,14 +356,18 @@ class TestRemat:
         y = jnp.asarray(rs.randint(0, 10, 8), jnp.int32)
 
         states = []
-        for remat in (False, True):
+        for remat in (False, True, "dots", "dots_no_batch"):
             st = create_train_state(model, opt, jax.random.PRNGKey(0),
                                     (8, 12, 12, 3))
             step = make_train_step(model, opt, donate=False, remat=remat)
             for _ in range(3):
                 st, m = step(st, x, y)
             states.append((st, float(m["loss"])))
-        assert states[0][1] == states[1][1]
+        for st, loss in states[1:]:
+            assert loss == states[0][1]
+
+        with pytest.raises(ValueError, match="unknown remat policy"):
+            make_train_step(model, opt, remat="typo")
         for a, b in zip(jax.tree_util.tree_leaves(states[0][0].params),
                         jax.tree_util.tree_leaves(states[1][0].params)):
             np.testing.assert_array_equal(np.asarray(a), np.asarray(b))

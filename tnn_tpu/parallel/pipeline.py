@@ -320,7 +320,14 @@ class HeteroPipeline:
         # 1, so one ppermute per sub-tick suffices and the whole schedule
         # stays a single compiled scan (same tightness argument as
         # ``spmd_pipeline_interleaved``, here with heterogeneous stages).
+        # bool OR a policy name ("dots", ...) — resolved once here so a typo
+        # raises at build time on this path too (train.step.resolve_remat_policy)
         self.remat = bool(remat)
+        self._remat_policy = None
+        if remat:
+            from ..train.step import resolve_remat_policy
+
+            self._remat_policy = resolve_remat_policy(remat)
 
         # shape propagation (parity: deploy_stages shape chain,
         # coordinator.hpp:368-456): microbatch-shaped activations per boundary
@@ -441,7 +448,11 @@ class HeteroPipeline:
             return out, s_codec.pack(new_state, self.s_len), aux
 
         if self.remat and train:
-            run_stage = jax.checkpoint(run_stage)
+            if self._remat_policy is None:
+                run_stage = jax.checkpoint(run_stage)
+            else:
+                run_stage = jax.checkpoint(run_stage,
+                                           policy=self._remat_policy)
 
         def branch(p_vec, s_vec, buf, labels_mb, key):
             x = buf[:int(np.prod(in_shape))].reshape(in_shape).astype(in_dtype)

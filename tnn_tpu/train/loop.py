@@ -164,7 +164,7 @@ def train_model(
             loss_fn=config.loss, num_microbatches=num_mb,
             input_dtype=io_dtype, scheduler=scheduler,
             data_axis="data" if dp > 1 else None, augment=augment,
-            remat=bool(config.remat), virtual=virtual)
+            remat=config.remat, virtual=virtual)
         if state is None:
             state = init_fn(rng)
         eval_fn = make_pipeline_eval_step(pipe)
@@ -211,7 +211,7 @@ def train_model(
                 fsdp=axes.get("fsdp", 1) > 1, tp=axes.get("model", 1) > 1,
                 ep=axes.get("expert", 1) > 1,
                 grad_accum=config.gradient_accumulation_steps, augment=augment,
-                remat=bool(config.remat))
+                remat=config.remat)
             if axes.get("seq", 1) > 1:
                 # sequence/context parallelism: run steps inside a ring
                 # context — every sdpa call becomes ring attention with K/V
@@ -248,7 +248,7 @@ def train_model(
             step_fn = make_train_step(
                 model, optimizer, loss_fn=config.loss, scheduler=scheduler,
                 grad_accum=config.gradient_accumulation_steps, augment=augment,
-                remat=bool(config.remat))
+                remat=config.remat)
         base_eval = make_eval_step(model, loss_fn=config.loss)
         if mesh is not None:
             def eval_fn(state, data, labels, _f=base_eval, _m=mesh, _r=ring):

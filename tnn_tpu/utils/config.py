@@ -39,7 +39,10 @@ class TrainingConfig:
     # distributed params
     num_microbatches: int = 2
     mesh_axes: Dict[str, int] = dataclasses.field(default_factory=dict)  # e.g. {"data": 8}
-    remat: bool = False  # rematerialize forward in backward (memory for FLOPs)
+    # rematerialize forward in backward (memory for FLOPs): False | True
+    # | a policy name ("dots", "dots_no_batch", "offload_dots") — see
+    # train.step.make_train_step
+    remat: Any = False
     # pipeline runs: virtual (interleaved) stages per device — v>1 splits the
     # model into v*pp stages and shrinks the GPipe bubble to (pp-1)/v
     pipeline_virtual: int = 1
