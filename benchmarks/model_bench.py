@@ -128,14 +128,18 @@ def bench_gpt2_long_train(batch: int = 1, seq: int = 8192, iters: int = 10,
 
 
 def bench_gpt2_decode(batch: int, prompt: int, new: int, size="small",
-                      int8: bool = False, fused: bool = False):
+                      int8: bool = False, fused: bool = False,
+                      kv_cache: str = ""):
     from tnn_tpu import models
     from tnn_tpu.models.gpt2 import generate
 
     tag = "_fused" if fused else ("_int8" if int8 else "")
+    if kv_cache:
+        tag += f"_kv{kv_cache}"
     int8 = int8 or fused  # the fused kernel is int8-only
     print(f"gpt2_{size} decode{tag} (bs={batch}, prompt={prompt}, new={new})")
-    model = models.create(f"gpt2_{size}")
+    model = models.create(f"gpt2_{size}",
+                          **({"kv_cache_dtype": kv_cache} if kv_cache else {}))
     variables = model.init(jax.random.PRNGKey(0), (batch, 8))
     params = variables["params"]
     extra = {"batch": batch}
@@ -279,6 +283,12 @@ def main(argv=None):
                                          int8=True))
         if not q:
             add(lambda: bench_gpt2_decode(8, 64, 128, int8=True))
+            # int8 KV cache on top of int8 weights: the LONG-prompt case is
+            # where cache bytes rival weight bytes (max_len-sized cache reads
+            # per token)
+            add(lambda: bench_gpt2_decode(1, 512, 128, int8=True,
+                                          kv_cache="int8"))
+            add(lambda: bench_gpt2_decode(1, 512, 128, int8=True))
     if "decode_fused" in wanted:
         # whole-stack-in-one-Pallas-launch decode (ops/pallas/decode_stack.py);
         # Mosaic-only — interpret-mode timing off-TPU is meaningless and takes

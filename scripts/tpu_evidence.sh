@@ -7,6 +7,9 @@ set -uo pipefail
 cd "$(dirname "$0")/.."
 mkdir -p benchmarks/results
 STAMP=$(date +%Y%m%d_%H%M%S)
+# persistent XLA compile cache: bench retries after a mid-run relay death (and
+# repeat stages within this script) skip the 20-40s first-compile each time
+export JAX_COMPILATION_CACHE_DIR="${JAX_COMPILATION_CACHE_DIR:-/tmp/jax_cache}"
 
 echo "== 1/8 headline bench (persists on success) =="
 python bench.py | tee "benchmarks/results/headline_${STAMP}.jsonl"

@@ -126,6 +126,22 @@ def test_fused_generate_rejects_float_params(small):
                        interpret=True)
 
 
+def test_fused_rejects_int8_cache_and_gqa_models():
+    """Unsupported cache/head configs must fail loudly (callers catch
+    ValueError and fall back to the standard generate path) — not feed raw
+    int8 codes or mismatched heads into the kernel."""
+    m8 = GPT2(vocab_size=128, max_len=32, num_layers=1, d_model=64,
+              num_heads=2, kv_cache_dtype="int8")
+    v8 = m8.init(jax.random.PRNGKey(0), (1, 8))
+    with pytest.raises(ValueError, match="kv_cache_dtype"):
+        stack_decode_weights(m8, quantize_for_decode(v8["params"]))
+    mg = GPT2(vocab_size=128, max_len=32, num_layers=1, d_model=64,
+              num_heads=4, num_kv_heads=2)
+    vg = mg.init(jax.random.PRNGKey(0), (1, 8))
+    with pytest.raises(ValueError, match="grouped-query"):
+        stack_decode_weights(mg, quantize_for_decode(vg["params"]))
+
+
 def test_pick_chunks():
     # gpt2-small at request-sized cache fits with 2 chunks
     assert pick_chunks(768, 3072, 1, 192) in (1, 2)

@@ -40,6 +40,16 @@ def _iw(p, what):
 def stack_decode_weights(model, params):
     """Stack every block's weights into (L, ...) arrays for the fused kernel."""
     f32 = jnp.float32
+    if getattr(model, "kv_cache_dtype", None):
+        # the stacked (L, B, T, D) cache the kernel reads is compute-dtype;
+        # an int8+scale cache would be fed in as raw codes — refuse loudly
+        # (callers catch ValueError and fall back to models.gpt2.generate)
+        raise ValueError("fused decode does not support kv_cache_dtype="
+                         f"{model.kv_cache_dtype!r}; use the standard "
+                         "generate() path")
+    if getattr(model, "num_kv_heads", model.num_heads) != model.num_heads:
+        raise ValueError("fused decode does not support grouped-query "
+                         "attention (num_kv_heads != num_heads)")
     blocks = [params[f"h{i}"] for i in range(model.num_layers)]
     for b in blocks:
         if "moe" in b:

@@ -30,7 +30,8 @@ class GPT2(Module):
     def __init__(self, vocab_size: int = 50257, max_len: int = 1024, num_layers: int = 12,
                  d_model: int = 768, num_heads: int = 12, dropout: float = 0.0,
                  backend: str = "xla", tie_embeddings: bool = True,
-                 moe_experts: int = 0, num_kv_heads=None, name=None, policy=None):
+                 moe_experts: int = 0, num_kv_heads=None,
+                 kv_cache_dtype=None, name=None, policy=None):
         super().__init__(name=name, policy=policy)
         self.vocab_size = int(vocab_size)
         self.max_len = int(max_len)
@@ -42,13 +43,15 @@ class GPT2(Module):
         self.tie_embeddings = bool(tie_embeddings)
         self.moe_experts = int(moe_experts)  # >0: MoE FFN in every block
         self.num_kv_heads = int(num_kv_heads) if num_kv_heads else self.num_heads
+        self.kv_cache_dtype = kv_cache_dtype
         p = self.policy
         self.wte = Embedding(vocab_size, d_model, policy=p)
         self.wpe = PositionalEmbedding(max_len, policy=p)
         self.drop = Dropout(dropout, policy=p)
         self.blocks = [GPTBlock(num_heads, dropout=dropout, backend=backend,
                                 moe_experts=moe_experts,
-                                num_kv_heads=self.num_kv_heads, policy=p)
+                                num_kv_heads=self.num_kv_heads,
+                                kv_cache_dtype=kv_cache_dtype, policy=p)
                        for _ in range(num_layers)]
         self.ln_f = LayerNorm(policy=p)
 
@@ -154,6 +157,8 @@ class GPT2(Module):
             cfg["moe_experts"] = self.moe_experts
         if self.num_kv_heads != self.num_heads:
             cfg["num_kv_heads"] = self.num_kv_heads
+        if self.kv_cache_dtype:
+            cfg["kv_cache_dtype"] = self.kv_cache_dtype
         return cfg
 
 

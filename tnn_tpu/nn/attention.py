@@ -220,7 +220,8 @@ class MultiHeadAttention(Module):
 
     def __init__(self, num_heads: int, causal: bool = False, dropout: float = 0.0,
                  backend: str = "xla", kernel_init: str = "xavier_uniform",
-                 num_kv_heads: Optional[int] = None, name=None, policy=None):
+                 num_kv_heads: Optional[int] = None,
+                 kv_cache_dtype: Optional[str] = None, name=None, policy=None):
         super().__init__(name=name, policy=policy)
         self.num_heads = int(num_heads)
         # grouped-query attention (beyond reference): H_kv < H shares each
@@ -230,6 +231,12 @@ class MultiHeadAttention(Module):
         if self.num_heads % self.num_kv_heads:
             raise ValueError(f"num_heads {self.num_heads} not divisible by "
                              f"num_kv_heads {self.num_kv_heads}")
+        # "int8": decode KV cache stored as per-row symmetric int8 + f32
+        # scale — halves cache residency/traffic (composes with GQA's H/H_kv)
+        if kv_cache_dtype not in (None, "int8"):
+            raise ValueError(f"kv_cache_dtype {kv_cache_dtype!r}: only "
+                             "None (compute dtype) or 'int8' supported")
+        self.kv_cache_dtype = kv_cache_dtype
         self.causal = bool(causal)
         self.dropout = float(dropout)
         self.backend = backend
@@ -293,14 +300,28 @@ class MultiHeadAttention(Module):
 
     def init_cache(self, batch: int, max_len: int, d_model: int):
         """Allocate a (k, v) ring cache for decode — sized to the KV heads,
-        so GQA shrinks the cache (and the decode HBM floor) by H/H_kv."""
+        so GQA shrinks the cache (and the decode HBM floor) by H/H_kv;
+        ``kv_cache_dtype="int8"`` halves it again (int8 rows + f32 scales)."""
         h = self.num_kv_heads
         dh = d_model // self.num_heads
+        if self.kv_cache_dtype == "int8":
+            z8 = jnp.zeros((batch, h, max_len, dh), jnp.int8)
+            zs = jnp.zeros((batch, h, max_len, 1), jnp.float32)
+            return {"k": z8, "v": z8, "k_scale": zs, "v_scale": zs}
         dtype = self.policy.compute_dtype
         return {
             "k": jnp.zeros((batch, h, max_len, dh), dtype),
             "v": jnp.zeros((batch, h, max_len, dh), dtype),
         }
+
+    @staticmethod
+    def _quant_rows(x):
+        """Symmetric per-row (per position, per head) int8: scale = amax/127."""
+        xf = x.astype(jnp.float32)
+        scale = jnp.maximum(jnp.max(jnp.abs(xf), axis=-1, keepdims=True),
+                            1e-8) / 127.0
+        q = jnp.clip(jnp.round(xf / scale), -127, 127).astype(jnp.int8)
+        return q, scale
 
     def apply_cached(self, variables, x, cache, offset):
         """Decode step: x is (N, S_new, D); cache holds keys/values for [0, offset).
@@ -310,20 +331,41 @@ class MultiHeadAttention(Module):
         """
         params = variables["params"]
         q, k_new, v_new = self._project_qkv(params, x)
-        k = jax.lax.dynamic_update_slice_in_dim(cache["k"], k_new, offset, axis=2)
-        v = jax.lax.dynamic_update_slice_in_dim(cache["v"], v_new, offset, axis=2)
+        upd = lambda buf, new: jax.lax.dynamic_update_slice_in_dim(  # noqa: E731
+            buf, new, offset, axis=2)
+        if self.kv_cache_dtype == "int8":
+            kq, ks = self._quant_rows(k_new)
+            vq, vs = self._quant_rows(v_new)
+            cache = {"k": upd(cache["k"], kq), "v": upd(cache["v"], vq),
+                     "k_scale": upd(cache["k_scale"], ks),
+                     "v_scale": upd(cache["v_scale"], vs)}
+            cd = self.policy.compute_dtype
+            # dequant at use. On the XLA backend the int8 read + scale can
+            # fuse into the attention contraction (traffic = int8 bytes); on
+            # backend="pallas" the dequantized arrays are pallas_call
+            # operands — a fusion boundary — so that path materializes
+            # compute-dtype K/V and only the RESIDENCY win remains. Pair
+            # int8 caches with the XLA decode backend for the traffic win.
+            k = (cache["k"].astype(jnp.float32) * cache["k_scale"]).astype(cd)
+            v = (cache["v"].astype(jnp.float32) * cache["v_scale"]).astype(cd)
+        else:
+            cache = {"k": upd(cache["k"], k_new), "v": upd(cache["v"], v_new)}
+            k, v = cache["k"], cache["v"]
         # decode follows the model's configured backend — a "pallas" model
         # runs the flash kernel with kv_offset instead of falling back to XLA
         out = sdpa(q, k, v, causal=True, kv_offset=offset,
                    backend=self.backend if self.backend != "ring" else "xla")
         y = self._project_out(params, out, False, None)
-        return y, {"k": k, "v": v}
+        return y, cache
 
     def output_shape(self, input_shape):
         return tuple(input_shape)
 
     def _config(self):
-        return {"num_heads": self.num_heads, "causal": self.causal,
-                "dropout": self.dropout, "backend": self.backend,
-                "num_kv_heads": self.num_kv_heads,
-                "kernel_init": initializers.name_of(self.kernel_init)}
+        cfg = {"num_heads": self.num_heads, "causal": self.causal,
+               "dropout": self.dropout, "backend": self.backend,
+               "num_kv_heads": self.num_kv_heads,
+               "kernel_init": initializers.name_of(self.kernel_init)}
+        if self.kv_cache_dtype:
+            cfg["kv_cache_dtype"] = self.kv_cache_dtype
+        return cfg

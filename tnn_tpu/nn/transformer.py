@@ -31,10 +31,11 @@ class GPTBlock(Module):
     def __init__(self, num_heads: int, mlp_ratio: int = 4, dropout: float = 0.0,
                  causal: bool = True, backend: str = "xla", activation: str = "gelu",
                  moe_experts: int = 0, moe_top_k: int = 2, num_kv_heads=None,
-                 name=None, policy=None):
+                 kv_cache_dtype=None, name=None, policy=None):
         super().__init__(name=name, policy=policy)
         self.num_heads = int(num_heads)
         self.num_kv_heads = int(num_kv_heads) if num_kv_heads else self.num_heads
+        self.kv_cache_dtype = kv_cache_dtype
         self.mlp_ratio = int(mlp_ratio)
         self.dropout = float(dropout)
         self.causal = bool(causal)
@@ -46,7 +47,8 @@ class GPTBlock(Module):
         self.ln1 = LayerNorm(policy=p)
         self.attn = MultiHeadAttention(num_heads, causal=causal, dropout=dropout,
                                        backend=backend,
-                                       num_kv_heads=self.num_kv_heads, policy=p)
+                                       num_kv_heads=self.num_kv_heads,
+                                       kv_cache_dtype=kv_cache_dtype, policy=p)
         self.ln2 = LayerNorm(policy=p)
         self.drop = Dropout(dropout, policy=p)
         self.moe = None
@@ -134,6 +136,8 @@ class GPTBlock(Module):
                "backend": self.backend, "activation": self.activation}
         if self.num_kv_heads != self.num_heads:
             cfg["num_kv_heads"] = self.num_kv_heads
+        if self.kv_cache_dtype:
+            cfg["kv_cache_dtype"] = self.kv_cache_dtype
         if self.moe_experts:
             cfg["moe_experts"] = self.moe_experts
             cfg["moe_top_k"] = self.moe_top_k

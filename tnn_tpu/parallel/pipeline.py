@@ -267,7 +267,7 @@ class HeteroPipeline:
                  input_dtype=jnp.bfloat16, num_microbatches: int = 4,
                  axis: str = "pipe", loss_fn: Optional[Callable] = None,
                  compute_accuracy: bool = True, data_axis: Optional[str] = None,
-                 remat: bool = False, virtual: int = 1):
+                 remat: "bool | str" = False, virtual: int = 1):
         from ..nn import losses as losses_lib
 
         self.stages = list(stages)
@@ -587,7 +587,7 @@ def make_pipeline_train_step(stages: Sequence, optimizer, mesh: Mesh,
                              donate: bool = True, compute_accuracy: bool = True,
                              data_axis: Optional[str] = None,
                              augment: Optional[Callable] = None,
-                             remat: bool = False, virtual: int = 1):
+                             remat: "bool | str" = False, virtual: int = 1):
     """Config-to-running-pipeline in one call (parity: the reference's
     coordinator deploy + async_train_batch + UPDATE_PARAMETERS cycle,
     coordinator.hpp:165-223, as ONE jitted program).
