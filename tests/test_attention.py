@@ -431,20 +431,43 @@ class TestGQA:
             nn.MultiHeadAttention(num_heads=6, num_kv_heads=4)
 
 
-def test_gqa_inside_ring_context_raises():
-    """GQA + sequence-parallel ring context must fail loudly, not silently
-    attend within each seq shard (wrong math)."""
+def test_gqa_ulysses_indivisible_kv_heads_raises():
+    """GQA + ulysses with H_kv not divisible by the shard count must fail
+    loudly (the kv head all-to-all cannot split), not silently attend within
+    each seq shard. Divisible H_kv proceeds; the ring method is always
+    GQA-aware (test_parallel.test_ring_attention_gqa_matches_local)."""
+    from tnn_tpu import parallel
     from tnn_tpu.nn import attention as attn_mod
 
     rs = np.random.RandomState(0)
     q = jnp.asarray(rs.randn(1, 4, 16, 8), jnp.float32)
     k = jnp.asarray(rs.randn(1, 2, 16, 8), jnp.float32)
-    attn_mod._RING_CTX["mesh"] = object()
+    mesh = parallel.make_mesh(seq=4)  # 2 kv heads cannot split over 4
+    attn_mod._RING_CTX["mesh"] = mesh
+    prev = attn_mod._RING_CTX.get("method")
+    attn_mod._RING_CTX["method"] = "ulysses"
     try:
-        with pytest.raises(NotImplementedError, match="grouped-query"):
+        with pytest.raises(NotImplementedError, match="kv heads"):
             sdpa(q, k, k, causal=True)
     finally:
         attn_mod._RING_CTX["mesh"] = None
+        attn_mod._RING_CTX["method"] = prev
+
+
+def test_gqa_ulysses_divisible_kv_heads_matches_local():
+    """H_kv % shards == 0: the ulysses kv all-to-all splits fine — verify
+    against the local GQA kernels."""
+    from tnn_tpu import parallel
+
+    rs = np.random.RandomState(1)
+    q = jnp.asarray(rs.randn(1, 4, 32, 8), jnp.float32)
+    k = jnp.asarray(rs.randn(1, 2, 32, 8), jnp.float32)
+    v = jnp.asarray(rs.randn(1, 2, 32, 8), jnp.float32)
+    mesh = parallel.make_mesh(seq=2)
+    ref = sdpa(q, k, v, causal=True)
+    out = parallel.ulysses_attention(q, k, v, mesh, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-4, atol=1e-5)
 
 
 class TestInt8KVCache:

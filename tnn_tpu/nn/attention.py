@@ -124,14 +124,21 @@ def sdpa(q, k, v, *, causal: bool = False, mask: Optional[jax.Array] = None,
     sequence — builds the correct causal mask for S_q != S_kv.
     """
     ringable = mask is None and kv_offset is None
-    if ringable and q.shape[1] != k.shape[1] and _RING_CTX["mesh"] is not None:
-        # seq-parallel context + GQA: falling through to local attention
-        # would silently attend within each seq shard only — wrong math.
-        # Fail loudly until ring/ulysses grow a grouped-kv path.
-        raise NotImplementedError(
-            "grouped-query attention (H_kv != H) inside a sequence-parallel "
-            "ring/ulysses context is not supported; use equal heads or drop "
-            "the seq axis for this model")
+    if ringable and q.shape[1] != k.shape[1] and _RING_CTX["mesh"] is not None \
+            and _RING_CTX["method"] == "ulysses":
+        from ..parallel import mesh as _mesh_lib
+
+        sp = _mesh_lib.axis_size(_RING_CTX["mesh"], _RING_CTX["axis"])
+        if k.shape[1] % sp:
+            # Ulysses all-to-alls the HEAD dim over the seq axis; H_kv not
+            # divisible by the shard count cannot split. Falling through to
+            # local attention would silently attend within each seq shard —
+            # wrong math — so fail loudly. (H_kv % sp == 0 proceeds: the kv
+            # all-to-all splits fine and is verified bit-exact.)
+            raise NotImplementedError(
+                f"grouped-query attention with {k.shape[1]} kv heads cannot "
+                f"split over {sp} ulysses shards; use "
+                "seq_parallel_method='ring' (GQA-aware) or H_kv % shards == 0")
     if _RING_CTX["mesh"] is not None and ringable:
         # context wins over the configured backend: inside a seq-parallel step
         # the activations are seq-sharded, so local/full attention would be

@@ -23,10 +23,13 @@ from . import mesh as mesh_lib
 
 
 def _ring_attention_local(q, k, v, *, axis: str, causal: bool, scale: float):
-    """Per-device body under shard_map. q/k/v: (B, H, S_local, D)."""
+    """Per-device body under shard_map. q: (B, H, S_local, D); k/v may carry
+    H_kv < H heads (GQA) — the blocks ROTATE at H_kv size (the ICI-traffic
+    win scales with the cache shrink) and repeat to H only at compute."""
     ring = jax.lax.axis_size(axis)
     idx = jax.lax.axis_index(axis)
     s_local = q.shape[-2]
+    group = q.shape[1] // k.shape[1]
 
     qpos = (idx * s_local + jnp.arange(s_local))[:, None]  # global query positions
 
@@ -41,6 +44,9 @@ def _ring_attention_local(q, k, v, *, axis: str, causal: bool, scale: float):
         """One online-softmax block update against the K/V block held after r hops."""
         # after r hops this device holds the block originally owned by (idx - r) % ring
         owner = jnp.mod(idx - r, ring)
+        if group > 1:  # GQA: broadcast kv heads at compute (XLA folds it)
+            k_blk = jnp.repeat(k_blk, group, axis=1)
+            v_blk = jnp.repeat(v_blk, group, axis=1)
         logits = jnp.einsum("bhqd,bhkd->bhqk", q, k_blk,
                             preferred_element_type=jnp.float32) * scale
         if causal:
@@ -89,5 +95,8 @@ def ring_attention(q, k, v, mesh: Mesh, *, axis: str = "seq", causal: bool = Fal
     ring = mesh_lib.axis_size(mesh, axis)
     if q.shape[-2] % ring:
         raise ValueError(f"seq len {q.shape[-2]} not divisible by ring size {ring}")
+    if q.shape[1] % k.shape[1] or v.shape[1] != k.shape[1]:
+        raise ValueError(f"q has {q.shape[1]} heads but k/v have "
+                         f"{k.shape[1]}/{v.shape[1]}; need H % H_kv == 0")
     body = functools.partial(_ring_attention_local, axis=axis, causal=causal, scale=scale)
     return mesh_lib.seq_shard_map(body, mesh, axis, batch_axis)(q, k, v)

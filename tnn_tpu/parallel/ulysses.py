@@ -79,5 +79,14 @@ def ulysses_attention(q, k, v, mesh: Mesh, *, axis: str = "seq",
         raise ValueError(
             f"num_heads {heads} not divisible by sp size {sp} — Ulysses shards "
             f"heads during attention; use ring_attention for this layout")
+    if v.shape[1] != k.shape[1] or heads % k.shape[1]:
+        raise ValueError(f"q has {heads} heads but k/v have "
+                         f"{k.shape[1]}/{v.shape[1]}; need H % H_kv == 0")
+    if k.shape[1] % sp:
+        # the kv all-to-all splits the head dim over the seq axis; fewer kv
+        # heads than shards cannot split (GQA-aware ring_attention can)
+        raise ValueError(
+            f"kv heads {k.shape[1]} not divisible by sp size {sp} — use "
+            f"ring_attention (GQA-aware) for this layout")
     body = functools.partial(_ulysses_local, axis=axis, causal=causal, scale=scale)
     return mesh_lib.seq_shard_map(body, mesh, axis, batch_axis)(q, k, v)
