@@ -447,7 +447,7 @@ def test_gqa_ulysses_indivisible_kv_heads_raises():
     prev = attn_mod._RING_CTX.get("method")
     attn_mod._RING_CTX["method"] = "ulysses"
     try:
-        with pytest.raises(NotImplementedError, match="kv heads"):
+        with pytest.raises(ValueError, match="kv heads"):
             sdpa(q, k, k, causal=True)
     finally:
         attn_mod._RING_CTX["mesh"] = None

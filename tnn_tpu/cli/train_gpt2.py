@@ -45,6 +45,9 @@ def main(argv=None):
     ap.add_argument("--layers", type=int, default=4)
     ap.add_argument("--d-model", type=int, default=256)
     ap.add_argument("--heads", type=int, default=8)
+    ap.add_argument("--kv-heads", type=int, default=0,
+                    help="grouped-query attention: KV heads (< --heads, "
+                         "divisor); 0 = full MHA")
     ap.add_argument("--lr", type=float, default=3e-4)
     ap.add_argument("--backend", default="xla", choices=["xla", "pallas"],
                     help="attention backend (pallas = the flash kernel)")
@@ -81,7 +84,7 @@ def main(argv=None):
 
     model = GPT2(vocab_size=vocab, max_len=args.seq, num_layers=args.layers,
                  d_model=args.d_model, num_heads=args.heads, dropout=0.0,
-                 backend=args.backend)
+                 backend=args.backend, num_kv_heads=args.kv_heads or None)
     opt = nn.AdamW(lr=args.lr, weight_decay=0.01, grad_clip_norm=1.0)
     sched = nn.WarmupCosineAnnealing(warmup=max(10, total_steps // 20),
                                      t_max=total_steps)

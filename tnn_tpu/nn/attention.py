@@ -123,22 +123,10 @@ def sdpa(q, k, v, *, causal: bool = False, mask: Optional[jax.Array] = None,
     ``kv_offset``: during cached decode, absolute position of q[0] within the kv
     sequence — builds the correct causal mask for S_q != S_kv.
     """
+    # GQA + seq parallelism: ring is GQA-aware for any group ratio; ulysses
+    # validates H_kv % shards itself (ulysses_attention raises a ValueError
+    # naming the ring fallback when kv heads cannot split)
     ringable = mask is None and kv_offset is None
-    if ringable and q.shape[1] != k.shape[1] and _RING_CTX["mesh"] is not None \
-            and _RING_CTX["method"] == "ulysses":
-        from ..parallel import mesh as _mesh_lib
-
-        sp = _mesh_lib.axis_size(_RING_CTX["mesh"], _RING_CTX["axis"])
-        if k.shape[1] % sp:
-            # Ulysses all-to-alls the HEAD dim over the seq axis; H_kv not
-            # divisible by the shard count cannot split. Falling through to
-            # local attention would silently attend within each seq shard —
-            # wrong math — so fail loudly. (H_kv % sp == 0 proceeds: the kv
-            # all-to-all splits fine and is verified bit-exact.)
-            raise NotImplementedError(
-                f"grouped-query attention with {k.shape[1]} kv heads cannot "
-                f"split over {sp} ulysses shards; use "
-                "seq_parallel_method='ring' (GQA-aware) or H_kv % shards == 0")
     if _RING_CTX["mesh"] is not None and ringable:
         # context wins over the configured backend: inside a seq-parallel step
         # the activations are seq-sharded, so local/full attention would be
@@ -235,9 +223,9 @@ class MultiHeadAttention(Module):
         # kv head across a group of query heads, shrinking the decode KV
         # cache (the decode bandwidth floor) by H/H_kv
         self.num_kv_heads = int(num_kv_heads) if num_kv_heads else self.num_heads
-        if self.num_heads % self.num_kv_heads:
-            raise ValueError(f"num_heads {self.num_heads} not divisible by "
-                             f"num_kv_heads {self.num_kv_heads}")
+        if self.num_kv_heads <= 0 or self.num_heads % self.num_kv_heads:
+            raise ValueError(f"num_kv_heads {self.num_kv_heads} must be a "
+                             f"positive divisor of num_heads {self.num_heads}")
         # "int8": decode KV cache stored as per-row symmetric int8 + f32
         # scale — halves cache residency/traffic (composes with GQA's H/H_kv)
         if kv_cache_dtype not in (None, "int8"):
