@@ -15,7 +15,9 @@ echo "== 1/8 headline bench (persists on success) =="
 python bench.py | tee "benchmarks/results/headline_${STAMP}.jsonl"
 
 echo "== 2/8 full microbench + model suite (incl. moe + int8 decode rows) =="
-timeout 2400 python -m benchmarks.run_all --json "benchmarks/results/run_all_tpu_${STAMP}.json"
+# budget sized for the round-5 row additions (hd128/gqa/same-config twins/
+# long-prompt cache A/Bs); the compile cache amortizes repeats
+timeout 3600 python -m benchmarks.run_all --json "benchmarks/results/run_all_tpu_${STAMP}.json"
 
 echo "== 3/8 GPT-2 LM on real tokens, Pallas flash attention backend =="
 if [ ! -f /tmp/pytok/meta.json ]; then
