@@ -11,13 +11,28 @@ STAMP=$(date +%Y%m%d_%H%M%S)
 # repeat stages within this script) skip the 20-40s first-compile each time
 export JAX_COMPILATION_CACHE_DIR="${JAX_COMPILATION_CACHE_DIR:-/tmp/jax_cache}"
 
+# Commit after EVERY stage: a relay that comes up late in the round may not
+# survive the full capture, and the driver snapshots whatever is committed —
+# partial evidence must not die with the script.
+checkpoint_evidence() {
+  # pathspec-restricted: never sweep unrelated staged files into an evidence
+  # commit; label says "after <stage>" — it records the attempt (bench.py
+  # error records are themselves evidence), not a success claim
+  git add benchmarks/results/ 2>/dev/null
+  git commit -q -m "TPU evidence checkpoint after: $1"       -- benchmarks/results/ 2>/dev/null || true
+}
+
 echo "== 1/8 headline bench (persists on success) =="
 python bench.py | tee "benchmarks/results/headline_${STAMP}.jsonl"
+
+checkpoint_evidence "headline bench"
 
 echo "== 2/8 full microbench + model suite (incl. moe + int8 decode rows) =="
 # budget sized for the round-5 row additions (hd128/gqa/same-config twins/
 # long-prompt cache A/Bs); the compile cache amortizes repeats
 timeout 3600 python -m benchmarks.run_all --json "benchmarks/results/run_all_tpu_${STAMP}.json"
+
+checkpoint_evidence "run_all microbench + model suite"
 
 echo "== 3/8 GPT-2 LM on real tokens, Pallas flash attention backend =="
 if [ ! -f /tmp/pytok/meta.json ]; then
@@ -26,6 +41,8 @@ if [ ! -f /tmp/pytok/meta.json ]; then
 fi
 timeout 1800 python -m tnn_tpu.cli.train_gpt2 --tokens /tmp/pytok --steps 200 \
     --batch 16 --seq 512 --backend pallas --results benchmarks/results
+
+checkpoint_evidence "real-token LM pallas run"
 
 echo "== 3b/8 real-token cliff A/B: 1 dispatch/step vs 16 steps/dispatch =="
 # round-4 weak #3: tiny-model real-token training ran 4x slower than the
@@ -43,6 +60,8 @@ timeout 900 python -m tnn_tpu.cli.train_gpt2 --tokens /tmp/pytok --steps 96 \
   cp /tmp/spc16_out/lm_gpt2_byte_xla.json \
      "benchmarks/results/lm_spc16_${STAMP}.json"
 
+checkpoint_evidence "steps-per-call dispatch A/B"
+
 echo "== 3c/8 fused-vs-split flash backward A/B at S=8192/16384 =="
 # round-5 kernel: single-pass backward (5 matmuls/tile vs 7). Same harness,
 # env-gated, so the pair is apples-to-apples.
@@ -57,6 +76,8 @@ TNN_FLASH_FUSED_BWD=0 timeout 1200 python -m benchmarks.ops_bench \
         "benchmarks/results/flash_split_bwd_${STAMP}.log" \
   || echo "split flash bench failed; log at /tmp/flash_split_${STAMP}.log"
 
+checkpoint_evidence "fused-vs-split flash backward A/B"
+
 echo "== 4/8 GPT-2 medium + large chip rows (train w/ remat, decode, int8) =="
 # stage to /tmp first: a failed/partial log must never be swept into the
 # evidence dir by the final git add -A
@@ -67,6 +88,8 @@ else
   echo "gpt2 m/l bench failed; log kept at /tmp/gpt2_ml_${STAMP}.log"
 fi
 
+checkpoint_evidence "gpt2 medium/large rows"
+
 echo "== 4b/8 long-context S=8192 train rows (full remat vs dots policy) =="
 # own budget: a timeout here must not take the medium/large rows with it
 if timeout 1200 python -m benchmarks.model_bench \
@@ -76,6 +99,8 @@ else
   echo "gpt2_long bench failed; log kept at /tmp/gpt2_long_${STAMP}.log"
 fi
 
+checkpoint_evidence "long-context remat A/B rows"
+
 echo "== 5/8 HBM-fit table (exact state bytes via eval_shape) =="
 if python -m tools.hbm_fit > "/tmp/hbm_fit_${STAMP}.txt" 2>&1; then
   cp "/tmp/hbm_fit_${STAMP}.txt" "benchmarks/results/hbm_fit_${STAMP}.txt"
@@ -83,6 +108,8 @@ if python -m tools.hbm_fit > "/tmp/hbm_fit_${STAMP}.txt" 2>&1; then
 else
   echo "hbm_fit failed; log kept at /tmp/hbm_fit_${STAMP}.txt"
 fi
+
+checkpoint_evidence "hbm fit table"
 
 echo "== 6/8 on-chip convergence curve: WRN-16-8 on REAL handwritten digits =="
 # the offline stand-in for the reference's CIFAR-100 accuracy logs
@@ -98,6 +125,8 @@ else
   echo "digits convergence run failed; log at /tmp/digits_curve_${STAMP}.json"
 fi
 
+checkpoint_evidence "digits convergence curve"
+
 echo "== 7/8 flash-attention block sweeps (promote winners if any) =="
 timeout 1200 python -m benchmarks.flash_tune --seq 1024 --seq 512 \
     > "/tmp/flash_tune_${STAMP}.log" 2>&1 \
@@ -112,7 +141,11 @@ timeout 1800 python -m benchmarks.flash_tune --seq 8192 --batch 1 --bwd \
         "benchmarks/results/flash_tune_bwd_${STAMP}.log" \
   || echo "bwd sweep failed; log at /tmp/flash_tune_bwd_${STAMP}.log"
 
-echo "== 8/8 commit the evidence =="
-git add -A benchmarks/results/
-git commit -m "TPU benchmark evidence: headline, microbench suite, LM curve, gpt2 m/l rows" || true
+checkpoint_evidence "flash block sweeps"
+
+echo "== 8/8 final catch-all commit =="
+# per-stage checkpoints above carry the evidence; this sweeps anything
+# written after the last checkpoint
+git add benchmarks/results/
+git commit -q -m "TPU evidence capture: final artifacts"     -- benchmarks/results/ || true
 echo "done"
