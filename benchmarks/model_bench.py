@@ -127,6 +127,25 @@ def bench_gpt2_long_train(batch: int = 1, seq: int = 8192, iters: int = 10,
                             extra={"seq": seq, "remat": remat})
 
 
+def bench_llama_train(batch: int, seq: int, iters: int):
+    from tnn_tpu import models, nn
+    from tnn_tpu.train import create_train_state, make_train_step
+
+    name = "flash_llama_small" if jax.default_backend() == "tpu" \
+        else "llama_small"
+    print(f"{name} train step (bs={batch}, S={seq})")
+    model = models.create(name, max_len=max(seq, 512))
+    opt = nn.AdamW(lr=1e-4)
+    state = create_train_state(model, opt, jax.random.PRNGKey(0), (batch, seq))
+    step = make_train_step(model, opt)
+    rs = np.random.RandomState(0)
+    ids = jnp.asarray(rs.randint(0, model.vocab_size, (batch, seq)), np.int32)
+    dt = _time_steps(step, state, ids, ids, iters)
+    flops = 6.0 * _count_params(state.params) * batch * seq
+    return report(f"{name}_train", dt, flops=flops, items=batch * seq,
+                  item_name="tok", extra={"kv_heads": model.num_kv_heads})
+
+
 def bench_gpt2_decode(batch: int, prompt: int, new: int, size="small",
                       int8: bool = False, fused: bool = False,
                       kv_cache: str = ""):
@@ -191,7 +210,8 @@ def main(argv=None):
     ap = argparse.ArgumentParser()
     ap.add_argument("--quick", action="store_true")
     ap.add_argument("--models", default="wrn,resnet9,vit,gpt2,gpt2_flash,moe,"
-                                        "gqa,decode,decode_int8,decode_fused")
+                                        "gqa,llama,decode,decode_int8,"
+                                        "decode_fused")
     args = ap.parse_args(argv)
     q = args.quick
     wanted = set(args.models.split(","))
@@ -246,6 +266,11 @@ def main(argv=None):
         if not q:
             add(lambda: bench_gpt2_train(8, 512, 10, size="small_gqa4",
                                          extra={"kv_heads": 4}))
+    if "llama" in wanted:
+        # modern decoder family (RoPE + RMSNorm + SwiGLU + GQA) — beyond the
+        # reference's GPT-2-only transformer story
+        add(lambda: bench_llama_train(2 if q else 8, 128 if q else 512,
+                                      3 if q else 10))
     if "moe" in wanted:
         # expert-routed FFN variant; MFU on active params (VERDICT r03 #4)
         add(lambda: bench_gpt2_train(2 if q else 8, 128 if q else 512,

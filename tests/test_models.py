@@ -123,3 +123,114 @@ def test_gpt2_trains_one_step(rng):
     # next-token: input ids, labels shifted
     state, m = step(state, ids, jnp.roll(ids, -1, axis=1))
     assert np.isfinite(float(m["loss"]))
+
+
+class TestRoPE:
+    def test_rope_rotation_preserves_norm_and_offset_consistency(self):
+        from tnn_tpu.nn.attention import apply_rope
+
+        rs = np.random.RandomState(0)
+        x = jnp.asarray(rs.randn(1, 2, 8, 16), jnp.float32)
+        r = apply_rope(x, 0)
+        # rotation preserves per-pair norms
+        np.testing.assert_allclose(np.linalg.norm(np.asarray(x), axis=-1),
+                                   np.linalg.norm(np.asarray(r), axis=-1),
+                                   rtol=1e-5)
+        # position 0 is the identity rotation
+        np.testing.assert_allclose(np.asarray(r[..., 0, :]),
+                                   np.asarray(x[..., 0, :]), rtol=1e-6)
+        # offset=t on a length-1 slice equals position t of the full pass
+        r3 = apply_rope(x[..., 3:4, :], 3)
+        np.testing.assert_allclose(np.asarray(r3[..., 0, :]),
+                                   np.asarray(r[..., 3, :]), rtol=1e-5,
+                                   atol=1e-6)
+
+    def test_rope_attention_is_shift_invariant(self):
+        """The defining property: attention logits depend only on RELATIVE
+        positions, so shifting q and k by the same offset leaves q.k^T
+        unchanged."""
+        from tnn_tpu.nn.attention import apply_rope
+
+        rs = np.random.RandomState(1)
+        q = jnp.asarray(rs.randn(1, 1, 6, 16), jnp.float32)
+        k = jnp.asarray(rs.randn(1, 1, 6, 16), jnp.float32)
+        dots0 = jnp.einsum("bhqd,bhkd->bhqk", apply_rope(q, 0),
+                           apply_rope(k, 0))
+        dots7 = jnp.einsum("bhqd,bhkd->bhqk", apply_rope(q, 7),
+                           apply_rope(k, 7))
+        np.testing.assert_allclose(np.asarray(dots0), np.asarray(dots7),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_odd_head_dim_raises(self):
+        from tnn_tpu.nn.attention import apply_rope
+
+        with pytest.raises(ValueError, match="even"):
+            apply_rope(jnp.zeros((1, 1, 4, 7)), 0)
+
+
+class TestLlama:
+    def _tiny(self, **kw):
+        from tnn_tpu.models.llama import Llama
+
+        return Llama(vocab_size=64, max_len=16, num_layers=2, d_model=32,
+                     num_heads=4, num_kv_heads=2, **kw)
+
+    @pytest.mark.parametrize("backend", ["xla", "pallas"])
+    def test_cached_decode_matches_full(self, backend):
+        """RoPE offsets through the KV cache: stitched cached logits must
+        equal the full forward (the rotation is position-absolute)."""
+        m = self._tiny(backend=backend)
+        v = m.init(jax.random.PRNGKey(0), (1, 8))
+        ids = jnp.asarray(np.random.RandomState(2).randint(0, 64, (1, 8)),
+                          jnp.int32)
+        full, _ = m.apply(v, ids, train=False)
+        caches = m.init_cache(1, 8)
+        out, caches = m.apply_cached(v["params"], ids[:, :5], caches, 0)
+        outs = [out]
+        for t in range(5, 8):
+            o, caches = m.apply_cached(v["params"], ids[:, t:t + 1], caches, t)
+            outs.append(o)
+        got = jnp.concatenate(outs, axis=1)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(full),
+                                   rtol=2e-2, atol=2e-3)
+
+    def test_causality(self):
+        m = self._tiny()
+        v = m.init(jax.random.PRNGKey(0), (1, 8))
+        ids = jnp.asarray(np.random.RandomState(3).randint(0, 64, (1, 8)),
+                          jnp.int32)
+        a, _ = m.apply(v, ids, train=False)
+        b, _ = m.apply(v, ids.at[:, 6:].set(0), train=False)
+        np.testing.assert_allclose(np.asarray(a[:, :6]), np.asarray(b[:, :6]),
+                                   rtol=1e-4, atol=1e-4)
+
+    def test_config_roundtrip(self):
+        from tnn_tpu.core.module import module_from_config
+
+        m = self._tiny(kv_cache_dtype="int8")
+        m2 = module_from_config(m.get_config())
+        assert (m2.num_kv_heads, m2.mlp_hidden, m2.rope_theta,
+                m2.kv_cache_dtype) == (2, m.mlp_hidden, 10000.0, "int8")
+        assert not m2.blocks[0].attn.use_bias
+
+    def test_no_bias_and_no_wpe_params(self):
+        m = self._tiny()
+        v = m.init(jax.random.PRNGKey(0), (1, 8))
+        flat = jax.tree_util.tree_flatten_with_path(v["params"])[0]
+        keys = ["/".join(str(k) for k in path) for path, _ in flat]
+        assert not any("bias" in k for k in keys)
+        assert not any("wpe" in k for k in keys)
+
+    def test_chunked_lm_head_loss_path(self):
+        from tnn_tpu import nn
+        from tnn_tpu.train import create_train_state, make_train_step
+
+        m = self._tiny()
+        opt = nn.AdamW(lr=1e-3)
+        st = create_train_state(m, opt, jax.random.PRNGKey(0), (2, 8))
+        step = make_train_step(m, opt, compute_accuracy=False,
+                               lm_head_chunk=32)
+        ids = jnp.asarray(np.random.RandomState(4).randint(0, 64, (2, 8)),
+                          jnp.int32)
+        st, mt = step(st, ids, ids)
+        assert np.isfinite(float(mt["loss"]))

@@ -1,6 +1,7 @@
-from . import gpt2, resnet, vit, zoo
+from . import gpt2, llama, resnet, vit, zoo
 from .gpt2 import GPT2, generate
+from .llama import Llama
 from .vit import ViT
 from .zoo import create, names
 
-__all__ = ["gpt2", "resnet", "vit", "zoo", "GPT2", "generate", "ViT", "create", "names"]
+__all__ = ["gpt2", "llama", "resnet", "vit", "zoo", "GPT2", "Llama", "generate", "ViT", "create", "names"]

@@ -9,6 +9,7 @@ from __future__ import annotations
 from typing import Callable, Dict, Optional, Sequence
 
 from . import gpt2 as gpt2_lib
+from . import llama as llama_lib
 from . import resnet, vit
 
 _REGISTRY: Dict[str, Callable] = {}
@@ -66,6 +67,10 @@ register("flash_gpt2_small_hd128")(
 register("gpt2_small_gqa4")(lambda **kw: gpt2_lib.gpt2_small_gqa4(**kw))
 register("flash_gpt2_small_gqa4")(
     lambda **kw: gpt2_lib.gpt2_small_gqa4(backend="pallas", **kw))
+register("llama_small")(lambda **kw: llama_lib.llama_small(**kw))
+register("flash_llama_small")(
+    lambda **kw: llama_lib.llama_small(backend="pallas", **kw))
+register("llama_1b")(lambda **kw: llama_lib.llama_1b(**kw))
 register("gpt2_medium")(lambda **kw: gpt2_lib.gpt2_medium(**kw))
 register("gpt2_large")(lambda **kw: gpt2_lib.gpt2_large(**kw))
 register("flash_gpt2_small")(lambda **kw: gpt2_lib.gpt2_small(backend="pallas", **kw))

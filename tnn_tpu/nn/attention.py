@@ -159,6 +159,26 @@ def sdpa(q, k, v, *, causal: bool = False, mask: Optional[jax.Array] = None,
                                kv_offset=kv_offset)
 
 
+def apply_rope(x, offset=0, theta: float = 10000.0):
+    """Rotary position embedding over (B, H, S, Dh) — half-split (NeoX-style)
+    pair rotation. ``offset`` is the absolute position of x[..., 0, :] (the
+    cached-decode case); may be a traced scalar. Rotation is a function of
+    ABSOLUTE position, so cached decode rotates keys at insert time and the
+    cache stores rotated keys."""
+    d = x.shape[-1]
+    if d % 2:
+        raise ValueError(f"RoPE needs an even head dim, got {d}")
+    half = d // 2
+    pos = offset + jnp.arange(x.shape[-2])
+    inv = theta ** (-jnp.arange(half, dtype=jnp.float32) / half)
+    freqs = pos[:, None].astype(jnp.float32) * inv[None, :]   # (S, half)
+    cos, sin = jnp.cos(freqs), jnp.sin(freqs)
+    x1 = x[..., :half].astype(jnp.float32)
+    x2 = x[..., half:].astype(jnp.float32)
+    out = jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
+    return out.astype(x.dtype)
+
+
 def local_xla_attention(q, k, v, *, causal: bool = False,
                         mask: Optional[jax.Array] = None,
                         scale: Optional[float] = None,
@@ -216,7 +236,9 @@ class MultiHeadAttention(Module):
     def __init__(self, num_heads: int, causal: bool = False, dropout: float = 0.0,
                  backend: str = "xla", kernel_init: str = "xavier_uniform",
                  num_kv_heads: Optional[int] = None,
-                 kv_cache_dtype: Optional[str] = None, name=None, policy=None):
+                 kv_cache_dtype: Optional[str] = None,
+                 rope_theta: Optional[float] = None, use_bias: bool = True,
+                 name=None, policy=None):
         super().__init__(name=name, policy=policy)
         self.num_heads = int(num_heads)
         # grouped-query attention (beyond reference): H_kv < H shares each
@@ -232,6 +254,12 @@ class MultiHeadAttention(Module):
             raise ValueError(f"kv_cache_dtype {kv_cache_dtype!r}: only "
                              "None (compute dtype) or 'int8' supported")
         self.kv_cache_dtype = kv_cache_dtype
+        # rotary position embedding (Llama-family): applied to q/k after the
+        # projection split; absolute-position offsets flow through cached
+        # decode. None = no rotation (positions come from elsewhere, e.g. a
+        # learned wpe as in GPT-2).
+        self.rope_theta = float(rope_theta) if rope_theta else None
+        self.use_bias = bool(use_bias)
         self.causal = bool(causal)
         self.dropout = float(dropout)
         self.backend = backend
@@ -250,10 +278,11 @@ class MultiHeadAttention(Module):
         pd = self.policy.param_dtype
         params = {
             "qkv_kernel": init(k1, (d, d + 2 * kv_d), pd),
-            "qkv_bias": jnp.zeros((d + 2 * kv_d,), pd),
             "out_kernel": init(k2, (d, d), pd),
-            "out_bias": jnp.zeros((d,), pd),
         }
+        if self.use_bias:
+            params["qkv_bias"] = jnp.zeros((d + 2 * kv_d,), pd)
+            params["out_bias"] = jnp.zeros((d,), pd)
         return params, {}
 
     def _split_heads(self, x, h=None):
@@ -270,7 +299,9 @@ class MultiHeadAttention(Module):
 
         x = self.policy.cast_in(x)
         w = self.policy.cast_param(params["qkv_kernel"])
-        qkv = qmatmul(x, w).astype(x.dtype) + params["qkv_bias"].astype(x.dtype)
+        qkv = qmatmul(x, w).astype(x.dtype)
+        if self.use_bias:
+            qkv = qkv + params["qkv_bias"].astype(x.dtype)
         d = x.shape[-1]
         kv_d = (d // self.num_heads) * self.num_kv_heads
         q, k, v = jnp.split(qkv, [d, d + kv_d], axis=-1)
@@ -282,12 +313,17 @@ class MultiHeadAttention(Module):
 
         y = self._merge_heads(attn)
         w = self.policy.cast_param(params["out_kernel"])
-        y = qmatmul(y, w).astype(y.dtype) + params["out_bias"].astype(y.dtype)
+        y = qmatmul(y, w).astype(y.dtype)
+        if self.use_bias:
+            y = y + params["out_bias"].astype(y.dtype)
         y, _ = self._drop.apply({}, y, train=train, rng=rng)
         return self.policy.cast_out(y)
 
     def _apply(self, params, state, x, *, train, rng):
         q, k, v = self._project_qkv(params, x)
+        if self.rope_theta:
+            q = apply_rope(q, 0, self.rope_theta)
+            k = apply_rope(k, 0, self.rope_theta)
         attn = sdpa(q, k, v, causal=self.causal, backend=self.backend)
         return self._project_out(params, attn, train, rng), state
 
@@ -326,6 +362,11 @@ class MultiHeadAttention(Module):
         """
         params = variables["params"]
         q, k_new, v_new = self._project_qkv(params, x)
+        if self.rope_theta:
+            # rotation depends on ABSOLUTE position: rotate q and the new
+            # keys at their true offsets; the cache stores rotated keys
+            q = apply_rope(q, offset, self.rope_theta)
+            k_new = apply_rope(k_new, offset, self.rope_theta)
         upd = lambda buf, new: jax.lax.dynamic_update_slice_in_dim(  # noqa: E731
             buf, new, offset, axis=2)
         if self.kv_cache_dtype == "int8":
@@ -363,4 +404,8 @@ class MultiHeadAttention(Module):
                "kernel_init": initializers.name_of(self.kernel_init)}
         if self.kv_cache_dtype:
             cfg["kv_cache_dtype"] = self.kv_cache_dtype
+        if self.rope_theta:
+            cfg["rope_theta"] = self.rope_theta
+        if not self.use_bias:
+            cfg["use_bias"] = False
         return cfg
