@@ -1,5 +1,5 @@
 #!/usr/bin/env python
-"""Train a GPT-2 language model on a real token stream, end to end.
+"""Train a decoder LM (GPT-2 or Llama family, --arch) on a real token stream.
 
 Parity-and-beyond: the reference trains its conv models but only INFERS with
 GPT-2 (examples/gpt2_inference.cpp); this drives the full LM training loop —
@@ -48,6 +48,9 @@ def main(argv=None):
     ap.add_argument("--kv-heads", type=int, default=0,
                     help="grouped-query attention: KV heads (< --heads, "
                          "divisor); 0 = full MHA")
+    ap.add_argument("--arch", default="gpt2", choices=["gpt2", "llama"],
+                    help="decoder family: gpt2 (learned positions, GELU MLP) "
+                         "or llama (RoPE + RMSNorm + SwiGLU)")
     ap.add_argument("--lr", type=float, default=3e-4)
     ap.add_argument("--backend", default="xla", choices=["xla", "pallas"],
                     help="attention backend (pallas = the flash kernel)")
@@ -82,9 +85,17 @@ def main(argv=None):
               f"({n_calls} dispatches x {spc} steps); pass --steps-per-call 1 "
               "or a divisor of --steps for the exact count")
 
-    model = GPT2(vocab_size=vocab, max_len=args.seq, num_layers=args.layers,
-                 d_model=args.d_model, num_heads=args.heads, dropout=0.0,
-                 backend=args.backend, num_kv_heads=args.kv_heads or None)
+    if args.arch == "llama":
+        from tnn_tpu.models.llama import Llama
+
+        model = Llama(vocab_size=vocab, max_len=args.seq,
+                      num_layers=args.layers, d_model=args.d_model,
+                      num_heads=args.heads, backend=args.backend,
+                      num_kv_heads=args.kv_heads or None)
+    else:
+        model = GPT2(vocab_size=vocab, max_len=args.seq, num_layers=args.layers,
+                     d_model=args.d_model, num_heads=args.heads, dropout=0.0,
+                     backend=args.backend, num_kv_heads=args.kv_heads or None)
     opt = nn.AdamW(lr=args.lr, weight_decay=0.01, grad_clip_norm=1.0)
     sched = nn.WarmupCosineAnnealing(warmup=max(10, total_steps // 20),
                                      t_max=total_steps)
@@ -114,7 +125,7 @@ def main(argv=None):
     train_secs = time.time() - t0
     tok_s = total_steps * args.batch * args.seq / train_secs
 
-    out = {"metric": "gpt2_bytes_lm", "backend": args.backend,
+    out = {"metric": f"{args.arch}_bytes_lm", "backend": args.backend,
            # a CPU curve must never masquerade as chip numbers
            "platform": jax.devices()[0].platform,
            "model": {"layers": args.layers, "d_model": args.d_model,
@@ -141,7 +152,11 @@ def main(argv=None):
     if args.sample > 0 and meta["mode"] == "byte":
         d, _ = val_loader.random_windows(1, rng) if val_loader is not None \
             else train_loader.random_windows(1, rng)
-        prompt = jnp.asarray(d[:, :32], jnp.int32)
+        # prompt + new tokens must fit the context; shrink the prompt (and,
+        # at tiny --seq, the sample) rather than erroring out of the run
+        args.sample = min(args.sample, args.seq - 1)
+        prompt_len = min(32, args.seq - args.sample)
+        prompt = jnp.asarray(d[:, :prompt_len], jnp.int32)
         t0 = time.time()
         toks = np.asarray(generate(model, state.params, prompt, args.sample,
                                    temperature=0.8, max_len=args.seq))
@@ -155,7 +170,7 @@ def main(argv=None):
 
     os.makedirs(args.results, exist_ok=True)
     path = os.path.join(args.results,
-                        f"lm_gpt2_{meta['mode']}_{args.backend}.json")
+                        f"lm_{args.arch}_{meta['mode']}_{args.backend}.json")
     with open(path, "w") as f:
         json.dump(out, f, indent=2)
     print("results ->", path)
