@@ -85,17 +85,16 @@ def main(argv=None):
               f"({n_calls} dispatches x {spc} steps); pass --steps-per-call 1 "
               "or a divisor of --steps for the exact count")
 
+    model_kw = dict(vocab_size=vocab, max_len=args.seq,
+                    num_layers=args.layers, d_model=args.d_model,
+                    num_heads=args.heads, backend=args.backend,
+                    num_kv_heads=args.kv_heads or None)
     if args.arch == "llama":
         from tnn_tpu.models.llama import Llama
 
-        model = Llama(vocab_size=vocab, max_len=args.seq,
-                      num_layers=args.layers, d_model=args.d_model,
-                      num_heads=args.heads, backend=args.backend,
-                      num_kv_heads=args.kv_heads or None)
+        model = Llama(**model_kw)
     else:
-        model = GPT2(vocab_size=vocab, max_len=args.seq, num_layers=args.layers,
-                     d_model=args.d_model, num_heads=args.heads, dropout=0.0,
-                     backend=args.backend, num_kv_heads=args.kv_heads or None)
+        model = GPT2(dropout=0.0, **model_kw)
     opt = nn.AdamW(lr=args.lr, weight_decay=0.01, grad_clip_norm=1.0)
     sched = nn.WarmupCosineAnnealing(warmup=max(10, total_steps // 20),
                                      t_max=total_steps)

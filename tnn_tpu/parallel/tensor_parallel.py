@@ -30,6 +30,11 @@ DEFAULT_TP_RULES: List[Tuple[str, P]] = [
     (r".*fc/bias$", P("model")),
     (r".*proj/kernel$", P("model", None)),
     (r".*proj/bias$", P()),
+    # Llama SwiGLU MLP: gate/up column-parallel, down row-parallel — the
+    # silu(gate) * up product stays shard-local, one all-reduce after down
+    (r".*gate/kernel$", P(None, "model")),
+    (r".*up/kernel$", P(None, "model")),
+    (r".*down/kernel$", P("model", None)),
     (r".*wte/table$", P("model", None)),
     (r".*embedding/table$", P("model", None)),
 ]
