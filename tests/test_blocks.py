@@ -134,3 +134,18 @@ def test_layer_builder_transformer(rng):
     v = model.init(rng, (2, 16), input_dtype=jnp.int32)
     y = model(v, jnp.zeros((2, 16), jnp.int32))
     assert y.shape == (2, 16, 100)
+
+
+def test_layer_builder_llama_block(rng):
+    """Builder DSL entry for the Llama-family block (beyond reference)."""
+    import jax.numpy as jnp
+
+    from tnn_tpu.nn.builder import LayerBuilder
+
+    model = (LayerBuilder((8, 32), policy=F32)
+             .llama_block(4, 64, num_kv_heads=2)
+             .llama_block(4, 64, num_kv_heads=2)
+             .build(name="builder_llama"))
+    v = model.init(rng, (2, 8, 32), input_dtype=jnp.float32)
+    y = model(v, jnp.zeros((2, 8, 32), jnp.float32))
+    assert y.shape == (2, 8, 32)

@@ -757,3 +757,12 @@ def test_tp_llama_matches_single_device(rng):
     gate_specs = [s for path, s in flat
                   if "gate" in "/".join(str(p) for p in path)]
     assert gate_specs and all("model" in str(s) for s in gate_specs)
+    # the MoE ROUTER gate must NOT be captured by the SwiGLU gate rule --
+    # it replicates (nn/moe.py ep_rules invariant)
+    moe_model = models.create("moe_gpt2_small", max_len=16)
+    mv = moe_model.init(jax.random.PRNGKey(0), (1, 8))
+    moe_specs = parallel.tensor_parallel.spec_tree(mv["params"])
+    for path, s in jax.tree_util.tree_flatten_with_path(moe_specs)[0]:
+        key = "/".join(str(p) for p in path)
+        if "moe" in key and "gate" in key:
+            assert "model" not in str(s), (key, s)

@@ -149,3 +149,14 @@ class LayerBuilder:
     def encoder_block(self, num_heads, mlp_ratio=4, dropout=0.0):
         return self._add(EncoderBlock(num_heads, mlp_ratio=mlp_ratio, dropout=dropout,
                                       policy=self.policy))
+
+    def llama_block(self, num_heads, mlp_hidden, num_kv_heads=None,
+                    rope_theta=10000.0, backend="xla"):
+        """Llama-family block (beyond reference): pre-RMSNorm, RoPE+GQA
+        attention, bias-free SwiGLU MLP."""
+        from ..models.llama import LlamaBlock
+
+        return self._add(LlamaBlock(num_heads, mlp_hidden,
+                                    num_kv_heads=num_kv_heads,
+                                    rope_theta=rope_theta, backend=backend,
+                                    policy=self.policy))

@@ -31,8 +31,10 @@ DEFAULT_TP_RULES: List[Tuple[str, P]] = [
     (r".*proj/kernel$", P("model", None)),
     (r".*proj/bias$", P()),
     # Llama SwiGLU MLP: gate/up column-parallel, down row-parallel — the
-    # silu(gate) * up product stays shard-local, one all-reduce after down
-    (r".*gate/kernel$", P(None, "model")),
+    # silu(gate) * up product stays shard-local, one all-reduce after down.
+    # The lookbehind keeps the MoE ROUTER gate (".../moe/gate/kernel") out:
+    # it must replicate (nn/moe.py ep_rules invariant).
+    (r".*(?<!moe/)gate/kernel$", P(None, "model")),
     (r".*up/kernel$", P(None, "model")),
     (r".*down/kernel$", P("model", None)),
     (r".*wte/table$", P("model", None)),
