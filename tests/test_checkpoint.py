@@ -117,6 +117,26 @@ class TestFullCheckpoint:
                         jax.tree_util.tree_leaves(state_cont.params)):
             np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
+    def test_llama_model_round_trip(self, tmp_path):
+        """The registry round-trip handles the Llama family (bias-free MHA,
+        RoPE, int8 cache config) — save_model/load_model reproduce outputs."""
+        from tnn_tpu.models.llama import Llama
+
+        m = Llama(vocab_size=64, max_len=16, num_layers=1, d_model=32,
+                  num_heads=4, num_kv_heads=2, kv_cache_dtype="int8")
+        v = m.init(jax.random.PRNGKey(0), (1, 8))
+        p = str(tmp_path / "llama.tnn")
+        ckpt_lib.save_model(p, m, v["params"])
+        m2, v2 = ckpt_lib.load_model(p, rng=jax.random.PRNGKey(1),
+                                     input_shape=(1, 8))
+        assert (m2.num_kv_heads, m2.kv_cache_dtype) == (2, "int8")
+        ids = jnp.asarray(np.random.RandomState(0).randint(0, 64, (1, 8)),
+                          jnp.int32)
+        o1, _ = m.apply({"params": v["params"], "state": {}}, ids, train=False)
+        o2, _ = m2.apply({"params": v2["params"], "state": {}}, ids,
+                         train=False)
+        np.testing.assert_allclose(np.asarray(o1), np.asarray(o2), rtol=1e-6)
+
     def test_async_save_matches_blocking(self, tmp_path):
         """block=False must produce an identical checkpoint even when the
         donated train state is immediately reused for more steps (the write

@@ -32,11 +32,13 @@ DEFAULT_TP_RULES: List[Tuple[str, P]] = [
     (r".*proj/bias$", P()),
     # Llama SwiGLU MLP: gate/up column-parallel, down row-parallel — the
     # silu(gate) * up product stays shard-local, one all-reduce after down.
-    # The lookbehind keeps the MoE ROUTER gate (".../moe/gate/kernel") out:
-    # it must replicate (nn/moe.py ep_rules invariant).
-    (r".*(?<!moe/)gate/kernel$", P(None, "model")),
-    (r".*up/kernel$", P(None, "model")),
-    (r".*down/kernel$", P("model", None)),
+    # The lookbehind keeps the MoE ROUTER gate (".../moe/gate/kernel") out —
+    # it must replicate (nn/moe.py ep_rules invariant) — and the required
+    # path prefix (.+/) keeps a BARE param tree (top-level "gate/kernel",
+    # e.g. spec_tree on a standalone MoE module) at the replicated default.
+    (r".+/(?<!moe/)gate/kernel$", P(None, "model")),
+    (r".+/up/kernel$", P(None, "model")),
+    (r".+/down/kernel$", P("model", None)),
     (r".*wte/table$", P("model", None)),
     (r".*embedding/table$", P("model", None)),
 ]
