@@ -156,8 +156,10 @@ def bench_gpt2_decode(batch: int, prompt: int, new: int, size="small",
     if kv_cache:
         tag += f"_kv{kv_cache}"
     int8 = int8 or fused  # the fused kernel is int8-only
-    print(f"gpt2_{size} decode{tag} (bs={batch}, prompt={prompt}, new={new})")
-    model = models.create(f"gpt2_{size}",
+    # size starting with "llama" selects the Llama family directly
+    name = size if size.startswith("llama") else f"gpt2_{size}"
+    print(f"{name} decode{tag} (bs={batch}, prompt={prompt}, new={new})")
+    model = models.create(name,
                           **({"kv_cache_dtype": kv_cache} if kv_cache else {}))
     variables = model.init(jax.random.PRNGKey(0), (batch, 8))
     params = variables["params"]
@@ -169,7 +171,7 @@ def bench_gpt2_decode(batch: int, prompt: int, new: int, size="small",
         params = jax.block_until_ready(quantize_for_decode(params))
         extra["weight_bytes_ratio"] = round(quantized_bytes(params) / before, 3)
     rs = np.random.RandomState(0)
-    ids = rs.randint(0, 50257, (batch, prompt)).astype(np.int32)
+    ids = rs.randint(0, model.vocab_size, (batch, prompt)).astype(np.int32)
     # verification gate (benchmark-with-verification discipline): quantized
     # logits must stay close to the float model's on a full forward. (Token
     # rollouts are NOT compared — greedy decode legitimately diverges forever
@@ -202,7 +204,7 @@ def bench_gpt2_decode(batch: int, prompt: int, new: int, size="small",
         return time.perf_counter() - t0
 
     dt = time_loop(run, 4, min_delta=0.3, cap=64)
-    return report(f"gpt2_{size}_decode{tag}", dt, items=batch * new,
+    return report(f"{name}_decode{tag}", dt, items=batch * new,
                   item_name="tok", extra=extra)
 
 
@@ -271,6 +273,9 @@ def main(argv=None):
         # reference's GPT-2-only transformer story
         add(lambda: bench_llama_train(2 if q else 8, 128 if q else 512,
                                       3 if q else 10))
+        # GQA (3x smaller cache) + RoPE decode through the shared harness
+        add(lambda: bench_gpt2_decode(1, 16 if q else 64, 8 if q else 64,
+                                      size="llama_small"))
     if "moe" in wanted:
         # expert-routed FFN variant; MFU on active params (VERDICT r03 #4)
         add(lambda: bench_gpt2_train(2 if q else 8, 128 if q else 512,

@@ -359,13 +359,15 @@ class TestGQA:
         v = jnp.asarray(rs.randn(2, hkv, skv, d), jnp.float32)
         return q, k, v
 
-    def test_flash_gqa_matches_repeated_kv(self):
+    @pytest.mark.parametrize("hkv", [2, 1])  # grouped and MQA (single kv head)
+    def test_flash_gqa_matches_repeated_kv(self, hkv):
         from tnn_tpu.ops.pallas.flash_attention import flash_attention
 
-        q, k, v = self._qkv()
+        q, k, v = self._qkv(hkv=hkv)
         out = flash_attention(q, k, v, True, None, 64, 64)
-        ref = flash_attention(q, jnp.repeat(k, 2, axis=1),
-                              jnp.repeat(v, 2, axis=1), True, None, 64, 64)
+        g = 4 // hkv
+        ref = flash_attention(q, jnp.repeat(k, g, axis=1),
+                              jnp.repeat(v, g, axis=1), True, None, 64, 64)
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                    rtol=1e-5, atol=1e-5)
 

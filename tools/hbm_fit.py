@@ -1,5 +1,5 @@
 #!/usr/bin/env python
-"""HBM-fit table for the GPT-2 family on one chip (VERDICT r03 #6).
+"""HBM-fit table for the GPT-2 + Llama families on one chip (VERDICT r03 #6).
 
 Computes EXACT train-state bytes via jax.eval_shape (params + optimizer
 moments + BatchNorm-style state; no device memory touched) and bounds the
@@ -26,7 +26,10 @@ def row(size: str, batch: int, seq: int):
     from tnn_tpu import models, nn
     from tnn_tpu.train.step import create_train_state
 
-    model = models.create(f"gpt2_{size}", max_len=seq)
+    # same convention as benchmarks/model_bench.py: a size starting with
+    # "llama" names the Llama family directly, anything else is gpt2_<size>
+    name = size if size.startswith("llama") else f"gpt2_{size}"
+    model = models.create(name, max_len=seq)
     opt = nn.AdamW(lr=1e-4)
     state = jax.eval_shape(
         lambda rng: create_train_state(model, opt, rng, (batch, seq)),
@@ -44,9 +47,13 @@ def row(size: str, batch: int, seq: int):
     train_total = state_b + boundary + interior + grads + logits
     # decode at bs=1: weights (bf16 / int8+wte-scales) + KV cache bf16
     w_bf16 = 2 * n_params
-    w_int8 = int(n_params * 0.52)  # measured ratio for GPT-2 (test_quant)
-    kv = 2 * L * seq * d * 2
-    return {"size": size, "params_M": round(n_params / 1e6),
+    # 0.52 is the measured int8-vs-bf16 BYTES ratio for GPT-2 (test_quant:
+    # int8 matmul weights + bf16-kept embeddings/norms), applied to bytes
+    w_int8 = int(w_bf16 * 0.52)
+    # GQA models carry H_kv/H of the kv width per position
+    kv_frac = getattr(model, "num_kv_heads", model.num_heads) / model.num_heads
+    kv = int(2 * L * seq * d * 2 * kv_frac)
+    return {"size": name, "params_M": round(n_params / 1e6),
             "train_batch": batch,
             "train_state_GB": round(state_b / 2**30, 2),
             "train_total_GB": round(train_total / 2**30, 2),
@@ -61,7 +68,8 @@ def main(argv=None):
                     help="per-chip HBM (v5e: 16)")
     args = ap.parse_args(argv)
     rows = [row("small", 8, args.seq), row("medium", 4, args.seq),
-            row("large", 1, args.seq)]
+            row("large", 1, args.seq), row("llama_small", 8, args.seq),
+            row("llama_1b", 2, args.seq)]
     cols = list(rows[0])
     print(" | ".join(cols))
     for r in rows:
