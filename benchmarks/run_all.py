@@ -1,19 +1,25 @@
 #!/usr/bin/env python
 """Run every benchmark; print one JSON line per result plus a summary table.
 
-    python -m benchmarks.run_all [--quick] [--json results.json]
+    python -m benchmarks.run_all [--quick] [--suite core|serving|all] \
+        [--json results.json]
 """
 import argparse
 import json
 import os
 
 
-from benchmarks import ab_bench, data_bench, model_bench, ops_bench  # noqa: E402
+from benchmarks import (ab_bench, data_bench, model_bench,  # noqa: E402
+                        ops_bench, serve_bench)
 
 
 def main(argv=None):
     ap = argparse.ArgumentParser()
     ap.add_argument("--quick", action="store_true")
+    ap.add_argument("--suite", default="core",
+                    choices=("core", "serving", "all"),
+                    help="core = ops/model/data/ab (the pre-existing set); "
+                         "serving = the continuous-batching engine")
     ap.add_argument("--json", default="", help="also write results to this file")
     ap.add_argument("--csv",
                     default=os.path.join(os.path.dirname(os.path.abspath(__file__)),
@@ -21,11 +27,15 @@ def main(argv=None):
                     help="append one row per result metric here ('' disables)")
     args = ap.parse_args(argv)
 
+    quick = ["--quick"] if args.quick else []
     results = []
-    results.extend(ops_bench.main(["--quick"] if args.quick else []))
-    results.extend(model_bench.main(["--quick"] if args.quick else []))
-    results.extend(data_bench.main(["--quick"] if args.quick else []))
-    results.extend(ab_bench.main(["--quick"] if args.quick else []))
+    if args.suite in ("core", "all"):
+        results.extend(ops_bench.main(list(quick)))
+        results.extend(model_bench.main(list(quick)))
+        results.extend(data_bench.main(list(quick)))
+        results.extend(ab_bench.main(list(quick)))
+    if args.suite in ("serving", "all"):
+        results.extend(serve_bench.main(list(quick)))
     results = [r for r in results if r]
 
     print("\n== results ==")

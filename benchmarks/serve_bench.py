@@ -1,0 +1,125 @@
+#!/usr/bin/env python
+"""Serving benchmark: synthetic Poisson arrivals through the continuous-
+batching engine (tnn_tpu/serving/), reporting TTFT and decode tokens/sec.
+
+Unlike the offline decode benchmarks (model_bench's gpt2 rows time a fixed
+batch decoding in lockstep), this measures the SERVING path: requests arrive
+staggered, join and leave the running batch continuously, and contend for the
+paged KV pool — so the numbers include scheduling, prefill interleave, and
+page gather/scatter overheads.
+
+    python -m benchmarks.serve_bench [--quick] [--smoke]
+
+--smoke runs a tiny randomly initialized GPT-2 (2L/32d) — seconds on CPU,
+exercising the whole engine; it is what tests/test_benchmarks.py runs.
+"""
+import argparse
+import time
+
+
+import jax
+import numpy as np
+
+from benchmarks.common import RowRunner, report
+
+
+def bench_serving(model, params, *, num_requests: int, rate_per_s: float,
+                  prompt_len: int, max_new: int, num_blocks: int,
+                  block_size: int, max_batch_size: int, label: str,
+                  seed: int = 0):
+    """Drive one engine through a Poisson arrival trace and report metrics."""
+    from tnn_tpu.serving import InferenceEngine, ServingMetrics
+
+    print(f"{label}: {num_requests} requests, ~{rate_per_s}/s Poisson, "
+          f"prompt {prompt_len}, max_new {max_new}")
+    rng = np.random.default_rng(seed)
+    arrivals = np.cumsum(rng.exponential(1.0 / rate_per_s, num_requests))
+    prompts = rng.integers(0, model.vocab_size,
+                           (num_requests, prompt_len)).astype(np.int32)
+
+    engine = InferenceEngine(
+        model, params, num_blocks=num_blocks, block_size=block_size,
+        max_batch_size=max_batch_size,
+        max_seq_len=prompt_len + max_new, seed=seed)
+
+    # warm the compile caches outside the timed window: one prefill at the
+    # benchmark's bucket and one decode step (the engine reuses both)
+    wid = engine.submit(prompts[0], 1)
+    engine.run_until_complete()
+    del engine.requests[wid]
+    engine.metrics = ServingMetrics(engine.profiler)  # drop warmup samples
+
+    t0 = time.perf_counter()
+    next_req = 0
+    while next_req < num_requests or engine.has_work:
+        now = time.perf_counter() - t0
+        while next_req < num_requests and arrivals[next_req] <= now:
+            engine.submit(prompts[next_req], max_new)
+            next_req += 1
+        if engine.has_work:
+            engine.step()
+        elif next_req < num_requests:
+            time.sleep(min(arrivals[next_req] - now, 0.05))
+    wall = time.perf_counter() - t0
+
+    s = engine.metrics.summary()
+    return report(
+        label, wall, items=s["decode_tokens"], item_name="tok",
+        extra={"ttft_ms_mean": s["ttft_ms_mean"],
+               "ttft_ms_p95": s["ttft_ms_p95"],
+               "token_latency_ms_p50": s["token_latency_ms_p50"],
+               "preemptions": s["preemptions"],
+               "batch_fill_mean": s["batch_fill_mean"],
+               "requests": s["requests_finished"]})
+
+
+def _smoke_model():
+    """Tiny random GPT-2 (2L/32d/2h): engine mechanics without model weight."""
+    from tnn_tpu.models.gpt2 import GPT2
+
+    model = GPT2(vocab_size=128, max_len=64, num_layers=2, d_model=32,
+                 num_heads=2)
+    params = model.init(jax.random.PRNGKey(0), (1, 8))["params"]
+    return model, params
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--quick", action="store_true",
+                    help="fewer requests, shorter generations")
+    ap.add_argument("--smoke", action="store_true",
+                    help="tiny random model (CI-fast, CPU-safe)")
+    ap.add_argument("--model", default="gpt2_small")
+    ap.add_argument("--rate", type=float, default=4.0,
+                    help="mean request arrivals per second")
+    args = ap.parse_args(argv)
+
+    rr = RowRunner()
+    if args.smoke:
+        model, params = _smoke_model()
+        rr.add(lambda: bench_serving(
+            model, params, num_requests=6, rate_per_s=50.0, prompt_len=6,
+            max_new=8, num_blocks=16, block_size=4, max_batch_size=4,
+            label="serve_smoke"), label="bench_serving")
+        return rr.results
+
+    from tnn_tpu import models
+
+    model = models.create(args.model)
+    params = model.init(jax.random.PRNGKey(0), (1, 8))["params"]
+    n, max_new = (8, 16) if args.quick else (32, 64)
+    rr.add(lambda: bench_serving(
+        model, params, num_requests=n, rate_per_s=args.rate, prompt_len=32,
+        max_new=max_new, num_blocks=128, block_size=16, max_batch_size=8,
+        label=f"serve_{args.model}"), label="bench_serving")
+    return rr.results
+
+
+if __name__ == "__main__":
+    import sys
+
+    from benchmarks.common import ROW_FAILED
+
+    rs = main()
+    sys.exit(1 if any(str(r.get("bench", "")).startswith(ROW_FAILED)
+                      for r in rs) else 0)

@@ -1,0 +1,11 @@
+#!/usr/bin/env python
+"""Thin launcher for `tnn_tpu.cli.serve` (kept so the reference's examples/
+directory shape survives; the logic lives in the installable package).
+
+Run `pip install -e .` once, or invoke as `python -m tnn_tpu.cli.serve` from
+the repo root. Installed console script: `tnn-serve`.
+"""
+from tnn_tpu.cli.serve import main
+
+if __name__ == "__main__":
+    main()

@@ -109,3 +109,19 @@ class TestBenchGateRetry:
         # default budget is >=15 min of retrying (VERDICT r03 follow-up)
         assert bench.TOTAL_BUDGET_S >= 900
         assert "budget" in capsys.readouterr().out
+
+
+def test_serve_bench_smoke():
+    """Fast (tiny random model) serving benchmark: must complete on CPU and
+    report TTFT + tokens/sec. Deliberately NOT slow-marked — it is the tier-1
+    guard that the serving suite stays runnable."""
+    from benchmarks import serve_bench
+
+    results = [r for r in serve_bench.main(["--smoke"]) if r]
+    assert len(results) == 1
+    r = results[0]
+    assert r["bench"] == "serve_smoke"
+    assert r["ms"] > 0
+    assert r["tok_per_s"] > 0
+    assert r["ttft_ms_mean"] > 0
+    assert r["requests"] == 6

@@ -64,3 +64,65 @@ def test_generate_with_sampling_runs():
     toks2 = generate(model, v["params"], prompt, 4, temperature=0.8,
                      top_k=10, top_p=0.9)
     np.testing.assert_array_equal(np.asarray(toks), np.asarray(toks2))
+
+
+# -- per-row (ragged) sampling: the serving engine's vectorized kernel --------
+
+
+def test_ragged_matches_scalar_same_key():
+    """Per-row arrays with every row at the same params must reproduce the
+    scalar sampler exactly (same key, same categorical draw)."""
+    from tnn_tpu.models.sampling import sample_ragged
+
+    rs = np.random.RandomState(2)
+    logits = jnp.asarray(rs.randn(4, 50) * 2)
+    key = jax.random.PRNGKey(7)
+    for t, k, p in [(0.0, 0, 0.0), (1.0, 0, 0.0), (0.8, 5, 0.0),
+                    (1.2, 0, 0.6), (0.7, 8, 0.9)]:
+        want = np.asarray(make_sampler(t, k, p)(logits, key))
+        got = np.asarray(sample_ragged(
+            logits, key, jnp.full((4,), t), jnp.full((4,), k, jnp.int32),
+            jnp.full((4,), p)))
+        np.testing.assert_array_equal(got, want, err_msg=f"t={t} k={k} p={p}")
+
+
+def test_ragged_mixed_rows():
+    """Greedy and stochastic rows coexist: temperature 0 rows are exact
+    argmax; top-k rows stay inside their own row's k-support."""
+    from tnn_tpu.models.sampling import sample_ragged
+
+    rs = np.random.RandomState(3)
+    logits = jnp.asarray(rs.randn(3, 40))
+    t = jnp.asarray([0.0, 1.0, 1.0])
+    k = jnp.asarray([0, 3, 0], jnp.int32)
+    p = jnp.asarray([0.0, 0.0, 0.9])
+    top3 = set(np.asarray(jnp.argsort(logits[1])[-3:]).tolist())
+    for i in range(32):
+        toks = np.asarray(sample_ragged(logits, jax.random.PRNGKey(i),
+                                        t, k, p))
+        assert toks[0] == int(jnp.argmax(logits[0]))
+        assert int(toks[1]) in top3
+        assert 0 <= int(toks[2]) < 40
+
+
+def test_make_sampler_accepts_perrow_arrays():
+    logits = jnp.asarray(np.random.RandomState(4).randn(2, 30))
+    s = make_sampler(jnp.asarray([0.0, 1.0]), top_k=jnp.asarray([0, 4]))
+    toks = np.asarray(s(logits, jax.random.PRNGKey(0)))
+    assert toks[0] == int(jnp.argmax(logits[0]))
+    top4 = set(np.asarray(jnp.argsort(logits[1])[-4:]).tolist())
+    assert int(toks[1]) in top4
+
+
+def test_ragged_jits_with_traced_params():
+    """The engine passes t/k/p as TRACED arrays inside one compiled decode
+    step — the kernel must not branch on their values."""
+    from tnn_tpu.models.sampling import sample_ragged
+
+    f = jax.jit(sample_ragged)
+    logits = jnp.asarray(np.random.RandomState(5).randn(2, 20))
+    toks = np.asarray(f(logits, jax.random.PRNGKey(0),
+                        jnp.asarray([0.0, 0.9]), jnp.asarray([0, 5]),
+                        jnp.asarray([0.0, 0.8])))
+    assert toks.shape == (2,)
+    assert toks[0] == int(jnp.argmax(logits[0]))

@@ -1,0 +1,163 @@
+#!/usr/bin/env python
+"""Serving front-end over stdin/stdout JSON lines (no HTTP — pipe-friendly).
+
+    echo '{"prompt": "The meaning of life is", "max_new_tokens": 16}' | \
+        python -m tnn_tpu.cli.serve --model gpt2_small
+
+Each input line is one request:
+
+    {"id": 3, "prompt": "text", "max_new_tokens": 32,
+     "temperature": 0.8, "top_k": 40, "top_p": 0.9}
+    {"id": 4, "tokens": [464, 3616, 286], "max_new_tokens": 8}
+
+``tokens`` bypasses tokenization; ``prompt`` text uses --vocab (reference
+vocab.bin) when given, else byte-level ids. ``id`` defaults to a counter.
+
+Responses stream as the engine produces them, one JSON object per line:
+
+    {"event": "token", "id": 3, "token": 257}
+    {"event": "done", "id": 3, "tokens": [...], "text": "...",
+     "finish_reason": "length", "ttft_ms": 12.3}
+
+New requests are accepted WHILE earlier ones decode (continuous batching):
+stdin is polled between engine steps, so interleaved pipes work. On stdin
+EOF the engine drains remaining work, prints a metrics summary to stderr,
+and exits.
+"""
+import argparse
+import json
+import select
+import sys
+import time
+
+
+from tnn_tpu.utils.platform import apply_env_platform  # noqa: E402
+
+apply_env_platform()  # TNN_PLATFORM=cpu routes around the pinned TPU platform
+
+import jax  # noqa: E402
+import numpy as np  # noqa: E402
+
+from tnn_tpu import checkpoint as ckpt_lib  # noqa: E402
+from tnn_tpu import models  # noqa: E402
+from tnn_tpu.data.tokenizer import Tokenizer  # noqa: E402
+from tnn_tpu.serving import InferenceEngine  # noqa: E402
+
+
+from tnn_tpu.cli import console_entry
+
+
+def _emit(obj):
+    sys.stdout.write(json.dumps(obj) + "\n")
+    sys.stdout.flush()
+
+
+def _stdin_ready(timeout: float) -> bool:
+    return bool(select.select([sys.stdin], [], [], timeout)[0])
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--model", default="gpt2_small",
+                    help="zoo name (used when --model-file is absent)")
+    ap.add_argument("--model-file", default="", help=".tnn snapshot")
+    ap.add_argument("--vocab", default="", help="vocab.bin (reference format)")
+    ap.add_argument("--num-blocks", type=int, default=64,
+                    help="KV pool size in blocks (1 is reserved scratch)")
+    ap.add_argument("--block-size", type=int, default=16,
+                    help="tokens per KV block")
+    ap.add_argument("--max-batch-size", type=int, default=8,
+                    help="decode batch width (one compile at this width)")
+    ap.add_argument("--max-seq-len", type=int, default=0,
+                    help="per-request position cap (0 = model/pool limit)")
+    ap.add_argument("--decode-path", default="auto",
+                    choices=("auto", "standard", "fused"))
+    ap.add_argument("--max-new-tokens", type=int, default=32,
+                    help="default for requests that omit it")
+    ap.add_argument("--seed", type=int, default=0)
+    args = ap.parse_args(argv)
+
+    tokenizer = None
+    if args.vocab:
+        tokenizer = Tokenizer().load(args.vocab)
+
+    if args.model_file:
+        model, variables = ckpt_lib.load_model(args.model_file)
+        params = variables["params"]
+    else:
+        model = models.create(args.model)
+        print(f"no --model-file: random-weight {args.model} "
+              "(smoke/benchmark mode)", file=sys.stderr)
+        params = model.init(jax.random.PRNGKey(args.seed), (1, 8))["params"]
+
+    engine = InferenceEngine(
+        model, params, num_blocks=args.num_blocks, block_size=args.block_size,
+        max_batch_size=args.max_batch_size,
+        max_seq_len=args.max_seq_len or None, decode_path=args.decode_path,
+        seed=args.seed)
+    if engine.fused_fallback_reason:
+        print(f"standard decode path: {engine.fused_fallback_reason}",
+              file=sys.stderr)
+
+    def encode(line: str):
+        req = json.loads(line)
+        if "tokens" in req:
+            ids = np.asarray(req["tokens"], np.int32)
+        elif tokenizer is not None:
+            ids = np.asarray(tokenizer.encode(req["prompt"]), np.int32)
+        else:
+            ids = np.frombuffer(req["prompt"].encode(), np.uint8).astype(
+                np.int32) % model.vocab_size
+        rid = engine.submit(
+            ids, int(req.get("max_new_tokens", args.max_new_tokens)),
+            temperature=float(req.get("temperature", 0.0)),
+            top_k=int(req.get("top_k", 0)),
+            top_p=float(req.get("top_p", 0.0)),
+            stop_token=req.get("stop_token"))
+        return rid, req.get("id", rid)
+
+    ids_by_rid = {}
+    eof = False
+    t0 = time.perf_counter()
+    while not eof or engine.has_work:
+        # poll stdin: block while idle, only peek while the engine has work
+        while not eof and _stdin_ready(0.0 if engine.has_work else 0.2):
+            line = sys.stdin.readline()
+            if not line:
+                eof = True
+                break
+            if not line.strip():
+                continue
+            try:
+                rid, user_id = encode(line)
+                ids_by_rid[rid] = user_id
+            except (ValueError, KeyError, json.JSONDecodeError) as e:
+                _emit({"event": "error", "error": str(e)})
+        if not engine.has_work:
+            continue
+        events = engine.step()
+        for rid, tok in events["tokens"]:
+            _emit({"event": "token", "id": ids_by_rid[rid], "token": int(tok)})
+        for rid in events["finished"]:
+            req = engine.result(rid)
+            done = {"event": "done", "id": ids_by_rid[rid],
+                    "tokens": [int(t) for t in req.out_tokens],
+                    "finish_reason": req.finish_reason,
+                    "ttft_ms": round((req.ttft_s or 0.0) * 1e3, 3)}
+            if tokenizer is not None:
+                done["text"] = tokenizer.decode(req.out_tokens)
+            _emit(done)
+
+    dt = time.perf_counter() - t0
+    summary = engine.metrics.summary()
+    summary["wall_s"] = round(dt, 3)
+    print("serve summary: " + json.dumps(
+        {k: round(v, 3) if isinstance(v, float) else v
+         for k, v in summary.items()}), file=sys.stderr)
+
+
+cli = console_entry(main)
+
+
+if __name__ == "__main__":
+    main()

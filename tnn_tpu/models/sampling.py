@@ -1,9 +1,18 @@
 """Token-sampling strategies for autoregressive generation.
 
 Greedy, temperature, top-k, and nucleus (top-p) sampling behind one factory —
-shared by models.gpt2.generate and models.fused_decode.fused_generate.
-Exceeds the reference, whose inference loop is greedy argmax only
-(examples/gpt2_inference.cpp:107-119).
+shared by models.gpt2.generate, models.fused_decode.fused_generate, and the
+serving engine (tnn_tpu/serving/engine.py). Exceeds the reference, whose
+inference loop is greedy argmax only (examples/gpt2_inference.cpp:107-119).
+
+Two entry points:
+  * ``make_sampler(t, k, p)`` — scalars OR per-row arrays; returns a
+    ``(logits, key) -> ids`` closure. Scalar behavior is byte-for-byte the
+    original implementation.
+  * ``sample_ragged(logits, key, t, k, p)`` — the fully vectorized kernel the
+    serving engine calls with TRACED per-request parameter arrays, so one
+    compiled decode step serves any mix of greedy/temperature/top-k/top-p
+    requests.
 """
 from __future__ import annotations
 
@@ -13,16 +22,68 @@ import jax.numpy as jnp
 NEG_INF = jnp.float32(-1e30)  # large-negative beats -inf: 0*inf NaN hazards
 
 
-def make_sampler(temperature: float = 0.0, top_k: int = 0,
-                 top_p: float = 0.0):
+def _is_perrow(x) -> bool:
+    return getattr(x, "ndim", 0) > 0
+
+
+def sample_ragged(logits, key, temperature, top_k, top_p):
+    """Vectorized sampling with per-row parameters.
+
+    logits: (..., V); temperature/top_k/top_p: scalars or arrays broadcastable
+    to logits.shape[:-1]. Per row: temperature<=0 -> greedy argmax; top_k<=0
+    or >=V -> keep-all; top_p outside (0, 1) -> keep-all. Filters compose as
+    in the scalar path (top-k first, then top-p over the survivors).
+    """
+    logits = logits.astype(jnp.float32)
+    v = logits.shape[-1]
+    rows = logits.shape[:-1]
+    t = jnp.broadcast_to(jnp.asarray(temperature, jnp.float32), rows)[..., None]
+    k = jnp.broadcast_to(jnp.asarray(top_k, jnp.int32), rows)[..., None]
+    p = jnp.broadcast_to(jnp.asarray(top_p, jnp.float32), rows)[..., None]
+
+    greedy = jnp.argmax(logits, axis=-1)
+    x = logits / jnp.where(t > 0.0, t, 1.0)
+    # top-k: the kth-largest value is the row's cutoff; k outside [1, V)
+    # degrades to keep-all (cutoff = the minimum)
+    k_eff = jnp.where((k > 0) & (k < v), k, v)
+    down = jnp.flip(jnp.sort(x, axis=-1), axis=-1)
+    kth = jnp.take_along_axis(down, k_eff - 1, axis=-1)
+    x = jnp.where(x < kth, NEG_INF, x)
+    # top-p over the top-k survivors: a token survives if the mass BEFORE it
+    # is still below top_p — the highest-probability token always survives
+    p_eff = jnp.where((p > 0.0) & (p < 1.0), p, 1.0)
+    down = jnp.flip(jnp.sort(x, axis=-1), axis=-1)
+    probs = jax.nn.softmax(down, axis=-1)
+    csum = jnp.cumsum(probs, axis=-1)
+    keep = (csum - probs) < p_eff
+    cutoff = jnp.min(jnp.where(keep, down, jnp.inf), axis=-1, keepdims=True)
+    x = jnp.where(x < cutoff, NEG_INF, x)
+    sampled = jax.random.categorical(key, x, axis=-1)
+    return jnp.where(jnp.squeeze(t, -1) > 0.0, sampled, greedy)
+
+
+def make_sampler(temperature=0.0, top_k=0, top_p=0.0):
     """Build a ``(logits (..., V), key) -> (...,) int32`` sampler.
 
-    temperature<=0 -> greedy argmax (top_k/top_p ignored). Otherwise scale by
-    temperature, then optionally keep only the k highest logits (top_k>0)
-    and/or the smallest set of tokens whose cumulative probability reaches
-    top_p (0<top_p<1, "nucleus"); sample categorically from what is left.
-    The filters compose (top-k first, then top-p over the survivors).
+    Scalars: temperature<=0 -> greedy argmax (top_k/top_p ignored). Otherwise
+    scale by temperature, then optionally keep only the k highest logits
+    (top_k>0) and/or the smallest set of tokens whose cumulative probability
+    reaches top_p (0<top_p<1, "nucleus"); sample categorically from what is
+    left. The filters compose (top-k first, then top-p over the survivors).
+
+    Any parameter may instead be a per-row ARRAY (shape broadcastable to the
+    logits' row dims) — per-request sampling params in one batched decode
+    step; rows with temperature<=0 stay greedy.
     """
+    if any(_is_perrow(x) for x in (temperature, top_k, top_p)):
+        t = jnp.asarray(temperature, jnp.float32)
+        k = jnp.asarray(top_k, jnp.int32)
+        p = jnp.asarray(top_p, jnp.float32)
+
+        def ragged(logits, key):
+            return sample_ragged(logits, key, t, k, p)
+        return ragged
+
     temperature = float(temperature)
     top_k = int(top_k)
     top_p = float(top_p)

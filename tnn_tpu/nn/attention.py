@@ -121,8 +121,11 @@ def sdpa(q, k, v, *, causal: bool = False, mask: Optional[jax.Array] = None,
     """Scaled dot-product attention over (B, H, S, Dh) tensors.
 
     ``kv_offset``: during cached decode, absolute position of q[0] within the kv
-    sequence — builds the correct causal mask for S_q != S_kv.
+    sequence — builds the correct causal mask for S_q != S_kv. May be a scalar
+    (uniform batch) or a (B,) array (ragged batch — serving's continuous
+    batching, where every row sits at its own decode position).
     """
+    ragged = kv_offset is not None and getattr(kv_offset, "ndim", 0) > 0
     # GQA + seq parallelism: ring is GQA-aware for any group ratio; ulysses
     # validates H_kv % shards itself (ulysses_attention raises a ValueError
     # naming the ring fallback when kv heads cannot split)
@@ -150,7 +153,10 @@ def sdpa(q, k, v, *, causal: bool = False, mask: Optional[jax.Array] = None,
             " — e.g. train_model with mesh_axes={'seq': N}" if ringable else
             "ring attention does not support mask/kv_offset (cached decode); "
             "run decode outside the ring context with backend='xla'")
-    if backend == "pallas":
+    if backend == "pallas" and not ragged:
+        # the flash kernel takes a scalar kv_offset only; ragged decode
+        # batches route to the XLA path (the ragged paged-attention kernel
+        # is future work — see docs/serving.md)
         from ..ops.pallas.flash_attention import flash_attention
 
         return flash_attention(q, k, v, causal=causal, scale=scale,
@@ -162,17 +168,23 @@ def sdpa(q, k, v, *, causal: bool = False, mask: Optional[jax.Array] = None,
 def apply_rope(x, offset=0, theta: float = 10000.0):
     """Rotary position embedding over (B, H, S, Dh) — half-split (NeoX-style)
     pair rotation. ``offset`` is the absolute position of x[..., 0, :] (the
-    cached-decode case); may be a traced scalar. Rotation is a function of
-    ABSOLUTE position, so cached decode rotates keys at insert time and the
-    cache stores rotated keys."""
+    cached-decode case); may be a traced scalar, or a (B,) array for ragged
+    decode batches where every row sits at its own position. Rotation is a
+    function of ABSOLUTE position, so cached decode rotates keys at insert
+    time and the cache stores rotated keys."""
     d = x.shape[-1]
     if d % 2:
         raise ValueError(f"RoPE needs an even head dim, got {d}")
     half = d // 2
-    pos = offset + jnp.arange(x.shape[-2])
+    if getattr(offset, "ndim", 0):  # per-row offsets: (B, S) positions
+        pos = offset[:, None] + jnp.arange(x.shape[-2])
+    else:
+        pos = offset + jnp.arange(x.shape[-2])
     inv = theta ** (-jnp.arange(half, dtype=jnp.float32) / half)
-    freqs = pos[:, None].astype(jnp.float32) * inv[None, :]   # (S, half)
+    freqs = pos[..., None].astype(jnp.float32) * inv   # (..., S, half)
     cos, sin = jnp.cos(freqs), jnp.sin(freqs)
+    if cos.ndim == 3:  # ragged: (B, S, half) -> broadcast over the head dim
+        cos, sin = cos[:, None], sin[:, None]
     x1 = x[..., :half].astype(jnp.float32)
     x2 = x[..., half:].astype(jnp.float32)
     out = jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
@@ -204,7 +216,10 @@ def local_xla_attention(q, k, v, *, causal: bool = False,
     if causal:
         qpos = jnp.arange(sq)[:, None]
         if kv_offset is not None:
-            qpos = qpos + kv_offset
+            if getattr(kv_offset, "ndim", 0):  # per-row (B,) -> (B, 1, sq, 1)
+                qpos = qpos + kv_offset[:, None, None, None]
+            else:
+                qpos = qpos + kv_offset
         kpos = jnp.arange(skv)[None, :]
         live = qpos >= kpos
         logits = jnp.where(live, logits, dt.neg_inf(logits.dtype))
@@ -359,6 +374,10 @@ class MultiHeadAttention(Module):
 
         Returns (out, new_cache). The full cache buffer participates in attention with a
         position mask, keeping shapes static for jit.
+
+        ``offset`` may be a scalar (uniform batch) or a (N,) array — the
+        ragged case, where each row writes and masks at its own position
+        (serving's continuous batching over pool-assembled caches).
         """
         params = variables["params"]
         q, k_new, v_new = self._project_qkv(params, x)
@@ -367,8 +386,13 @@ class MultiHeadAttention(Module):
             # keys at their true offsets; the cache stores rotated keys
             q = apply_rope(q, offset, self.rope_theta)
             k_new = apply_rope(k_new, offset, self.rope_theta)
-        upd = lambda buf, new: jax.lax.dynamic_update_slice_in_dim(  # noqa: E731
-            buf, new, offset, axis=2)
+        if getattr(offset, "ndim", 0):  # per-row write positions
+            upd = lambda buf, new: jax.vmap(  # noqa: E731
+                lambda b, n, o: jax.lax.dynamic_update_slice_in_dim(
+                    b, n, o, axis=1))(buf, new, offset)
+        else:
+            upd = lambda buf, new: jax.lax.dynamic_update_slice_in_dim(  # noqa: E731
+                buf, new, offset, axis=2)
         if self.kv_cache_dtype == "int8":
             kq, ks = self._quant_rows(k_new)
             vq, vs = self._quant_rows(v_new)

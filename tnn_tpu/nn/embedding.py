@@ -85,9 +85,14 @@ class PositionalEmbedding(Module):
         init = initializers.get(self.kernel_init)
         return {"pos": init(rng, (max_len, d), self.policy.param_dtype)}, {}
 
-    def _apply(self, params, state, x, *, train, rng, offset: int = 0):
+    def _apply(self, params, state, x, *, train, rng, offset=0):
         s = x.shape[-2]
-        pos = jax.lax.dynamic_slice_in_dim(params["pos"], offset, s, axis=0)
+        if getattr(offset, "ndim", 0):  # per-row offsets (B,) -> (B, S, D)
+            pos = jnp.take(params["pos"], offset[:, None] + jnp.arange(s),
+                           axis=0)
+        else:
+            pos = jax.lax.dynamic_slice_in_dim(params["pos"], offset, s,
+                                               axis=0)
         return x + self.policy.cast_param(pos), state
 
     def output_shape(self, input_shape):

@@ -38,6 +38,10 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+# jax 0.4.x spells it TPUCompilerParams; the kwargs used here are identical
+_CompilerParams = getattr(pltpu, "CompilerParams", None) \
+    or pltpu.TPUCompilerParams
+
 # Upper bounds on block sizes (VMEM: x 256x2048x2 + q 1024x2048x1 + acc
 # 256x1024x4 + out ≈ 6 MB with double buffering — comfortably inside VMEM).
 MAX_BLOCK_M = 256
@@ -198,7 +202,7 @@ def int8_matmul(x, q, scale, *, n: int | None = None, k: int | None = None,
                                memory_space=pltpu.VMEM),
         out_shape=jax.ShapeDtypeStruct((mp, np_), out_dtype),
         scratch_shapes=[pltpu.VMEM((bm, bn), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=jax.default_backend() != "tpu",
     )(xf, q, sp)

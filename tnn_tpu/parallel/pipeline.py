@@ -117,7 +117,7 @@ def spmd_pipeline(block_fn: Callable, stacked_params, x_microbatches, mesh: Mesh
 
     in_specs = (jax.tree_util.tree_map(lambda _: P(axis), stacked_params), P())
     out_specs = P(axis)
-    fn = jax.shard_map(per_device, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+    fn = mesh_lib.shard_map(per_device, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
                        check_vma=False)
     stacked_out = fn(stacked_params, x_microbatches)  # (pp, num_mb, ...)
     return stacked_out[-1]
@@ -206,7 +206,7 @@ def spmd_pipeline_interleaved(block_fn: Callable, stacked_params, x_microbatches
         return outputs[None]
 
     in_specs = (jax.tree_util.tree_map(lambda _: P(axis), placed), P())
-    fn = jax.shard_map(per_device, mesh=mesh, in_specs=in_specs,
+    fn = mesh_lib.shard_map(per_device, mesh=mesh, in_specs=in_specs,
                        out_specs=P(axis), check_vma=False)
     stacked_out = fn(placed, x_microbatches)  # (pp, num_mb, ...)
     return stacked_out[-1]
@@ -469,18 +469,29 @@ class HeteroPipeline:
             else:
                 loss = aux
                 corr = jnp.zeros((), jnp.float32)
-            return self._encode(out), new_s_vec, loss, corr
+            return (self._vary(self._encode(out)), self._vary(new_s_vec),
+                    self._vary(loss), self._vary(corr))
 
         return branch
 
-    def pipeline_loss(self, packed_params, packed_state, data, labels, rng,
-                      train: bool = True):
-        """(mean loss over microbatches, (new_packed_state, metrics)).
+    def _vary(self, x):
+        """Join ``x``'s replication type to "varying over pipe (+data)".
 
-        ``data``: (num_mb * mb, ...) or (num_mb, mb, ...); labels likewise.
-        Differentiable w.r.t. packed_params. Run under ``self.mesh``.
-        """
-        num_mb, pp, axis, v = self.num_mb, self.pp, self.axis, self.v
+        Under shard_map replication tracking (``check_rep=True`` on jax
+        0.4.x), ``lax.switch`` requires every branch to produce identical
+        replication types. Non-last branches return constant-zero
+        loss/corrects (inferred replicated) while the last branch computes
+        them from device-varying data — add a zero derived from
+        ``axis_index`` so all branches agree. XLA folds the add away."""
+        bump = jax.lax.axis_index(self.axis)
+        if self.data_axis is not None:
+            bump = bump + jax.lax.axis_index(self.data_axis)
+        return x + (0 * bump).astype(x.dtype)
+
+    def _prep(self, data, labels, train: bool):
+        """Shared prologue: reshape the batch to (num_mb, mb_global, ...) and
+        build the per-tick switch branches + tick count."""
+        num_mb, pp, v = self.num_mb, self.pp, self.v
         mb = self.in_shapes[0][0]  # LOCAL microbatch size (per data shard)
         mb_global = mb * self.dp
         if data.shape[0] != num_mb:
@@ -496,88 +507,162 @@ class HeteroPipeline:
             # last sub-tick: stage L-1 = (c=v-1, d=pp-1) on microbatch num_mb-1
             n_ticks = ((pp - 1) + ((num_mb - 1) % pp)
                        + pp * ((v - 1) + v * ((num_mb - 1) // pp)) + 1)
+        return data, labels, mb_global, branches, n_ticks
 
-        def per_device(p_rows, s_rows, data_mb, labels_mb, key):
-            d = jax.lax.axis_index(axis)
-            if self.data_axis is not None:
-                # distinct dropout masks per data shard — without this every
-                # shard would reuse the replicated key on different samples
-                key = jax.random.fold_in(key, jax.lax.axis_index(self.data_axis))
-            # encode all injected microbatches once (stage c=0, d=0 consumes)
-            inject = jax.vmap(self._encode)(data_mb)
+    def _device_schedule(self, branches, n_ticks, p_rows, s_rows, data_mb,
+                         labels_mb, key):
+        """The fill/drain schedule for ONE device; call inside shard_map.
 
-            def tick(carry, t):
-                recv, s_rows_l, loss_acc, corr_acc = carry
-                if v == 1:
-                    c = jnp.zeros((), jnp.int32)
-                    m = t - d
-                    active = jnp.logical_and(d <= t, m < num_mb)
-                else:
-                    # invert tau: which (chunk c, microbatch m) runs now?
-                    w = t - d
-                    q, j = w // pp, jnp.mod(w, pp)
-                    c = jnp.mod(q, v)
-                    m = (q // v) * pp + j
-                    active = jnp.logical_and(w >= 0, m < num_mb)
-                m_idx = jnp.clip(m, 0, num_mb - 1)
-                inject_here = jnp.logical_and(c == 0, d == 0)
-                inp = jnp.where(inject_here, inject[m_idx], recv)
-                s_vec = jax.lax.dynamic_index_in_dim(s_rows_l, c, 0,
-                                                     keepdims=False)
-                p_vec = jax.lax.dynamic_index_in_dim(p_rows, c, 0,
-                                                     keepdims=False)
-                gstage = c * pp + d
-                key_t = jax.random.fold_in(jax.random.fold_in(key, t), gstage)
-                out_buf, new_s, loss, corr = jax.lax.switch(
-                    gstage, branches, p_vec, s_vec, inp, labels_mb[m_idx],
-                    key_t)
-                # a stage holds a real microbatch only during its active window;
-                # outside it the input is schedule garbage — state/loss must not
-                # absorb it (this is what keeps BatchNorm statistics exact)
-                s_rows_l = jax.lax.dynamic_update_index_in_dim(
-                    s_rows_l, jnp.where(active, new_s, s_vec), c, 0)
-                # every ACTIVE stage contributes (non-last stages return their
-                # aux losses only — 0 unless the stage carries MoE routing);
-                # accuracy still comes from the emitting last stage alone
-                emit = jnp.logical_and(
-                    active, jnp.logical_and(d == pp - 1, c == v - 1))
-                loss_acc = loss_acc + jnp.where(active, loss, 0.0)
-                corr_acc = corr_acc + jnp.where(emit, corr, 0.0)
-                perm = [(i, (i + 1) % pp) for i in range(pp)]
-                recv = jax.lax.ppermute(out_buf, axis, perm)
-                return (recv, s_rows_l, loss_acc, corr_acc), None
+        Returns (new state rows, loss sum, corrects sum) — data-axis
+        reductions already applied, so all three are data-axis invariant."""
+        num_mb, pp, axis, v = self.num_mb, self.pp, self.axis, self.v
+        d = jax.lax.axis_index(axis)
+        if self.data_axis is not None:
+            # distinct dropout masks per data shard — without this every
+            # shard would reuse the replicated key on different samples
+            key = jax.random.fold_in(key, jax.lax.axis_index(self.data_axis))
+        # encode all injected microbatches once (stage c=0, d=0 consumes)
+        inject = jax.vmap(self._encode)(data_mb)
 
-            zero_buf = jnp.zeros((self.buf_elems,), self.buf_dtype)
-            (recv, s_rows_l, loss_acc, corr_acc), _ = jax.lax.scan(
-                tick, (zero_buf, s_rows, jnp.zeros((), jnp.float32),
-                       jnp.zeros((), jnp.float32)),
-                jnp.arange(n_ticks))
-            if self.data_axis is not None:
-                # data ranks saw different samples: average the running-stat
-                # updates (sync-BN-style state merge; normalization itself used
-                # per-shard batch stats — standard "ghost BN" dp semantics) and
-                # reduce loss/corrects so outputs are data-axis invariant
-                s_rows_l = jax.lax.pmean(s_rows_l, self.data_axis)
-                loss_acc = jax.lax.pmean(loss_acc, self.data_axis)
-                corr_acc = jax.lax.psum(corr_acc, self.data_axis)
-            # local (v, s_len) rows concatenate over pipe to (L, s_len)
-            return s_rows_l, loss_acc[None], corr_acc[None]
+        def tick(carry, t):
+            recv, s_rows_l, loss_acc, corr_acc = carry
+            if v == 1:
+                c = jnp.zeros((), jnp.int32)
+                m = t - d
+                active = jnp.logical_and(d <= t, m < num_mb)
+            else:
+                # invert tau: which (chunk c, microbatch m) runs now?
+                w = t - d
+                q, j = w // pp, jnp.mod(w, pp)
+                c = jnp.mod(q, v)
+                m = (q // v) * pp + j
+                active = jnp.logical_and(w >= 0, m < num_mb)
+            m_idx = jnp.clip(m, 0, num_mb - 1)
+            inject_here = jnp.logical_and(c == 0, d == 0)
+            inp = jnp.where(inject_here, inject[m_idx], recv)
+            s_vec = jax.lax.dynamic_index_in_dim(s_rows_l, c, 0,
+                                                 keepdims=False)
+            p_vec = jax.lax.dynamic_index_in_dim(p_rows, c, 0,
+                                                 keepdims=False)
+            gstage = c * pp + d
+            key_t = jax.random.fold_in(jax.random.fold_in(key, t), gstage)
+            out_buf, new_s, loss, corr = jax.lax.switch(
+                gstage, branches, p_vec, s_vec, inp, labels_mb[m_idx],
+                key_t)
+            # a stage holds a real microbatch only during its active window;
+            # outside it the input is schedule garbage — state/loss must not
+            # absorb it (this is what keeps BatchNorm statistics exact)
+            s_rows_l = jax.lax.dynamic_update_index_in_dim(
+                s_rows_l, jnp.where(active, new_s, s_vec), c, 0)
+            # every ACTIVE stage contributes (non-last stages return their
+            # aux losses only — 0 unless the stage carries MoE routing);
+            # accuracy still comes from the emitting last stage alone
+            emit = jnp.logical_and(
+                active, jnp.logical_and(d == pp - 1, c == v - 1))
+            loss_acc = loss_acc + jnp.where(active, loss, 0.0)
+            corr_acc = corr_acc + jnp.where(emit, corr, 0.0)
+            perm = [(i, (i + 1) % pp) for i in range(pp)]
+            recv = jax.lax.ppermute(out_buf, axis, perm)
+            return (recv, s_rows_l, loss_acc, corr_acc), None
 
+        zero_buf = jnp.zeros((self.buf_elems,), self.buf_dtype)
+        (recv, s_rows_l, loss_acc, corr_acc), _ = jax.lax.scan(
+            tick, (zero_buf, s_rows, jnp.zeros((), jnp.float32),
+                   jnp.zeros((), jnp.float32)),
+            jnp.arange(n_ticks))
+        if self.data_axis is not None:
+            # data ranks saw different samples: average the running-stat
+            # updates (sync-BN-style state merge; normalization itself used
+            # per-shard batch stats — standard "ghost BN" dp semantics) and
+            # reduce loss/corrects so outputs are data-axis invariant
+            s_rows_l = jax.lax.pmean(s_rows_l, self.data_axis)
+            loss_acc = jax.lax.pmean(loss_acc, self.data_axis)
+            corr_acc = jax.lax.psum(corr_acc, self.data_axis)
+        # local (v, s_len) state rows; caller decides how to expose them
+        return s_rows_l, loss_acc, corr_acc
+
+    def _in_specs(self):
         dp_ax = self.data_axis
-        in_specs = (P(axis), P(axis), P(None, dp_ax), P(None, dp_ax), P())
-        out_specs = (P(axis), P(axis), P(axis))
-        fn = jax.shard_map(per_device, mesh=self.mesh, in_specs=in_specs,
-                           out_specs=out_specs, check_vma=False)
-        new_state, losses, corrects = fn(packed_params, packed_state, data,
-                                         labels, rng)
+        return (P(self.axis), P(self.axis), P(None, dp_ax), P(None, dp_ax),
+                P())
+
+    def _collect(self, losses, corrects, mb_global):
+        """Device-concatenated per-device sums -> (mean loss, metrics)."""
         # summing over devices collects the last stage's data losses AND every
         # stage's aux losses, averaged per microbatch — the same total
         # make_train_step's loss_fn + aux_loss_sum produces under grad accum
-        loss = jnp.sum(losses) / num_mb
+        loss = jnp.sum(losses) / self.num_mb
         metrics = {"loss": loss}
         if self.compute_accuracy:
-            metrics["accuracy"] = jnp.sum(corrects) / (num_mb * mb_global)
+            metrics["accuracy"] = jnp.sum(corrects) / (self.num_mb * mb_global)
+        return loss, metrics
+
+    def pipeline_loss(self, packed_params, packed_state, data, labels, rng,
+                      train: bool = True):
+        """(mean loss over microbatches, (new_packed_state, metrics)).
+
+        ``data``: (num_mb * mb, ...) or (num_mb, mb, ...); labels likewise.
+        Differentiable w.r.t. packed_params. Run under ``self.mesh``.
+        """
+        data, labels, mb_global, branches, n_ticks = self._prep(
+            data, labels, train)
+
+        def per_device(p_rows, s_rows, data_mb, labels_mb, key):
+            s_rows_l, loss_acc, corr_acc = self._device_schedule(
+                branches, n_ticks, p_rows, s_rows, data_mb, labels_mb, key)
+            # local (v, s_len) rows concatenate over pipe to (L, s_len)
+            return s_rows_l, loss_acc[None], corr_acc[None]
+
+        fn = mesh_lib.shard_map(
+            per_device, mesh=self.mesh, in_specs=self._in_specs(),
+            out_specs=(P(self.axis), P(self.axis), P(self.axis)),
+            check_vma=False)
+        new_state, losses, corrects = fn(packed_params, packed_state, data,
+                                         labels, rng)
+        loss, metrics = self._collect(losses, corrects, mb_global)
         return loss, (new_state, metrics)
+
+    def pipeline_value_and_grad(self, packed_params, packed_state, data,
+                                labels, rng):
+        """(loss, new_packed_state, metrics, grads) for one train batch.
+
+        Same math as ``jax.value_and_grad(pipeline_loss)``, but the VJP runs
+        INSIDE the shard_map body: each device differentiates the global
+        scalar loss (psum over pipe of its schedule's contribution) w.r.t.
+        its own packed rows, with the collectives transposed per device
+        (ppermute -> inverse permutation, psum -> identity + a manual psum of
+        the row grads over the data axis). shard_map's own transpose rule is
+        never invoked — on jax 0.4.x it mishandles grad-of-switch programs
+        (scalar residual out-specs, symbolic-zero cotangents), and this path
+        sidesteps all of it while staying exactly as parallel.
+        """
+        data, labels, mb_global, branches, n_ticks = self._prep(
+            data, labels, True)
+
+        def per_device(p_rows, s_rows, data_mb, labels_mb, key):
+            def local_loss(p):
+                s_l, loss_acc, corr_acc = self._device_schedule(
+                    branches, n_ticks, p, s_rows, data_mb, labels_mb, key)
+                # the SAME global scalar on every device: sum each device's
+                # (data-reduced) contribution over the pipe ring
+                gloss = jax.lax.psum(loss_acc, self.axis) / self.num_mb
+                return gloss, (s_l, loss_acc, corr_acc)
+
+            (_, (s_l, loss_acc, corr_acc)), gp = jax.value_and_grad(
+                local_loss, has_aux=True)(p_rows)
+            if self.data_axis is not None:
+                # per-device psum transpose is identity, so gp holds only this
+                # data shard's term of d(loss)/d(rows) — sum the shards
+                gp = jax.lax.psum(gp, self.data_axis)
+            return gp, s_l, loss_acc[None], corr_acc[None]
+
+        fn = mesh_lib.shard_map(
+            per_device, mesh=self.mesh, in_specs=self._in_specs(),
+            out_specs=(P(self.axis),) * 4, check_vma=False)
+        grads, new_state, losses, corrects = fn(
+            packed_params, packed_state, data, labels, rng)
+        loss, metrics = self._collect(losses, corrects, mb_global)
+        return loss, new_state, metrics, grads
 
 
 def make_pipeline_train_step(stages: Sequence, optimizer, mesh: Mesh,
@@ -625,11 +710,10 @@ def make_pipeline_train_step(stages: Sequence, optimizer, mesh: Mesh,
         rng, aug_rng, sub = jax.random.split(state.rng, 3)
         if augment is not None:  # on-device augmentation, fused into the step
             data = augment(aug_rng, data)
-        grad_fn = jax.value_and_grad(pipe.pipeline_loss, has_aux=True)
-        # pipeline_loss already averages over microbatches, so grads carry the
-        # 1/num_mb factor — same math as single-device gradient accumulation
-        (loss, (new_net, metrics)), grads = grad_fn(
-            state.params, state.net_state, data, labels, sub, True)
+        # the schedule averages over microbatches, so grads carry the 1/num_mb
+        # factor — same math as single-device gradient accumulation
+        loss, new_net, metrics, grads = pipe.pipeline_value_and_grad(
+            state.params, state.net_state, data, labels, sub)
         if not host_driven:
             lr_scale = scheduler.scale(state.step)
         new_params, new_opt = optimizer.update(grads, state.opt_state,

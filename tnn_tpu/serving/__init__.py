@@ -1,0 +1,20 @@
+"""Serving engine: continuous batching over a paged KV-cache pool.
+
+    from tnn_tpu import serving
+    engine = serving.InferenceEngine(model, params, num_blocks=64)
+    rid = engine.submit(prompt_ids, max_new_tokens=32)
+    outputs = engine.run_until_complete()
+
+See docs/serving.md for the architecture and request lifecycle.
+"""
+from .engine import InferenceEngine
+from .kv_pool import (PagedKVPool, PoolExhausted, gather_kv, scatter_prefill,
+                      scatter_token)
+from .metrics import ServingMetrics
+from .scheduler import Request, RequestState, Scheduler, StepPlan
+
+__all__ = [
+    "InferenceEngine", "PagedKVPool", "PoolExhausted", "gather_kv",
+    "scatter_prefill", "scatter_token", "ServingMetrics", "Request",
+    "RequestState", "Scheduler", "StepPlan",
+]

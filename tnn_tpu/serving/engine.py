@@ -1,0 +1,423 @@
+"""InferenceEngine: request lifecycle over continuous batching + paged KV.
+
+Ties the subsystem together:
+
+    submit() --> Scheduler (FCFS queue) --> step():
+        prefill admitted requests   (one jitted program per prompt bucket)
+        decode the running batch    (ONE jitted program, fixed batch width)
+      --> streamed tokens / finished requests
+
+Static-shape discipline (the whole point on XLA backends): the decode step is
+compiled ONCE for (max_batch_size, assembly_width) — requests joining or
+leaving the batch never retrace; absent rows are padded onto the pool's
+scratch block and masked by the per-row causal offsets. Prefill pads prompts
+up to a block multiple, so prompt-length buckets (not exact lengths) key its
+jit cache.
+
+Decode-path fallback: ``decode_path="auto"`` probes the fused one-launch
+Pallas kernel (models.fused_decode) at init — it needs decode-quantized
+params, no MoE/GQA/int8-cache, and a VMEM-fitting geometry — and uses it for
+any step whose live rows all sit at one common offset (lockstep batches);
+every other step, and any model the probe rejects (reason recorded in
+``fused_fallback_reason``), runs the standard cached path. Both paths read
+and write the same paged pool, so the engine can switch per step.
+"""
+from __future__ import annotations
+
+import itertools
+import time
+from typing import Any, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..models import sampling
+from ..profiling.profiler import EventType, Profiler, profiled
+from . import kv_pool as kv_pool_lib
+from .kv_pool import PagedKVPool
+from .metrics import ServingMetrics
+from .scheduler import Request, RequestState, Scheduler
+
+
+class InferenceEngine:
+    """Continuous-batching inference over one GPT2-family model.
+
+    Parameters
+    ----------
+    model, params : the module tree and its params (``variables["params"]``).
+    num_blocks, block_size : KV pool geometry (block 0 is reserved scratch).
+    max_batch_size : decode batch width the step is compiled at.
+    token_budget : per-step cap on model tokens (decodes + admitted prompts).
+    max_seq_len : per-request position cap (prompt + generated); defaults to
+        the smaller of model.max_len and the pool's whole capacity.
+    decode_path : "auto" | "standard" | "fused" (see module docstring).
+    profiler : optional profiling.Profiler for span/counter wiring.
+    """
+
+    def __init__(self, model, params, *, num_blocks: int = 64,
+                 block_size: int = 16, max_batch_size: int = 8,
+                 token_budget: int = 2048, max_seq_len: Optional[int] = None,
+                 decode_path: str = "auto",
+                 profiler: Optional[Profiler] = None, seed: int = 0):
+        if getattr(model, "kv_cache_dtype", None):
+            raise ValueError(
+                "the paged pool stores compute-dtype pages; "
+                f"kv_cache_dtype={model.kv_cache_dtype!r} models are not "
+                "servable yet — use models.gpt2.generate")
+        if decode_path not in ("auto", "standard", "fused"):
+            raise ValueError(f"unknown decode_path {decode_path!r}")
+        self.model = model
+        self.params = params
+        self.head_dim = model.d_model // model.num_heads
+        self.pool = PagedKVPool(
+            num_layers=model.num_layers, num_kv_heads=model.num_kv_heads,
+            head_dim=self.head_dim, num_blocks=num_blocks,
+            block_size=block_size, dtype=model.policy.compute_dtype)
+        cap = min(model.max_len, self.pool.capacity * block_size)
+        self.max_seq_len = min(max_seq_len or cap, cap)
+        # fixed assembly width: every decode step gathers this many blocks per
+        # row (padded with scratch), so ONE compile covers all batch states
+        self.blocks_per_seq = self.pool.blocks_for(self.max_seq_len)
+        self.assembly_len = self.blocks_per_seq * block_size
+        self.scheduler = Scheduler(max_batch_size=max_batch_size,
+                                   token_budget=token_budget)
+        self.profiler = profiler
+        self.metrics = ServingMetrics(profiler)
+        self.requests: Dict[int, Request] = {}
+        self._rid = itertools.count()
+        self._key = jax.random.PRNGKey(seed)
+        self._jit: Dict[Any, Any] = {}
+        self.fused_fallback_reason: Optional[str] = None
+        self._fused: Optional[Dict[str, Any]] = None
+        if decode_path in ("auto", "fused"):
+            try:
+                self._fused = self._probe_fused(max_batch_size)
+            except ValueError as e:
+                if decode_path == "fused":
+                    raise
+                self.fused_fallback_reason = str(e)
+        else:
+            self.fused_fallback_reason = "disabled (decode_path='standard')"
+
+    # -- fused-path probe -----------------------------------------------------
+
+    def _probe_fused(self, batch: int) -> Dict[str, Any]:
+        """Validate the fused decode kernel against this model/params; raises
+        ValueError (with the reason) when the standard path must be used."""
+        from ..models import fused_decode
+
+        chunks = fused_decode.pick_chunks(
+            self.model.d_model, 4 * self.model.d_model, batch,
+            self.assembly_len)
+        if chunks is None:
+            raise ValueError("model too large for the fused kernel's VMEM "
+                             "budget at this batch/assembly geometry")
+        stacks = fused_decode.stack_decode_weights(self.model, self.params)
+        return {"stacks": stacks, "chunks": chunks,
+                "interpret": jax.default_backend() != "tpu"}
+
+    # -- request lifecycle ----------------------------------------------------
+
+    def submit(self, prompt_ids, max_new_tokens: int, *,
+               temperature: float = 0.0, top_k: int = 0, top_p: float = 0.0,
+               stop_token: Optional[int] = None) -> int:
+        """Queue a generation request; returns its request id."""
+        prompt = np.asarray(prompt_ids, np.int32).reshape(-1)
+        if prompt.size == 0:
+            raise ValueError("empty prompt")
+        if max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        total = prompt.size + max_new_tokens
+        if total > self.max_seq_len:
+            raise ValueError(
+                f"prompt {prompt.size} + max_new_tokens {max_new_tokens} "
+                f"exceeds max_seq_len {self.max_seq_len}")
+        if self.pool.blocks_for(total) > self.pool.capacity:
+            raise ValueError(
+                f"request needs {self.pool.blocks_for(total)} blocks but the "
+                f"pool only has {self.pool.capacity} — it could never run")
+        rid = next(self._rid)
+        req = Request(rid=rid, prompt=prompt, max_new_tokens=int(max_new_tokens),
+                      temperature=float(temperature), top_k=int(top_k),
+                      top_p=float(top_p), stop_token=stop_token,
+                      submit_time=time.perf_counter())
+        self.requests[rid] = req
+        self.scheduler.submit(req)
+        return rid
+
+    @property
+    def has_work(self) -> bool:
+        return self.scheduler.has_work
+
+    def result(self, rid: int) -> Request:
+        return self.requests[rid]
+
+    def output_tokens(self, rid: int) -> List[int]:
+        return list(self.requests[rid].out_tokens)
+
+    # -- engine step ----------------------------------------------------------
+
+    def step(self) -> Dict[str, List]:
+        """Run one serving step: admit+prefill, then one batched decode.
+
+        Returns ``{"tokens": [(rid, token), ...], "finished": [rid, ...]}`` —
+        the streamed increment this step produced.
+        """
+        events: Dict[str, List] = {"tokens": [], "finished": []}
+        plan = self.scheduler.schedule(self.pool)
+        for req in plan.prefills:
+            self._prefill(req, events)
+        self._ensure_decode_capacity()
+        live = [r for r in self.scheduler.running
+                if r.state is RequestState.RUNNING]
+        if live:
+            self._decode(live, events)
+        self.metrics.observe_gauges(self.scheduler.queue_depth,
+                                    self.pool.occupancy)
+        return events
+
+    def run_until_complete(self, max_steps: int = 100_000) -> Dict[int, List[int]]:
+        """Drive steps until every submitted request finished; returns
+        {rid: generated tokens}."""
+        steps = 0
+        while self.has_work:
+            self.step()
+            steps += 1
+            if steps > max_steps:
+                raise RuntimeError(f"no convergence after {max_steps} steps")
+        return {rid: list(r.out_tokens) for rid, r in self.requests.items()
+                if r.state is RequestState.FINISHED}
+
+    # -- prefill --------------------------------------------------------------
+
+    def _next_key(self):
+        self._key, sub = jax.random.split(self._key)
+        return sub
+
+    def _prefill_fn(self, padded_len: int, nb: int):
+        model = self.model
+
+        def fn(params, pages_k, pages_v, ids, length, blocks, t, k, p, key):
+            caches = model.init_cache(1, padded_len)
+            logits, caches = model.apply_cached(params, ids, caches, 0)
+            last = jnp.take(logits[0], length - 1, axis=0)      # (V,)
+            tok = sampling.sample_ragged(last[None], key, t[None], k[None],
+                                         p[None])[0]
+            k_all = jnp.stack([c["k"][0] for c in caches])      # (L, H, P, Dh)
+            v_all = jnp.stack([c["v"][0] for c in caches])
+            pages_k = kv_pool_lib.scatter_prefill(pages_k, blocks, k_all)
+            pages_v = kv_pool_lib.scatter_prefill(pages_v, blocks, v_all)
+            return tok, pages_k, pages_v
+
+        return jax.jit(fn)
+
+    def _prefill(self, req: Request, events) -> None:
+        t0 = time.perf_counter()
+        seq = req.resume_tokens
+        bs = self.pool.block_size
+        nb = self.pool.blocks_for(len(seq))
+        padded = nb * bs
+        blocks = self.pool.alloc(nb)
+        ids = np.zeros((1, padded), np.int32)
+        ids[0, :len(seq)] = seq
+        key = ("prefill", padded)
+        fn = self._jit.get(key)
+        if fn is None:
+            fn = self._jit[key] = self._prefill_fn(padded, nb)
+        with profiled("serve.prefill", EventType.COMPUTE, self.profiler):
+            tok, pk, pv = fn(
+                self.params, self.pool.pages_k, self.pool.pages_v,
+                jnp.asarray(ids), jnp.asarray(len(seq), jnp.int32),
+                jnp.asarray(blocks, jnp.int32),
+                jnp.asarray(req.temperature, jnp.float32),
+                jnp.asarray(req.top_k, jnp.int32),
+                jnp.asarray(req.top_p, jnp.float32), self._next_key())
+            tok = int(tok)
+        self.pool.update_pages(pk, pv)
+        req.block_table = blocks
+        req.cache_len = len(seq)
+        self.scheduler.admit(req)
+        now = time.perf_counter()
+        self.metrics.observe_prefill(len(seq), now - t0)
+        if req.out_tokens:
+            # preemption recovery: the pending next_token survives; the
+            # prefill's own sample is redundant (greedy: identical) — drop it
+            pass
+        else:
+            req.next_token = tok
+            req.out_tokens.append(tok)
+            req.ttft_s = now - req.submit_time
+            self.metrics.observe_ttft(req.ttft_s)
+            events["tokens"].append((req.rid, tok))
+            self._maybe_finish(req, tok, events)
+
+    # -- decode ---------------------------------------------------------------
+
+    def _ensure_decode_capacity(self) -> None:
+        """Every running request must own the block its next token writes to;
+        preempt (LIFO) when the pool runs dry."""
+        bs = self.pool.block_size
+        for req in list(self.scheduler.running):
+            if req.state is not RequestState.RUNNING:
+                continue
+            if req.cache_len < len(req.block_table) * bs:
+                continue
+            while not self.pool.can_alloc(1):
+                victim = self.scheduler.preempt_victim()
+                if victim is None or (victim is req
+                                      and len(self.scheduler.running) == 1):
+                    # unreachable given submit()'s capacity validation
+                    raise RuntimeError(
+                        "KV pool deadlock: no preemption victim can free "
+                        "enough blocks")
+                self._preempt(victim)
+                if victim is req:
+                    break
+            if req.state is RequestState.RUNNING:
+                req.block_table.extend(self.pool.alloc(1))
+
+    def _preempt(self, req: Request) -> None:
+        self.pool.free(req.block_table)
+        req.block_table = []
+        req.cache_len = 0
+        self.scheduler.requeue(req)
+        self.metrics.observe_preemption()
+
+    def _decode_fn(self, batch: int, nb: int):
+        model = self.model
+
+        def fn(params, pages_k, pages_v, toks, offsets, tables, t, k, p, key):
+            kf, vf = kv_pool_lib.gather_kv(pages_k, pages_v, tables)
+            x, _ = model.wte.apply({"params": params["wte"], "state": {}},
+                                   toks[:, None])                 # (B, 1, D)
+            x, _ = model.wpe.apply({"params": params["wpe"], "state": {}},
+                                   x, offset=offsets)
+            rows_k, rows_v = [], []
+            idx = offsets[:, None, None, None]
+            for i, block in enumerate(model.blocks):
+                cache = {"k": kf[i], "v": vf[i]}
+                x, cache = block.apply_cached(params[f"h{i}"], x, cache,
+                                              offsets)
+                rows_k.append(
+                    jnp.take_along_axis(cache["k"], idx, axis=2)[:, :, 0])
+                rows_v.append(
+                    jnp.take_along_axis(cache["v"], idx, axis=2)[:, :, 0])
+            x, _ = model.ln_f.apply({"params": params["ln_f"], "state": {}}, x)
+            logits = model._head(params, x)[:, -1]                # (B, V)
+            newtok = sampling.sample_ragged(logits, key, t, k, p)
+            pages_k = kv_pool_lib.scatter_token(pages_k, tables, offsets,
+                                                jnp.stack(rows_k))
+            pages_v = kv_pool_lib.scatter_token(pages_v, tables, offsets,
+                                                jnp.stack(rows_v))
+            return newtok, pages_k, pages_v
+
+        return jax.jit(fn)
+
+    def _fused_decode_fn(self, batch: int, nb: int):
+        model = self.model
+        fused = self._fused
+        bs = self.pool.block_size
+
+        def fn(params, stacks, pages_k, pages_v, toks, offset, tables,
+               t, k, p, key):
+            from ..ops.pallas.decode_stack import fused_decode_stack
+
+            kf, vf = kv_pool_lib.gather_kv(pages_k, pages_v, tables)
+            # (L, B, H, T, Dh) -> the kernel's flat (L, B, T, D) layout
+            def flat(c):
+                l, b, h, tt, dh = c.shape
+                return c.transpose(0, 1, 3, 2, 4).reshape(l, b, tt, h * dh)
+            kc, vc = flat(kf), flat(vf)
+            x, _ = model.wte.apply({"params": params["wte"], "state": {}},
+                                   toks[:, None])
+            x, _ = model.wpe.apply({"params": params["wpe"], "state": {}},
+                                   x, offset=offset)
+            x_out, kc, vc = fused_decode_stack(
+                x[:, 0, :], offset, kc, vc, stacks,
+                num_heads=model.num_heads, chunks=fused["chunks"],
+                interpret=fused["interpret"])
+            xf, _ = model.ln_f.apply({"params": params["ln_f"], "state": {}},
+                                     x_out[:, None, :])
+            logits = model._head(params, xf)[:, -1]
+            newtok = sampling.sample_ragged(logits, key, t, k, p)
+            # extract the one new row per layer and page it back in
+            row_k = jax.lax.dynamic_slice_in_dim(kc, offset, 1, axis=2)[:, :, 0]
+            row_v = jax.lax.dynamic_slice_in_dim(vc, offset, 1, axis=2)[:, :, 0]
+            l, b, d = row_k.shape
+            h = model.num_kv_heads
+            offsets = jnp.full((b,), offset, jnp.int32)
+            pages_k = kv_pool_lib.scatter_token(
+                pages_k, tables, offsets, row_k.reshape(l, b, h, d // h))
+            pages_v = kv_pool_lib.scatter_token(
+                pages_v, tables, offsets, row_v.reshape(l, b, h, d // h))
+            return newtok, pages_k, pages_v
+
+        return jax.jit(fn)
+
+    def _decode(self, live: Sequence[Request], events) -> None:
+        t0 = time.perf_counter()
+        b = self.scheduler.max_batch_size
+        nb = self.blocks_per_seq
+        toks = np.zeros((b,), np.int32)
+        offsets = np.zeros((b,), np.int32)
+        tables = np.full((b, nb), PagedKVPool.SCRATCH, np.int32)
+        temps = np.zeros((b,), np.float32)
+        topks = np.zeros((b,), np.int32)
+        topps = np.zeros((b,), np.float32)
+        for i, req in enumerate(live):
+            toks[i] = req.next_token
+            offsets[i] = req.cache_len
+            tables[i, :len(req.block_table)] = req.block_table
+            temps[i] = req.temperature
+            topks[i] = req.top_k
+            topps[i] = req.top_p
+        lockstep = (self._fused is not None
+                    and len(set(offsets[:len(live)].tolist())) == 1)
+        if lockstep:
+            # padded rows share the live offset: their scratch-block writes
+            # stay harmless and the kernel's scalar position is uniform
+            offsets[len(live):] = offsets[0]
+        key, label = (("fdecode", b, nb), "serve.decode_fused") if lockstep \
+            else (("decode", b, nb), "serve.decode")
+        fn = self._jit.get(key)
+        if fn is None:
+            fn = self._jit[key] = (self._fused_decode_fn(b, nb) if lockstep
+                                   else self._decode_fn(b, nb))
+        with profiled(label, EventType.COMPUTE, self.profiler):
+            if lockstep:
+                newtok, pk, pv = fn(
+                    self.params, self._fused["stacks"], self.pool.pages_k,
+                    self.pool.pages_v, jnp.asarray(toks),
+                    jnp.asarray(int(offsets[0]), jnp.int32),
+                    jnp.asarray(tables), jnp.asarray(temps),
+                    jnp.asarray(topks), jnp.asarray(topps), self._next_key())
+            else:
+                newtok, pk, pv = fn(
+                    self.params, self.pool.pages_k, self.pool.pages_v,
+                    jnp.asarray(toks), jnp.asarray(offsets),
+                    jnp.asarray(tables), jnp.asarray(temps),
+                    jnp.asarray(topks), jnp.asarray(topps), self._next_key())
+            newtok = np.asarray(newtok)
+        self.pool.update_pages(pk, pv)
+        for i, req in enumerate(live):
+            tok = int(newtok[i])
+            req.cache_len += 1
+            req.next_token = tok
+            req.out_tokens.append(tok)
+            events["tokens"].append((req.rid, tok))
+            self._maybe_finish(req, tok, events)
+        self.metrics.observe_decode(len(live), time.perf_counter() - t0, b)
+
+    def _maybe_finish(self, req: Request, tok: int, events) -> None:
+        if req.stop_token is not None and tok == req.stop_token:
+            reason = "stop_token"
+        elif req.num_generated >= req.max_new_tokens:
+            reason = "length"
+        else:
+            return
+        self.pool.free(req.block_table)
+        req.block_table = []
+        self.scheduler.finish(req, reason)
+        self.metrics.observe_finish()
+        events["finished"].append(req.rid)

@@ -1,0 +1,181 @@
+"""Block-based paged KV-cache pool (vLLM-style, arXiv:2604.15464's storage
+model) for continuous-batching inference.
+
+The pool owns two device arrays of fixed-size token pages per layer,
+
+    pages_k, pages_v : (L, num_blocks, H_kv, block_size, head_dim)
+
+plus host-side bookkeeping: a free list, and a per-block refcount so a shared
+prompt prefix can be forked (``fork``) instead of copied. Sequences hold a
+*block table* — an ordered list of block ids — and the assembly helpers below
+turn a batch of block tables into the contiguous ``(B, H, T, Dh)`` caches that
+``nn.attention.MultiHeadAttention.apply_cached`` / ``GPT2.apply_cached``
+consume, so the whole model stack is reused unchanged.
+
+Block 0 is RESERVED as scratch: padded rows of a ragged batch (the engine
+always decodes at a fixed batch width) point their block tables at it, so
+their garbage reads/writes land somewhere harmless instead of in live blocks.
+
+The gather/scatter helpers are pure jnp functions — they trace into the
+engine's jitted prefill/decode steps, keeping the pool device-resident; only
+the alloc/free bookkeeping lives on the host.
+"""
+from __future__ import annotations
+
+import math
+from typing import Dict, List, Optional, Sequence
+
+import jax.numpy as jnp
+
+
+class PoolExhausted(RuntimeError):
+    """No free blocks — the scheduler preempts and retries."""
+
+
+class PagedKVPool:
+    SCRATCH = 0  # reserved block for padded/inactive batch rows
+
+    def __init__(self, num_layers: int, num_kv_heads: int, head_dim: int,
+                 num_blocks: int, block_size: int = 16, dtype=jnp.float32):
+        if num_blocks < 2:
+            raise ValueError("need >= 2 blocks (block 0 is reserved scratch)")
+        if block_size < 1:
+            raise ValueError(f"block_size must be >= 1, got {block_size}")
+        self.num_layers = int(num_layers)
+        self.num_kv_heads = int(num_kv_heads)
+        self.head_dim = int(head_dim)
+        self.num_blocks = int(num_blocks)
+        self.block_size = int(block_size)
+        self.dtype = dtype
+        shape = (self.num_layers, self.num_blocks, self.num_kv_heads,
+                 self.block_size, self.head_dim)
+        self.pages_k = jnp.zeros(shape, dtype)
+        self.pages_v = jnp.zeros(shape, dtype)
+        # LIFO free list: freshly freed blocks are reused first (their pages
+        # are warmest); block 0 never enters it
+        self._free: List[int] = list(range(self.num_blocks - 1, 0, -1))
+        self._ref: Dict[int, int] = {}
+
+    # -- bookkeeping ----------------------------------------------------------
+
+    @property
+    def capacity(self) -> int:
+        """Allocatable blocks (total minus the reserved scratch block)."""
+        return self.num_blocks - 1
+
+    @property
+    def num_free(self) -> int:
+        return len(self._free)
+
+    @property
+    def num_allocated(self) -> int:
+        return self.capacity - len(self._free)
+
+    @property
+    def occupancy(self) -> float:
+        return self.num_allocated / max(self.capacity, 1)
+
+    def blocks_for(self, num_tokens: int) -> int:
+        """Blocks needed to hold ``num_tokens`` cache positions."""
+        return max(1, math.ceil(num_tokens / self.block_size))
+
+    def can_alloc(self, n: int) -> bool:
+        return n <= len(self._free)
+
+    def alloc(self, n: int) -> List[int]:
+        """Take ``n`` blocks (refcount 1 each); raises PoolExhausted."""
+        if n > len(self._free):
+            raise PoolExhausted(
+                f"need {n} blocks, {len(self._free)} free "
+                f"(capacity {self.capacity})")
+        blocks = [self._free.pop() for _ in range(n)]
+        for b in blocks:
+            self._ref[b] = 1
+        return blocks
+
+    def fork(self, blocks: Sequence[int]) -> List[int]:
+        """Share ``blocks`` with another sequence (copy-on-write prefix
+        reuse): bump each refcount; the caller stores the same ids."""
+        for b in blocks:
+            if b not in self._ref:
+                raise KeyError(f"block {b} is not allocated")
+            self._ref[b] += 1
+        return list(blocks)
+
+    def free(self, blocks: Sequence[int]) -> None:
+        """Drop one reference per block; blocks reaching zero return to the
+        free list."""
+        for b in blocks:
+            r = self._ref.get(b)
+            if r is None:
+                raise KeyError(f"block {b} is not allocated (double free?)")
+            if r == 1:
+                del self._ref[b]
+                self._free.append(b)
+            else:
+                self._ref[b] = r - 1
+
+    # -- device pages ---------------------------------------------------------
+
+    def update_pages(self, pages_k, pages_v) -> None:
+        """Adopt the functionally-updated page arrays a jitted step returned."""
+        self.pages_k = pages_k
+        self.pages_v = pages_v
+
+    def padded_table(self, block_table: Sequence[int], width: int):
+        """Right-pad a block table with SCRATCH to a fixed ``width``."""
+        if len(block_table) > width:
+            raise ValueError(f"block table of {len(block_table)} exceeds "
+                             f"assembly width {width}")
+        return list(block_table) + [self.SCRATCH] * (width - len(block_table))
+
+
+# -- jit-safe assembly (trace into the engine's compiled steps) ---------------
+
+
+def gather_kv(pages_k, pages_v, block_tables):
+    """Block tables -> contiguous ragged-batch caches.
+
+    pages_*: (L, N, H, bs, Dh); block_tables: (B, nb) int32.
+    Returns two (L, B, H, nb*bs, Dh) arrays — per layer, exactly the cache
+    layout ``MultiHeadAttention.apply_cached`` reads. Positions past a row's
+    true length hold garbage; the ragged causal mask (per-row kv_offset) keeps
+    them out of the softmax.
+    """
+    def g(pages):
+        l, _, h, bs, dh = pages.shape
+        b, nb = block_tables.shape
+        x = pages[:, block_tables]               # (L, B, nb, H, bs, Dh)
+        x = x.transpose(0, 1, 3, 2, 4, 5)        # (L, B, H, nb, bs, Dh)
+        return x.reshape(l, b, h, nb * bs, dh)
+    return g(pages_k), g(pages_v)
+
+
+def scatter_prefill(pages, blocks, kv):
+    """Write one sequence's contiguous prefill cache into its blocks.
+
+    pages: (L, N, H, bs, Dh); blocks: (nb,) int32; kv: (L, H, nb*bs, Dh).
+    Returns the updated pages.
+    """
+    l, _, h, bs, dh = pages.shape
+    nb = blocks.shape[0]
+    x = kv.transpose(0, 2, 1, 3)                 # (L, P, H, Dh)
+    x = x.reshape(l, nb, bs, h, dh)              # (L, nb, bs, H, Dh)
+    x = x.transpose(0, 1, 3, 2, 4)               # (L, nb, H, bs, Dh)
+    return pages.at[:, blocks].set(x)
+
+
+def scatter_token(pages, block_tables, offsets, rows):
+    """Write one new KV row per sequence at its decode position.
+
+    pages: (L, N, H, bs, Dh); block_tables: (B, nb); offsets: (B,) the
+    position each row just wrote; rows: (L, B, H, Dh). Padded rows point
+    their table at SCRATCH, so their writes land in the scratch block.
+    """
+    bs = pages.shape[3]
+    blk = jnp.take_along_axis(block_tables, (offsets // bs)[:, None],
+                              axis=1)[:, 0]
+    slot = offsets % bs
+    # the two advanced indices (blk, slot) around sliced axes put the batch
+    # dim first in the update operand: (B, L, H, Dh)
+    return pages.at[:, blk, :, slot, :].set(rows.transpose(1, 0, 2, 3))

@@ -1,0 +1,132 @@
+"""Serving metrics: TTFT, per-token latency, queue depth, pool occupancy,
+throughput — wired into profiling.profiler.
+
+The engine wraps prefill/decode work in ``profiling.profiled`` spans (visible
+in the Chrome trace alongside training spans) and mirrors the aggregate
+counters into a Profiler via ``tick`` under ``serve.*`` keys, so one merged
+timeline covers both a training job and the serving engine colocated with it.
+"""
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional
+
+from ..profiling.profiler import Profiler
+
+
+def _percentile(xs: List[float], q: float) -> float:
+    """Nearest-rank percentile without a numpy dependency on the hot path."""
+    if not xs:
+        return 0.0
+    ys = sorted(xs)
+    idx = min(len(ys) - 1, max(0, int(round(q / 100.0 * (len(ys) - 1)))))
+    return ys[idx]
+
+
+class ServingMetrics:
+    """Aggregates one engine's request/step observations.
+
+    Latency samples are wall-clock seconds; throughput is generated tokens
+    over the span from the first observation to the latest one.
+    """
+
+    def __init__(self, profiler: Optional[Profiler] = None):
+        self.profiler = profiler
+        self.ttft_s: List[float] = []
+        self.token_latency_s: List[float] = []
+        self.queue_depth: List[int] = []
+        self.pool_occupancy: List[float] = []
+        self.batch_fill: List[float] = []
+        self.prefill_tokens = 0
+        self.decode_tokens = 0
+        self.preemptions = 0
+        self.finished = 0
+        self.steps = 0
+        self._t_first: Optional[float] = None
+        self._t_last: Optional[float] = None
+
+    # -- observations ---------------------------------------------------------
+
+    def _mark(self) -> float:
+        now = time.perf_counter()
+        if self._t_first is None:
+            self._t_first = now
+        self._t_last = now
+        return now
+
+    def _tick(self, key: str, value: float) -> None:
+        if self.profiler is not None:
+            self.profiler.tick(key, value)
+
+    def observe_ttft(self, seconds: float) -> None:
+        self._mark()
+        self.ttft_s.append(seconds)
+        self._tick("serve.ttft_s", seconds)
+
+    def observe_prefill(self, num_tokens: int, seconds: float) -> None:
+        self._mark()
+        self.prefill_tokens += num_tokens
+        self._tick("serve.prefill_s", seconds)
+
+    def observe_decode(self, num_tokens: int, seconds: float,
+                       batch_width: int) -> None:
+        """One decode step producing ``num_tokens`` live tokens out of a
+        compiled batch ``batch_width`` wide (fill ratio = padding waste)."""
+        self._mark()
+        self.decode_tokens += num_tokens
+        self.steps += 1
+        if num_tokens:
+            # every live request received exactly one token this step, so the
+            # step wall time IS the per-token latency each of them experienced
+            self.token_latency_s.append(seconds)
+        if batch_width:
+            self.batch_fill.append(num_tokens / batch_width)
+        self._tick("serve.decode_s", seconds)
+
+    def observe_gauges(self, queue_depth: int, pool_occupancy: float) -> None:
+        self.queue_depth.append(queue_depth)
+        self.pool_occupancy.append(pool_occupancy)
+
+    def observe_preemption(self) -> None:
+        self.preemptions += 1
+        self._tick("serve.preemptions", 1)
+
+    def observe_finish(self) -> None:
+        self.finished += 1
+
+    # -- aggregates -----------------------------------------------------------
+
+    @property
+    def elapsed_s(self) -> float:
+        if self._t_first is None or self._t_last is None:
+            return 0.0
+        return self._t_last - self._t_first
+
+    @property
+    def tokens_per_s(self) -> float:
+        el = self.elapsed_s
+        return self.decode_tokens / el if el > 0 else 0.0
+
+    def summary(self) -> Dict[str, float]:
+        """One flat dict — the shape benchmarks/serve_bench.py reports."""
+        def ms(x):
+            return x * 1e3
+
+        return {
+            "requests_finished": self.finished,
+            "decode_tokens": self.decode_tokens,
+            "prefill_tokens": self.prefill_tokens,
+            "steps": self.steps,
+            "preemptions": self.preemptions,
+            "tok_per_s": self.tokens_per_s,
+            "ttft_ms_mean": ms(sum(self.ttft_s) / len(self.ttft_s))
+            if self.ttft_s else 0.0,
+            "ttft_ms_p50": ms(_percentile(self.ttft_s, 50)),
+            "ttft_ms_p95": ms(_percentile(self.ttft_s, 95)),
+            "token_latency_ms_p50": ms(_percentile(self.token_latency_s, 50)),
+            "token_latency_ms_p95": ms(_percentile(self.token_latency_s, 95)),
+            "queue_depth_max": max(self.queue_depth, default=0),
+            "pool_occupancy_max": max(self.pool_occupancy, default=0.0),
+            "batch_fill_mean": (sum(self.batch_fill) / len(self.batch_fill))
+            if self.batch_fill else 0.0,
+        }

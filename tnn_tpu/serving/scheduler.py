@@ -1,0 +1,157 @@
+"""Continuous-batching scheduler: FCFS admission, per-step token budget,
+preemption with recompute-requeue.
+
+The scheduling model follows the Gemma-on-TPU serving comparison
+(arXiv:2605.25645): running requests decode one token every engine step;
+queued requests are admitted (prefilled) whenever the decode batch has a free
+slot, the step's token budget allows the prompt, and the KV pool has blocks —
+so the batch refills continuously instead of draining to empty like static
+batching.
+
+Preemption is recompute-style (vLLM's default): when the pool runs dry the
+LATEST-admitted running request frees all its blocks and re-queues at the
+FRONT of the wait queue, carrying its generated-so-far tokens as an extended
+prompt. Under greedy decoding the re-prefill reproduces the same KV state
+token-for-token, so preemption is invisible in the output stream.
+
+The scheduler is pure host-side policy — it never touches device arrays. The
+engine executes its plans and reports back via admit/finish/requeue.
+"""
+from __future__ import annotations
+
+import enum
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Deque, List, Optional
+
+import numpy as np
+
+
+class RequestState(enum.Enum):
+    QUEUED = "queued"        # waiting for admission (fresh or preempted)
+    RUNNING = "running"      # holds pool blocks; decodes every step
+    FINISHED = "finished"
+
+
+@dataclass
+class Request:
+    """One generation request plus its engine-managed lifecycle state."""
+    rid: int
+    prompt: np.ndarray                  # (P,) int32
+    max_new_tokens: int
+    temperature: float = 0.0
+    top_k: int = 0
+    top_p: float = 0.0
+    stop_token: Optional[int] = None
+    submit_time: float = 0.0
+
+    # -- engine-managed --
+    state: RequestState = RequestState.QUEUED
+    block_table: List[int] = field(default_factory=list)
+    cache_len: int = 0                  # tokens resident in the KV pool
+    next_token: Optional[int] = None    # sampled but not yet fed back
+    out_tokens: List[int] = field(default_factory=list)
+    preemptions: int = 0
+    ttft_s: Optional[float] = None
+    finish_reason: str = ""
+
+    @property
+    def num_generated(self) -> int:
+        return len(self.out_tokens)
+
+    @property
+    def resume_tokens(self) -> np.ndarray:
+        """The sequence a (re-)prefill must push through the model: the
+        prompt plus every generated token already fed back. The pending
+        ``next_token`` (sampled, not yet fed) is excluded — after preemption
+        it is carried over as-is, so recovery never re-samples."""
+        if not self.out_tokens:
+            return self.prompt
+        fed = np.asarray(self.out_tokens[:-1], np.int32)
+        return np.concatenate([self.prompt, fed])
+
+
+@dataclass
+class StepPlan:
+    prefills: List[Request]
+    decodes: List[Request]
+
+
+class Scheduler:
+    """FCFS continuous batching over a PagedKVPool.
+
+    ``token_budget`` caps the model tokens processed per step (decode steps
+    cost 1 per running request and take priority; prefills fill the rest).
+    A prompt longer than the whole budget is still admitted when it is the
+    only work — otherwise it could never start.
+    """
+
+    def __init__(self, max_batch_size: int = 8, token_budget: int = 2048):
+        if max_batch_size < 1:
+            raise ValueError("max_batch_size must be >= 1")
+        self.max_batch_size = int(max_batch_size)
+        self.token_budget = int(token_budget)
+        self.waiting: Deque[Request] = deque()
+        self.running: List[Request] = []  # admission order (oldest first)
+
+    # -- queue state ----------------------------------------------------------
+
+    @property
+    def has_work(self) -> bool:
+        return bool(self.waiting or self.running)
+
+    @property
+    def queue_depth(self) -> int:
+        return len(self.waiting)
+
+    def submit(self, req: Request) -> None:
+        req.state = RequestState.QUEUED
+        self.waiting.append(req)
+
+    # -- planning -------------------------------------------------------------
+
+    def schedule(self, pool) -> StepPlan:
+        """Plan one engine step: which queued requests to prefill-admit, and
+        the running set to decode. Admission is strictly FCFS — a blocked
+        queue head blocks everyone behind it (no out-of-order admission, so
+        no starvation)."""
+        budget = self.token_budget - len(self.running)
+        prefills: List[Request] = []
+        planned_blocks = 0
+        while self.waiting and \
+                len(self.running) + len(prefills) < self.max_batch_size:
+            req = self.waiting[0]
+            need = len(req.resume_tokens)
+            nb = pool.blocks_for(need)
+            if planned_blocks + nb > pool.num_free:
+                break
+            if need > budget and (prefills or self.running):
+                break  # over budget — admissible only as the sole work
+            budget -= need
+            planned_blocks += nb
+            prefills.append(self.waiting.popleft())
+        return StepPlan(prefills=prefills, decodes=list(self.running))
+
+    # -- lifecycle callbacks (engine-driven) ----------------------------------
+
+    def admit(self, req: Request) -> None:
+        req.state = RequestState.RUNNING
+        self.running.append(req)
+
+    def finish(self, req: Request, reason: str = "length") -> None:
+        req.state = RequestState.FINISHED
+        req.finish_reason = reason
+        self.running.remove(req)
+
+    def preempt_victim(self) -> Optional[Request]:
+        """LIFO victim choice: the latest-admitted running request loses its
+        blocks first (it has the least sunk prefill work)."""
+        return self.running[-1] if self.running else None
+
+    def requeue(self, req: Request) -> None:
+        """Recompute-preemption: back to the FRONT of the queue so FCFS order
+        is preserved; generated tokens ride along via ``resume_tokens``."""
+        self.running.remove(req)
+        req.state = RequestState.QUEUED
+        req.preemptions += 1
+        self.waiting.appendleft(req)
