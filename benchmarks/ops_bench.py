@@ -135,6 +135,74 @@ def bench_attention(quick=False):
     return out
 
 
+def bench_paged_attention(quick=False):
+    """Paged decode attention vs the gather_kv+XLA baseline it replaced.
+
+    The serving engine's old decode step assembled every live request's full
+    paged cache contiguously (kv_pool.gather_kv) before attending — O(B*T)
+    HBM copies per token. The paged kernel streams pages via block tables
+    instead. This row pair quantifies the win per (B, T, block_size) point;
+    the acceptance bar is paged >= 2x the gather baseline at T >= 512 on TPU
+    (off-TPU the "kernel" is the XLA reference — itself a gather — so the
+    CPU rows only check plumbing, not the speedup).
+    """
+    print("paged attention (decode step vs gather_kv+XLA baseline)")
+    from tnn_tpu.ops.pallas import paged_attention as pa
+    from tnn_tpu.serving import kv_pool as kv_pool_lib
+
+    on_tpu = jax.devices()[0].platform == "tpu"
+    H, HKV, D = 12, 12, 64  # gpt2_small decode geometry, one layer
+    sweep = [(8, 512, 16)] if quick else \
+        [(4, 512, 16), (8, 512, 16), (8, 1024, 16), (8, 2048, 16),
+         (8, 1024, 32)]
+    out = []
+    for B, T, bs in sweep:
+        nb = T // bs
+        num_blocks = B * nb + 1  # + scratch
+        rs = np.random.RandomState(0)
+        shape = (1, num_blocks, HKV, bs, D)
+        pages_k = jnp.asarray(rs.randn(*shape), jnp.bfloat16)
+        pages_v = jnp.asarray(rs.randn(*shape), jnp.bfloat16)
+        tables = jnp.asarray(
+            1 + np.arange(B * nb).reshape(B, nb), jnp.int32)
+        # ragged: rows spread over [T/2, T] like a live continuous batch
+        lens = jnp.asarray(np.linspace(T // 2, T, B).astype(np.int32))
+        q = jnp.asarray(rs.randn(B, H, D), jnp.bfloat16)
+
+        def baseline(q, pk, pv, tables, lens):
+            kf, vf = kv_pool_lib.gather_kv(pk, pv, tables)
+            from tnn_tpu.nn.attention import sdpa
+
+            o = sdpa(q[:, :, None, :], kf[0], vf[0], causal=True,
+                     kv_offset=lens - 1, backend="xla")
+            return o[:, :, 0]
+
+        def paged(q, pk, pv, tables, lens):
+            return pa.paged_attention(q, pk, pv, tables, lens)
+
+        fb = jax.jit(baseline)
+        fp = jax.jit(paged)
+        ref = pa.paged_attention_reference(q, pages_k, pages_v, tables, lens)
+        verify(f"paged_B{B}_T{T}", fp(q, pages_k, pages_v, tables, lens),
+               np.asarray(ref, np.float32), rtol=5e-2, atol=5e-2)
+        verify(f"gather_B{B}_T{T}", fb(q, pages_k, pages_v, tables, lens),
+               np.asarray(ref, np.float32), rtol=5e-2, atol=5e-2)
+        iters = 10 if quick else 50
+        dt_b = time_fn(fb, q, pages_k, pages_v, tables, lens, iters=iters)
+        dt_p = time_fn(fp, q, pages_k, pages_v, tables, lens, iters=iters)
+        # traffic actually attended (bf16 K+V), the bandwidth floor
+        bytes_live = 2 * 2 * float(np.asarray(lens).sum()) * HKV * D
+        out.append(report(f"paged_attn_B{B}_T{T}_bs{bs}", dt_p,
+                          extra={"kv_gb_per_s": bytes_live / dt_p / 1e9,
+                                 "gather_baseline_ms": dt_b * 1e3,
+                                 "speedup_vs_gather": dt_b / dt_p}))
+        if on_tpu and T >= 512 and dt_b / dt_p < 2.0:
+            raise AssertionError(
+                f"paged decode only {dt_b / dt_p:.2f}x vs gather at "
+                f"B={B} T={T} — acceptance bar is 2x")
+    return out
+
+
 def bench_long_context(quick=False):
     """Long-context flash attention fwd+bwd — the capability the reference
     caps at seq_len=1024 (example_models.cpp:385). The Pallas kernels keep
@@ -181,9 +249,9 @@ def main(argv=None):
     ap.add_argument("--quick", action="store_true", help="small shapes (CI/CPU)")
     ap.add_argument("--only", default=None,
                     help="comma list of benches to run "
-                         "(gemm,conv2d,dense,attention,long_context)")
+                         "(gemm,conv2d,dense,attention,paged,long_context)")
     args = ap.parse_args(argv)
-    known = {"gemm", "conv2d", "dense", "attention", "long_context"}
+    known = {"gemm", "conv2d", "dense", "attention", "paged", "long_context"}
     only = set(args.only.split(",")) if args.only else None
     if only is not None and only - known:
         # a typo must not produce an empty-but-rc=0 "evidence" log
@@ -205,6 +273,8 @@ def main(argv=None):
         runner.add(lambda: bench_dense_train(args.quick))
     if want("attention"):
         runner.add(lambda: bench_attention(args.quick), many=True)
+    if want("paged"):
+        runner.add(lambda: bench_paged_attention(args.quick), many=True)
     if want("long_context"):
         runner.add(lambda: bench_long_context(args.quick), many=True)
     main.last_runner = runner

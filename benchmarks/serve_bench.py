@@ -26,12 +26,13 @@ from benchmarks.common import RowRunner, report
 def bench_serving(model, params, *, num_requests: int, rate_per_s: float,
                   prompt_len: int, max_new: int, num_blocks: int,
                   block_size: int, max_batch_size: int, label: str,
-                  seed: int = 0):
+                  seed: int = 0, decode_path: str = "auto"):
     """Drive one engine through a Poisson arrival trace and report metrics."""
     from tnn_tpu.serving import InferenceEngine, ServingMetrics
 
     print(f"{label}: {num_requests} requests, ~{rate_per_s}/s Poisson, "
-          f"prompt {prompt_len}, max_new {max_new}")
+          f"prompt {prompt_len}, max_new {max_new}, "
+          f"decode_path={decode_path}")
     rng = np.random.default_rng(seed)
     arrivals = np.cumsum(rng.exponential(1.0 / rate_per_s, num_requests))
     prompts = rng.integers(0, model.vocab_size,
@@ -40,7 +41,8 @@ def bench_serving(model, params, *, num_requests: int, rate_per_s: float,
     engine = InferenceEngine(
         model, params, num_blocks=num_blocks, block_size=block_size,
         max_batch_size=max_batch_size,
-        max_seq_len=prompt_len + max_new, seed=seed)
+        max_seq_len=prompt_len + max_new, seed=seed,
+        decode_path=decode_path)
 
     # warm the compile caches outside the timed window: one prefill at the
     # benchmark's bucket and one decode step (the engine reuses both)
@@ -96,11 +98,15 @@ def main(argv=None):
 
     rr = RowRunner()
     if args.smoke:
+        # standard/paged A/B even in smoke: the decode_path column is the
+        # benchmark's whole point after the paged rewire
         model, params = _smoke_model()
-        rr.add(lambda: bench_serving(
-            model, params, num_requests=6, rate_per_s=50.0, prompt_len=6,
-            max_new=8, num_blocks=16, block_size=4, max_batch_size=4,
-            label="serve_smoke"), label="bench_serving")
+        for path in ("standard", "paged"):
+            rr.add(lambda p=path: bench_serving(
+                model, params, num_requests=6, rate_per_s=50.0, prompt_len=6,
+                max_new=8, num_blocks=16, block_size=4, max_batch_size=4,
+                label=f"serve_smoke_{p}", decode_path=p),
+                label=f"bench_serving_{path}")
         return rr.results
 
     from tnn_tpu import models
@@ -108,10 +114,12 @@ def main(argv=None):
     model = models.create(args.model)
     params = model.init(jax.random.PRNGKey(0), (1, 8))["params"]
     n, max_new = (8, 16) if args.quick else (32, 64)
-    rr.add(lambda: bench_serving(
-        model, params, num_requests=n, rate_per_s=args.rate, prompt_len=32,
-        max_new=max_new, num_blocks=128, block_size=16, max_batch_size=8,
-        label=f"serve_{args.model}"), label="bench_serving")
+    for path in ("standard", "paged"):
+        rr.add(lambda p=path: bench_serving(
+            model, params, num_requests=n, rate_per_s=args.rate,
+            prompt_len=32, max_new=max_new, num_blocks=128, block_size=16,
+            max_batch_size=8, label=f"serve_{args.model}_{p}",
+            decode_path=p), label=f"bench_serving_{path}")
     return rr.results
 
 

@@ -24,6 +24,18 @@ def rng():
     return jax.random.PRNGKey(0)
 
 
+@pytest.fixture(autouse=True)
+def _force_kernel_interpret(request, monkeypatch):
+    """@pytest.mark.kernel tests exercise Pallas kernel BODIES; off-TPU there
+    is no Mosaic compiler, so pin interpret mode via the shared runtime knob
+    (ops/pallas/runtime.interpret_default) rather than letting each call site
+    guess. On real TPU hardware (TNN_TEST_PLATFORM=tpu) the flag is left
+    alone and the kernels compile."""
+    if request.node.get_closest_marker("kernel") \
+            and jax.default_backend() != "tpu":
+        monkeypatch.setenv("TNN_PALLAS_INTERPRET", "1")
+
+
 # -- test tiers ---------------------------------------------------------------
 # Measured-slow tests (>15s on a 1-CPU host, mostly multi-minute mesh/pipeline
 # XLA compiles) are auto-marked so `pytest -m "not slow"` is a fast dev tier;

@@ -113,15 +113,32 @@ class TestBenchGateRetry:
 
 def test_serve_bench_smoke():
     """Fast (tiny random model) serving benchmark: must complete on CPU and
-    report TTFT + tokens/sec. Deliberately NOT slow-marked — it is the tier-1
-    guard that the serving suite stays runnable."""
+    report TTFT + tokens/sec for BOTH decode paths (standard/paged A/B).
+    Deliberately NOT slow-marked — it is the tier-1 guard that the serving
+    suite stays runnable."""
     from benchmarks import serve_bench
 
     results = [r for r in serve_bench.main(["--smoke"]) if r]
+    assert len(results) == 2
+    assert [r["bench"] for r in results] == ["serve_smoke_standard",
+                                             "serve_smoke_paged"]
+    for r in results:
+        assert r["ms"] > 0
+        assert r["tok_per_s"] > 0
+        assert r["ttft_ms_mean"] > 0
+        assert r["requests"] == 6
+
+
+@pytest.mark.slow
+def test_paged_attention_bench_quick():
+    """The paged-vs-gather ops bench must verify and report its speedup
+    column (quick sweep; off-TPU the speedup is informational only)."""
+    from benchmarks import ops_bench
+
+    results = [r for r in ops_bench.main(["--quick", "--only", "paged"])
+               if r]
     assert len(results) == 1
     r = results[0]
-    assert r["bench"] == "serve_smoke"
-    assert r["ms"] > 0
-    assert r["tok_per_s"] > 0
-    assert r["ttft_ms_mean"] > 0
-    assert r["requests"] == 6
+    assert r["bench"].startswith("paged_attn_B8_T512")
+    assert r["ms"] > 0 and r["gather_baseline_ms"] > 0
+    assert r["speedup_vs_gather"] > 0

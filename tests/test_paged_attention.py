@@ -1,0 +1,183 @@
+"""Parity tests for the ragged paged-attention decode kernel.
+
+The Pallas kernel (``ops/pallas/paged_attention``) runs in interpret mode on
+CPU (forced by the ``kernel`` marker's conftest fixture), checked against the
+XLA-lax reference in the same module; the reference itself is checked against
+a dense softmax-attention oracle built here. Covers ragged lengths, block
+sizes, GQA head ratios, layer selection, zero-length rows, and the
+``scatter_kv_rows`` write half of the page contract.
+"""
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tnn_tpu.ops.pallas import paged_attention as pa
+
+pytestmark = pytest.mark.kernel
+
+
+def _random_case(seed, *, num_layers=2, num_blocks=12, block_size=8,
+                 num_heads=4, num_kv_heads=2, head_dim=16, batch=3,
+                 blocks_per_row=3, dtype=jnp.float32):
+    """Random pool pages + block tables with ragged per-row lengths.
+
+    Block 0 plays the pool's reserved-scratch role: live tables draw from
+    blocks 1.., and rows' table tails are padded with 0 like the engine does.
+    """
+    rng = np.random.default_rng(seed)
+    shape = (num_layers, num_blocks, num_kv_heads, block_size, head_dim)
+    pages_k = jnp.asarray(rng.normal(size=shape), dtype)
+    pages_v = jnp.asarray(rng.normal(size=shape), dtype)
+    need = batch * blocks_per_row
+    assert need <= num_blocks - 1, "test geometry: not enough live blocks"
+    perm = rng.permutation(np.arange(1, num_blocks))[:need]
+    tables = perm.reshape(batch, blocks_per_row).astype(np.int32)
+    # ragged: one short row, one full row, one mid row ending mid-block
+    lens = rng.integers(1, blocks_per_row * block_size + 1, size=batch)
+    lens[0] = 1
+    lens[-1] = blocks_per_row * block_size
+    # dead trailing table entries point at scratch, as the engine pads them
+    for i in range(batch):
+        nb_live = math.ceil(lens[i] / block_size)
+        tables[i, nb_live:] = 0
+    q = jnp.asarray(rng.normal(size=(batch, num_heads, head_dim)), dtype)
+    return q, pages_k, pages_v, jnp.asarray(tables), jnp.asarray(
+        lens, jnp.int32)
+
+
+def _dense_oracle(q, pages_k, pages_v, tables, lens, layer):
+    """Plain-numpy masked softmax attention — independent of the module."""
+    q = np.asarray(q, np.float32)
+    k = np.asarray(pages_k[layer], np.float32)[np.asarray(tables)]
+    v = np.asarray(pages_v[layer], np.float32)[np.asarray(tables)]
+    b, nb, hkv, bs, dh = k.shape
+    h = q.shape[1]
+    g = h // hkv
+    k = k.transpose(0, 2, 1, 3, 4).reshape(b, hkv, nb * bs, dh)
+    v = v.transpose(0, 2, 1, 3, 4).reshape(b, hkv, nb * bs, dh)
+    out = np.zeros_like(q)
+    for i in range(b):
+        n = int(lens[i])
+        for qh in range(h):
+            kh = qh // g
+            if n == 0:
+                continue
+            s = k[i, kh, :n] @ q[i, qh] / math.sqrt(dh)
+            p = np.exp(s - s.max())
+            p /= p.sum()
+            out[i, qh] = p @ v[i, kh, :n]
+    return out
+
+
+@pytest.mark.parametrize("block_size", [4, 8])
+@pytest.mark.parametrize("heads", [(4, 4), (4, 2), (4, 1)],
+                         ids=["mha", "gqa2", "mqa"])
+def test_kernel_matches_reference_ragged(block_size, heads):
+    h, hkv = heads
+    q, pk, pv, tables, lens = _random_case(
+        block_size * 10 + h, block_size=block_size, num_heads=h,
+        num_kv_heads=hkv)
+    for layer in range(pk.shape[0]):
+        ref = pa.paged_attention_reference(q, pk, pv, tables, lens,
+                                           layer=layer)
+        out = pa.paged_attention(q, pk, pv, tables, lens, layer=layer,
+                                 backend="pallas")
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-5, rtol=2e-5)
+
+
+def test_reference_matches_dense_oracle():
+    q, pk, pv, tables, lens = _random_case(7)
+    for layer in range(pk.shape[0]):
+        ref = pa.paged_attention_reference(q, pk, pv, tables, lens,
+                                           layer=layer)
+        oracle = _dense_oracle(q, pk, pv, tables, lens, layer)
+        np.testing.assert_allclose(np.asarray(ref), oracle, atol=1e-5,
+                                   rtol=1e-5)
+
+
+def test_zero_length_rows_output_zero():
+    q, pk, pv, tables, lens = _random_case(11)
+    lens = lens.at[0].set(0).at[2].set(0)
+    for backend in ("pallas", "xla"):
+        out = pa.paged_attention(q, pk, pv, tables, lens, backend=backend)
+        assert np.all(np.asarray(out[0]) == 0), backend
+        assert np.all(np.asarray(out[2]) == 0), backend
+        np.testing.assert_allclose(
+            np.asarray(out[1]),
+            _dense_oracle(q, pk, pv, tables, lens, 0)[1],
+            atol=2e-5, rtol=2e-5)
+
+
+def test_single_token_rows():
+    """kv_len == 1 everywhere: attention is the identity over the one row."""
+    q, pk, pv, tables, _ = _random_case(13)
+    lens = jnp.ones((q.shape[0],), jnp.int32)
+    out = pa.paged_attention(q, pk, pv, tables, lens, backend="pallas")
+    oracle = _dense_oracle(q, pk, pv, tables, lens, 0)
+    np.testing.assert_allclose(np.asarray(out), oracle, atol=2e-5, rtol=2e-5)
+
+
+def test_single_layer_pages_and_bf16():
+    q, pk, pv, tables, lens = _random_case(17, dtype=jnp.bfloat16)
+    out = pa.paged_attention(q, pk[0], pv[0], tables, lens, backend="pallas")
+    ref = pa.paged_attention_reference(q, pk[0], pv[0], tables, lens)
+    assert out.dtype == jnp.bfloat16
+    np.testing.assert_allclose(np.asarray(out, np.float32),
+                               np.asarray(ref, np.float32), atol=3e-2)
+
+
+def test_scatter_kv_rows_roundtrip():
+    rng = np.random.default_rng(3)
+    q, pk, pv, tables, lens = _random_case(19)
+    b, h_kv, bs, dh = q.shape[0], pk.shape[2], pk.shape[3], pk.shape[4]
+    rows = jnp.asarray(rng.normal(size=(b, h_kv, dh)), jnp.float32)
+    offsets = lens - 1  # write at each row's last live position
+    pk2 = pa.scatter_kv_rows(pk, tables, offsets, rows, layer=1)
+    for i in range(b):
+        blk = int(tables[i, int(offsets[i]) // bs])
+        slot = int(offsets[i]) % bs
+        np.testing.assert_array_equal(np.asarray(pk2[1, blk, :, slot, :]),
+                                      np.asarray(rows[i]))
+    # layer 0 untouched
+    np.testing.assert_array_equal(np.asarray(pk2[0]), np.asarray(pk[0]))
+    # 4-D single-layer form
+    pk1 = pa.scatter_kv_rows(pk[0], tables, offsets, rows)
+    blk0 = int(tables[0, int(offsets[0]) // bs])
+    np.testing.assert_array_equal(
+        np.asarray(pk1[blk0, :, int(offsets[0]) % bs, :]),
+        np.asarray(rows[0]))
+
+
+def test_jit_and_traced_layer_index():
+    """The engine traces layer as a loop-carried python int, but the kernel
+    must also accept it traced (scalar-prefetch operand)."""
+    q, pk, pv, tables, lens = _random_case(23)
+
+    @jax.jit
+    def run(q, pk, pv, tables, lens, layer):
+        return pa.paged_attention(q, pk, pv, tables, lens, layer=layer,
+                                  backend="pallas")
+
+    for layer in range(pk.shape[0]):
+        out = run(q, pk, pv, tables, lens, jnp.asarray(layer, jnp.int32))
+        ref = pa.paged_attention_reference(q, pk, pv, tables, lens,
+                                           layer=layer)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-5, rtol=2e-5)
+
+
+def test_arg_validation():
+    q, pk, pv, tables, lens = _random_case(29)
+    with pytest.raises(ValueError, match="kv heads"):
+        pa.paged_attention(q[:, :3], pk, pv, tables, lens)
+    with pytest.raises(ValueError, match="batch"):
+        pa.paged_attention(q, pk, pv, tables[:2], lens)
+    with pytest.raises(ValueError, match="backend"):
+        pa.paged_attention(q, pk, pv, tables, lens, backend="cuda")
+    with pytest.raises(ValueError, match="layer is required"):
+        pa.scatter_kv_rows(pk, tables, lens - 1,
+                           jnp.zeros((3, 2, 16)))

@@ -252,6 +252,107 @@ class TestEngineTiny:
         assert eng.result(rid).finish_reason == "stop_token"
         assert eng.pool.num_allocated == 0
 
+    def test_paged_parity_staggered(self, tiny_lm):
+        """decode_path="paged" (no gather_kv, pages attended via block
+        tables) must match "standard" token-for-token AND the offline
+        reference, under staggered admission (ragged offsets)."""
+        model, params = tiny_lm
+        rng = np.random.default_rng(5)
+        prompts = [rng.integers(0, 128, p).astype(np.int32)
+                   for p in (5, 9, 16, 7)]
+
+        def run(path):
+            eng = InferenceEngine(model, params, num_blocks=32, block_size=4,
+                                  max_batch_size=4, max_seq_len=32,
+                                  decode_path=path)
+            rids = [eng.submit(prompts[0], 10)]
+            eng.step(); eng.step()
+            rids += [eng.submit(p, 10) for p in prompts[1:]]
+            out = eng.run_until_complete()
+            return eng, [out[r] for r in rids]
+
+        eng, paged = run("paged")
+        assert eng._paged and eng.paged_fallback_reason is None
+        assert eng.fused_fallback_reason == \
+            "unused (paged decode path selected)"
+        _, std = run("standard")
+        assert paged == std
+        for toks, p in zip(paged, prompts):
+            assert toks == _greedy_ref(model, params, p, 10,
+                                       eng.assembly_len)
+
+    def test_paged_preemption_parity(self, tiny_lm):
+        """Preemption-recovery (recompute-requeue) must be byte-identical
+        between the paged and standard decode paths."""
+        model, params = tiny_lm
+        rng = np.random.default_rng(6)
+        prompts = [rng.integers(0, 128, p).astype(np.int32)
+                   for p in (5, 9, 16, 7)]
+
+        def run(path):
+            eng = InferenceEngine(model, params, num_blocks=9, block_size=4,
+                                  max_batch_size=4, max_seq_len=32,
+                                  decode_path=path)
+            for p in prompts:
+                eng.submit(p, 10)
+            return eng, eng.run_until_complete()
+
+        eng_p, out_p = run("paged")
+        eng_s, out_s = run("standard")
+        assert eng_p.metrics.preemptions > 0, "pool was never exhausted"
+        assert out_p == out_s
+        assert eng_p.pool.num_allocated == 0
+
+    def test_paged_mixed_sampling(self, tiny_lm):
+        """Stochastic rows ride the paged step too: same engine seed =>
+        identical streams vs the standard path (same sampling draws over
+        identical logits)."""
+        model, params = tiny_lm
+
+        def run(path):
+            eng = InferenceEngine(model, params, num_blocks=32, block_size=4,
+                                  max_batch_size=4, max_seq_len=32, seed=3,
+                                  decode_path=path)
+            p = np.arange(6, dtype=np.int32)
+            g = eng.submit(p, 8)
+            s = eng.submit(p, 8, temperature=0.9, top_k=16, top_p=0.9)
+            out = eng.run_until_complete()
+            return out[g], out[s]
+
+        assert run("paged") == run("standard")
+
+    def test_paged_probe_fallback(self, tiny_lm):
+        """A model without apply_decode_paged falls back under auto (reason
+        recorded); decode_path="paged" makes the failure fatal."""
+        model, params = tiny_lm
+        plain = type("NoPaged", (), {})()
+        for attr in ("kv_cache_dtype", "max_len", "d_model", "num_heads",
+                     "num_kv_heads", "num_layers", "policy", "moe_experts"):
+            setattr(plain, attr, getattr(model, attr, None))
+        eng = InferenceEngine.__new__(InferenceEngine)
+        # probe in isolation: the full engine needs a real model elsewhere
+        eng.model = plain
+        with pytest.raises(ValueError, match="apply_decode_paged"):
+            eng._probe_paged()
+        eng2 = InferenceEngine(model, params, num_blocks=8, block_size=4,
+                               max_batch_size=2, max_seq_len=16,
+                               decode_path="standard")
+        assert not eng2._paged
+        assert "decode_path" in eng2.paged_fallback_reason
+
+    def test_prefill_bucketing_bounds_compiles(self, tiny_lm):
+        """Prompt lengths quantize to power-of-two block buckets: many
+        distinct lengths share O(log) compiled prefill programs."""
+        model, params = tiny_lm
+        eng = InferenceEngine(model, params, num_blocks=32, block_size=4,
+                              max_batch_size=4, max_seq_len=32)
+        for n in (1, 2, 3, 4, 5, 7, 9, 11, 13, 15):
+            eng.submit(np.arange(n, dtype=np.int32) % 128, 2)
+        eng.run_until_complete()
+        buckets = sorted(k[1] for k in eng._jit if k[0] == "prefill")
+        # nb 1,2,3,4 -> buckets 1,2,4 -> padded 4,8,16 (cap: blocks_per_seq 8)
+        assert buckets == [4, 8, 16]
+
     def test_submit_validation(self, tiny_lm):
         model, params = tiny_lm
         eng = InferenceEngine(model, params, num_blocks=4, block_size=4,
@@ -324,3 +425,41 @@ def test_gpt2_small_staggered_greedy():
     # ~0.01+ top-2 gaps a non-greedy bug would violate
     assert exact >= 0.9 * total, f"only {exact}/{total} tokens were argmax"
     assert all(m < 0.05 for m in ties), f"non-tie divergence: {ties}"
+
+
+def test_gpt2_small_paged_matches_standard():
+    """Acceptance bar for the paged decode path: on gpt2_small, staggered
+    submissions with preemption, decode_path="paged" must produce
+    TOKEN-IDENTICAL streams to "standard".
+
+    Unlike the teacher-forced test above, exact equality is well-posed here:
+    both engines run the same schedule over the same weights, so every
+    near-tie must resolve the same way — any divergence is a real paged-path
+    bug (wrong page read/write, off-by-one kv length, table mix-up), not fp
+    noise."""
+    from tnn_tpu.models.zoo import create
+
+    model = create("gpt2_small")
+    params = model.init(jax.random.PRNGKey(0), (1, 8))["params"]
+    rng = np.random.default_rng(0)
+    prompts = rng.integers(0, model.vocab_size, (8, 12)).astype(np.int32)
+    max_new = 16
+
+    def run(path):
+        eng = InferenceEngine(model, params, num_blocks=14, block_size=16,
+                              max_batch_size=8, max_seq_len=32,
+                              decode_path=path)
+        rids = []
+        for i, p in enumerate(prompts):
+            rids.append(eng.submit(p, max_new))
+            if i % 3 == 2:
+                eng.step()
+        out = eng.run_until_complete()
+        return eng, [out[r] for r in rids]
+
+    eng_p, paged = run("paged")
+    eng_s, std = run("standard")
+    assert eng_p.metrics.preemptions > 0, "pool was never exhausted"
+    assert eng_s.metrics.preemptions > 0
+    assert paged == std
+    assert eng_p.pool.num_allocated == 0

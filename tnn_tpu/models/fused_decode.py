@@ -133,7 +133,9 @@ def fused_generate(model, params, prompt_ids, max_new_tokens: int,
     if rng is None:
         rng = jax.random.PRNGKey(0)
     if interpret is None:  # Mosaic path on TPU; emulated elsewhere
-        interpret = jax.default_backend() != "tpu"
+        from ..ops.pallas.runtime import interpret_default
+
+        interpret = interpret_default()
     if chunks is None:
         chunks = pick_chunks(model.d_model, 4 * model.d_model, batch, max_len)
         if chunks is None:

@@ -148,6 +148,26 @@ class GPT2(Module):
         x, _ = self.ln_f.apply({"params": params["ln_f"], "state": {}}, x)
         return self._head(params, x), new_caches
 
+    def apply_decode_paged(self, params, toks, pages_k, pages_v, block_tables,
+                           offsets):
+        """One decode step straight against the paged KV pool (serving).
+
+        toks (B,) this step's token per row; pages_k/pages_v the pool's
+        (L, N, H_kv, bs, Dh) arrays with L == num_layers; block_tables (B, nb)
+        page ids; offsets (B,) each row's position (kv length before this
+        token). Every layer scatters its new K/V row into its page and
+        attends over the tables (GPTBlock.apply_paged) — no contiguous cache
+        is ever assembled. Returns (last-position logits (B, V), pages_k,
+        pages_v); donate the pages through jit for in-place pool updates.
+        """
+        x, _ = self._trunk(params, toks[:, None], False, None, offset=offsets)
+        for i, block in enumerate(self.blocks):
+            x, pages_k, pages_v = block.apply_paged(
+                params[f"h{i}"], x, pages_k, pages_v, block_tables, offsets,
+                layer=i)
+        x, _ = self.ln_f.apply({"params": params["ln_f"], "state": {}}, x)
+        return self._head(params, x)[:, -1], pages_k, pages_v
+
     def _config(self):
         cfg = {"vocab_size": self.vocab_size, "max_len": self.max_len,
                "num_layers": self.num_layers, "d_model": self.d_model,

@@ -154,9 +154,10 @@ def sdpa(q, k, v, *, causal: bool = False, mask: Optional[jax.Array] = None,
             "ring attention does not support mask/kv_offset (cached decode); "
             "run decode outside the ring context with backend='xla'")
     if backend == "pallas" and not ragged:
-        # the flash kernel takes a scalar kv_offset only; ragged decode
-        # batches route to the XLA path (the ragged paged-attention kernel
-        # is future work — see docs/serving.md)
+        # the flash kernel takes a scalar kv_offset only; ragged
+        # assembled-cache batches route to the XLA path (ragged decode's
+        # native route is the paged path — apply_paged over
+        # ops.pallas.paged_attention, no assembled cache at all)
         from ..ops.pallas.flash_attention import flash_attention
 
         return flash_attention(q, k, v, causal=causal, scale=scale,
@@ -417,6 +418,47 @@ class MultiHeadAttention(Module):
                    backend=self.backend if self.backend != "ring" else "xla")
         y = self._project_out(params, out, False, None)
         return y, cache
+
+    def apply_paged(self, variables, x, pages_k, pages_v, block_tables,
+                    offsets, layer=0):
+        """One decode step straight against the paged KV pool.
+
+        The serving hot path (docs/serving.md): instead of assembling a
+        contiguous cache (``apply_cached`` over ``kv_pool.gather_kv``), the
+        new token's K/V row is scattered into its page and attention streams
+        the pages the block table names (``ops.pallas.paged_attention``).
+
+        x : (B, 1, D) — this step's single token per row.
+        pages_k / pages_v : the pool's (L, N, H_kv, bs, Dh) arrays; ``layer``
+            selects this block's slice without copying it.
+        block_tables : (B, nb) page ids; offsets : (B,) the position each row
+            writes (its kv length BEFORE this token).
+
+        Returns (out (B, 1, D), pages_k, pages_v) — pages updated only at the
+        B written rows, so with the pool buffers donated through jit the
+        update is in place.
+        """
+        if self.kv_cache_dtype == "int8":
+            raise NotImplementedError(
+                "paged decode with int8 KV pages is future work — pool pages "
+                "are compute-dtype (see docs/serving.md limits)")
+        params = variables["params"]
+        q, k_new, v_new = self._project_qkv(params, x)   # (B, H*, 1, Dh)
+        if self.rope_theta:
+            q = apply_rope(q, offsets, self.rope_theta)
+            k_new = apply_rope(k_new, offsets, self.rope_theta)
+        from ..ops.pallas import paged_attention as pa
+
+        pages_k = pa.scatter_kv_rows(pages_k, block_tables, offsets,
+                                     k_new[:, :, 0].astype(pages_k.dtype),
+                                     layer=layer)
+        pages_v = pa.scatter_kv_rows(pages_v, block_tables, offsets,
+                                     v_new[:, :, 0].astype(pages_v.dtype),
+                                     layer=layer)
+        out = pa.paged_attention(q[:, :, 0], pages_k, pages_v, block_tables,
+                                 kv_lens=offsets + 1, layer=layer)
+        y = self._project_out(params, out[:, :, None, :], False, None)
+        return y, pages_k, pages_v
 
     def output_shape(self, input_shape):
         return tuple(input_shape)

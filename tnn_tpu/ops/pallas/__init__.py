@@ -1,3 +1,5 @@
 from . import flash_attention
+from . import paged_attention
+from . import runtime
 
-__all__ = ["flash_attention"]
+__all__ = ["flash_attention", "paged_attention", "runtime"]

@@ -28,6 +28,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from .runtime import interpret_default
+
 # jax 0.4.x spells it TPUCompilerParams; the kwargs used here are identical
 _CompilerParams = getattr(pltpu, "CompilerParams", None) \
     or pltpu.TPUCompilerParams
@@ -321,7 +323,7 @@ def _flash_fwd(q, k, v, mask, off, causal, scale, block_q, block_k,
         # iterations are independent, which lets Mosaic pipeline them
         compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
-        interpret=jax.default_backend() != "tpu",
+        interpret=interpret_default(),
     )(*inputs)
     out = out[:, :sq].reshape(b, h, sq, d)
     # residual is the compact UNPADDED (b*h, sq) row vector — the backward may
@@ -535,7 +537,7 @@ def _flash_bwd(causal, scale, block_q, block_k, block_q_bwd, block_k_bwd,
     has_mask = mask is not None
     maskp = (_pad_to(_pad_to(mask, sq_p, 1), skv_p, 2) if has_mask else None)
 
-    interpret = jax.default_backend() != "tpu"
+    interpret = interpret_default()
     common = dict(scale=scale, causal=causal, bq=bq, bk=bk, kv_len=skv,
                   has_mask=has_mask)
     # dead-block DMA elision, same as forward/fused: dq grid (bh, i, j) has
@@ -729,7 +731,7 @@ def _flash_bwd_fused(causal, scale, bq, bk, clamp_dead, residuals, g):
         compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "arbitrary", "arbitrary"),
             vmem_limit_bytes=100 * 2**20),
-        interpret=jax.default_backend() != "tpu",
+        interpret=interpret_default(),
     )(*inputs)
 
     dq = dq[:, :sq].reshape(b, h, sq, d)

@@ -1,0 +1,261 @@
+"""Ragged paged-attention decode kernel (Pallas TPU) + XLA-lax reference.
+
+The serving engine's decode hot path (arXiv:2604.15464's storage model): each
+request's KV cache lives in fixed-size pages of the pool arrays
+
+    pages_k, pages_v : (L, num_blocks, H_kv, block_size, head_dim)
+
+and a per-request *block table* names its pages in logical order. The old
+decode step materialized every live request's full cache contiguously
+(``serving.kv_pool.gather_kv``) before attending — O(B * T_max) HBM copies per
+token. This kernel consumes the pages DIRECTLY: the block tables and per-row
+kv lengths are scalar-prefetched, the BlockSpec index maps chase the tables,
+and flash-style online softmax accumulates over the streamed pages — so the
+only KV traffic per step is the KV actually attended over, and no contiguous
+cache ever exists.
+
+Grid: ``(B, H_kv, num_table_entries)`` — the innermost axis sweeps one row's
+block table; the (m, l, acc) scratch carries the online softmax across it.
+Grouped-query attention is zero-copy: q is viewed as (B, H_kv, G, Dh) and each
+grid step attends its whole q-head group against one fetched kv page. Pages
+past a row's live length clamp their fetch index to the last live page, so the
+Pallas pipeline elides the dead DMAs (same trick as flash_attention's causal
+dead-block clamp), and ``pl.when`` skips their compute.
+
+``paged_attention_reference`` is the same math in plain lax (gather the tables
+into a contiguous cache, masked softmax) — the parity oracle for the kernel
+and the CPU/interpret fallback the router picks off-TPU, mirroring how
+``flash_attention`` routes. ``scatter_kv_rows`` is the write half of the page
+contract: the one new KV row per sequence per step.
+"""
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .runtime import interpret_default
+
+# jax 0.4.x spells it TPUCompilerParams; the kwargs used here are identical
+_CompilerParams = getattr(pltpu, "CompilerParams", None) \
+    or pltpu.TPUCompilerParams
+
+_NEG_INF = -1e30
+
+
+def _decode_kernel(tables_ref, lens_ref, layer_ref, q_ref, k_ref, v_ref,
+                   o_ref, m_scr, l_scr, acc_scr, *, scale: float, bs: int,
+                   g: int):
+    del tables_ref, layer_ref  # consumed by the index maps, not the body
+    b = pl.program_id(0)
+    j = pl.program_id(2)
+    nj = pl.num_programs(2)
+
+    @pl.when(j == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, _NEG_INF)   # (g, 1) running max
+        l_scr[:] = jnp.zeros_like(l_scr)            # (g, 1) running denom
+        acc_scr[:] = jnp.zeros_like(acc_scr)        # (g, Dh) output acc
+
+    kv_len = lens_ref[b]
+
+    @pl.when(j * bs < kv_len)
+    def _block():
+        q = q_ref[0, 0]        # (g, Dh) — one kv head's whole query group
+        k = k_ref[0, 0, 0]     # (bs, Dh) — one page
+        v = v_ref[0, 0, 0]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        kpos = j * bs + jax.lax.broadcasted_iota(jnp.int32, (g, bs), 1)
+        mask = kpos < kv_len   # ragged tail of the last live page
+        s = jnp.where(mask, s, _NEG_INF)
+        m_prev, l_prev = m_scr[:], l_scr[:]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        p = jnp.where(mask, jnp.exp(s - m_new), 0.0)
+        alpha = jnp.exp(m_prev - m_new)
+        l_scr[:] = alpha * l_prev + jnp.sum(p, axis=1, keepdims=True)
+        acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_scr[:] = m_new
+
+    @pl.when(j == nj - 1)
+    def _final():
+        l = l_scr[:]
+        lsafe = jnp.where(l == 0.0, 1.0, l)  # kv_len == 0 rows -> output 0
+        o_ref[0, 0] = (acc_scr[:] / lsafe).astype(o_ref.dtype)
+
+
+def _paged_attention_pallas(q, pages_k, pages_v, block_tables, kv_lens,
+                            layer, scale, interpret):
+    b, h, dh = q.shape
+    _, _, hkv, bs, _ = pages_k.shape
+    g = h // hkv
+    nb = block_tables.shape[1]
+    qg = q.reshape(b, hkv, g, dh)
+    tables = block_tables.astype(jnp.int32)
+    lens = kv_lens.astype(jnp.int32)
+    layer_arr = jnp.reshape(jnp.asarray(layer, jnp.int32), (1,))
+
+    def kv_index(bi, hi, j, tbl, ln, ly):
+        # clamp dead trailing pages to the row's last live page: the repeated
+        # block index lets the pipeline elide the DMA (compute is pl.when-
+        # skipped); max(len, 1) keeps fully-dead rows fetching page 0
+        nlive = (jnp.maximum(ln[bi], 1) + bs - 1) // bs
+        return (ly[0], tbl[bi, jnp.minimum(j, nlive - 1)], hi, 0, 0)
+
+    def q_index(bi, hi, j, tbl, ln, ly):
+        return (bi, hi, 0, 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=(b, hkv, nb),
+        in_specs=[
+            pl.BlockSpec((1, 1, g, dh), q_index),
+            pl.BlockSpec((1, 1, 1, bs, dh), kv_index),
+            pl.BlockSpec((1, 1, 1, bs, dh), kv_index),
+        ],
+        out_specs=pl.BlockSpec((1, 1, g, dh), q_index),
+        scratch_shapes=[
+            pltpu.VMEM((g, 1), jnp.float32),
+            pltpu.VMEM((g, 1), jnp.float32),
+            pltpu.VMEM((g, dh), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        functools.partial(_decode_kernel, scale=scale, bs=bs, g=g),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, hkv, g, dh), q.dtype),
+        # scratch carries only along the innermost (page) sweep
+        compiler_params=_CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(tables, lens, layer_arr, qg, pages_k, pages_v)
+    return out.reshape(b, h, dh)
+
+
+def _paged_attention_xla(q, pages_k, pages_v, block_tables, kv_lens, layer,
+                         scale):
+    b, h, dh = q.shape
+    _, _, hkv, bs, _ = pages_k.shape
+    g = h // hkv
+    t = block_tables.shape[1] * bs
+
+    def gather(pages):
+        x = pages[layer][block_tables]           # (B, nb, Hkv, bs, Dh)
+        return x.transpose(0, 2, 1, 3, 4).reshape(b, hkv, t, dh)
+
+    k, v = gather(pages_k), gather(pages_v)
+    qg = q.reshape(b, hkv, g, dh)
+    s = jnp.einsum("bhgd,bhtd->bhgt", qg, k,
+                   preferred_element_type=jnp.float32) * scale
+    live = jnp.arange(t)[None, :] < kv_lens[:, None]      # (B, T)
+    s = jnp.where(live[:, None, None, :], s, _NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    # kv_len == 0 rows attend to NOTHING (output 0), matching the kernel's
+    # l == 0 guard — softmax alone would return uniform garbage attention
+    p = jnp.where(kv_lens[:, None, None, None] > 0, p, 0.0)
+    out = jnp.einsum("bhgt,bhtd->bhgd", p.astype(v.dtype), v,
+                     preferred_element_type=jnp.float32)
+    return out.astype(q.dtype).reshape(b, h, dh)
+
+
+def paged_attention_reference(q, pages_k, pages_v, block_tables, kv_lens, *,
+                              layer=0, scale: Optional[float] = None):
+    """XLA-lax reference: gather the tables contiguous, masked softmax.
+
+    Same signature/semantics as ``paged_attention`` — the parity oracle for
+    the kernel and the off-TPU fallback (it IS a gather, which is exactly
+    what the kernel exists to avoid on TPU)."""
+    q, pages_k, pages_v, scale = _check_args(q, pages_k, pages_v,
+                                             block_tables, kv_lens, scale)
+    return _paged_attention_xla(q, pages_k, pages_v, block_tables, kv_lens,
+                                layer, scale)
+
+
+def _check_args(q, pages_k, pages_v, block_tables, kv_lens, scale):
+    if pages_k.ndim == 4:      # single-layer pages: add the unit layer axis
+        pages_k, pages_v = pages_k[None], pages_v[None]
+    if pages_k.shape != pages_v.shape or pages_k.ndim != 5:
+        raise ValueError(f"pages must both be (L, N, H_kv, bs, Dh); got "
+                         f"{pages_k.shape} / {pages_v.shape}")
+    b, h, dh = q.shape
+    hkv = pages_k.shape[2]
+    if h % hkv or pages_k.shape[4] != dh:
+        raise ValueError(f"q has {h} heads / Dh {dh} but pages carry "
+                         f"{hkv} kv heads / Dh {pages_k.shape[4]}; "
+                         "need H % H_kv == 0 and equal head dims")
+    if block_tables.shape[0] != b or kv_lens.shape != (b,):
+        raise ValueError(f"block_tables {block_tables.shape} / kv_lens "
+                         f"{kv_lens.shape} do not match batch {b}")
+    if scale is None:
+        scale = 1.0 / math.sqrt(dh)
+    return q, pages_k, pages_v, scale
+
+
+def paged_attention(q, pages_k, pages_v, block_tables, kv_lens, *,
+                    layer=0, scale: Optional[float] = None,
+                    backend: str = "auto",
+                    interpret: Optional[bool] = None):
+    """Decode attention for the current step's q rows over paged KV.
+
+    q : (B, H, Dh) — this step's query rows (one token per sequence).
+    pages_k / pages_v : (L, N, H_kv, bs, Dh) pool pages (or a single layer's
+        (N, H_kv, bs, Dh); ``layer`` then ignored). Never copied: the kernel
+        fetches only the pages the tables name.
+    block_tables : (B, nb) int32 — page ids in logical order; entries past a
+        row's live pages may be anything in-range (the pool pads with its
+        scratch page 0).
+    kv_lens : (B,) int32 — live KV positions per row INCLUDING the row
+        written this step (the engine scatters the new row first and passes
+        ``offsets + 1``). A 0 row outputs exactly 0.
+    layer : which layer's pages to read (static or traced scalar).
+    backend : "pallas" (the kernel; interprets off-TPU), "xla" (the gather
+        reference), or "auto" — kernel on TPU, reference elsewhere (the
+        reference is faster than interpret mode and numerically identical
+        up to reduction order).
+
+    GQA: H % H_kv == 0; each kv head's page is fetched once and attended by
+    its whole query-head group.
+    """
+    q, pages_k, pages_v, scale = _check_args(q, pages_k, pages_v,
+                                             block_tables, kv_lens, scale)
+    if backend == "auto":
+        backend = "pallas" if jax.default_backend() == "tpu" else "xla"
+    if backend == "xla":
+        return _paged_attention_xla(q, pages_k, pages_v, block_tables,
+                                    kv_lens, layer, scale)
+    if backend != "pallas":
+        raise ValueError(f"unknown paged-attention backend {backend!r}")
+    if interpret is None:
+        interpret = interpret_default()
+    return _paged_attention_pallas(q, pages_k, pages_v, block_tables,
+                                   kv_lens, layer, scale, interpret)
+
+
+def scatter_kv_rows(pages, block_tables, offsets, rows, *, layer=None):
+    """Write one new KV row per sequence at its decode position.
+
+    The write half of the page contract: ``pages`` is (L, N, H, bs, Dh) with
+    ``layer`` naming the layer (or a single layer's (N, H, bs, Dh));
+    ``block_tables`` (B, nb); ``offsets`` (B,) the position each row writes;
+    ``rows`` (B, H, Dh). Rows whose table points at the pool's scratch page
+    land there harmlessly. Returns the updated pages — under jit with the
+    pool buffers donated this lowers to an in-place dynamic-update-scatter.
+    """
+    bs = pages.shape[-2]
+    blk = jnp.take_along_axis(block_tables, (offsets // bs)[:, None],
+                              axis=1)[:, 0]
+    slot = offsets % bs
+    # two advanced indices (blk, slot) around the sliced head axis put the
+    # batch dim first in the update operand: rows is already (B, H, Dh)
+    if pages.ndim == 5:
+        if layer is None:
+            raise ValueError("layer is required for (L, N, H, bs, Dh) pages")
+        return pages.at[layer, blk, :, slot, :].set(rows)
+    return pages.at[blk, :, slot, :].set(rows)

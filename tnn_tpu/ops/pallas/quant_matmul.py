@@ -38,6 +38,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from .runtime import interpret_default
+
 # jax 0.4.x spells it TPUCompilerParams; the kwargs used here are identical
 _CompilerParams = getattr(pltpu, "CompilerParams", None) \
     or pltpu.TPUCompilerParams
@@ -204,7 +206,7 @@ def int8_matmul(x, q, scale, *, n: int | None = None, k: int | None = None,
         scratch_shapes=[pltpu.VMEM((bm, bn), jnp.float32)],
         compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
-        interpret=jax.default_backend() != "tpu",
+        interpret=interpret_default(),
     )(xf, q, sp)
     return out[:m, :n].reshape(*lead, n)
 

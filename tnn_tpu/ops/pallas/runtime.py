@@ -1,0 +1,22 @@
+"""Shared Pallas runtime knobs.
+
+One switch for every kernel in this package: whether ``pallas_call`` runs in
+interpret mode. Off-TPU backends (CPU tests, the forced 8-device virtual
+platform in tests/conftest.py) have no Mosaic compiler, so kernels interpret
+there by default; ``TNN_PALLAS_INTERPRET=1|0`` overrides either way (the
+test-suite fixture forces ``1`` for ``@pytest.mark.kernel`` tests so tier-1
+exercises the real kernel code paths on CPU).
+"""
+from __future__ import annotations
+
+import os
+
+import jax
+
+
+def interpret_default() -> bool:
+    """Resolve the interpret flag for a pallas_call at trace time."""
+    env = os.environ.get("TNN_PALLAS_INTERPRET")
+    if env:
+        return env not in ("0", "false", "False")
+    return jax.default_backend() != "tpu"

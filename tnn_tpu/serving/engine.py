@@ -14,13 +14,20 @@ scratch block and masked by the per-row causal offsets. Prefill pads prompts
 up to a block multiple, so prompt-length buckets (not exact lengths) key its
 jit cache.
 
-Decode-path fallback: ``decode_path="auto"`` probes the fused one-launch
-Pallas kernel (models.fused_decode) at init — it needs decode-quantized
-params, no MoE/GQA/int8-cache, and a VMEM-fitting geometry — and uses it for
-any step whose live rows all sit at one common offset (lockstep batches);
-every other step, and any model the probe rejects (reason recorded in
-``fused_fallback_reason``), runs the standard cached path. Both paths read
-and write the same paged pool, so the engine can switch per step.
+Decode-path selection: ``decode_path="auto"`` probes the PAGED path first —
+``model.apply_decode_paged`` over the ragged paged-attention kernel
+(ops/pallas/paged_attention.py), which consumes the pool's pages + block
+tables directly with no assembled cache and no ``gather_kv`` in the step
+trace. When the model can't take it (reason in ``paged_fallback_reason``),
+auto falls back to probing the fused one-launch Pallas kernel
+(models.fused_decode) — needs decode-quantized params, no MoE/GQA/int8-cache,
+a VMEM-fitting geometry, and a lockstep batch (all rows at one offset; ragged
+steps drop to standard within the same run, reason in
+``fused_fallback_reason``) — and finally to the standard assembled-cache
+path. All paths read and write the same paged pool; the pool buffers are
+DONATED through every jitted step (prefill and all decode paths), so XLA
+updates pages in place instead of copying the pool each token. See
+docs/serving.md for the full decode-path matrix.
 """
 from __future__ import annotations
 
@@ -51,7 +58,8 @@ class InferenceEngine:
     token_budget : per-step cap on model tokens (decodes + admitted prompts).
     max_seq_len : per-request position cap (prompt + generated); defaults to
         the smaller of model.max_len and the pool's whole capacity.
-    decode_path : "auto" | "standard" | "fused" (see module docstring).
+    decode_path : "auto" | "standard" | "fused" | "paged" (see module
+        docstring and docs/serving.md).
     profiler : optional profiling.Profiler for span/counter wiring.
     """
 
@@ -65,7 +73,7 @@ class InferenceEngine:
                 "the paged pool stores compute-dtype pages; "
                 f"kv_cache_dtype={model.kv_cache_dtype!r} models are not "
                 "servable yet — use models.gpt2.generate")
-        if decode_path not in ("auto", "standard", "fused"):
+        if decode_path not in ("auto", "standard", "fused", "paged"):
             raise ValueError(f"unknown decode_path {decode_path!r}")
         self.model = model
         self.params = params
@@ -88,9 +96,25 @@ class InferenceEngine:
         self._rid = itertools.count()
         self._key = jax.random.PRNGKey(seed)
         self._jit: Dict[Any, Any] = {}
+        self.paged_fallback_reason: Optional[str] = None
         self.fused_fallback_reason: Optional[str] = None
+        self._paged = False
         self._fused: Optional[Dict[str, Any]] = None
-        if decode_path in ("auto", "fused"):
+        # auto probes paged first: it handles ragged batches natively (the
+        # common continuous-batching state) and never assembles a cache
+        if decode_path in ("auto", "paged"):
+            try:
+                self._probe_paged()
+                self._paged = True
+            except ValueError as e:
+                if decode_path == "paged":
+                    raise
+                self.paged_fallback_reason = str(e)
+        else:
+            self.paged_fallback_reason = f"disabled (decode_path={decode_path!r})"
+        if self._paged:
+            self.fused_fallback_reason = "unused (paged decode path selected)"
+        elif decode_path in ("auto", "fused"):
             try:
                 self._fused = self._probe_fused(max_batch_size)
             except ValueError as e:
@@ -98,9 +122,18 @@ class InferenceEngine:
                     raise
                 self.fused_fallback_reason = str(e)
         else:
-            self.fused_fallback_reason = "disabled (decode_path='standard')"
+            self.fused_fallback_reason = f"disabled (decode_path={decode_path!r})"
 
-    # -- fused-path probe -----------------------------------------------------
+    # -- decode-path probes ---------------------------------------------------
+
+    def _probe_paged(self) -> None:
+        """Validate the paged decode path against this model; raises
+        ValueError (with the reason) when auto must fall back."""
+        if not hasattr(self.model, "apply_decode_paged"):
+            raise ValueError(
+                f"{type(self.model).__name__} has no apply_decode_paged — "
+                "the paged path needs the model to decode straight against "
+                "pool pages (see GPT2.apply_decode_paged)")
 
     def _probe_fused(self, batch: int) -> Dict[str, Any]:
         """Validate the fused decode kernel against this model/params; raises
@@ -113,9 +146,11 @@ class InferenceEngine:
         if chunks is None:
             raise ValueError("model too large for the fused kernel's VMEM "
                              "budget at this batch/assembly geometry")
+        from ..ops.pallas.runtime import interpret_default
+
         stacks = fused_decode.stack_decode_weights(self.model, self.params)
         return {"stacks": stacks, "chunks": chunks,
-                "interpret": jax.default_backend() != "tpu"}
+                "interpret": interpret_default()}
 
     # -- request lifecycle ----------------------------------------------------
 
@@ -210,26 +245,34 @@ class InferenceEngine:
             pages_v = kv_pool_lib.scatter_prefill(pages_v, blocks, v_all)
             return tok, pages_k, pages_v
 
-        return jax.jit(fn)
+        # pool buffers are donated: the scatter updates pages in place
+        # instead of copying the whole pool per prefill
+        return jax.jit(fn, donate_argnums=(1, 2))
 
     def _prefill(self, req: Request, events) -> None:
         t0 = time.perf_counter()
         seq = req.resume_tokens
         bs = self.pool.block_size
         nb = self.pool.blocks_for(len(seq))
-        padded = nb * bs
+        # bucket the COMPILED width to the next power of two (capped at the
+        # assembly width) so N distinct prompt lengths cost O(log N) compiles,
+        # not one each; only the nb real blocks are allocated — the bucket's
+        # tail rows scatter into the reserved scratch block and vanish
+        nb_bucket = min(self.blocks_per_seq, 1 << (nb - 1).bit_length())
+        padded = nb_bucket * bs
         blocks = self.pool.alloc(nb)
         ids = np.zeros((1, padded), np.int32)
         ids[0, :len(seq)] = seq
         key = ("prefill", padded)
         fn = self._jit.get(key)
         if fn is None:
-            fn = self._jit[key] = self._prefill_fn(padded, nb)
+            fn = self._jit[key] = self._prefill_fn(padded, nb_bucket)
         with profiled("serve.prefill", EventType.COMPUTE, self.profiler):
             tok, pk, pv = fn(
                 self.params, self.pool.pages_k, self.pool.pages_v,
                 jnp.asarray(ids), jnp.asarray(len(seq), jnp.int32),
-                jnp.asarray(blocks, jnp.int32),
+                jnp.asarray(self.pool.padded_table(blocks, nb_bucket),
+                            jnp.int32),
                 jnp.asarray(req.temperature, jnp.float32),
                 jnp.asarray(req.top_k, jnp.int32),
                 jnp.asarray(req.top_p, jnp.float32), self._next_key())
@@ -312,7 +355,22 @@ class InferenceEngine:
                                                 jnp.stack(rows_v))
             return newtok, pages_k, pages_v
 
-        return jax.jit(fn)
+        return jax.jit(fn, donate_argnums=(1, 2))
+
+    def _paged_decode_fn(self, batch: int, nb: int):
+        model = self.model
+
+        def fn(params, pages_k, pages_v, toks, offsets, tables, t, k, p, key):
+            # no gather_kv, no assembled cache: the model scatters each
+            # layer's new row into its page and the paged-attention kernel
+            # streams KV via the block tables — per-step pool traffic is B
+            # row writes plus the KV actually attended over
+            logits, pages_k, pages_v = model.apply_decode_paged(
+                params, toks, pages_k, pages_v, tables, offsets)
+            newtok = sampling.sample_ragged(logits, key, t, k, p)
+            return newtok, pages_k, pages_v
+
+        return jax.jit(fn, donate_argnums=(1, 2))
 
     def _fused_decode_fn(self, batch: int, nb: int):
         model = self.model
@@ -353,7 +411,7 @@ class InferenceEngine:
                 pages_v, tables, offsets, row_v.reshape(l, b, h, d // h))
             return newtok, pages_k, pages_v
 
-        return jax.jit(fn)
+        return jax.jit(fn, donate_argnums=(2, 3))
 
     def _decode(self, live: Sequence[Request], events) -> None:
         t0 = time.perf_counter()
@@ -372,18 +430,24 @@ class InferenceEngine:
             temps[i] = req.temperature
             topks[i] = req.top_k
             topps[i] = req.top_p
-        lockstep = (self._fused is not None
+        lockstep = (not self._paged and self._fused is not None
                     and len(set(offsets[:len(live)].tolist())) == 1)
         if lockstep:
             # padded rows share the live offset: their scratch-block writes
             # stay harmless and the kernel's scalar position is uniform
             offsets[len(live):] = offsets[0]
-        key, label = (("fdecode", b, nb), "serve.decode_fused") if lockstep \
-            else (("decode", b, nb), "serve.decode")
+        if self._paged:
+            key, label = ("pdecode", b, nb), "serve.decode_paged"
+        elif lockstep:
+            key, label = ("fdecode", b, nb), "serve.decode_fused"
+        else:
+            key, label = ("decode", b, nb), "serve.decode"
         fn = self._jit.get(key)
         if fn is None:
-            fn = self._jit[key] = (self._fused_decode_fn(b, nb) if lockstep
-                                   else self._decode_fn(b, nb))
+            fn = self._jit[key] = (
+                self._paged_decode_fn(b, nb) if self._paged
+                else self._fused_decode_fn(b, nb) if lockstep
+                else self._decode_fn(b, nb))
         with profiled(label, EventType.COMPUTE, self.profiler):
             if lockstep:
                 newtok, pk, pv = fn(

@@ -19,6 +19,26 @@ their garbage reads/writes land somewhere harmless instead of in live blocks.
 The gather/scatter helpers are pure jnp functions — they trace into the
 engine's jitted prefill/decode steps, keeping the pool device-resident; only
 the alloc/free bookkeeping lives on the host.
+
+Page layout contract
+--------------------
+The ragged paged-attention kernel (``ops/pallas/paged_attention.py``) reads
+the pages *directly* — no gather — so the layout below is a cross-module
+contract, not an implementation detail:
+
+- A sequence's cache position ``p`` lives at
+  ``pages_*[layer, table[p // block_size], kv_head, p % block_size, :]``:
+  positions are contiguous within a block and ordered across the block
+  table, while the blocks themselves may sit anywhere in the pool.
+- Block tables handed to the kernel are right-padded with ``SCRATCH``
+  (``padded_table``); the kernel clamps its page fetches to each row's last
+  live block, so padding entries are never DMA'd on TPU.
+- Token position ``p`` is live iff ``p < kv_len`` for that row; slots past
+  ``kv_len`` (the block's tail, scratch writes of padded rows) hold garbage
+  by design and every consumer must mask them.
+- Pages are stored in the pool dtype (the model's compute dtype); the
+  engine donates them through every jitted step, so after a step the
+  previously-held arrays are invalid — always re-read ``pool.pages_*``.
 """
 from __future__ import annotations
 
