@@ -12,6 +12,12 @@ page gather/scatter overheads.
 
 --smoke runs a tiny randomly initialized GPT-2 (2L/32d) — seconds on CPU,
 exercising the whole engine; it is what tests/test_benchmarks.py runs.
+
+--chaos runs the smoke workload under a seeded FaultPlan (pool-alloc
+failures + injected NaN logits) and asserts the fault-tolerance contract:
+every request terminal, zero leaked blocks, pool invariants clean. It is a
+robustness gate shaped like a benchmark row, so regressions show up in the
+same regression.csv pipeline as performance.
 """
 import argparse
 import time
@@ -75,6 +81,46 @@ def bench_serving(model, params, *, num_requests: int, rate_per_s: float,
                "requests": s["requests_finished"]})
 
 
+def bench_chaos(model, params, *, num_requests: int, max_new: int,
+                label: str, seed: int = 0):
+    """Smoke the fault-tolerance layer: Poisson-free back-to-back submits
+    under a seeded FaultPlan, asserting the terminal-state and zero-leak
+    contracts. The row reports terminal-state counts instead of latency."""
+    from tnn_tpu.serving import TERMINAL_STATES, FaultPlan, InferenceEngine
+
+    print(f"{label}: {num_requests} requests under seeded faults "
+          f"(alloc_fail_prob=0.1, nan logits)")
+    rng = np.random.default_rng(seed)
+    prompts = [rng.integers(0, model.vocab_size, int(l)).astype(np.int32)
+               for l in rng.integers(4, 14, num_requests)]
+    plan = FaultPlan(seed=seed + 1, alloc_fail_prob=0.1,
+                     nan_logit_calls=(4,))
+    engine = InferenceEngine(model, params, num_blocks=16, block_size=4,
+                             max_batch_size=4, max_seq_len=32, seed=seed,
+                             faults=plan)
+
+    t0 = time.perf_counter()
+    rids = [engine.submit(p, max_new) for p in prompts]
+    engine.run_until_complete()
+    wall = time.perf_counter() - t0
+
+    states = [engine.result(r).state for r in rids]
+    assert all(s in TERMINAL_STATES for s in states), states
+    assert engine.pool.num_allocated == 0, "leaked KV blocks under chaos"
+    engine.check_invariants()
+    assert plan.fired["pool.alloc"] >= 1, "fault plan never fired"
+    s = engine.stats()
+    return report(
+        label, wall, items=num_requests, item_name="req",
+        extra={"finished": s["requests_finished"],
+               "failed": s["requests_failed"],
+               "faults_fired": int(sum(plan.fired.values())),
+               "leaked_blocks": int(engine.pool.num_allocated),
+               "step_retries": s["step_retries"],
+               "terminal": int(sum(1 for st in states
+                                   if st in TERMINAL_STATES))})
+
+
 def _smoke_model():
     """Tiny random GPT-2 (2L/32d/2h): engine mechanics without model weight."""
     from tnn_tpu.models.gpt2 import GPT2
@@ -91,12 +137,22 @@ def main(argv=None):
                     help="fewer requests, shorter generations")
     ap.add_argument("--smoke", action="store_true",
                     help="tiny random model (CI-fast, CPU-safe)")
+    ap.add_argument("--chaos", action="store_true",
+                    help="tiny model under a seeded FaultPlan: asserts the "
+                         "fault-tolerance contract (terminal states, zero "
+                         "leaked blocks) and reports it as a bench row")
     ap.add_argument("--model", default="gpt2_small")
     ap.add_argument("--rate", type=float, default=4.0,
                     help="mean request arrivals per second")
     args = ap.parse_args(argv)
 
     rr = RowRunner()
+    if args.chaos:
+        model, params = _smoke_model()
+        rr.add(lambda: bench_chaos(model, params, num_requests=8, max_new=8,
+                                   label="serve_chaos"),
+               label="bench_chaos")
+        return rr.results
     if args.smoke:
         # standard/paged A/B even in smoke: the decode_path column is the
         # benchmark's whole point after the paged rewire

@@ -129,6 +129,22 @@ def test_serve_bench_smoke():
         assert r["requests"] == 6
 
 
+def test_serve_bench_chaos():
+    """The --chaos row is the benchmark-shaped fault-tolerance gate: seeded
+    pool-alloc failures + NaN logits, asserting every request terminal and
+    zero leaked blocks. Tier-1 so robustness regressions fail fast."""
+    from benchmarks import serve_bench
+
+    results = [r for r in serve_bench.main(["--chaos"]) if r]
+    assert len(results) == 1
+    r = results[0]
+    assert r["bench"] == "serve_chaos"
+    assert r["terminal"] == 8
+    assert r["leaked_blocks"] == 0
+    assert r["faults_fired"] >= 1
+    assert r["finished"] + r["failed"] <= 8
+
+
 @pytest.mark.slow
 def test_paged_attention_bench_quick():
     """The paged-vs-gather ops bench must verify and report its speedup

@@ -7,15 +7,18 @@ fixed width (blocks_per_seq * block_size) and generate() is run with
 ``max_len`` equal to that width, so both paths softmax over identically
 shaped (masked) caches — greedy outputs must then match exactly.
 """
+import time
+
 import numpy as np
 import pytest
 
 import jax
 import jax.numpy as jnp
 
-from tnn_tpu.serving import (InferenceEngine, PagedKVPool, PoolExhausted,
-                             Request, Scheduler, gather_kv, scatter_prefill,
-                             scatter_token)
+from tnn_tpu.serving import (TERMINAL_STATES, AdmissionRejected, FaultPlan,
+                             InferenceEngine, PagedKVPool, PoolExhausted,
+                             Request, RequestState, Scheduler, gather_kv,
+                             scatter_prefill, scatter_token)
 
 
 # -- pool bookkeeping ---------------------------------------------------------
@@ -463,3 +466,431 @@ def test_gpt2_small_paged_matches_standard():
     assert eng_s.metrics.preemptions > 0
     assert paged == std
     assert eng_p.pool.num_allocated == 0
+
+
+# -- fault tolerance: invariants, lifecycle, backpressure, chaos --------------
+
+
+def _assert_drained(eng):
+    """The chaos invariant: every submitted request terminal, no leaked
+    blocks, bookkeeping clean."""
+    states = {r.rid: r.state for r in eng.requests.values()}
+    assert all(s in TERMINAL_STATES for s in states.values()), states
+    assert not eng.has_work
+    assert eng.pool.num_allocated == 0
+    assert eng.pool.num_free == eng.pool.capacity
+    eng.check_invariants()
+
+
+def _finished(eng):
+    return {rid: list(r.out_tokens) for rid, r in eng.requests.items()
+            if r.state is RequestState.FINISHED}
+
+
+class TestPoolInvariants:
+    def _pool(self):
+        return PagedKVPool(num_layers=1, num_kv_heads=1, head_dim=2,
+                           num_blocks=8, block_size=4)
+
+    def test_clean_pool_passes(self):
+        pool = self._pool()
+        blocks = pool.alloc(3)
+        pool.check_invariants()
+        pool.check_invariants([blocks])
+        pool.free(blocks)
+        pool.check_invariants([])
+
+    def test_double_circulation_detected(self):
+        pool = self._pool()
+        blocks = pool.alloc(2)
+        pool._free.append(blocks[0])      # corrupt: free AND allocated
+        with pytest.raises(ValueError, match="both free and allocated"):
+            pool.check_invariants()
+
+    def test_scratch_never_circulates(self):
+        pool = self._pool()
+        pool._ref[PagedKVPool.SCRATCH] = 1
+        with pytest.raises(ValueError, match="scratch"):
+            pool.check_invariants()
+
+    def test_leak_detected_via_tables(self):
+        """A block allocated but owned by no live table is a leak."""
+        pool = self._pool()
+        blocks = pool.alloc(2)
+        with pytest.raises(ValueError, match="leaked"):
+            pool.check_invariants([])     # nobody claims `blocks`
+        pool.check_invariants([blocks])   # claimed: clean
+        del blocks
+
+    def test_overshared_block_detected(self):
+        pool = self._pool()
+        blocks = pool.alloc(1)
+        with pytest.raises(ValueError, match="mismatch"):
+            pool.check_invariants([blocks, blocks])  # refcount 1, 2 tables
+        pool.fork(blocks)
+        pool.check_invariants([blocks, blocks])      # refcount 2: fine
+
+    def test_count_mismatch_detected(self):
+        pool = self._pool()
+        pool._free.pop()                  # block vanishes entirely
+        with pytest.raises(ValueError, match="capacity"):
+            pool.check_invariants()
+
+    def test_debug_mode_checks_on_free(self, monkeypatch):
+        monkeypatch.setenv("TNN_POOL_DEBUG", "1")
+        pool = self._pool()
+        assert pool.debug
+        a = pool.alloc(2)
+        pool.free(a)                      # clean: no raise
+        b = pool.alloc(1)
+        pool._free.append(b[0])           # corrupt behind the pool's back
+        with pytest.raises(ValueError):
+            pool.free(b)
+
+
+class TestFaultPlan:
+    def test_nth_call_alloc_failure_is_exact(self):
+        plan = FaultPlan(alloc_fail_calls=(3,))
+        pool = PagedKVPool(num_layers=1, num_kv_heads=1, head_dim=2,
+                           num_blocks=8, block_size=4)
+        pool.fault_plan = plan
+        pool.free(pool.alloc(1))
+        pool.free(pool.alloc(1))
+        with pytest.raises(PoolExhausted, match="injected"):
+            pool.alloc(1)
+        pool.free(pool.alloc(1))          # call 4: passes again
+        assert plan.calls["pool.alloc"] == 4
+        assert plan.fired["pool.alloc"] == 1
+        pool.check_invariants()           # rejected alloc mutated nothing
+
+    def test_seeded_plans_are_deterministic(self):
+        def trace(plan):
+            fires = []
+            for _ in range(64):
+                try:
+                    plan.on_alloc(1, 8)
+                    fires.append(False)
+                except PoolExhausted:
+                    fires.append(True)
+            return fires
+
+        a = trace(FaultPlan(seed=11, alloc_fail_prob=0.3))
+        b = trace(FaultPlan(seed=11, alloc_fail_prob=0.3))
+        c = trace(FaultPlan(seed=12, alloc_fail_prob=0.3))
+        assert a == b
+        assert any(a) and not all(a)
+        assert a != c                     # different seed, different schedule
+
+    def test_poison_rows_nth_call_hits_row_zero(self):
+        plan = FaultPlan(nan_logit_calls=(2,))
+        assert not plan.poison_rows(3).any()
+        mask = plan.poison_rows(3)
+        assert mask.tolist() == [True, False, False]
+        assert plan.fired["decode.logits"] == 1
+
+
+class TestLifecycle:
+    """Cancellation, deadlines, and bounded admission on the tiny model."""
+
+    KW = dict(num_blocks=32, block_size=4, max_batch_size=4, max_seq_len=32)
+
+    def test_cancel_while_queued(self, tiny_lm):
+        model, params = tiny_lm
+        eng = InferenceEngine(model, params, num_blocks=32, block_size=4,
+                              max_batch_size=1, max_seq_len=32)
+        p = np.arange(5, dtype=np.int32)
+        r0 = eng.submit(p, 6)
+        eng.step()                                  # r0 admitted
+        r1 = eng.submit(p, 6)                       # stuck behind r0 (batch 1)
+        assert eng.cancel(r1)
+        assert eng.result(r1).state is RequestState.CANCELLED
+        out = eng.run_until_complete()
+        assert out[r0] == _greedy_ref(model, params, p, 6, eng.assembly_len)
+        assert r1 not in out
+        assert eng.metrics.cancelled == 1
+        _assert_drained(eng)
+
+    def test_cancel_while_running_frees_blocks(self, tiny_lm):
+        model, params = tiny_lm
+        eng = InferenceEngine(model, params, **self.KW)
+        rid = eng.submit(np.arange(6, dtype=np.int32), 20)
+        eng.step()
+        assert eng.result(rid).state is RequestState.RUNNING
+        assert eng.pool.num_allocated > 0
+        assert eng.cancel(rid)
+        assert eng.result(rid).state is RequestState.CANCELLED
+        _assert_drained(eng)
+
+    def test_cancel_terminal_or_unknown_is_noop(self, tiny_lm):
+        model, params = tiny_lm
+        eng = InferenceEngine(model, params, **self.KW)
+        rid = eng.submit(np.arange(4, dtype=np.int32), 2)
+        eng.run_until_complete()
+        assert not eng.cancel(rid)                  # already FINISHED
+        assert not eng.cancel(12345)                # never existed
+        assert eng.result(rid).state is RequestState.FINISHED
+
+    def test_deadline_expires_while_queued(self, tiny_lm):
+        model, params = tiny_lm
+        eng = InferenceEngine(model, params, **self.KW)
+        rid = eng.submit(np.arange(4, dtype=np.int32), 4, deadline_s=0.0)
+        events = eng.step()
+        assert [rid_ for rid_, _ in events["timed_out"]] == [rid]
+        req = eng.result(rid)
+        assert req.state is RequestState.TIMED_OUT
+        assert "deadline" in req.error
+        assert eng.metrics.timed_out == 1
+        _assert_drained(eng)
+
+    def test_deadline_expires_while_running(self, tiny_lm):
+        model, params = tiny_lm
+        eng = InferenceEngine(model, params, **self.KW)
+        rid = eng.submit(np.arange(4, dtype=np.int32), 25, deadline_s=0.15)
+        eng.step()
+        assert eng.result(rid).state is RequestState.RUNNING
+        time.sleep(0.2)
+        eng.step()
+        req = eng.result(rid)
+        assert req.state is RequestState.TIMED_OUT
+        assert req.out_tokens, "made progress before the deadline"
+        _assert_drained(eng)
+
+    def test_max_queue_s_expires_only_queued(self, tiny_lm):
+        model, params = tiny_lm
+        eng = InferenceEngine(model, params, num_blocks=32, block_size=4,
+                              max_batch_size=1, max_seq_len=32)
+        p = np.arange(4, dtype=np.int32)
+        r0 = eng.submit(p, 6)
+        eng.step()                                  # r0 running
+        r1 = eng.submit(p, 6, max_queue_s=0.0)      # expires at next step
+        eng.step()
+        assert eng.result(r1).state is RequestState.TIMED_OUT
+        assert "max_queue_s" in eng.result(r1).error
+        out = eng.run_until_complete()
+        assert out[r0] == _greedy_ref(model, params, p, 6, eng.assembly_len)
+        _assert_drained(eng)
+
+    def test_admission_reject_backpressure(self, tiny_lm):
+        model, params = tiny_lm
+        eng = InferenceEngine(model, params, max_queue_depth=2,
+                              admission_policy="reject", **self.KW)
+        p = np.arange(4, dtype=np.int32)
+        eng.submit(p, 4)
+        eng.submit(p, 4)
+        with pytest.raises(AdmissionRejected) as ei:
+            eng.submit(p, 4)
+        assert ei.value.queue_depth == 2
+        assert ei.value.max_queue_depth == 2
+        assert eng.metrics.rejected == 1
+        assert len(eng.requests) == 2               # rejected never entered
+        eng.run_until_complete()
+        _assert_drained(eng)
+
+    def test_admission_block_drains_then_accepts(self, tiny_lm):
+        model, params = tiny_lm
+        eng = InferenceEngine(model, params, max_queue_depth=1,
+                              admission_policy="block", **self.KW)
+        p = np.arange(4, dtype=np.int32)
+        ref = _greedy_ref(model, params, p, 6, eng.assembly_len)
+        rids = [eng.submit(p, 6) for _ in range(4)]  # blocks, never raises
+        out = eng.run_until_complete()
+        assert [out[r] for r in rids] == [ref] * 4
+        assert eng.metrics.rejected == 0
+        _assert_drained(eng)
+
+    def test_preemption_budget_fails_victim_cleanly(self, tiny_lm):
+        """With budget 0 the first would-be preemption victim FAILs (blocks
+        freed) instead of thrashing; everyone else still finishes exactly."""
+        model, params = tiny_lm
+        rng = np.random.default_rng(1)
+        prompts = [rng.integers(0, 128, n).astype(np.int32)
+                   for n in (5, 9, 16, 7)]
+        eng = InferenceEngine(model, params, num_blocks=9, block_size=4,
+                              max_batch_size=4, max_seq_len=32,
+                              preemption_budget=0)
+        rids = [eng.submit(p, 10) for p in prompts]
+        eng.run_until_complete()
+        failed = [r for r in eng.requests.values()
+                  if r.state is RequestState.FAILED]
+        assert failed, "pool never filled — scenario broken"
+        assert all("preemption budget" in r.error for r in failed)
+        assert eng.metrics.preemptions == 0
+        assert eng.metrics.failed == len(failed)
+        out = _finished(eng)
+        for rid, p in zip(rids, prompts):
+            if rid in out:
+                assert out[rid] == _greedy_ref(model, params, p, 10,
+                                               eng.assembly_len)
+        _assert_drained(eng)
+
+    def test_stats_shape(self, tiny_lm):
+        model, params = tiny_lm
+        eng = InferenceEngine(model, params, **self.KW)
+        rid = eng.submit(np.arange(4, dtype=np.int32), 3)
+        eng.cancel(rid)
+        eng.submit(np.arange(4, dtype=np.int32), 3)
+        eng.run_until_complete()
+        s = eng.stats()
+        assert s["requests_cancelled"] == 1
+        assert s["requests_finished"] == 1
+        assert s["cancelled"] == 1
+        assert s["pool_allocated_blocks"] == 0
+        assert s["queue_depth"] == 0 and s["num_running"] == 0
+        assert s["decode_path"] in ("paged", "fused", "standard")
+
+
+class TestChaos:
+    """Seeded FaultPlan runs: every request reaches a terminal state,
+    survivors are token-identical to a fault-free run, zero leaked blocks."""
+
+    KW = dict(num_blocks=32, block_size=4, max_batch_size=4, max_seq_len=32)
+
+    def _prompts(self, n, seed=0):
+        rng = np.random.default_rng(seed)
+        return [rng.integers(0, 128, int(l)).astype(np.int32)
+                for l in rng.integers(4, 14, n)]
+
+    def _run(self, model, params, prompts, max_new=8, plan=None, **kw):
+        merged = dict(self.KW)
+        merged.update(kw)
+        eng = InferenceEngine(model, params, faults=plan, **merged)
+        rids = [eng.submit(p, max_new) for p in prompts]
+        eng.run_until_complete()
+        return eng, rids
+
+    def test_alloc_failure_mid_prefill_is_isolated(self, tiny_lm):
+        model, params = tiny_lm
+        prompts = self._prompts(3)
+        ref_eng, ref_rids = self._run(model, params, prompts)
+        plan = FaultPlan(alloc_fail_calls=(2,))     # r1's prefill alloc
+        eng, rids = self._run(model, params, prompts, plan=plan)
+        assert eng.result(rids[1]).state is RequestState.FAILED
+        assert "injected allocation failure" in eng.result(rids[1]).error
+        out, ref = _finished(eng), _finished(ref_eng)
+        for i in (0, 2):
+            assert out[rids[i]] == ref[ref_rids[i]]
+        _assert_drained(eng)
+
+    def test_alloc_failure_mid_decode_is_isolated(self, tiny_lm):
+        """Growth alloc fails for one request mid-decode; the other finishes
+        token-exact — a pool fault no longer aborts unrelated requests."""
+        model, params = tiny_lm
+        p = np.arange(6, dtype=np.int32)
+        ref_eng, ref_rids = self._run(model, params, [p, p])
+        # alloc calls: prefill r0 (1), prefill r1 (2), growth r0 (3), ...
+        plan = FaultPlan(alloc_fail_calls=(3,))
+        eng, rids = self._run(model, params, [p, p], plan=plan)
+        assert eng.result(rids[0]).state is RequestState.FAILED
+        assert "mid-decode" in eng.result(rids[0]).error
+        assert _finished(eng)[rids[1]] == _finished(ref_eng)[ref_rids[1]]
+        assert plan.fired["pool.alloc"] == 1
+        _assert_drained(eng)
+
+    def test_nan_logits_in_decode_fail_one_row(self, tiny_lm):
+        model, params = tiny_lm
+        prompts = self._prompts(3, seed=2)
+        ref_eng, ref_rids = self._run(model, params, prompts)
+        plan = FaultPlan(nan_logit_calls=(2,))      # row 0 of decode call 2
+        eng, rids = self._run(model, params, prompts, plan=plan)
+        victim = eng.result(rids[0])
+        assert victim.state is RequestState.FAILED
+        assert "non-finite logits" in victim.error
+        assert victim.out_tokens, "failed after producing valid tokens"
+        out, ref = _finished(eng), _finished(ref_eng)
+        for i in (1, 2):
+            assert out[rids[i]] == ref[ref_rids[i]]
+        _assert_drained(eng)
+
+    def test_nan_logits_in_prefill_fail_request(self, tiny_lm):
+        model, params = tiny_lm
+        prompts = self._prompts(3, seed=3)
+        ref_eng, ref_rids = self._run(model, params, prompts)
+        plan = FaultPlan(nan_prefill_calls=(2,))
+        eng, rids = self._run(model, params, prompts, plan=plan)
+        assert eng.result(rids[1]).state is RequestState.FAILED
+        assert "prefill" in eng.result(rids[1]).error
+        out, ref = _finished(eng), _finished(ref_eng)
+        for i in (0, 2):
+            assert out[rids[i]] == ref[ref_rids[i]]
+        _assert_drained(eng)
+
+    def test_logit_guard_can_be_disabled(self, tiny_lm):
+        """With the guard off a poisoned row is NOT failed — the garbage
+        token streams through (caller's choice to run unguarded)."""
+        model, params = tiny_lm
+        plan = FaultPlan(nan_logit_calls=(2,))
+        eng, rids = self._run(model, params, self._prompts(2, seed=4),
+                              plan=plan, logit_guard=False)
+        assert all(eng.result(r).state is RequestState.FINISHED
+                   for r in rids)
+        _assert_drained(eng)
+
+    def test_transient_step_exception_retries_exactly(self, tiny_lm):
+        """A transient decode fault is retried with the SAME key: outputs
+        are bit-identical to a fault-free run — the fault is invisible."""
+        model, params = tiny_lm
+        prompts = self._prompts(3, seed=5)
+        ref_eng, ref_rids = self._run(model, params, prompts)
+        plan = FaultPlan(decode_exc_calls=(2,), transient_exc=True)
+        eng, rids = self._run(model, params, prompts, plan=plan)
+        assert plan.fired["decode"] == 1
+        assert eng.metrics.step_retries == 1
+        out, ref = _finished(eng), _finished(ref_eng)
+        assert [out[r] for r in rids] == [ref[r] for r in ref_rids]
+        _assert_drained(eng)
+
+    def test_persistent_step_exception_aborts_batch_only(self, tiny_lm):
+        """A hard decode failure fails the LIVE batch but the engine keeps
+        serving: queued requests still complete token-exact."""
+        model, params = tiny_lm
+        p = np.arange(6, dtype=np.int32)
+        ref_eng, ref_rids = self._run(model, params, [p, p, p],
+                                      max_batch_size=2)
+        plan = FaultPlan(decode_exc_calls=(1,), transient_exc=False)
+        eng, rids = self._run(model, params, [p, p, p], plan=plan,
+                              max_batch_size=2)
+        for r in rids[:2]:                          # the aborted batch
+            assert eng.result(r).state is RequestState.FAILED
+            assert "injected persistent fault" in eng.result(r).error
+        assert _finished(eng)[rids[2]] == _finished(ref_eng)[ref_rids[2]]
+        _assert_drained(eng)
+
+    def test_chaos_gate(self, tiny_lm):
+        """The acceptance gate: >=10% pool-alloc failure probability plus
+        injected NaN logits on the tiny gpt2. Every submitted request must
+        reach a terminal state, survivors must be token-identical to a
+        fault-free run, and the pool must end with zero leaked blocks."""
+        model, params = tiny_lm
+        prompts = self._prompts(8, seed=6)
+        kw = dict(num_blocks=16, block_size=4, max_batch_size=4,
+                  max_seq_len=32)
+        ref_eng, ref_rids = self._run(model, params, prompts, **kw)
+        plan = FaultPlan(seed=9, alloc_fail_prob=0.12, nan_logit_calls=(5,))
+        eng, rids = self._run(model, params, prompts, plan=plan, **kw)
+        assert plan.fired["pool.alloc"] >= 1, "chaos never fired — dead test"
+        states = [eng.result(r).state for r in rids]
+        assert all(s in TERMINAL_STATES for s in states)
+        assert RequestState.FAILED in states, "no request failed"
+        assert RequestState.FINISHED in states, "no request survived"
+        out, ref = _finished(eng), _finished(ref_eng)
+        for rid, ref_rid in zip(rids, ref_rids):
+            if rid in out:
+                assert out[rid] == ref[ref_rid], f"survivor {rid} diverged"
+        _assert_drained(eng)
+
+    def test_chaos_gate_paged_path(self, tiny_lm):
+        """Same gate over the paged decode path (its own compiled step and
+        KV plumbing must honor the same isolation)."""
+        model, params = tiny_lm
+        prompts = self._prompts(6, seed=7)
+        kw = dict(num_blocks=16, block_size=4, max_batch_size=4,
+                  max_seq_len=32, decode_path="paged")
+        ref_eng, ref_rids = self._run(model, params, prompts, **kw)
+        plan = FaultPlan(seed=13, alloc_fail_prob=0.12, nan_logit_calls=(4,))
+        eng, rids = self._run(model, params, prompts, plan=plan, **kw)
+        assert plan.fired["pool.alloc"] >= 1
+        out, ref = _finished(eng), _finished(ref_eng)
+        for rid, ref_rid in zip(rids, ref_rids):
+            if rid in out:
+                assert out[rid] == ref[ref_rid]
+        _assert_drained(eng)

@@ -7,21 +7,33 @@
 Each input line is one request:
 
     {"id": 3, "prompt": "text", "max_new_tokens": 32,
-     "temperature": 0.8, "top_k": 40, "top_p": 0.9}
+     "temperature": 0.8, "top_k": 40, "top_p": 0.9,
+     "deadline_s": 30.0, "max_queue_s": 5.0}
     {"id": 4, "tokens": [464, 3616, 286], "max_new_tokens": 8}
+    {"op": "cancel", "id": 4}
 
 ``tokens`` bypasses tokenization; ``prompt`` text uses --vocab (reference
 vocab.bin) when given, else byte-level ids. ``id`` defaults to a counter.
+``op: cancel`` aborts a queued or running request by its user id.
 
 Responses stream as the engine produces them, one JSON object per line:
 
     {"event": "token", "id": 3, "token": 257}
     {"event": "done", "id": 3, "tokens": [...], "text": "...",
      "finish_reason": "length", "ttft_ms": 12.3}
+    {"event": "error", "id": 3, "reason": "..."}       (failed / rejected)
+    {"event": "timeout", "id": 3, "reason": "..."}     (deadline expired)
+    {"event": "cancelled", "id": 3}
+
+The server process is fault-tolerant by construction: a bad JSON line, a
+rejected submit (queue full under --max-queue-depth), or an engine-step
+failure emits a structured event and the loop keeps serving — one poisoned
+request can never kill the process (see docs/serving.md's failure-mode
+matrix).
 
 New requests are accepted WHILE earlier ones decode (continuous batching):
 stdin is polled between engine steps, so interleaved pipes work. On stdin
-EOF the engine drains remaining work, prints a metrics summary to stderr,
+EOF the engine drains remaining work, prints a stats summary to stderr,
 and exits.
 """
 import argparse
@@ -41,10 +53,12 @@ import numpy as np  # noqa: E402
 from tnn_tpu import checkpoint as ckpt_lib  # noqa: E402
 from tnn_tpu import models  # noqa: E402
 from tnn_tpu.data.tokenizer import Tokenizer  # noqa: E402
-from tnn_tpu.serving import InferenceEngine  # noqa: E402
+from tnn_tpu.serving import AdmissionRejected, InferenceEngine  # noqa: E402
 
 
 from tnn_tpu.cli import console_entry
+
+TERMINAL_EVENT = {"failed": "error", "timed_out": "timeout"}
 
 
 def _emit(obj):
@@ -71,9 +85,19 @@ def main(argv=None):
     ap.add_argument("--max-seq-len", type=int, default=0,
                     help="per-request position cap (0 = model/pool limit)")
     ap.add_argument("--decode-path", default="auto",
-                    choices=("auto", "standard", "fused"))
+                    choices=("auto", "standard", "fused", "paged"))
     ap.add_argument("--max-new-tokens", type=int, default=32,
                     help="default for requests that omit it")
+    ap.add_argument("--max-queue-depth", type=int, default=0,
+                    help="bounded admission: reject submits past this many "
+                         "waiting requests (0 = unbounded)")
+    ap.add_argument("--preemption-budget", type=int, default=16,
+                    help="recompute preemptions a request may absorb before "
+                         "it fails cleanly (-1 = unlimited)")
+    ap.add_argument("--deadline-s", type=float, default=0.0,
+                    help="default per-request wall deadline (0 = none)")
+    ap.add_argument("--no-logit-guard", action="store_true",
+                    help="disable per-row non-finite logit detection")
     ap.add_argument("--seed", type=int, default=0)
     args = ap.parse_args(argv)
 
@@ -94,50 +118,73 @@ def main(argv=None):
         model, params, num_blocks=args.num_blocks, block_size=args.block_size,
         max_batch_size=args.max_batch_size,
         max_seq_len=args.max_seq_len or None, decode_path=args.decode_path,
-        seed=args.seed)
-    if engine.fused_fallback_reason:
+        max_queue_depth=args.max_queue_depth,
+        preemption_budget=(None if args.preemption_budget < 0
+                           else args.preemption_budget),
+        logit_guard=not args.no_logit_guard, seed=args.seed)
+    if not engine._paged and engine.paged_fallback_reason:
+        print(f"paged decode unavailable: {engine.paged_fallback_reason}",
+              file=sys.stderr)
+    if not engine._paged and engine.fused_fallback_reason:
         print(f"standard decode path: {engine.fused_fallback_reason}",
               file=sys.stderr)
 
-    def encode(line: str):
-        req = json.loads(line)
-        if "tokens" in req:
-            ids = np.asarray(req["tokens"], np.int32)
-        elif tokenizer is not None:
-            ids = np.asarray(tokenizer.encode(req["prompt"]), np.int32)
-        else:
-            ids = np.frombuffer(req["prompt"].encode(), np.uint8).astype(
-                np.int32) % model.vocab_size
-        rid = engine.submit(
-            ids, int(req.get("max_new_tokens", args.max_new_tokens)),
-            temperature=float(req.get("temperature", 0.0)),
-            top_k=int(req.get("top_k", 0)),
-            top_p=float(req.get("top_p", 0.0)),
-            stop_token=req.get("stop_token"))
-        return rid, req.get("id", rid)
-
     ids_by_rid = {}
-    eof = False
-    t0 = time.perf_counter()
-    while not eof or engine.has_work:
-        # poll stdin: block while idle, only peek while the engine has work
-        while not eof and _stdin_ready(0.0 if engine.has_work else 0.2):
-            line = sys.stdin.readline()
-            if not line:
-                eof = True
-                break
-            if not line.strip():
-                continue
-            try:
-                rid, user_id = encode(line)
-                ids_by_rid[rid] = user_id
-            except (ValueError, KeyError, json.JSONDecodeError) as e:
-                _emit({"event": "error", "error": str(e)})
-        if not engine.has_work:
-            continue
-        events = engine.step()
+    rid_by_user = {}
+
+    def handle_line(line: str):
+        """One client line: submit or cancel. Emits structured error events
+        instead of raising — a bad line must never kill the server."""
+        try:
+            req = json.loads(line)
+        except json.JSONDecodeError as e:
+            _emit({"event": "error", "reason": f"bad json: {e}"})
+            return
+        if req.get("op") == "cancel":
+            user_id = req.get("id")
+            rid = rid_by_user.get(user_id)
+            if rid is not None and engine.cancel(rid):
+                _emit({"event": "cancelled", "id": user_id})
+            else:
+                _emit({"event": "error", "id": user_id,
+                       "reason": "cancel: unknown or already-terminal id"})
+            return
+        try:
+            if "tokens" in req:
+                ids = np.asarray(req["tokens"], np.int32)
+            elif tokenizer is not None:
+                ids = np.asarray(tokenizer.encode(req["prompt"]), np.int32)
+            else:
+                ids = np.frombuffer(req["prompt"].encode(), np.uint8).astype(
+                    np.int32) % model.vocab_size
+            deadline = req.get("deadline_s", args.deadline_s or None)
+            rid = engine.submit(
+                ids, int(req.get("max_new_tokens", args.max_new_tokens)),
+                temperature=float(req.get("temperature", 0.0)),
+                top_k=int(req.get("top_k", 0)),
+                top_p=float(req.get("top_p", 0.0)),
+                stop_token=req.get("stop_token"),
+                deadline_s=(float(deadline) if deadline else None),
+                max_queue_s=(float(req["max_queue_s"])
+                             if req.get("max_queue_s") else None))
+        except AdmissionRejected as e:
+            _emit({"event": "error", "id": req.get("id"),
+                   "reason": str(e), "rejected": True})
+            return
+        except (ValueError, KeyError, TypeError) as e:
+            _emit({"event": "error", "id": req.get("id"), "reason": str(e)})
+            return
+        user_id = req.get("id", rid)
+        ids_by_rid[rid] = user_id
+        rid_by_user[user_id] = rid
+
+    def drain_events(events):
         for rid, tok in events["tokens"]:
             _emit({"event": "token", "id": ids_by_rid[rid], "token": int(tok)})
+        for bucket, event in TERMINAL_EVENT.items():
+            for rid, reason in events[bucket]:
+                _emit({"event": event, "id": ids_by_rid.get(rid, rid),
+                       "reason": reason})
         for rid in events["finished"]:
             req = engine.result(rid)
             done = {"event": "done", "id": ids_by_rid[rid],
@@ -148,8 +195,31 @@ def main(argv=None):
                 done["text"] = tokenizer.decode(req.out_tokens)
             _emit(done)
 
+    eof = False
+    t0 = time.perf_counter()
+    while not eof or engine.has_work:
+        # poll stdin: block while idle, only peek while the engine has work
+        while not eof and _stdin_ready(0.0 if engine.has_work else 0.2):
+            line = sys.stdin.readline()
+            if not line:
+                eof = True
+                break
+            if line.strip():
+                handle_line(line)
+        if not engine.has_work:
+            continue
+        try:
+            events = engine.step()
+        except Exception as e:  # noqa: BLE001 — keep serving: the engine
+            # isolates per-request faults internally; anything escaping here
+            # is reported and the loop continues (terminal states guarantee
+            # forward progress, so a poisoned step cannot spin forever)
+            _emit({"event": "error", "reason": f"engine step failed: {e}"})
+            continue
+        drain_events(events)
+
     dt = time.perf_counter() - t0
-    summary = engine.metrics.summary()
+    summary = engine.stats()
     summary["wall_s"] = round(dt, 3)
     print("serve summary: " + json.dumps(
         {k: round(v, 3) if isinstance(v, float) else v

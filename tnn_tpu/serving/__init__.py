@@ -5,16 +5,23 @@
     rid = engine.submit(prompt_ids, max_new_tokens=32)
     outputs = engine.run_until_complete()
 
-See docs/serving.md for the architecture and request lifecycle.
+Fault tolerance: requests always reach a terminal state (FINISHED / FAILED
+/ CANCELLED / TIMED_OUT), failures are isolated per request, admission is
+bounded (``max_queue_depth``), and ``faults.FaultPlan`` injects
+deterministic chaos for testing. See docs/serving.md for the architecture,
+request lifecycle, and failure-mode matrix.
 """
 from .engine import InferenceEngine
+from .faults import FaultInjected, FaultPlan
 from .kv_pool import (PagedKVPool, PoolExhausted, gather_kv, scatter_prefill,
                       scatter_token)
 from .metrics import ServingMetrics
-from .scheduler import Request, RequestState, Scheduler, StepPlan
+from .scheduler import (TERMINAL_STATES, AdmissionRejected, Request,
+                        RequestState, Scheduler, StepPlan)
 
 __all__ = [
     "InferenceEngine", "PagedKVPool", "PoolExhausted", "gather_kv",
     "scatter_prefill", "scatter_token", "ServingMetrics", "Request",
-    "RequestState", "Scheduler", "StepPlan",
+    "RequestState", "Scheduler", "StepPlan", "AdmissionRejected",
+    "TERMINAL_STATES", "FaultPlan", "FaultInjected",
 ]

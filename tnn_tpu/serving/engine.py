@@ -28,6 +28,23 @@ path. All paths read and write the same paged pool; the pool buffers are
 DONATED through every jitted step (prefill and all decode paths), so XLA
 updates pages in place instead of copying the pool each token. See
 docs/serving.md for the full decode-path matrix.
+
+Fault tolerance (docs/serving.md has the full failure-mode matrix): every
+submitted request reaches a terminal state — FINISHED, FAILED, CANCELLED,
+or TIMED_OUT — and failures are isolated per request. A pool-alloc failure,
+non-finite logits (caught per row by the configurable logit guard), or an
+oversized resume fails only the poisoned request, frees its blocks, and the
+rest of the batch keeps decoding. Recompute-preemption is capped per request
+(``preemption_budget``): a thrashing victim fails cleanly instead of
+livelocking the pool. ``submit`` applies bounded admission
+(``max_queue_depth`` with ``reject``/``block`` policy), ``cancel(rid)``
+aborts a queued or running request, and per-request ``deadline_s`` /
+``max_queue_s`` are enforced at the top of every step. An unattributable
+decode-step exception is retried once when transient (injected faults fire
+before the jitted call, so donated buffers are intact), else the live batch
+aborts — queued requests keep the engine serving. A seeded
+``faults.FaultPlan`` injects all of the above deterministically for chaos
+tests.
 """
 from __future__ import annotations
 
@@ -42,9 +59,11 @@ import numpy as np
 from ..models import sampling
 from ..profiling.profiler import EventType, Profiler, profiled
 from . import kv_pool as kv_pool_lib
-from .kv_pool import PagedKVPool
+from .faults import FaultInjected, FaultPlan
+from .kv_pool import PagedKVPool, PoolExhausted
 from .metrics import ServingMetrics
-from .scheduler import Request, RequestState, Scheduler
+from .scheduler import (TERMINAL_STATES, AdmissionRejected, Request,
+                        RequestState, Scheduler)
 
 
 class InferenceEngine:
@@ -60,13 +79,27 @@ class InferenceEngine:
         the smaller of model.max_len and the pool's whole capacity.
     decode_path : "auto" | "standard" | "fused" | "paged" (see module
         docstring and docs/serving.md).
+    max_queue_depth : bounded admission — waiting requests beyond this make
+        ``submit`` apply backpressure (0 = unbounded).
+    admission_policy : "reject" (submit raises ``AdmissionRejected``) or
+        "block" (submit drives ``step()`` until the queue drains below the
+        bound — single-threaded backpressure).
+    preemption_budget : max recompute-preemptions per request before the
+        victim FAILs instead of requeueing (None = unlimited; caps the
+        two-large-requests livelock).
+    logit_guard : per-row non-finite logit detection; a poisoned row FAILs
+        its request while the rest of the batch keeps its tokens.
+    faults : optional ``faults.FaultPlan`` for deterministic chaos testing.
     profiler : optional profiling.Profiler for span/counter wiring.
     """
 
     def __init__(self, model, params, *, num_blocks: int = 64,
                  block_size: int = 16, max_batch_size: int = 8,
                  token_budget: int = 2048, max_seq_len: Optional[int] = None,
-                 decode_path: str = "auto",
+                 decode_path: str = "auto", max_queue_depth: int = 0,
+                 admission_policy: str = "reject",
+                 preemption_budget: Optional[int] = 16,
+                 logit_guard: bool = True, faults: Optional[FaultPlan] = None,
                  profiler: Optional[Profiler] = None, seed: int = 0):
         if getattr(model, "kv_cache_dtype", None):
             raise ValueError(
@@ -75,6 +108,18 @@ class InferenceEngine:
                 "servable yet — use models.gpt2.generate")
         if decode_path not in ("auto", "standard", "fused", "paged"):
             raise ValueError(f"unknown decode_path {decode_path!r}")
+        if admission_policy not in ("reject", "block"):
+            raise ValueError(
+                f"unknown admission_policy {admission_policy!r}")
+        if max_queue_depth < 0:
+            raise ValueError("max_queue_depth must be >= 0 (0 = unbounded)")
+        if preemption_budget is not None and preemption_budget < 0:
+            raise ValueError("preemption_budget must be >= 0 or None")
+        self.max_queue_depth = int(max_queue_depth)
+        self.admission_policy = admission_policy
+        self.preemption_budget = preemption_budget
+        self.logit_guard = bool(logit_guard)
+        self.faults = faults
         self.model = model
         self.params = params
         self.head_dim = model.d_model // model.num_heads
@@ -82,6 +127,7 @@ class InferenceEngine:
             num_layers=model.num_layers, num_kv_heads=model.num_kv_heads,
             head_dim=self.head_dim, num_blocks=num_blocks,
             block_size=block_size, dtype=model.policy.compute_dtype)
+        self.pool.fault_plan = faults
         cap = min(model.max_len, self.pool.capacity * block_size)
         self.max_seq_len = min(max_seq_len or cap, cap)
         # fixed assembly width: every decode step gathers this many blocks per
@@ -156,8 +202,19 @@ class InferenceEngine:
 
     def submit(self, prompt_ids, max_new_tokens: int, *,
                temperature: float = 0.0, top_k: int = 0, top_p: float = 0.0,
-               stop_token: Optional[int] = None) -> int:
-        """Queue a generation request; returns its request id."""
+               stop_token: Optional[int] = None,
+               deadline_s: Optional[float] = None,
+               max_queue_s: Optional[float] = None) -> int:
+        """Queue a generation request; returns its request id.
+
+        ``deadline_s`` bounds the request's total wall time from submit;
+        ``max_queue_s`` bounds one continuous stretch in the wait queue —
+        either expiring transitions it to TIMED_OUT at the next step.
+
+        With ``max_queue_depth`` set, a full queue makes submit apply
+        backpressure: policy "reject" raises ``AdmissionRejected``; policy
+        "block" drives ``step()`` until a slot opens.
+        """
         prompt = np.asarray(prompt_ids, np.int32).reshape(-1)
         if prompt.size == 0:
             raise ValueError("empty prompt")
@@ -172,14 +229,37 @@ class InferenceEngine:
             raise ValueError(
                 f"request needs {self.pool.blocks_for(total)} blocks but the "
                 f"pool only has {self.pool.capacity} — it could never run")
+        if self.max_queue_depth and \
+                self.scheduler.queue_depth >= self.max_queue_depth:
+            if self.admission_policy == "reject":
+                self.metrics.observe_rejected()
+                raise AdmissionRejected(self.scheduler.queue_depth,
+                                        self.max_queue_depth)
+            # "block": drain our own queue — each step admits/expires work,
+            # and the queue head is guaranteed admissible once the pool
+            # drains (submit validated it fits alone), so this terminates
+            while self.has_work and \
+                    self.scheduler.queue_depth >= self.max_queue_depth:
+                self.step()
         rid = next(self._rid)
         req = Request(rid=rid, prompt=prompt, max_new_tokens=int(max_new_tokens),
                       temperature=float(temperature), top_k=int(top_k),
                       top_p=float(top_p), stop_token=stop_token,
-                      submit_time=time.perf_counter())
+                      submit_time=time.perf_counter(),
+                      deadline_s=deadline_s, max_queue_s=max_queue_s)
         self.requests[rid] = req
         self.scheduler.submit(req)
         return rid
+
+    def cancel(self, rid: int) -> bool:
+        """Abort a queued or running request: frees its blocks, transitions
+        it to CANCELLED. Returns False when the id is unknown or already
+        terminal (cancel races are benign)."""
+        req = self.requests.get(rid)
+        if req is None or req.state in TERMINAL_STATES:
+            return False
+        self._terminate(req, RequestState.CANCELLED, "cancelled by client")
+        return True
 
     @property
     def has_work(self) -> bool:
@@ -191,19 +271,78 @@ class InferenceEngine:
     def output_tokens(self, rid: int) -> List[int]:
         return list(self.requests[rid].out_tokens)
 
+    def stats(self) -> Dict[str, Any]:
+        """One flat dict: metrics summary + live engine/pool state and
+        request-state counts (``requests_<state>``)."""
+        s: Dict[str, Any] = dict(self.metrics.summary())
+        states: Dict[str, int] = {st.value: 0 for st in RequestState}
+        for r in self.requests.values():
+            states[r.state.value] += 1
+        s.update({f"requests_{k}": v for k, v in states.items()})
+        s.update({
+            "queue_depth": self.scheduler.queue_depth,
+            "num_running": len(self.scheduler.running),
+            "pool_free_blocks": self.pool.num_free,
+            "pool_allocated_blocks": self.pool.num_allocated,
+            "decode_path": ("paged" if self._paged
+                            else "fused" if self._fused is not None
+                            else "standard"),
+        })
+        return s
+
+    def check_invariants(self) -> None:
+        """Pool bookkeeping + full block accounting against every running
+        request's live table (only running requests hold blocks). Raises
+        ValueError on any violation — the chaos suite's leak detector."""
+        tables = [r.block_table for r in self.scheduler.running
+                  if r.block_table]
+        self.pool.check_invariants(tables)
+
+    def _terminate(self, req: Request, state: RequestState, error: str,
+                   events: Optional[Dict[str, List]] = None,
+                   bucket: Optional[str] = None) -> None:
+        """Fault-isolation exit: free the request's blocks, move it to a
+        terminal failure state, count it, and (when mid-step) report it in
+        the step's event bucket."""
+        if req.block_table:
+            self.pool.free(req.block_table)
+            req.block_table = []
+        self.scheduler.terminate(req, state, error)
+        if state is RequestState.FAILED:
+            self.metrics.observe_failed()
+        elif state is RequestState.CANCELLED:
+            self.metrics.observe_cancelled()
+        elif state is RequestState.TIMED_OUT:
+            self.metrics.observe_timeout()
+        if events is not None and bucket is not None:
+            events[bucket].append((req.rid, error))
+
     # -- engine step ----------------------------------------------------------
 
     def step(self) -> Dict[str, List]:
-        """Run one serving step: admit+prefill, then one batched decode.
+        """Run one serving step: expire deadlines, admit+prefill, then one
+        batched decode.
 
-        Returns ``{"tokens": [(rid, token), ...], "finished": [rid, ...]}`` —
-        the streamed increment this step produced.
+        Returns the streamed increment this step produced::
+
+            {"tokens":    [(rid, token), ...],
+             "finished":  [rid, ...],
+             "failed":    [(rid, error), ...],
+             "timed_out": [(rid, error), ...]}
+
+        Failures are isolated: a poisoned request (alloc failure, NaN
+        logits, oversized resume, exhausted preemption budget) lands in
+        ``failed`` and the rest of the batch keeps decoding.
         """
-        events: Dict[str, List] = {"tokens": [], "finished": []}
+        events: Dict[str, List] = {"tokens": [], "finished": [],
+                                   "failed": [], "timed_out": []}
+        if self.faults is not None:
+            self.faults.on_step()
+        self._enforce_deadlines(events)
         plan = self.scheduler.schedule(self.pool)
         for req in plan.prefills:
             self._prefill(req, events)
-        self._ensure_decode_capacity()
+        self._ensure_decode_capacity(events)
         live = [r for r in self.scheduler.running
                 if r.state is RequestState.RUNNING]
         if live:
@@ -211,6 +350,29 @@ class InferenceEngine:
         self.metrics.observe_gauges(self.scheduler.queue_depth,
                                     self.pool.occupancy)
         return events
+
+    def _enforce_deadlines(self, events: Dict[str, List]) -> None:
+        now = time.perf_counter()
+        for req in list(self.scheduler.waiting):
+            if req.deadline_s is not None and \
+                    now - req.submit_time > req.deadline_s:
+                self._terminate(
+                    req, RequestState.TIMED_OUT,
+                    f"deadline {req.deadline_s}s exceeded while queued",
+                    events, "timed_out")
+            elif req.max_queue_s is not None and \
+                    now - req.queued_time > req.max_queue_s:
+                self._terminate(
+                    req, RequestState.TIMED_OUT,
+                    f"max_queue_s {req.max_queue_s}s exceeded",
+                    events, "timed_out")
+        for req in list(self.scheduler.running):
+            if req.deadline_s is not None and \
+                    now - req.submit_time > req.deadline_s:
+                self._terminate(
+                    req, RequestState.TIMED_OUT,
+                    f"deadline {req.deadline_s}s exceeded after "
+                    f"{req.num_generated} tokens", events, "timed_out")
 
     def run_until_complete(self, max_steps: int = 100_000) -> Dict[int, List[int]]:
         """Drive steps until every submitted request finished; returns
@@ -233,17 +395,19 @@ class InferenceEngine:
     def _prefill_fn(self, padded_len: int, nb: int):
         model = self.model
 
-        def fn(params, pages_k, pages_v, ids, length, blocks, t, k, p, key):
+        def fn(params, pages_k, pages_v, ids, length, blocks, t, k, p, key,
+               poison):
             caches = model.init_cache(1, padded_len)
             logits, caches = model.apply_cached(params, ids, caches, 0)
-            last = jnp.take(logits[0], length - 1, axis=0)      # (V,)
+            last = jnp.take(logits[0], length - 1, axis=0) + poison  # (V,)
+            ok = jnp.isfinite(last).all()
             tok = sampling.sample_ragged(last[None], key, t[None], k[None],
                                          p[None])[0]
             k_all = jnp.stack([c["k"][0] for c in caches])      # (L, H, P, Dh)
             v_all = jnp.stack([c["v"][0] for c in caches])
             pages_k = kv_pool_lib.scatter_prefill(pages_k, blocks, k_all)
             pages_v = kv_pool_lib.scatter_prefill(pages_v, blocks, v_all)
-            return tok, pages_k, pages_v
+            return tok, ok, pages_k, pages_v
 
         # pool buffers are donated: the scatter updates pages in place
         # instead of copying the whole pool per prefill
@@ -254,31 +418,61 @@ class InferenceEngine:
         seq = req.resume_tokens
         bs = self.pool.block_size
         nb = self.pool.blocks_for(len(seq))
+        if nb > self.blocks_per_seq:
+            # unreachable via submit()'s validation (resume <= prompt +
+            # max_new), but a corrupted resume must not poison the batch
+            self._terminate(
+                req, RequestState.FAILED,
+                f"oversized resume: {len(seq)} tokens need {nb} blocks > "
+                f"assembly capacity {self.blocks_per_seq}", events, "failed")
+            return
+        try:
+            if self.faults is not None:
+                self.faults.on_prefill()
+            req.block_table = self.pool.alloc(nb)
+        except (PoolExhausted, FaultInjected) as e:
+            self._terminate(req, RequestState.FAILED,
+                            f"prefill failed: {e}", events, "failed")
+            return
         # bucket the COMPILED width to the next power of two (capped at the
         # assembly width) so N distinct prompt lengths cost O(log N) compiles,
         # not one each; only the nb real blocks are allocated — the bucket's
         # tail rows scatter into the reserved scratch block and vanish
         nb_bucket = min(self.blocks_per_seq, 1 << (nb - 1).bit_length())
         padded = nb_bucket * bs
-        blocks = self.pool.alloc(nb)
+        blocks = req.block_table
         ids = np.zeros((1, padded), np.int32)
         ids[0, :len(seq)] = seq
+        poison = np.float32("nan") if (
+            self.faults is not None and self.faults.poison_prefill()
+        ) else np.float32(0.0)
         key = ("prefill", padded)
         fn = self._jit.get(key)
         if fn is None:
             fn = self._jit[key] = self._prefill_fn(padded, nb_bucket)
-        with profiled("serve.prefill", EventType.COMPUTE, self.profiler):
-            tok, pk, pv = fn(
-                self.params, self.pool.pages_k, self.pool.pages_v,
-                jnp.asarray(ids), jnp.asarray(len(seq), jnp.int32),
-                jnp.asarray(self.pool.padded_table(blocks, nb_bucket),
-                            jnp.int32),
-                jnp.asarray(req.temperature, jnp.float32),
-                jnp.asarray(req.top_k, jnp.int32),
-                jnp.asarray(req.top_p, jnp.float32), self._next_key())
-            tok = int(tok)
+        try:
+            with profiled("serve.prefill", EventType.COMPUTE,
+                          self.profiler):
+                tok, ok, pk, pv = fn(
+                    self.params, self.pool.pages_k, self.pool.pages_v,
+                    jnp.asarray(ids), jnp.asarray(len(seq), jnp.int32),
+                    jnp.asarray(self.pool.padded_table(blocks, nb_bucket),
+                                jnp.int32),
+                    jnp.asarray(req.temperature, jnp.float32),
+                    jnp.asarray(req.top_k, jnp.int32),
+                    jnp.asarray(req.top_p, jnp.float32), self._next_key(),
+                    jnp.asarray(poison))
+                tok, ok = int(tok), bool(ok)
+        except Exception as e:  # noqa: BLE001 — isolate, don't crash serving
+            self._terminate(req, RequestState.FAILED,
+                            f"prefill step failed: {e}", events, "failed")
+            self._recover_pages_if_dead(events)
+            return
         self.pool.update_pages(pk, pv)
-        req.block_table = blocks
+        if self.logit_guard and not ok:
+            self._terminate(req, RequestState.FAILED,
+                            "non-finite logits in prefill", events, "failed")
+            return
         req.cache_len = len(seq)
         self.scheduler.admit(req)
         now = time.perf_counter()
@@ -297,9 +491,12 @@ class InferenceEngine:
 
     # -- decode ---------------------------------------------------------------
 
-    def _ensure_decode_capacity(self) -> None:
+    def _ensure_decode_capacity(self, events: Dict[str, List]) -> None:
         """Every running request must own the block its next token writes to;
-        preempt (LIFO) when the pool runs dry."""
+        preempt (LIFO) when the pool runs dry. A victim that already spent
+        its ``preemption_budget`` FAILs instead of requeueing — its freed
+        blocks break the two-large-requests livelock; and an allocation that
+        still fails (injected fault) FAILs only the requesting row."""
         bs = self.pool.block_size
         for req in list(self.scheduler.running):
             if req.state is not RequestState.RUNNING:
@@ -314,23 +511,39 @@ class InferenceEngine:
                     raise RuntimeError(
                         "KV pool deadlock: no preemption victim can free "
                         "enough blocks")
-                self._preempt(victim)
+                if self.preemption_budget is not None and \
+                        victim.preemptions >= self.preemption_budget:
+                    self._terminate(
+                        victim, RequestState.FAILED,
+                        f"preemption budget exhausted "
+                        f"({victim.preemptions} recompute preemptions >= "
+                        f"budget {self.preemption_budget})",
+                        events, "failed")
+                else:
+                    self._preempt(victim)
                 if victim is req:
                     break
-            if req.state is RequestState.RUNNING:
+            if req.state is not RequestState.RUNNING:
+                continue
+            try:
                 req.block_table.extend(self.pool.alloc(1))
+            except PoolExhausted as e:
+                self._terminate(req, RequestState.FAILED,
+                                f"pool allocation failed mid-decode: {e}",
+                                events, "failed")
 
     def _preempt(self, req: Request) -> None:
         self.pool.free(req.block_table)
         req.block_table = []
         req.cache_len = 0
         self.scheduler.requeue(req)
-        self.metrics.observe_preemption()
+        self.metrics.observe_preemption(req.rid)
 
     def _decode_fn(self, batch: int, nb: int):
         model = self.model
 
-        def fn(params, pages_k, pages_v, toks, offsets, tables, t, k, p, key):
+        def fn(params, pages_k, pages_v, toks, offsets, tables, t, k, p, key,
+               poison):
             kf, vf = kv_pool_lib.gather_kv(pages_k, pages_v, tables)
             x, _ = model.wte.apply({"params": params["wte"], "state": {}},
                                    toks[:, None])                 # (B, 1, D)
@@ -347,28 +560,32 @@ class InferenceEngine:
                 rows_v.append(
                     jnp.take_along_axis(cache["v"], idx, axis=2)[:, :, 0])
             x, _ = model.ln_f.apply({"params": params["ln_f"], "state": {}}, x)
-            logits = model._head(params, x)[:, -1]                # (B, V)
+            logits = model._head(params, x)[:, -1] + poison[:, None]  # (B, V)
+            ok = jnp.isfinite(logits).all(axis=-1)                # (B,)
             newtok = sampling.sample_ragged(logits, key, t, k, p)
             pages_k = kv_pool_lib.scatter_token(pages_k, tables, offsets,
                                                 jnp.stack(rows_k))
             pages_v = kv_pool_lib.scatter_token(pages_v, tables, offsets,
                                                 jnp.stack(rows_v))
-            return newtok, pages_k, pages_v
+            return newtok, ok, pages_k, pages_v
 
         return jax.jit(fn, donate_argnums=(1, 2))
 
     def _paged_decode_fn(self, batch: int, nb: int):
         model = self.model
 
-        def fn(params, pages_k, pages_v, toks, offsets, tables, t, k, p, key):
+        def fn(params, pages_k, pages_v, toks, offsets, tables, t, k, p, key,
+               poison):
             # no gather_kv, no assembled cache: the model scatters each
             # layer's new row into its page and the paged-attention kernel
             # streams KV via the block tables — per-step pool traffic is B
             # row writes plus the KV actually attended over
             logits, pages_k, pages_v = model.apply_decode_paged(
                 params, toks, pages_k, pages_v, tables, offsets)
+            logits = logits + poison[:, None]
+            ok = jnp.isfinite(logits).all(axis=-1)
             newtok = sampling.sample_ragged(logits, key, t, k, p)
-            return newtok, pages_k, pages_v
+            return newtok, ok, pages_k, pages_v
 
         return jax.jit(fn, donate_argnums=(1, 2))
 
@@ -378,7 +595,7 @@ class InferenceEngine:
         bs = self.pool.block_size
 
         def fn(params, stacks, pages_k, pages_v, toks, offset, tables,
-               t, k, p, key):
+               t, k, p, key, poison):
             from ..ops.pallas.decode_stack import fused_decode_stack
 
             kf, vf = kv_pool_lib.gather_kv(pages_k, pages_v, tables)
@@ -397,7 +614,8 @@ class InferenceEngine:
                 interpret=fused["interpret"])
             xf, _ = model.ln_f.apply({"params": params["ln_f"], "state": {}},
                                      x_out[:, None, :])
-            logits = model._head(params, xf)[:, -1]
+            logits = model._head(params, xf)[:, -1] + poison[:, None]
+            ok = jnp.isfinite(logits).all(axis=-1)
             newtok = sampling.sample_ragged(logits, key, t, k, p)
             # extract the one new row per layer and page it back in
             row_k = jax.lax.dynamic_slice_in_dim(kc, offset, 1, axis=2)[:, :, 0]
@@ -409,7 +627,7 @@ class InferenceEngine:
                 pages_k, tables, offsets, row_k.reshape(l, b, h, d // h))
             pages_v = kv_pool_lib.scatter_token(
                 pages_v, tables, offsets, row_v.reshape(l, b, h, d // h))
-            return newtok, pages_k, pages_v
+            return newtok, ok, pages_k, pages_v
 
         return jax.jit(fn, donate_argnums=(2, 3))
 
@@ -423,6 +641,7 @@ class InferenceEngine:
         temps = np.zeros((b,), np.float32)
         topks = np.zeros((b,), np.int32)
         topps = np.zeros((b,), np.float32)
+        poison = np.zeros((b,), np.float32)
         for i, req in enumerate(live):
             toks[i] = req.next_token
             offsets[i] = req.cache_len
@@ -430,6 +649,8 @@ class InferenceEngine:
             temps[i] = req.temperature
             topks[i] = req.top_k
             topps[i] = req.top_p
+        if self.faults is not None:
+            poison[:len(live)][self.faults.poison_rows(len(live))] = np.nan
         lockstep = (not self._paged and self._fused is not None
                     and len(set(offsets[:len(live)].tolist())) == 1)
         if lockstep:
@@ -448,23 +669,55 @@ class InferenceEngine:
                 self._paged_decode_fn(b, nb) if self._paged
                 else self._fused_decode_fn(b, nb) if lockstep
                 else self._decode_fn(b, nb))
-        with profiled(label, EventType.COMPUTE, self.profiler):
-            if lockstep:
-                newtok, pk, pv = fn(
-                    self.params, self._fused["stacks"], self.pool.pages_k,
-                    self.pool.pages_v, jnp.asarray(toks),
-                    jnp.asarray(int(offsets[0]), jnp.int32),
-                    jnp.asarray(tables), jnp.asarray(temps),
-                    jnp.asarray(topks), jnp.asarray(topps), self._next_key())
-            else:
-                newtok, pk, pv = fn(
-                    self.params, self.pool.pages_k, self.pool.pages_v,
-                    jnp.asarray(toks), jnp.asarray(offsets),
-                    jnp.asarray(tables), jnp.asarray(temps),
-                    jnp.asarray(topks), jnp.asarray(topps), self._next_key())
-            newtok = np.asarray(newtok)
+        # one key per STEP (held across the retry): a transient fault retried
+        # with the same key reproduces the fault-free step bit-for-bit
+        step_key = self._next_key()
+        for attempt in (0, 1):
+            try:
+                if self.faults is not None:
+                    self.faults.on_decode()
+                with profiled(label, EventType.COMPUTE, self.profiler):
+                    if lockstep:
+                        newtok, ok, pk, pv = fn(
+                            self.params, self._fused["stacks"],
+                            self.pool.pages_k, self.pool.pages_v,
+                            jnp.asarray(toks),
+                            jnp.asarray(int(offsets[0]), jnp.int32),
+                            jnp.asarray(tables), jnp.asarray(temps),
+                            jnp.asarray(topks), jnp.asarray(topps), step_key,
+                            jnp.asarray(poison))
+                    else:
+                        newtok, ok, pk, pv = fn(
+                            self.params, self.pool.pages_k, self.pool.pages_v,
+                            jnp.asarray(toks), jnp.asarray(offsets),
+                            jnp.asarray(tables), jnp.asarray(temps),
+                            jnp.asarray(topks), jnp.asarray(topps), step_key,
+                            jnp.asarray(poison))
+                    newtok = np.asarray(newtok)
+                    ok = np.asarray(ok)
+                break
+            except FaultInjected as e:
+                # injected pre-call: donated buffers untouched, retryable
+                if attempt == 0 and e.transient:
+                    self.metrics.observe_step_retry()
+                    continue
+                self._abort_batch(live, f"decode step failed: {e}", events)
+                return
+            except Exception as e:  # noqa: BLE001 — a real step failure may
+                # have consumed the donated pages: unattributable, so the
+                # live batch aborts but the engine survives for queued work
+                self._abort_batch(live, f"decode step failed: {e}", events)
+                return
         self.pool.update_pages(pk, pv)
         for i, req in enumerate(live):
+            if self.logit_guard and not bool(ok[i]):
+                # poisoned row: only this request fails — its sampled token
+                # is garbage and its KV blocks are freed; the other rows'
+                # tokens in this very batch remain valid
+                self._terminate(req, RequestState.FAILED,
+                                "non-finite logits in decode step",
+                                events, "failed")
+                continue
             tok = int(newtok[i])
             req.cache_len += 1
             req.next_token = tok
@@ -472,6 +725,31 @@ class InferenceEngine:
             events["tokens"].append((req.rid, tok))
             self._maybe_finish(req, tok, events)
         self.metrics.observe_decode(len(live), time.perf_counter() - t0, b)
+
+    def _abort_batch(self, live: Sequence[Request], error: str,
+                     events) -> None:
+        """A decode failure that cannot be pinned on one row: fail every
+        live request, then restore valid page buffers (a failed jitted call
+        may have consumed the donated ones). Queued requests are untouched
+        and re-prefill from scratch, so serving continues."""
+        for req in live:
+            if req.state is RequestState.RUNNING:
+                self._terminate(req, RequestState.FAILED, error,
+                                events, "failed")
+        self._recover_pages_if_dead(events, force=True)
+
+    def _recover_pages_if_dead(self, events, *, force: bool = False) -> None:
+        """Re-zero the pool pages when a failed jitted step consumed the
+        donated buffers (or unconditionally with ``force``, when no running
+        request holds KV anyway). Any request still holding blocks at that
+        point has lost its KV and must fail too."""
+        dead = getattr(self.pool.pages_k, "is_deleted", lambda: False)()
+        if not (dead or force):
+            return
+        for req in list(self.scheduler.running):
+            self._terminate(req, RequestState.FAILED,
+                            "KV pages lost to a failed step", events, "failed")
+        self.pool.reset_pages()
 
     def _maybe_finish(self, req: Request, tok: int, events) -> None:
         if req.stop_token is not None and tok == req.stop_token:

@@ -1,0 +1,146 @@
+"""Deterministic fault injection for chaos-testing the serving engine.
+
+A seeded ``FaultPlan`` hooks into the two places faults enter a serving
+stack — the allocator (``PagedKVPool.alloc`` consults ``pool.fault_plan``)
+and the engine's step sites (``InferenceEngine(..., faults=plan)``) — and
+fires failures either probabilistically or at explicit 1-based call
+indices:
+
+- **pool-alloc failure**: an injected ``PoolExhausted`` raised before any
+  bookkeeping mutates, mid-prefill or mid-decode-growth;
+- **step exceptions**: ``FaultInjected`` raised at the prefill/decode call
+  sites (host-level, *before* the jitted call, so donated pool buffers are
+  never harmed by the injection itself). ``transient_exc=True`` models a
+  recoverable glitch — the engine retries the decode once with the same
+  sampling key; ``False`` models a hard step failure (batch abort);
+- **NaN logits**: per-row poison values that flow through the compiled
+  step's real logits, exercising the engine's logit guard exactly as a
+  genuine numeric blowup would;
+- **artificial step latency**: ``time.sleep`` at the top of every engine
+  step, for deadline/queue-timeout tests that need wall time to pass.
+
+Everything is driven by one ``numpy`` Generator seeded at construction:
+the same plan over the same call sequence fires the same faults, so chaos
+tests are reproducible bit-for-bit. ``plan.calls`` / ``plan.fired`` record
+per-site call and fire counts for assertions.
+
+    plan = FaultPlan(seed=7, alloc_fail_prob=0.1, nan_logit_calls=(4,))
+    eng = InferenceEngine(model, params, faults=plan, ...)
+    ...
+    assert plan.fired["pool.alloc"] > 0
+
+The invariant every chaos test asserts: every submitted request reaches a
+terminal state, survivors are token-identical to a fault-free run, and the
+pool ends with zero leaked blocks (``check_invariants`` clean).
+"""
+from __future__ import annotations
+
+import time
+from collections import Counter
+from dataclasses import dataclass, field
+from typing import Tuple
+
+import numpy as np
+
+
+class FaultInjected(RuntimeError):
+    """Raised by a FaultPlan at an injected step-exception site."""
+
+    def __init__(self, site: str, call: int, transient: bool = True):
+        self.site = site
+        self.call = call
+        self.transient = transient
+        kind = "transient" if transient else "persistent"
+        super().__init__(f"injected {kind} fault at {site} (call #{call})")
+
+
+@dataclass
+class FaultPlan:
+    """Seeded, deterministic fault schedule. ``*_calls`` are explicit
+    1-based call indices that always fire; ``*_prob`` adds an independent
+    per-call (or per-row, for NaN logits) Bernoulli draw."""
+
+    seed: int = 0
+    # injected PoolExhausted from pool.alloc (site "pool.alloc")
+    alloc_fail_prob: float = 0.0
+    alloc_fail_calls: Tuple[int, ...] = ()
+    # host-level exceptions at the engine's step sites
+    prefill_exc_prob: float = 0.0
+    prefill_exc_calls: Tuple[int, ...] = ()       # site "prefill"
+    decode_exc_prob: float = 0.0
+    decode_exc_calls: Tuple[int, ...] = ()        # site "decode"
+    transient_exc: bool = True                    # decode retries once if True
+    # NaN poison added to logits inside the compiled step
+    nan_logit_prob: float = 0.0                   # per live row, per decode
+    nan_logit_calls: Tuple[int, ...] = ()         # poisons row 0 of that call
+    nan_prefill_calls: Tuple[int, ...] = ()       # site "prefill.logits"
+    # artificial latency at the top of every engine step
+    step_delay_s: float = 0.0
+
+    calls: Counter = field(default_factory=Counter, init=False)
+    fired: Counter = field(default_factory=Counter, init=False)
+
+    def __post_init__(self):
+        self._rng = np.random.default_rng(self.seed)
+
+    # -- internal -------------------------------------------------------------
+
+    def _fires(self, site: str, prob: float, at_calls: Tuple[int, ...]) -> bool:
+        self.calls[site] += 1
+        n = self.calls[site]
+        # draw even on scheduled hits so the rng stream depends only on the
+        # call sequence, not on which mechanism fired
+        drew = prob > 0.0 and float(self._rng.random()) < prob
+        hit = n in at_calls or drew
+        if hit:
+            self.fired[site] += 1
+        return hit
+
+    # -- hook sites -----------------------------------------------------------
+
+    def on_alloc(self, n: int, num_free: int) -> None:
+        """Called by PagedKVPool.alloc before mutating the free list."""
+        if self._fires("pool.alloc", self.alloc_fail_prob,
+                       self.alloc_fail_calls):
+            from .kv_pool import PoolExhausted
+
+            raise PoolExhausted(
+                f"injected allocation failure "
+                f"(call #{self.calls['pool.alloc']}: wanted {n}, "
+                f"{num_free} free)")
+
+    def on_prefill(self) -> None:
+        """Engine prefill site — fires before the request allocates blocks."""
+        if self._fires("prefill", self.prefill_exc_prob,
+                       self.prefill_exc_calls):
+            raise FaultInjected("prefill", self.calls["prefill"],
+                                self.transient_exc)
+
+    def on_decode(self) -> None:
+        """Engine decode site — fires before the jitted decode call (donated
+        buffers untouched, so a transient fault is safely retryable)."""
+        if self._fires("decode", self.decode_exc_prob, self.decode_exc_calls):
+            raise FaultInjected("decode", self.calls["decode"],
+                                self.transient_exc)
+
+    def poison_prefill(self) -> bool:
+        """True when this prefill's logits should be poisoned to NaN."""
+        return self._fires("prefill.logits", 0.0, self.nan_prefill_calls)
+
+    def poison_rows(self, num_live: int) -> np.ndarray:
+        """Boolean ``(num_live,)`` mask of decode rows whose logits this
+        call poisons to NaN (site "decode.logits"; nth-call poisons row 0)."""
+        self.calls["decode.logits"] += 1
+        n = self.calls["decode.logits"]
+        mask = np.zeros(num_live, bool)
+        if num_live and n in self.nan_logit_calls:
+            mask[0] = True
+        if self.nan_logit_prob > 0.0 and num_live:
+            mask |= self._rng.random(num_live) < self.nan_logit_prob
+        self.fired["decode.logits"] += int(mask.sum())
+        return mask
+
+    def on_step(self) -> None:
+        """Top of every engine step: artificial latency."""
+        if self.step_delay_s > 0.0:
+            time.sleep(self.step_delay_s)

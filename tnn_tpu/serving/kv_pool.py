@@ -43,7 +43,9 @@ contract, not an implementation detail:
 from __future__ import annotations
 
 import math
-from typing import Dict, List, Optional, Sequence
+import os
+from collections import Counter
+from typing import Dict, Iterable, List, Optional, Sequence
 
 import jax.numpy as jnp
 
@@ -75,6 +77,12 @@ class PagedKVPool:
         # are warmest); block 0 never enters it
         self._free: List[int] = list(range(self.num_blocks - 1, 0, -1))
         self._ref: Dict[int, int] = {}
+        # chaos hook: when set (serving.faults.FaultPlan), alloc() consults
+        # it and may raise an injected PoolExhausted before mutating state
+        self.fault_plan = None
+        # TNN_POOL_DEBUG=1: re-verify bookkeeping invariants on every free
+        # (eviction) — cheap O(blocks) host work, off by default
+        self.debug = os.environ.get("TNN_POOL_DEBUG", "") == "1"
 
     # -- bookkeeping ----------------------------------------------------------
 
@@ -108,6 +116,10 @@ class PagedKVPool:
             raise PoolExhausted(
                 f"need {n} blocks, {len(self._free)} free "
                 f"(capacity {self.capacity})")
+        if self.fault_plan is not None:
+            # may raise an injected PoolExhausted; fires BEFORE any state
+            # mutation so a rejected alloc never half-takes blocks
+            self.fault_plan.on_alloc(n, len(self._free))
         blocks = [self._free.pop() for _ in range(n)]
         for b in blocks:
             self._ref[b] = 1
@@ -134,6 +146,55 @@ class PagedKVPool:
                 self._free.append(b)
             else:
                 self._ref[b] = r - 1
+        if self.debug:
+            self.check_invariants()
+
+    def check_invariants(
+            self,
+            block_tables: Optional[Iterable[Sequence[int]]] = None) -> None:
+        """Verify the pool's bookkeeping; raises ValueError on violation.
+
+        Always checked: free + allocated == capacity, every refcount >= 1,
+        the scratch block is neither free nor allocated, no block is both
+        free and allocated, no duplicate free-list entries, all ids in range.
+
+        With ``block_tables`` (the live tables of every running request),
+        additionally checks full accounting: each allocated block appears in
+        exactly ``refcount`` live tables — no leaked blocks (allocated but
+        unreferenced) and no block shared beyond its refcount.
+        """
+        free_set = set(self._free)
+        if len(free_set) != len(self._free):
+            raise ValueError(f"duplicate blocks in free list: {self._free}")
+        if self.SCRATCH in free_set or self.SCRATCH in self._ref:
+            raise ValueError("scratch block 0 entered circulation")
+        if free_set & self._ref.keys():
+            raise ValueError(
+                f"blocks both free and allocated: {free_set & self._ref.keys()}")
+        if len(self._free) + len(self._ref) != self.capacity:
+            raise ValueError(
+                f"free ({len(self._free)}) + allocated ({len(self._ref)}) != "
+                f"capacity ({self.capacity})")
+        bad = [b for b in (free_set | self._ref.keys())
+               if not 1 <= b < self.num_blocks]
+        if bad:
+            raise ValueError(f"block ids out of range: {bad}")
+        if any(r < 1 for r in self._ref.values()):
+            raise ValueError(f"refcount < 1: {self._ref}")
+        if block_tables is not None:
+            usage: Counter = Counter()
+            for table in block_tables:
+                usage.update(table)
+            usage.pop(self.SCRATCH, None)   # padded entries are legal
+            if set(usage) != set(self._ref) or any(
+                    usage[b] != r for b, r in self._ref.items()):
+                leaked = set(self._ref) - set(usage)
+                unknown = set(usage) - set(self._ref)
+                counts = {b: (usage[b], self._ref.get(b)) for b in usage}
+                raise ValueError(
+                    f"table/refcount mismatch: leaked={sorted(leaked)} "
+                    f"unallocated-in-tables={sorted(unknown)} "
+                    f"(table_uses, refcount)={counts}")
 
     # -- device pages ---------------------------------------------------------
 
@@ -141,6 +202,16 @@ class PagedKVPool:
         """Adopt the functionally-updated page arrays a jitted step returned."""
         self.pages_k = pages_k
         self.pages_v = pages_v
+
+    def reset_pages(self) -> None:
+        """Re-zero the device pages (fresh buffers). Recovery path for a
+        failed jitted step whose DONATED page buffers died with it: the
+        engine fails every request that held KV first, so only bookkeeping
+        (untouched here) and empty pages remain."""
+        shape = (self.num_layers, self.num_blocks, self.num_kv_heads,
+                 self.block_size, self.head_dim)
+        self.pages_k = jnp.zeros(shape, self.dtype)
+        self.pages_v = jnp.zeros(shape, self.dtype)
 
     def padded_table(self, block_table: Sequence[int], width: int):
         """Right-pad a block table with SCRATCH to a fixed ``width``."""

@@ -40,7 +40,13 @@ class ServingMetrics:
         self.prefill_tokens = 0
         self.decode_tokens = 0
         self.preemptions = 0
+        self.preemptions_by_request: Dict[int, int] = {}
         self.finished = 0
+        self.rejected = 0
+        self.cancelled = 0
+        self.timed_out = 0
+        self.failed = 0
+        self.step_retries = 0
         self.steps = 0
         self._t_first: Optional[float] = None
         self._t_last: Optional[float] = None
@@ -87,12 +93,36 @@ class ServingMetrics:
         self.queue_depth.append(queue_depth)
         self.pool_occupancy.append(pool_occupancy)
 
-    def observe_preemption(self) -> None:
+    def observe_preemption(self, rid: Optional[int] = None) -> None:
         self.preemptions += 1
+        if rid is not None:
+            self.preemptions_by_request[rid] = \
+                self.preemptions_by_request.get(rid, 0) + 1
         self._tick("serve.preemptions", 1)
 
     def observe_finish(self) -> None:
         self.finished += 1
+
+    def observe_rejected(self) -> None:
+        self.rejected += 1
+        self._tick("serve.rejected", 1)
+
+    def observe_cancelled(self) -> None:
+        self.cancelled += 1
+        self._tick("serve.cancelled", 1)
+
+    def observe_timeout(self) -> None:
+        self.timed_out += 1
+        self._tick("serve.timed_out", 1)
+
+    def observe_failed(self) -> None:
+        self.failed += 1
+        self._tick("serve.failed", 1)
+
+    def observe_step_retry(self) -> None:
+        """A transient decode fault was retried (same key, same inputs)."""
+        self.step_retries += 1
+        self._tick("serve.step_retries", 1)
 
     # -- aggregates -----------------------------------------------------------
 
@@ -118,6 +148,13 @@ class ServingMetrics:
             "prefill_tokens": self.prefill_tokens,
             "steps": self.steps,
             "preemptions": self.preemptions,
+            "preemptions_max_per_request": max(
+                self.preemptions_by_request.values(), default=0),
+            "rejected": self.rejected,
+            "cancelled": self.cancelled,
+            "timed_out": self.timed_out,
+            "failed": self.failed,
+            "step_retries": self.step_retries,
             "tok_per_s": self.tokens_per_s,
             "ttft_ms_mean": ms(sum(self.ttft_s) / len(self.ttft_s))
             if self.ttft_s else 0.0,

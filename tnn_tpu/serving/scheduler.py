@@ -20,6 +20,7 @@ engine executes its plans and reports back via admit/finish/requeue.
 from __future__ import annotations
 
 import enum
+import time
 from collections import deque
 from dataclasses import dataclass, field
 from typing import Deque, List, Optional
@@ -30,7 +31,30 @@ import numpy as np
 class RequestState(enum.Enum):
     QUEUED = "queued"        # waiting for admission (fresh or preempted)
     RUNNING = "running"      # holds pool blocks; decodes every step
-    FINISHED = "finished"
+    FINISHED = "finished"    # completed normally (length | stop_token)
+    FAILED = "failed"        # isolated fault: alloc failure, NaN logits,
+    #                          injected/step exception, preemption budget
+    CANCELLED = "cancelled"  # client called engine.cancel(rid)
+    TIMED_OUT = "timed_out"  # deadline_s / max_queue_s expired
+
+
+#: States a request never leaves; every submitted request must reach one —
+#: the chaos suite's core invariant.
+TERMINAL_STATES = frozenset({RequestState.FINISHED, RequestState.FAILED,
+                             RequestState.CANCELLED, RequestState.TIMED_OUT})
+
+
+class AdmissionRejected(RuntimeError):
+    """Structured backpressure from ``InferenceEngine.submit``: the wait
+    queue is at ``max_queue_depth`` under the ``reject`` admission policy.
+    Clients retry later (or the server runs ``admission_policy="block"``)."""
+
+    def __init__(self, queue_depth: int, max_queue_depth: int):
+        self.queue_depth = queue_depth
+        self.max_queue_depth = max_queue_depth
+        super().__init__(
+            f"queue full: {queue_depth} waiting >= max_queue_depth "
+            f"{max_queue_depth}")
 
 
 @dataclass
@@ -44,6 +68,8 @@ class Request:
     top_p: float = 0.0
     stop_token: Optional[int] = None
     submit_time: float = 0.0
+    deadline_s: Optional[float] = None   # total wall budget from submit
+    max_queue_s: Optional[float] = None  # max continuous time spent QUEUED
 
     # -- engine-managed --
     state: RequestState = RequestState.QUEUED
@@ -54,6 +80,12 @@ class Request:
     preemptions: int = 0
     ttft_s: Optional[float] = None
     finish_reason: str = ""
+    error: str = ""                     # detail for FAILED/CANCELLED/TIMED_OUT
+    queued_time: float = 0.0            # last transition into QUEUED
+
+    @property
+    def is_terminal(self) -> bool:
+        return self.state in TERMINAL_STATES
 
     @property
     def num_generated(self) -> int:
@@ -106,6 +138,7 @@ class Scheduler:
 
     def submit(self, req: Request) -> None:
         req.state = RequestState.QUEUED
+        req.queued_time = time.perf_counter()
         self.waiting.append(req)
 
     # -- planning -------------------------------------------------------------
@@ -143,6 +176,25 @@ class Scheduler:
         req.finish_reason = reason
         self.running.remove(req)
 
+    def terminate(self, req: Request, state: RequestState,
+                  error: str = "") -> None:
+        """Move a request to a non-FINISHED terminal state (FAILED /
+        CANCELLED / TIMED_OUT) from wherever it currently lives. The engine
+        frees any pool blocks BEFORE calling this — the scheduler never
+        touches device state."""
+        if state not in TERMINAL_STATES or state is RequestState.FINISHED:
+            raise ValueError(f"terminate() is for failure states, got {state}")
+        if req in self.running:
+            self.running.remove(req)
+        else:
+            try:
+                self.waiting.remove(req)
+            except ValueError:
+                pass                     # already out of both structures
+        req.state = state
+        req.finish_reason = state.value
+        req.error = error
+
     def preempt_victim(self) -> Optional[Request]:
         """LIFO victim choice: the latest-admitted running request loses its
         blocks first (it has the least sunk prefill work)."""
@@ -153,5 +205,6 @@ class Scheduler:
         is preserved; generated tokens ride along via ``resume_tokens``."""
         self.running.remove(req)
         req.state = RequestState.QUEUED
+        req.queued_time = time.perf_counter()
         req.preemptions += 1
         self.waiting.appendleft(req)
