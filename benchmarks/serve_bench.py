@@ -13,6 +13,12 @@ page gather/scatter overheads.
 --smoke runs a tiny randomly initialized GPT-2 (2L/32d) — seconds on CPU,
 exercising the whole engine; it is what tests/test_benchmarks.py runs.
 
+Both modes also run a mixed-load chunked/whole A/B: long prompts arriving
+under decode load, once with chunked prefill (the default) and once with the
+whole-prompt path (chunked_prefill=False), reporting ttft_ms_p50/p99 and
+decode_stall_ms_p50/p99/max so the step-packing win (no monolithic prefill
+stalling the decode stream) is visible in regression.csv.
+
 --chaos runs the smoke workload under a seeded FaultPlan (pool-alloc
 failures + injected NaN logits) and asserts the fault-tolerance contract:
 every request terminal, zero leaked blocks, pool invariants clean. It is a
@@ -32,13 +38,15 @@ from benchmarks.common import RowRunner, report
 def bench_serving(model, params, *, num_requests: int, rate_per_s: float,
                   prompt_len: int, max_new: int, num_blocks: int,
                   block_size: int, max_batch_size: int, label: str,
-                  seed: int = 0, decode_path: str = "auto"):
+                  seed: int = 0, decode_path: str = "auto",
+                  chunked: bool = True, chunk_size: int = 64):
     """Drive one engine through a Poisson arrival trace and report metrics."""
     from tnn_tpu.serving import InferenceEngine, ServingMetrics
 
+    mode = f"chunk={chunk_size}" if chunked else "whole-prompt"
     print(f"{label}: {num_requests} requests, ~{rate_per_s}/s Poisson, "
           f"prompt {prompt_len}, max_new {max_new}, "
-          f"decode_path={decode_path}")
+          f"decode_path={decode_path}, {mode}")
     rng = np.random.default_rng(seed)
     arrivals = np.cumsum(rng.exponential(1.0 / rate_per_s, num_requests))
     prompts = rng.integers(0, model.vocab_size,
@@ -48,7 +56,8 @@ def bench_serving(model, params, *, num_requests: int, rate_per_s: float,
         model, params, num_blocks=num_blocks, block_size=block_size,
         max_batch_size=max_batch_size,
         max_seq_len=prompt_len + max_new, seed=seed,
-        decode_path=decode_path)
+        decode_path=decode_path, chunked_prefill=chunked,
+        chunk_size=chunk_size)
 
     # warm the compile caches outside the timed window: one prefill at the
     # benchmark's bucket and one decode step (the engine reuses both)
@@ -74,8 +83,16 @@ def bench_serving(model, params, *, num_requests: int, rate_per_s: float,
     return report(
         label, wall, items=s["decode_tokens"], item_name="tok",
         extra={"ttft_ms_mean": s["ttft_ms_mean"],
+               "ttft_ms_p50": s["ttft_ms_p50"],
                "ttft_ms_p95": s["ttft_ms_p95"],
+               "ttft_ms_p99": s["ttft_ms_p99"],
+               "ttft_under_load_ms_p99": s["ttft_under_load_ms_p99"],
+               "decode_stall_ms_p50": s["decode_stall_ms_p50"],
+               "decode_stall_ms_p99": s["decode_stall_ms_p99"],
+               "decode_stall_ms_max": s["decode_stall_ms_max"],
                "token_latency_ms_p50": s["token_latency_ms_p50"],
+               "prefill_chunks": s["prefill_chunks"],
+               "mixed_step_fill_mean": s["mixed_step_fill_mean"],
                "preemptions": s["preemptions"],
                "batch_fill_mean": s["batch_fill_mean"],
                "requests": s["requests_finished"]})
@@ -163,6 +180,17 @@ def main(argv=None):
                 max_new=8, num_blocks=16, block_size=4, max_batch_size=4,
                 label=f"serve_smoke_{p}", decode_path=p),
                 label=f"bench_serving_{path}")
+        # mixed-load chunked/whole A/B: 24-token prompts arrive while other
+        # rows decode, so whole-prompt prefills stall the decode stream and
+        # chunked prefill (chunk 8) interleaves it — compare ttft_ms_p99 and
+        # decode_stall_ms_* between the two rows
+        for tag, ckw in (("chunked", dict(chunked=True, chunk_size=8)),
+                         ("whole", dict(chunked=False))):
+            rr.add(lambda t=tag, c=dict(ckw): bench_serving(
+                model, params, num_requests=6, rate_per_s=50.0,
+                prompt_len=24, max_new=8, num_blocks=64, block_size=4,
+                max_batch_size=4, label=f"serve_smoke_mixed_{t}", **c),
+                label=f"bench_serving_mixed_{tag}")
         return rr.results
 
     from tnn_tpu import models
@@ -176,6 +204,15 @@ def main(argv=None):
             prompt_len=32, max_new=max_new, num_blocks=128, block_size=16,
             max_batch_size=8, label=f"serve_{args.model}_{p}",
             decode_path=p), label=f"bench_serving_{path}")
+    # mixed-load chunked/whole A/B at the full prompt length (chunk 16 splits
+    # each 32-token prompt into two mixed steps under decode load)
+    for tag, ckw in (("chunked", dict(chunked=True, chunk_size=16)),
+                     ("whole", dict(chunked=False))):
+        rr.add(lambda t=tag, c=dict(ckw): bench_serving(
+            model, params, num_requests=n, rate_per_s=args.rate,
+            prompt_len=32, max_new=max_new, num_blocks=128, block_size=16,
+            max_batch_size=8, label=f"serve_{args.model}_mixed_{t}", **c),
+            label=f"bench_serving_mixed_{tag}")
     return rr.results
 
 

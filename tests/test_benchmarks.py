@@ -113,20 +113,30 @@ class TestBenchGateRetry:
 
 def test_serve_bench_smoke():
     """Fast (tiny random model) serving benchmark: must complete on CPU and
-    report TTFT + tokens/sec for BOTH decode paths (standard/paged A/B).
-    Deliberately NOT slow-marked — it is the tier-1 guard that the serving
-    suite stays runnable."""
+    report TTFT + tokens/sec for BOTH decode paths (standard/paged A/B) plus
+    the mixed-load chunked/whole A/B. Deliberately NOT slow-marked — it is
+    the tier-1 guard that the serving suite stays runnable."""
     from benchmarks import serve_bench
 
     results = [r for r in serve_bench.main(["--smoke"]) if r]
-    assert len(results) == 2
+    assert len(results) == 4
     assert [r["bench"] for r in results] == ["serve_smoke_standard",
-                                             "serve_smoke_paged"]
+                                             "serve_smoke_paged",
+                                             "serve_smoke_mixed_chunked",
+                                             "serve_smoke_mixed_whole"]
     for r in results:
         assert r["ms"] > 0
         assert r["tok_per_s"] > 0
         assert r["ttft_ms_mean"] > 0
+        assert r["ttft_ms_p99"] >= r["ttft_ms_p50"] > 0
         assert r["requests"] == 6
+    # the A/B is live: chunked really split prompts, whole never did (wall-
+    # clock comparisons between the rows stay informational — CI CPU noise)
+    chunked = next(r for r in results
+                   if r["bench"] == "serve_smoke_mixed_chunked")
+    whole = next(r for r in results if r["bench"] == "serve_smoke_mixed_whole")
+    assert chunked["prefill_chunks"] >= 3 * 6      # 24-token prompts, chunk 8
+    assert whole["prefill_chunks"] == 0
 
 
 def test_serve_bench_chaos():

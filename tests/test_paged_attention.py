@@ -181,3 +181,147 @@ def test_arg_validation():
     with pytest.raises(ValueError, match="layer is required"):
         pa.scatter_kv_rows(pk, tables, lens - 1,
                            jnp.zeros((3, 2, 16)))
+    with pytest.raises(ValueError, match="q_lens"):
+        pa.paged_attention(q, pk, pv, tables, lens, q_lens=lens)
+    with pytest.raises(ValueError, match="layer is required"):
+        pa.scatter_kv_chunk(pk, tables, lens - 1, jnp.zeros((3, 4, 2, 16)),
+                            jnp.ones((3,), jnp.int32))
+
+
+# -- ragged multi-token query chunks (chunked prefill) ------------------------
+
+
+def _random_chunk_case(seed, *, num_layers=2, num_blocks=16, block_size=8,
+                       num_heads=4, num_kv_heads=2, head_dim=16, batch=4,
+                       blocks_per_row=3, qw=4, dtype=jnp.float32):
+    """Random pool history + a ragged chunk per row: row i has ``starts[i]``
+    previously written positions and ``q_lens[i]`` new tokens this step
+    (0 = absent padding row, 1 = decode-like, up to the full chunk width)."""
+    rng = np.random.default_rng(seed)
+    shape = (num_layers, num_blocks, num_kv_heads, block_size, head_dim)
+    pages_k = jnp.asarray(rng.normal(size=shape), dtype)
+    pages_v = jnp.asarray(rng.normal(size=shape), dtype)
+    need = batch * blocks_per_row
+    assert need <= num_blocks - 1, "test geometry: not enough live blocks"
+    perm = rng.permutation(np.arange(1, num_blocks))[:need]
+    tables = perm.reshape(batch, blocks_per_row).astype(np.int32)
+    cap = blocks_per_row * block_size
+    q_lens = rng.integers(0, qw + 1, size=batch)
+    q_lens[0] = 0            # absent row: must output exactly 0
+    q_lens[1] = 1            # decode-like row inside the chunked launch
+    q_lens[-1] = qw          # full chunk
+    starts = np.array([int(rng.integers(0, cap - ql + 1))
+                       for ql in q_lens], np.int32)
+    kv_lens = starts + q_lens
+    for i in range(batch):
+        nb_live = max(1, math.ceil(max(int(kv_lens[i]), 1) / block_size))
+        tables[i, nb_live:] = 0
+    q = jnp.asarray(rng.normal(size=(batch, qw, num_heads, head_dim)), dtype)
+    rows_k = jnp.asarray(rng.normal(size=(batch, qw, num_kv_heads, head_dim)),
+                         dtype)
+    rows_v = jnp.asarray(rng.normal(size=(batch, qw, num_kv_heads, head_dim)),
+                         dtype)
+    return (q, pages_k, pages_v, jnp.asarray(tables),
+            jnp.asarray(starts, jnp.int32), jnp.asarray(q_lens, jnp.int32),
+            rows_k, rows_v)
+
+
+def _dense_oracle_mq(q, pages_k, pages_v, tables, kv_lens, q_lens, layer):
+    """Numpy oracle for the ragged-chunk form: chunk token t sits at absolute
+    position kv_lens - q_lens + t and attends causally over everything up to
+    and including itself; dead tokens (t >= q_lens) output exactly 0."""
+    q = np.asarray(q, np.float32)
+    k = np.asarray(pages_k[layer], np.float32)[np.asarray(tables)]
+    v = np.asarray(pages_v[layer], np.float32)[np.asarray(tables)]
+    b, nb, hkv, bs, dh = k.shape
+    qw, h = q.shape[1], q.shape[2]
+    g = h // hkv
+    k = k.transpose(0, 2, 1, 3, 4).reshape(b, hkv, nb * bs, dh)
+    v = v.transpose(0, 2, 1, 3, 4).reshape(b, hkv, nb * bs, dh)
+    out = np.zeros_like(q)
+    for i in range(b):
+        n, ql = int(kv_lens[i]), int(q_lens[i])
+        for t in range(ql):
+            m = n - ql + t + 1   # keys visible to chunk token t (causal)
+            if m <= 0:
+                continue
+            for qh in range(h):
+                kh = qh // g
+                s = k[i, kh, :m] @ q[i, t, qh] / math.sqrt(dh)
+                p = np.exp(s - s.max())
+                p /= p.sum()
+                out[i, t, qh] = p @ v[i, kh, :m]
+    return out
+
+
+@pytest.mark.parametrize("block_size", [4, 8])
+@pytest.mark.parametrize("heads", [(4, 4), (4, 2), (4, 1)],
+                         ids=["mha", "gqa2", "mqa"])
+@pytest.mark.parametrize("qw", [4, 8])
+def test_multitoken_kernel_matches_oracle(block_size, heads, qw):
+    """Ragged q chunks x GQA ratios x block sizes: the kernel, the XLA
+    reference, and the dense oracle agree; scatter_kv_chunk writes the
+    chunk's KV where attention then reads it."""
+    h, hkv = heads
+    q, pk, pv, tables, starts, q_lens, rows_k, rows_v = _random_chunk_case(
+        block_size * 100 + h * 10 + qw, block_size=block_size, num_heads=h,
+        num_kv_heads=hkv, qw=qw)
+    kv_lens = starts + q_lens
+    pk = pa.scatter_kv_chunk(pk, tables, starts, rows_k, q_lens, layer=1)
+    pv = pa.scatter_kv_chunk(pv, tables, starts, rows_v, q_lens, layer=1)
+    ref = pa.paged_attention_reference(q, pk, pv, tables, kv_lens,
+                                       q_lens=q_lens, layer=1)
+    out = pa.paged_attention(q, pk, pv, tables, kv_lens, q_lens=q_lens,
+                             layer=1, backend="pallas")
+    oracle = _dense_oracle_mq(q, pk, pv, tables, kv_lens, q_lens, 1)
+    np.testing.assert_allclose(np.asarray(ref), oracle, atol=2e-5, rtol=2e-5)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5,
+                               rtol=2e-5)
+    # dead rows (q_lens 0 / t >= q_lens) are exactly 0, not just close
+    assert np.all(np.asarray(out[0]) == 0)
+    ql = np.asarray(q_lens)
+    for i in range(q.shape[0]):
+        assert np.all(np.asarray(out[i, ql[i]:]) == 0), i
+
+
+def test_multitoken_q1_matches_decode_form():
+    """A chunked launch with every row at q_len 1 must reproduce the legacy
+    decode form bit-for-bit (same kernel geometry, same mask)."""
+    q3, pk, pv, tables, lens = _random_case(31)
+    dec = pa.paged_attention(q3, pk, pv, tables, lens, backend="pallas")
+    mq = pa.paged_attention(q3[:, None], pk, pv, tables, lens,
+                            q_lens=jnp.ones_like(lens), backend="pallas")
+    assert mq.shape == (q3.shape[0], 1) + q3.shape[1:]
+    np.testing.assert_array_equal(np.asarray(dec), np.asarray(mq[:, 0]))
+    ref_dec = pa.paged_attention_reference(q3, pk, pv, tables, lens)
+    ref_mq = pa.paged_attention_reference(q3[:, None], pk, pv, tables, lens,
+                                          q_lens=jnp.ones_like(lens))
+    np.testing.assert_array_equal(np.asarray(ref_dec),
+                                  np.asarray(ref_mq[:, 0]))
+
+
+def test_scatter_kv_chunk_roundtrip_and_scratch_only():
+    """Live chunk tokens land at table[pos // bs] slot pos % bs; dead tokens
+    write ONLY the reserved scratch block 0; other layers untouched."""
+    q, pk, pv, tables, starts, q_lens, rows_k, _ = _random_chunk_case(37)
+    bs = pk.shape[3]
+    pk2 = pa.scatter_kv_chunk(pk, tables, starts, rows_k, q_lens, layer=1)
+    b, qw = rows_k.shape[:2]
+    live_slots = set()
+    for i in range(b):
+        for t in range(int(q_lens[i])):
+            pos = int(starts[i]) + t
+            blk = int(tables[i, pos // bs])
+            slot = pos % bs
+            live_slots.add((blk, slot))
+            np.testing.assert_array_equal(
+                np.asarray(pk2[1, blk, :, slot, :]),
+                np.asarray(rows_k[i, t]))
+    # any other change is confined to the scratch block
+    changed = np.any(np.asarray(pk2[1] != pk[1]), axis=(1, 3))  # (N, bs)
+    for blk, slot in zip(*np.nonzero(changed)):
+        assert blk == 0 or (int(blk), int(slot)) in live_slots, (blk, slot)
+    np.testing.assert_array_equal(np.asarray(pk2[0]), np.asarray(pk[0]))
+    # 4-D single-layer form
+    pk1 = pa.scatter_kv_chunk(pk[1], tables, starts, rows_k, q_lens)
+    np.testing.assert_array_equal(np.asarray(pk1), np.asarray(pk2[1]))

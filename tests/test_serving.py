@@ -345,10 +345,13 @@ class TestEngineTiny:
 
     def test_prefill_bucketing_bounds_compiles(self, tiny_lm):
         """Prompt lengths quantize to power-of-two block buckets: many
-        distinct lengths share O(log) compiled prefill programs."""
+        distinct lengths share O(log) compiled prefill programs (legacy
+        whole-prompt path; the chunked default compiles NO prefill programs
+        — see TestChunkedPrefill.test_mixed_bucketing_bounds_compiles)."""
         model, params = tiny_lm
         eng = InferenceEngine(model, params, num_blocks=32, block_size=4,
-                              max_batch_size=4, max_seq_len=32)
+                              max_batch_size=4, max_seq_len=32,
+                              chunked_prefill=False)
         for n in (1, 2, 3, 4, 5, 7, 9, 11, 13, 15):
             eng.submit(np.arange(n, dtype=np.int32) % 128, 2)
         eng.run_until_complete()
@@ -366,6 +369,106 @@ class TestEngineTiny:
             eng.submit(np.asarray([], np.int32), 4)        # empty prompt
         with pytest.raises(ValueError):
             eng.submit(np.arange(4, dtype=np.int32), 0)    # no tokens asked
+
+
+# -- chunked prefill: the mixed prefill+decode step --------------------------
+
+
+class TestChunkedPrefill:
+    """The PR 4 tentpole: prompts advance chunk_size tokens per step inside
+    the SAME compiled program as the decode rows. Every schedule must stay
+    token-exact against the retired whole-prompt path, on both decode paths,
+    with and without preemption."""
+
+    def _run(self, tiny_lm, prompts, *, stagger=True, **kw):
+        model, params = tiny_lm
+        merged = dict(num_blocks=32, block_size=4, max_batch_size=4,
+                      max_seq_len=32)
+        merged.update(kw)
+        eng = InferenceEngine(model, params, **merged)
+        rids = [eng.submit(prompts[0], 10)]
+        if stagger:
+            eng.step(); eng.step()          # r0 mid-stream before the rest
+        rids += [eng.submit(p, 10) for p in prompts[1:]]
+        out = eng.run_until_complete()
+        return eng, [out[r] for r in rids]
+
+    def test_chunked_matches_whole_staggered(self, tiny_lm):
+        """chunk_size=4 splits the 9/16-token prompts across several mixed
+        steps; outputs must equal the whole-prompt path AND the offline
+        reference, on the standard and paged decode paths alike."""
+        model, params = tiny_lm
+        rng = np.random.default_rng(7)
+        prompts = [rng.integers(0, 128, p).astype(np.int32)
+                   for p in (5, 9, 16, 7)]
+        eng_c, chunked = self._run(tiny_lm, prompts, chunk_size=4)
+        _, whole = self._run(tiny_lm, prompts, chunked_prefill=False)
+        eng_p, chunked_paged = self._run(tiny_lm, prompts, chunk_size=4,
+                                         decode_path="paged")
+        _, whole_paged = self._run(tiny_lm, prompts, chunked_prefill=False,
+                                   decode_path="paged")
+        assert chunked == whole == chunked_paged == whole_paged
+        for toks, p in zip(chunked, prompts):
+            assert toks == _greedy_ref(model, params, p, 10,
+                                       eng_c.assembly_len)
+        # the 16-token prompt really took several chunks, and no legacy
+        # prefill program was ever compiled
+        assert eng_c.metrics.prefill_chunks >= 4 + 3 + 2 + 2
+        assert not any(k[0] == "prefill" for k in eng_c._jit)
+        assert eng_p._paged and not any(k[0] == "prefill" for k in eng_p._jit)
+        _assert_drained(eng_c)
+
+    def test_chunked_preemption_recovers_exactly(self, tiny_lm):
+        """A starved pool preempts mid-stream; partially-prefilled work is
+        re-chunked on resume and every stream stays byte-identical."""
+        model, params = tiny_lm
+        rng = np.random.default_rng(1)
+        prompts = [rng.integers(0, 128, p).astype(np.int32)
+                   for p in (5, 9, 16, 7)]
+        for path in ("standard", "paged"):
+            eng, outs = self._run(tiny_lm, prompts, stagger=False,
+                                  num_blocks=9, chunk_size=4,
+                                  decode_path=path)
+            assert eng.metrics.preemptions > 0, "pool was never exhausted"
+            for toks, p in zip(outs, prompts):
+                assert toks == _greedy_ref(model, params, p, 10,
+                                           eng.assembly_len)
+            _assert_drained(eng)
+
+    def test_mixed_bucketing_bounds_compiles(self, tiny_lm):
+        """Chunk takes quantize to power-of-two query widths: many distinct
+        prompt lengths share O(log chunk_size) compiled mixed programs, and
+        the legacy prefill program is never built."""
+        model, params = tiny_lm
+        eng = InferenceEngine(model, params, num_blocks=32, block_size=4,
+                              max_batch_size=4, max_seq_len=32, chunk_size=8)
+        for n in (1, 2, 3, 4, 5, 7, 9, 11, 13, 15):
+            eng.submit(np.arange(n, dtype=np.int32) % 128, 2)
+        eng.run_until_complete()
+        assert not any(k[0] == "prefill" for k in eng._jit)
+        widths = {k[2] for k in eng._jit if k[0] == "mixed"}
+        assert widths, "mixed step never ran"
+        assert widths <= {1, 2, 4, 8}      # pow2 buckets, capped by chunk_size
+        _assert_drained(eng)
+
+    def test_mixed_sampling_in_chunked_steps(self, tiny_lm):
+        """Greedy and stochastic rows share mixed steps with in-flight prompt
+        chunks; the greedy stream stays exact and stochastic rows stay
+        in-vocab. (Cross-schedule stochastic equality vs the whole-prompt
+        path is NOT asserted: the two paths draw step keys at different
+        points of the stream, so the draws legitimately differ.)"""
+        model, params = tiny_lm
+        eng = InferenceEngine(model, params, num_blocks=32, block_size=4,
+                              max_batch_size=4, max_seq_len=32, seed=3,
+                              chunk_size=4)
+        p = np.arange(9, dtype=np.int32)
+        g = eng.submit(p, 8)
+        s = eng.submit(p, 8, temperature=0.9, top_k=16, top_p=0.9)
+        out = eng.run_until_complete()
+        assert out[g] == _greedy_ref(model, params, p, 8, eng.assembly_len)
+        assert len(out[s]) == 8
+        assert all(0 <= t < model.vocab_size for t in out[s])
+        _assert_drained(eng)
 
 
 # -- acceptance: gpt2_small, 8 staggered requests ----------------------------
@@ -466,6 +569,44 @@ def test_gpt2_small_paged_matches_standard():
     assert eng_s.metrics.preemptions > 0
     assert paged == std
     assert eng_p.pool.num_allocated == 0
+
+
+def test_gpt2_small_chunked_paged_matches_standard():
+    """Chunked-prefill acceptance on gpt2_small: chunk_size=8 splits every
+    12-token prompt across two mixed steps, the pool preempts under load,
+    and the paged path must stay TOKEN-IDENTICAL to the standard path.
+
+    As above, exact equality is well-posed because both engines run the same
+    schedule over the same weights — identical near-tie resolution — so any
+    divergence is a real mixed-step bug (ragged query gather, chunk scatter,
+    per-row kv length), not fp noise."""
+    from tnn_tpu.models.zoo import create
+
+    model = create("gpt2_small")
+    params = model.init(jax.random.PRNGKey(0), (1, 8))["params"]
+    rng = np.random.default_rng(0)
+    prompts = rng.integers(0, model.vocab_size, (8, 12)).astype(np.int32)
+    max_new = 16
+
+    def run(path):
+        eng = InferenceEngine(model, params, num_blocks=14, block_size=16,
+                              max_batch_size=8, max_seq_len=32,
+                              decode_path=path, chunk_size=8)
+        rids = []
+        for i, p in enumerate(prompts):
+            rids.append(eng.submit(p, max_new))
+            if i % 3 == 2:
+                eng.step()
+        out = eng.run_until_complete()
+        return eng, [out[r] for r in rids]
+
+    eng_p, paged = run("paged")
+    eng_s, std = run("standard")
+    assert eng_p.metrics.preemptions > 0, "pool was never exhausted"
+    assert eng_p.metrics.prefill_chunks > len(prompts), "prompts never split"
+    assert paged == std
+    assert eng_p.pool.num_allocated == 0
+    assert eng_p.pool.num_free == eng_p.pool.capacity
 
 
 # -- fault tolerance: invariants, lifecycle, backpressure, chaos --------------
@@ -784,6 +925,26 @@ class TestChaos:
         assert "mid-decode" in eng.result(rids[0]).error
         assert _finished(eng)[rids[1]] == _finished(ref_eng)[ref_rids[1]]
         assert plan.fired["pool.alloc"] == 1
+        _assert_drained(eng)
+
+    def test_alloc_failure_at_chunk_boundary_is_isolated(self, tiny_lm):
+        """A chunked prompt's block alloc fails at a chunk boundary (between
+        chunk 1 and chunk 2): only that request FAILs, its partial blocks are
+        freed, and the co-scheduled request finishes token-exact."""
+        model, params = tiny_lm
+        prompts = [np.arange(12, dtype=np.int32),    # 3 chunks at chunk_size 4
+                   np.arange(4, dtype=np.int32)]     # 1 chunk
+        ref_eng, ref_rids = self._run(model, params, prompts, chunk_size=4)
+        # alloc calls: step1 chunk r0 (1), chunk r1 (2); step2 chunk r0 (3)
+        plan = FaultPlan(alloc_fail_calls=(3,))
+        eng, rids = self._run(model, params, prompts, plan=plan,
+                              chunk_size=4)
+        victim = eng.result(rids[0])
+        assert victim.state is RequestState.FAILED
+        assert "at chunk boundary" in victim.error
+        assert not victim.out_tokens, "failed mid-prefill, before any token"
+        assert plan.fired["pool.alloc"] == 1
+        assert _finished(eng)[rids[1]] == _finished(ref_eng)[ref_rids[1]]
         _assert_drained(eng)
 
     def test_nan_logits_in_decode_fail_one_row(self, tiny_lm):

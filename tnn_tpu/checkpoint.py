@@ -19,6 +19,7 @@ Binary layout of a ``.tnn`` tensor file:
 """
 from __future__ import annotations
 
+import atexit
 import json
 import os
 import shutil
@@ -200,6 +201,19 @@ class Checkpoint:
         self.directory = directory
         self.keep = int(keep)
         self._pending = None  # in-flight async writer thread
+        # a block=False save still in flight at interpreter exit would be
+        # killed mid-write (daemon thread) — join it so the newest checkpoint
+        # is complete on clean shutdown
+        atexit.register(self._join_at_exit)
+
+    def _join_at_exit(self) -> None:
+        try:
+            self.wait()
+        except Exception as e:  # noqa: BLE001 — exit path: report, don't raise
+            import sys
+
+            print(f"checkpoint: async save failed at exit: {e}",
+                  file=sys.stderr)
 
     # -- write ---------------------------------------------------------------
 
@@ -290,11 +304,18 @@ class Checkpoint:
             return []
         out = []
         for d in os.listdir(self.directory):
-            if d.startswith("step_"):
-                try:
-                    out.append(int(d[5:]))
-                except ValueError:
-                    pass
+            if not d.startswith("step_"):
+                continue
+            # write() creates state.tnn before meta.json, so meta.json marks
+            # a COMPLETE snapshot — a crash between the two must not leave a
+            # torn step dir restorable (or GC-countable) as the latest
+            if not os.path.isfile(os.path.join(self.directory, d,
+                                               "meta.json")):
+                continue
+            try:
+                out.append(int(d[5:]))
+            except ValueError:
+                pass
         return out
 
     # -- read ----------------------------------------------------------------

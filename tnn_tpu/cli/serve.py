@@ -82,6 +82,13 @@ def main(argv=None):
                     help="tokens per KV block")
     ap.add_argument("--max-batch-size", type=int, default=8,
                     help="decode batch width (one compile at this width)")
+    ap.add_argument("--chunk-size", type=int, default=64,
+                    help="prompt tokens per mixed-step prefill chunk "
+                         "(chunked prefill co-schedules prompt chunks with "
+                         "decode rows in one compiled step)")
+    ap.add_argument("--no-chunked-prefill", action="store_true",
+                    help="restore the legacy whole-prompt prefill path "
+                         "(one bucketed prefill program per admitted prompt)")
     ap.add_argument("--max-seq-len", type=int, default=0,
                     help="per-request position cap (0 = model/pool limit)")
     ap.add_argument("--decode-path", default="auto",
@@ -116,7 +123,8 @@ def main(argv=None):
 
     engine = InferenceEngine(
         model, params, num_blocks=args.num_blocks, block_size=args.block_size,
-        max_batch_size=args.max_batch_size,
+        max_batch_size=args.max_batch_size, chunk_size=args.chunk_size,
+        chunked_prefill=not args.no_chunked_prefill,
         max_seq_len=args.max_seq_len or None, decode_path=args.decode_path,
         max_queue_depth=args.max_queue_depth,
         preemption_budget=(None if args.preemption_budget < 0

@@ -59,11 +59,12 @@ def main(argv=None):
     ap.add_argument("--fused-head-loss", type=int, default=0, metavar="CHUNK",
                     help="vocab chunk for the streaming LM-head loss "
                          "(nn.lm_loss) — 0 uses the materialized-logits path")
-    ap.add_argument("--steps-per-call", type=int, default=16,
+    ap.add_argument("--steps-per-call", type=int, default=1,
                     help="optimizer steps per compiled dispatch (lax.scan); "
                          ">1 amortizes the host->device round trip that "
-                         "dominates small models over the relay (1 = the "
-                         "old one-dispatch-per-step loop)")
+                         "dominates small models over the relay (a non-"
+                         "divisor remainder runs as one final smaller "
+                         "dispatch, so --steps is always exact)")
     ap.add_argument("--results", default="benchmarks/results")
     args = ap.parse_args(argv)
 
@@ -76,14 +77,16 @@ def main(argv=None):
         if os.path.exists(val_path) else None
     print(f"corpus: {meta['train_tokens']} train tokens, vocab {vocab}")
 
-    # dispatch granularity first: total_steps feeds the scheduler horizon
+    # dispatch granularity first: total_steps feeds the scheduler horizon.
+    # A non-divisor remainder folds into one final smaller dispatch, so
+    # --steps 200 runs exactly 200 optimizer steps at any --steps-per-call.
     spc = max(1, min(args.steps_per_call, args.steps))
-    n_calls = args.steps // spc
-    total_steps = n_calls * spc
-    if total_steps != args.steps:
-        print(f"note: --steps {args.steps} rounded down to {total_steps} "
-              f"({n_calls} dispatches x {spc} steps); pass --steps-per-call 1 "
-              "or a divisor of --steps for the exact count")
+    n_full, rem = divmod(args.steps, spc)
+    total_steps = args.steps
+    call_sizes = [spc] * n_full + ([rem] if rem else [])
+    if rem:
+        print(f"note: {n_full} dispatches x {spc} steps + one {rem}-step "
+              "remainder dispatch (exact --steps)")
 
     model_kw = dict(vocab_size=vocab, max_len=args.seq,
                     num_layers=args.layers, d_model=args.d_model,
@@ -100,24 +103,31 @@ def main(argv=None):
                                      t_max=total_steps)
     state = create_train_state(model, opt, jax.random.PRNGKey(0),
                                (args.batch, args.seq))
-    step = make_train_step(model, opt, scheduler=sched,
-                           compute_accuracy=not args.fused_head_loss,
-                           lm_head_chunk=args.fused_head_loss or None,
-                           steps_per_call=spc)
+    def make_step(n):
+        return make_train_step(model, opt, scheduler=sched,
+                               compute_accuracy=not args.fused_head_loss,
+                               lm_head_chunk=args.fused_head_loss or None,
+                               steps_per_call=n)
+
+    step = make_step(spc)
+    step_rem = make_step(rem) if rem else None
 
     rng = np.random.default_rng(0)
     curve = []
+    done = 0
     t0 = time.time()
-    for c in range(n_calls):
-        data, labels = train_loader.random_windows(args.batch * spc, rng)
-        if spc > 1:
-            data = data.reshape(spc, args.batch, args.seq)
-            labels = labels.reshape(spc, args.batch, args.seq)
-        state, m = step(state, jnp.asarray(data, jnp.int32),
-                        jnp.asarray(labels, jnp.int32))
-        i = (c + 1) * spc - 1
-        if c % max(1, 20 // spc) == 0 or c == n_calls - 1:
-            loss = float(m["loss_trace"][-1]) if spc > 1 else float(m["loss"])
+    for c, n in enumerate(call_sizes):
+        data, labels = train_loader.random_windows(args.batch * n, rng)
+        if n > 1:
+            data = data.reshape(n, args.batch, args.seq)
+            labels = labels.reshape(n, args.batch, args.seq)
+        fn = step if n == spc else step_rem
+        state, m = fn(state, jnp.asarray(data, jnp.int32),
+                      jnp.asarray(labels, jnp.int32))
+        done += n
+        i = done - 1
+        if c % max(1, 20 // spc) == 0 or done == total_steps:
+            loss = float(m["loss_trace"][-1]) if n > 1 else float(m["loss"])
             curve.append({"step": i, "loss": round(loss, 4),
                           "ppl": round(float(np.exp(loss)), 3)})
             print(f"step {i}: loss {loss:.4f} ppl {np.exp(loss):.2f}")

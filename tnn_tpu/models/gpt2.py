@@ -168,6 +168,25 @@ class GPT2(Module):
         x, _ = self.ln_f.apply({"params": params["ln_f"], "state": {}}, x)
         return self._head(params, x)[:, -1], pages_k, pages_v
 
+    def apply_paged(self, params, toks, pages_k, pages_v, block_tables,
+                    offsets, q_lens):
+        """Ragged multi-token step against the paged KV pool (serving).
+
+        The mixed prefill+decode form of ``apply_decode_paged``: toks is
+        (B, Q) with row b carrying ``q_lens[b]`` live new tokens starting at
+        position ``offsets[b]`` (the rest padding — their KV lands in the
+        pool's scratch page, their logits are garbage). Returns (full logits
+        (B, Q, V), pages_k, pages_v); the caller reads row b's next-token
+        logits at q position ``q_lens[b] - 1``.
+        """
+        x, _ = self._trunk(params, toks, False, None, offset=offsets)
+        for i, block in enumerate(self.blocks):
+            x, pages_k, pages_v = block.apply_paged(
+                params[f"h{i}"], x, pages_k, pages_v, block_tables, offsets,
+                layer=i, q_lens=q_lens)
+        x, _ = self.ln_f.apply({"params": params["ln_f"], "state": {}}, x)
+        return self._head(params, x), pages_k, pages_v
+
     def _config(self):
         cfg = {"vocab_size": self.vocab_size, "max_len": self.max_len,
                "num_layers": self.num_layers, "d_model": self.d_model,

@@ -420,44 +420,71 @@ class MultiHeadAttention(Module):
         return y, cache
 
     def apply_paged(self, variables, x, pages_k, pages_v, block_tables,
-                    offsets, layer=0):
-        """One decode step straight against the paged KV pool.
+                    offsets, layer=0, q_lens=None):
+        """One step straight against the paged KV pool.
 
         The serving hot path (docs/serving.md): instead of assembling a
         contiguous cache (``apply_cached`` over ``kv_pool.gather_kv``), the
-        new token's K/V row is scattered into its page and attention streams
-        the pages the block table names (``ops.pallas.paged_attention``).
+        new tokens' K/V rows are scattered into their pages and attention
+        streams the pages the block table names
+        (``ops.pallas.paged_attention``).
 
-        x : (B, 1, D) — this step's single token per row.
+        x : (B, Q, D) — this step's new tokens per row (Q = 1 for pure
+            decode; Q > 1 for ragged prefill chunks).
         pages_k / pages_v : the pool's (L, N, H_kv, bs, Dh) arrays; ``layer``
             selects this block's slice without copying it.
         block_tables : (B, nb) page ids; offsets : (B,) the position each row
-            writes (its kv length BEFORE this token).
+            writes first (its kv length BEFORE this step's tokens).
+        q_lens : (B,) live tokens per row this step, or None for the decode
+            form (Q must then be 1). Tokens past ``q_lens[b]`` are padding:
+            their KV lands in the pool's scratch page and their outputs are
+            garbage the caller must ignore.
 
-        Returns (out (B, 1, D), pages_k, pages_v) — pages updated only at the
-        B written rows, so with the pool buffers donated through jit the
-        update is in place.
+        Returns (out (B, Q, D), pages_k, pages_v) — pages updated only at the
+        written rows, so with the pool buffers donated through jit the update
+        is in place.
         """
         if self.kv_cache_dtype == "int8":
             raise NotImplementedError(
                 "paged decode with int8 KV pages is future work — pool pages "
                 "are compute-dtype (see docs/serving.md limits)")
         params = variables["params"]
-        q, k_new, v_new = self._project_qkv(params, x)   # (B, H*, 1, Dh)
+        q, k_new, v_new = self._project_qkv(params, x)   # (B, H*, Q, Dh)
         if self.rope_theta:
             q = apply_rope(q, offsets, self.rope_theta)
             k_new = apply_rope(k_new, offsets, self.rope_theta)
         from ..ops.pallas import paged_attention as pa
 
-        pages_k = pa.scatter_kv_rows(pages_k, block_tables, offsets,
-                                     k_new[:, :, 0].astype(pages_k.dtype),
+        if q_lens is None and x.shape[1] == 1:
+            # decode form, kept verbatim: the pure-decode compiled step must
+            # stay bit-identical to the pre-chunking program
+            pages_k = pa.scatter_kv_rows(pages_k, block_tables, offsets,
+                                         k_new[:, :, 0].astype(pages_k.dtype),
+                                         layer=layer)
+            pages_v = pa.scatter_kv_rows(pages_v, block_tables, offsets,
+                                         v_new[:, :, 0].astype(pages_v.dtype),
+                                         layer=layer)
+            out = pa.paged_attention(q[:, :, 0], pages_k, pages_v,
+                                     block_tables, kv_lens=offsets + 1,
                                      layer=layer)
-        pages_v = pa.scatter_kv_rows(pages_v, block_tables, offsets,
-                                     v_new[:, :, 0].astype(pages_v.dtype),
-                                     layer=layer)
-        out = pa.paged_attention(q[:, :, 0], pages_k, pages_v, block_tables,
-                                 kv_lens=offsets + 1, layer=layer)
-        y = self._project_out(params, out[:, :, None, :], False, None)
+            y = self._project_out(params, out[:, :, None, :], False, None)
+            return y, pages_k, pages_v
+        if q_lens is None:
+            raise ValueError("apply_paged with Q > 1 requires q_lens")
+        # ragged chunk form: scatter the whole chunk's KV first, then attend
+        # each row's live tokens against its own chunk + all prior positions
+        pages_k = pa.scatter_kv_chunk(
+            pages_k, block_tables, offsets,
+            k_new.transpose(0, 2, 1, 3).astype(pages_k.dtype),
+            q_lens, layer=layer)
+        pages_v = pa.scatter_kv_chunk(
+            pages_v, block_tables, offsets,
+            v_new.transpose(0, 2, 1, 3).astype(pages_v.dtype),
+            q_lens, layer=layer)
+        out = pa.paged_attention(q.transpose(0, 2, 1, 3), pages_k, pages_v,
+                                 block_tables, kv_lens=offsets + q_lens,
+                                 q_lens=q_lens, layer=layer)
+        y = self._project_out(params, out.transpose(0, 2, 1, 3), False, None)
         return y, pages_k, pages_v
 
     def output_shape(self, input_shape):

@@ -128,13 +128,13 @@ class GPTBlock(Module):
         return x + h, new_cache
 
     def apply_paged(self, params, x, pages_k, pages_v, block_tables, offsets,
-                    layer):
+                    layer, q_lens=None):
         """apply_cached against the paged KV pool instead of an assembled
         cache — see MultiHeadAttention.apply_paged for the contract."""
         h, _ = self.ln1.apply({"params": params["ln1"], "state": {}}, x)
         h, pages_k, pages_v = self.attn.apply_paged(
             {"params": params["attn"]}, h, pages_k, pages_v, block_tables,
-            offsets, layer=layer)
+            offsets, layer=layer, q_lens=q_lens)
         x = x + h
         h, _ = self.ln2.apply({"params": params["ln2"], "state": {}}, x)
         h, _ = self._mlp(params, h, False, None)

@@ -490,17 +490,29 @@ def _bwd_fused_kernel(off_ref, q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
         dq_ref[0] = dq_scr[:].astype(dq_ref.dtype)
 
 
-# Full-seq f32 dQ scratch cap for the fused backward; above it (sq*d*4 bytes)
-# the split two-kernel path runs instead. 4 MB = S=16384 at D=64 inside the
-# ~16 MB/core VMEM envelope alongside blocks and intermediates.
-_FUSED_BWD_MAX_DQ_BYTES = int(
-    os.environ.get("TNN_FLASH_FUSED_BWD_MAX_BYTES", 4 * 2**20))
+# VMEM budget for the fused backward's resident set; above it the split
+# two-kernel path runs instead. 12 MB keeps S=16384 at D=64 (f32) on the
+# fused path (~10.5 MB estimated) inside the ~16 MB/core VMEM envelope.
+_FUSED_BWD_MAX_BYTES = int(
+    os.environ.get("TNN_FLASH_FUSED_BWD_MAX_BYTES", 12 * 2**20))
 
 
-def _fused_bwd_applicable(sq_p: int, d: int) -> bool:
+def _fused_bwd_applicable(sq_p: int, d: int, bq: int = 512, bk: int = 512,
+                          itemsize: int = 4) -> bool:
+    """Estimate the fused kernel's whole VMEM-resident set — not just the
+    full-seq dQ scratch: the dQ OUTPUT block is also full-seq (constant index
+    map, so it stays resident), the per-block q/o/do/k/v operands and dk/dv
+    outputs are double-buffered by the pipeline, and the dk/dv accumulators
+    are f32 scratch. Underestimating here fails inside Mosaic at lowering
+    time instead of cleanly taking the split path."""
     if os.environ.get("TNN_FLASH_FUSED_BWD", "1") == "0":
         return False
-    return sq_p * d * 4 <= _FUSED_BWD_MAX_DQ_BYTES
+    dq_bytes = sq_p * d * (itemsize + 4)      # dq out block + f32 accumulator
+    blk_in = (3 * bq + 2 * bk) * d * itemsize + bq * 4  # q/o/do, k/v, lse
+    blk_out = 2 * bk * d * itemsize                     # dk/dv out blocks
+    acc = 2 * bk * d * 4                                # dk/dv f32 scratch
+    resident = dq_bytes + 2 * (blk_in + blk_out) + acc
+    return resident <= _FUSED_BWD_MAX_BYTES
 
 
 def _flash_bwd(causal, scale, block_q, block_k, block_q_bwd, block_k_bwd,
@@ -517,7 +529,7 @@ def _flash_bwd(causal, scale, block_q, block_k, block_q_bwd, block_k_bwd,
     bq_f = block_q_bwd if block_q_bwd is not None else 512
     bk_f = block_k_bwd if block_k_bwd is not None else 512
     bqp, bkp, sq_pf, _ = _block_geometry(sq, skv, bq_f, bk_f)
-    if _fused_bwd_applicable(sq_pf, d):
+    if _fused_bwd_applicable(sq_pf, d, bqp, bkp, q.dtype.itemsize):
         return _flash_bwd_fused(causal, scale, bqp, bkp, clamp_dead,
                                 residuals, g)
     bq_bwd, bk_bwd = _bwd_blocks(block_q, block_k, block_q_bwd, block_k_bwd)

@@ -1,7 +1,7 @@
-"""Ragged paged-attention decode kernel (Pallas TPU) + XLA-lax reference.
+"""Ragged paged-attention kernel (Pallas TPU) + XLA-lax reference.
 
-The serving engine's decode hot path (arXiv:2604.15464's storage model): each
-request's KV cache lives in fixed-size pages of the pool arrays
+The serving engine's attention hot path (arXiv:2604.15464's storage model):
+each request's KV cache lives in fixed-size pages of the pool arrays
 
     pages_k, pages_v : (L, num_blocks, H_kv, block_size, head_dim)
 
@@ -14,19 +14,28 @@ and flash-style online softmax accumulates over the streamed pages — so the
 only KV traffic per step is the KV actually attended over, and no contiguous
 cache ever exists.
 
+Queries are RAGGED MULTI-TOKEN: each row carries ``q_lens[b]`` live query
+tokens (1 for a decode row, up to the padded chunk width for a prefill
+chunk), already scattered into the row's pages, so row b's token t sits at
+absolute position ``kv_lens[b] - q_lens[b] + t`` and attends causally against
+its own chunk plus every previously written position. ``q_lens = 1``
+reproduces the PR 2 decode kernel exactly; this is what lets the engine pack
+decode rows and prefill chunks into ONE compiled mixed step.
+
 Grid: ``(B, H_kv, num_table_entries)`` — the innermost axis sweeps one row's
 block table; the (m, l, acc) scratch carries the online softmax across it.
-Grouped-query attention is zero-copy: q is viewed as (B, H_kv, G, Dh) and each
-grid step attends its whole q-head group against one fetched kv page. Pages
-past a row's live length clamp their fetch index to the last live page, so the
-Pallas pipeline elides the dead DMAs (same trick as flash_attention's causal
-dead-block clamp), and ``pl.when`` skips their compute.
+Grouped-query attention is zero-copy: q is viewed as (B, Q, H_kv, G, Dh) and
+each grid step attends the whole (Q * G)-row query block against one fetched
+kv page. Pages past a row's live length clamp their fetch index to the last
+live page, so the Pallas pipeline elides the dead DMAs (same trick as
+flash_attention's causal dead-block clamp), and ``pl.when`` skips their
+compute.
 
 ``paged_attention_reference`` is the same math in plain lax (gather the tables
 into a contiguous cache, masked softmax) — the parity oracle for the kernel
 and the CPU/interpret fallback the router picks off-TPU, mirroring how
-``flash_attention`` routes. ``scatter_kv_rows`` is the write half of the page
-contract: the one new KV row per sequence per step.
+``flash_attention`` routes. ``scatter_kv_rows`` / ``scatter_kv_chunk`` are the
+write half of the page contract: the new KV rows per sequence per step.
 """
 from __future__ import annotations
 
@@ -48,31 +57,39 @@ _CompilerParams = getattr(pltpu, "CompilerParams", None) \
 _NEG_INF = -1e30
 
 
-def _decode_kernel(tables_ref, lens_ref, layer_ref, q_ref, k_ref, v_ref,
-                   o_ref, m_scr, l_scr, acc_scr, *, scale: float, bs: int,
-                   g: int):
+def _attn_kernel(tables_ref, lens_ref, qlens_ref, layer_ref, q_ref, k_ref,
+                 v_ref, o_ref, m_scr, l_scr, acc_scr, *, scale: float,
+                 bs: int, g: int, qw: int):
     del tables_ref, layer_ref  # consumed by the index maps, not the body
     b = pl.program_id(0)
     j = pl.program_id(2)
     nj = pl.num_programs(2)
+    dh = q_ref.shape[-1]
 
     @pl.when(j == 0)
     def _init():
-        m_scr[:] = jnp.full_like(m_scr, _NEG_INF)   # (g, 1) running max
-        l_scr[:] = jnp.zeros_like(l_scr)            # (g, 1) running denom
-        acc_scr[:] = jnp.zeros_like(acc_scr)        # (g, Dh) output acc
+        m_scr[:] = jnp.full_like(m_scr, _NEG_INF)   # (Q*g, 1) running max
+        l_scr[:] = jnp.zeros_like(l_scr)            # (Q*g, 1) running denom
+        acc_scr[:] = jnp.zeros_like(acc_scr)        # (Q*g, Dh) output acc
 
     kv_len = lens_ref[b]
+    q_live = qlens_ref[b]
 
     @pl.when(j * bs < kv_len)
     def _block():
-        q = q_ref[0, 0]        # (g, Dh) — one kv head's whole query group
+        q = q_ref[0, :, 0].reshape(qw * g, dh)   # whole ragged query chunk
         k = k_ref[0, 0, 0]     # (bs, Dh) — one page
         v = v_ref[0, 0, 0]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
-        kpos = j * bs + jax.lax.broadcasted_iota(jnp.int32, (g, bs), 1)
-        mask = kpos < kv_len   # ragged tail of the last live page
+        kpos = j * bs + jax.lax.broadcasted_iota(jnp.int32, (qw * g, bs), 1)
+        trow = jax.lax.broadcasted_iota(jnp.int32, (qw, g), 0) \
+            .reshape(qw * g, 1)
+        # query token t sits at absolute position start + t with
+        # start = kv_len - q_live: causal over its own chunk AND over every
+        # previously written position; rows past q_live are fully masked
+        # (q_live = 1 degenerates to the decode mask kpos < kv_len)
+        mask = (kpos <= kv_len - q_live + trow) & (trow < q_live)
         s = jnp.where(mask, s, _NEG_INF)
         m_prev, l_prev = m_scr[:], l_scr[:]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
@@ -87,70 +104,76 @@ def _decode_kernel(tables_ref, lens_ref, layer_ref, q_ref, k_ref, v_ref,
     @pl.when(j == nj - 1)
     def _final():
         l = l_scr[:]
-        lsafe = jnp.where(l == 0.0, 1.0, l)  # kv_len == 0 rows -> output 0
-        o_ref[0, 0] = (acc_scr[:] / lsafe).astype(o_ref.dtype)
+        lsafe = jnp.where(l == 0.0, 1.0, l)  # fully-masked rows -> exactly 0
+        o_ref[0, :, 0] = (acc_scr[:] / lsafe).astype(o_ref.dtype) \
+            .reshape(qw, g, dh)
 
 
 def _paged_attention_pallas(q, pages_k, pages_v, block_tables, kv_lens,
-                            layer, scale, interpret):
-    b, h, dh = q.shape
+                            q_lens, layer, scale, interpret):
+    b, qw, h, dh = q.shape
     _, _, hkv, bs, _ = pages_k.shape
     g = h // hkv
     nb = block_tables.shape[1]
-    qg = q.reshape(b, hkv, g, dh)
+    qg = q.reshape(b, qw, hkv, g, dh)
     tables = block_tables.astype(jnp.int32)
     lens = kv_lens.astype(jnp.int32)
+    qlens = q_lens.astype(jnp.int32)
     layer_arr = jnp.reshape(jnp.asarray(layer, jnp.int32), (1,))
 
-    def kv_index(bi, hi, j, tbl, ln, ly):
+    def kv_index(bi, hi, j, tbl, ln, qln, ly):
         # clamp dead trailing pages to the row's last live page: the repeated
         # block index lets the pipeline elide the DMA (compute is pl.when-
         # skipped); max(len, 1) keeps fully-dead rows fetching page 0
         nlive = (jnp.maximum(ln[bi], 1) + bs - 1) // bs
         return (ly[0], tbl[bi, jnp.minimum(j, nlive - 1)], hi, 0, 0)
 
-    def q_index(bi, hi, j, tbl, ln, ly):
-        return (bi, hi, 0, 0)
+    def q_index(bi, hi, j, tbl, ln, qln, ly):
+        return (bi, 0, hi, 0, 0)
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=3,
+        num_scalar_prefetch=4,
         grid=(b, hkv, nb),
         in_specs=[
-            pl.BlockSpec((1, 1, g, dh), q_index),
+            pl.BlockSpec((1, qw, 1, g, dh), q_index),
             pl.BlockSpec((1, 1, 1, bs, dh), kv_index),
             pl.BlockSpec((1, 1, 1, bs, dh), kv_index),
         ],
-        out_specs=pl.BlockSpec((1, 1, g, dh), q_index),
+        out_specs=pl.BlockSpec((1, qw, 1, g, dh), q_index),
         scratch_shapes=[
-            pltpu.VMEM((g, 1), jnp.float32),
-            pltpu.VMEM((g, 1), jnp.float32),
-            pltpu.VMEM((g, dh), jnp.float32),
+            pltpu.VMEM((qw * g, 1), jnp.float32),
+            pltpu.VMEM((qw * g, 1), jnp.float32),
+            pltpu.VMEM((qw * g, dh), jnp.float32),
         ],
     )
     out = pl.pallas_call(
-        functools.partial(_decode_kernel, scale=scale, bs=bs, g=g),
+        functools.partial(_attn_kernel, scale=scale, bs=bs, g=g, qw=qw),
         grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((b, hkv, g, dh), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((b, qw, hkv, g, dh), q.dtype),
         # scratch carries only along the innermost (page) sweep
         compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
-    )(tables, lens, layer_arr, qg, pages_k, pages_v)
-    return out.reshape(b, h, dh)
+    )(tables, lens, qlens, layer_arr, qg, pages_k, pages_v)
+    return out.reshape(b, qw, h, dh)
+
+
+def _gather_pages(pages, block_tables, layer, b, hkv, t, dh):
+    x = pages[layer][block_tables]           # (B, nb, Hkv, bs, Dh)
+    return x.transpose(0, 2, 1, 3, 4).reshape(b, hkv, t, dh)
 
 
 def _paged_attention_xla(q, pages_k, pages_v, block_tables, kv_lens, layer,
                          scale):
+    """Single-token (decode) reference — the PR 2 math, kept verbatim so the
+    legacy decode traces stay bit-identical."""
     b, h, dh = q.shape
     _, _, hkv, bs, _ = pages_k.shape
     g = h // hkv
     t = block_tables.shape[1] * bs
 
-    def gather(pages):
-        x = pages[layer][block_tables]           # (B, nb, Hkv, bs, Dh)
-        return x.transpose(0, 2, 1, 3, 4).reshape(b, hkv, t, dh)
-
-    k, v = gather(pages_k), gather(pages_v)
+    k = _gather_pages(pages_k, block_tables, layer, b, hkv, t, dh)
+    v = _gather_pages(pages_v, block_tables, layer, b, hkv, t, dh)
     qg = q.reshape(b, hkv, g, dh)
     s = jnp.einsum("bhgd,bhtd->bhgt", qg, k,
                    preferred_element_type=jnp.float32) * scale
@@ -165,26 +188,68 @@ def _paged_attention_xla(q, pages_k, pages_v, block_tables, kv_lens, layer,
     return out.astype(q.dtype).reshape(b, h, dh)
 
 
+def _paged_attention_xla_mq(q, pages_k, pages_v, block_tables, kv_lens,
+                            q_lens, layer, scale):
+    """Multi-token-query reference: same ragged causal mask as the kernel."""
+    b, qw, h, dh = q.shape
+    _, _, hkv, bs, _ = pages_k.shape
+    g = h // hkv
+    t = block_tables.shape[1] * bs
+
+    k = _gather_pages(pages_k, block_tables, layer, b, hkv, t, dh)
+    v = _gather_pages(pages_v, block_tables, layer, b, hkv, t, dh)
+    qg = q.reshape(b, qw, hkv, g, dh)
+    s = jnp.einsum("bqhgd,bhtd->bqhgt", qg, k,
+                   preferred_element_type=jnp.float32) * scale
+    start = (kv_lens - q_lens)[:, None]                   # (B, 1)
+    tpos = jnp.arange(qw)[None, :]                        # (1, Q)
+    kpos = jnp.arange(t)
+    live = (kpos[None, None, :] <= (start + tpos)[:, :, None]) \
+        & (tpos < q_lens[:, None])[:, :, None]            # (B, Q, T)
+    s = jnp.where(live[:, :, None, None, :], s, _NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    # fully-masked query rows (padding past q_lens, or q_lens/kv_lens == 0)
+    # output exactly 0, matching the kernel's l == 0 guard
+    row_live = (tpos < q_lens[:, None]) & (start + tpos >= 0)   # (B, Q)
+    p = jnp.where(row_live[:, :, None, None, None], p, 0.0)
+    out = jnp.einsum("bqhgt,bhtd->bqhgd", p.astype(v.dtype), v,
+                     preferred_element_type=jnp.float32)
+    return out.astype(q.dtype).reshape(b, qw, h, dh)
+
+
 def paged_attention_reference(q, pages_k, pages_v, block_tables, kv_lens, *,
-                              layer=0, scale: Optional[float] = None):
+                              q_lens=None, layer=0,
+                              scale: Optional[float] = None):
     """XLA-lax reference: gather the tables contiguous, masked softmax.
 
     Same signature/semantics as ``paged_attention`` — the parity oracle for
     the kernel and the off-TPU fallback (it IS a gather, which is exactly
     what the kernel exists to avoid on TPU)."""
-    q, pages_k, pages_v, scale = _check_args(q, pages_k, pages_v,
-                                             block_tables, kv_lens, scale)
-    return _paged_attention_xla(q, pages_k, pages_v, block_tables, kv_lens,
-                                layer, scale)
+    q, was_3d, q_lens, pages_k, pages_v, scale = _check_args(
+        q, pages_k, pages_v, block_tables, kv_lens, q_lens, scale)
+    if was_3d:
+        return _paged_attention_xla(q[:, 0], pages_k, pages_v, block_tables,
+                                    kv_lens, layer, scale)
+    return _paged_attention_xla_mq(q, pages_k, pages_v, block_tables,
+                                   kv_lens, q_lens, layer, scale)
 
 
-def _check_args(q, pages_k, pages_v, block_tables, kv_lens, scale):
+def _check_args(q, pages_k, pages_v, block_tables, kv_lens, q_lens, scale):
     if pages_k.ndim == 4:      # single-layer pages: add the unit layer axis
         pages_k, pages_v = pages_k[None], pages_v[None]
     if pages_k.shape != pages_v.shape or pages_k.ndim != 5:
         raise ValueError(f"pages must both be (L, N, H_kv, bs, Dh); got "
                          f"{pages_k.shape} / {pages_v.shape}")
-    b, h, dh = q.shape
+    was_3d = q.ndim == 3
+    if was_3d:
+        if q_lens is not None:
+            raise ValueError("q_lens requires multi-token q (B, Q, H, Dh); "
+                             f"got q {q.shape}")
+        q = q[:, None]
+    if q.ndim != 4:
+        raise ValueError(f"q must be (B, H, Dh) or (B, Q, H, Dh); "
+                         f"got {q.shape}")
+    b, qw, h, dh = q.shape
     hkv = pages_k.shape[2]
     if h % hkv or pages_k.shape[4] != dh:
         raise ValueError(f"q has {h} heads / Dh {dh} but pages carry "
@@ -193,27 +258,36 @@ def _check_args(q, pages_k, pages_v, block_tables, kv_lens, scale):
     if block_tables.shape[0] != b or kv_lens.shape != (b,):
         raise ValueError(f"block_tables {block_tables.shape} / kv_lens "
                          f"{kv_lens.shape} do not match batch {b}")
+    if q_lens is None:
+        q_lens = jnp.full((b,), qw, jnp.int32)
+    elif q_lens.shape != (b,):
+        raise ValueError(f"q_lens {q_lens.shape} does not match batch {b}")
     if scale is None:
         scale = 1.0 / math.sqrt(dh)
-    return q, pages_k, pages_v, scale
+    return q, was_3d, q_lens, pages_k, pages_v, scale
 
 
 def paged_attention(q, pages_k, pages_v, block_tables, kv_lens, *,
-                    layer=0, scale: Optional[float] = None,
+                    q_lens=None, layer=0, scale: Optional[float] = None,
                     backend: str = "auto",
                     interpret: Optional[bool] = None):
-    """Decode attention for the current step's q rows over paged KV.
+    """Ragged attention for the current step's query rows over paged KV.
 
-    q : (B, H, Dh) — this step's query rows (one token per sequence).
+    q : (B, H, Dh) — decode form, one token per sequence — or (B, Q, H, Dh)
+        for ragged multi-token chunks (``q_lens[b]`` live tokens per row,
+        left-aligned; the rest is padding and outputs exactly 0).
     pages_k / pages_v : (L, N, H_kv, bs, Dh) pool pages (or a single layer's
         (N, H_kv, bs, Dh); ``layer`` then ignored). Never copied: the kernel
         fetches only the pages the tables name.
     block_tables : (B, nb) int32 — page ids in logical order; entries past a
         row's live pages may be anything in-range (the pool pads with its
         scratch page 0).
-    kv_lens : (B,) int32 — live KV positions per row INCLUDING the row
-        written this step (the engine scatters the new row first and passes
-        ``offsets + 1``). A 0 row outputs exactly 0.
+    kv_lens : (B,) int32 — live KV positions per row INCLUDING the rows
+        written this step (the engine scatters the new rows first and passes
+        ``offsets + q_lens``). A 0 row outputs exactly 0.
+    q_lens : (B,) int32 — live query tokens per row (only with 4-D q;
+        defaults to the full width Q). Token t of row b sits at absolute
+        position ``kv_lens[b] - q_lens[b] + t`` and attends causally.
     layer : which layer's pages to read (static or traced scalar).
     backend : "pallas" (the kernel; interprets off-TPU), "xla" (the gather
         reference), or "auto" — kernel on TPU, reference elsewhere (the
@@ -221,21 +295,25 @@ def paged_attention(q, pages_k, pages_v, block_tables, kv_lens, *,
         up to reduction order).
 
     GQA: H % H_kv == 0; each kv head's page is fetched once and attended by
-    its whole query-head group.
+    its whole query-head group. Returns q's shape.
     """
-    q, pages_k, pages_v, scale = _check_args(q, pages_k, pages_v,
-                                             block_tables, kv_lens, scale)
+    q, was_3d, q_lens, pages_k, pages_v, scale = _check_args(
+        q, pages_k, pages_v, block_tables, kv_lens, q_lens, scale)
     if backend == "auto":
         backend = "pallas" if jax.default_backend() == "tpu" else "xla"
     if backend == "xla":
-        return _paged_attention_xla(q, pages_k, pages_v, block_tables,
-                                    kv_lens, layer, scale)
+        if was_3d:
+            return _paged_attention_xla(q[:, 0], pages_k, pages_v,
+                                        block_tables, kv_lens, layer, scale)
+        return _paged_attention_xla_mq(q, pages_k, pages_v, block_tables,
+                                       kv_lens, q_lens, layer, scale)
     if backend != "pallas":
         raise ValueError(f"unknown paged-attention backend {backend!r}")
     if interpret is None:
         interpret = interpret_default()
-    return _paged_attention_pallas(q, pages_k, pages_v, block_tables,
-                                   kv_lens, layer, scale, interpret)
+    out = _paged_attention_pallas(q, pages_k, pages_v, block_tables,
+                                  kv_lens, q_lens, layer, scale, interpret)
+    return out[:, 0] if was_3d else out
 
 
 def scatter_kv_rows(pages, block_tables, offsets, rows, *, layer=None):
@@ -254,6 +332,34 @@ def scatter_kv_rows(pages, block_tables, offsets, rows, *, layer=None):
     slot = offsets % bs
     # two advanced indices (blk, slot) around the sliced head axis put the
     # batch dim first in the update operand: rows is already (B, H, Dh)
+    if pages.ndim == 5:
+        if layer is None:
+            raise ValueError("layer is required for (L, N, H, bs, Dh) pages")
+        return pages.at[layer, blk, :, slot, :].set(rows)
+    return pages.at[blk, :, slot, :].set(rows)
+
+
+def scatter_kv_chunk(pages, block_tables, starts, rows, q_lens, *,
+                     layer=None):
+    """Write a ragged chunk of new KV rows per sequence.
+
+    ``rows`` is (B, Q, H, Dh): row b's tokens t < q_lens[b] land at positions
+    ``starts[b] + t`` through its block table; padding tokens (and whole rows
+    with q_lens == 0) are redirected to the pool's scratch page 0, which is
+    never allocated to a request, so they can't corrupt live KV. Same layer /
+    donation semantics as ``scatter_kv_rows``.
+    """
+    bs = pages.shape[-2]
+    qw = rows.shape[1]
+    nbt = block_tables.shape[1]
+    pos = starts[:, None] + jnp.arange(qw)                # (B, Q)
+    live = jnp.arange(qw)[None, :] < q_lens[:, None]      # (B, Q)
+    blk = jnp.take_along_axis(block_tables,
+                              jnp.clip(pos // bs, 0, nbt - 1), axis=1)
+    blk = jnp.where(live, blk, 0)   # dead tokens land in the scratch page
+    slot = pos % bs
+    # advanced (blk, slot) indices around the sliced head axis broadcast to
+    # (B, Q) and lead the update operand: rows is already (B, Q, H, Dh)
     if pages.ndim == 5:
         if layer is None:
             raise ValueError("layer is required for (L, N, H, bs, Dh) pages")

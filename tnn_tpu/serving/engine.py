@@ -3,16 +3,29 @@
 Ties the subsystem together:
 
     submit() --> Scheduler (FCFS queue) --> step():
-        prefill admitted requests   (one jitted program per prompt bucket)
-        decode the running batch    (ONE jitted program, fixed batch width)
+        ONE mixed step packs decode rows (1 token each) and prefill CHUNKS
+        (up to chunk_size prompt tokens each) into a single compiled program
       --> streamed tokens / finished requests
 
-Static-shape discipline (the whole point on XLA backends): the decode step is
-compiled ONCE for (max_batch_size, assembly_width) — requests joining or
-leaving the batch never retrace; absent rows are padded onto the pool's
-scratch block and masked by the per-row causal offsets. Prefill pads prompts
-up to a block multiple, so prompt-length buckets (not exact lengths) key its
-jit cache.
+Chunked prefill (the default; ``chunked_prefill=False`` restores the retired
+whole-prompt path): prompts are pushed ``chunk_size`` tokens at a time,
+co-scheduled with the decode rows inside the same ``token_budget``, so a long
+prompt arriving mid-stream never stalls decoding requests for a whole
+prompt-length forward pass — the dominant TTFT/latency tail under mixed load.
+Partially-prefilled requests persist their progress in pool blocks and take
+their next chunk on later steps without recompute. Steps with no chunk work
+delegate to the SAME pure-decode program as before chunking existed, so
+decode streams are bit-identical.
+
+Static-shape discipline (the whole point on XLA backends): the mixed step is
+compiled per (max_batch_size, chunk-width bucket, assembly_width) with chunk
+widths bucketed to powers of two — requests joining or leaving the batch
+never retrace; absent rows are padded onto the pool's scratch block and
+masked by the per-row q_lens/offsets (padding tokens write their KV to the
+scratch page and output garbage that is never read). In legacy whole-prompt
+mode, prefill pads prompts up to a block multiple, so prompt-length buckets
+(not exact lengths) key its jit cache; either way N distinct prompt lengths
+cost O(log N) compiles.
 
 Decode-path selection: ``decode_path="auto"`` probes the PAGED path first —
 ``model.apply_decode_paged`` over the ragged paged-attention kernel
@@ -74,7 +87,11 @@ class InferenceEngine:
     model, params : the module tree and its params (``variables["params"]``).
     num_blocks, block_size : KV pool geometry (block 0 is reserved scratch).
     max_batch_size : decode batch width the step is compiled at.
-    token_budget : per-step cap on model tokens (decodes + admitted prompts).
+    token_budget : per-step cap on model tokens (decodes + prompt chunks).
+    chunk_size : prompt tokens a request may push per mixed step (chunk
+        widths are bucketed to powers of two for compile-cache boundedness).
+    chunked_prefill : False restores the legacy whole-prompt prefill path
+        (one bucketed prefill program per admitted prompt, decode separate).
     max_seq_len : per-request position cap (prompt + generated); defaults to
         the smaller of model.max_len and the pool's whole capacity.
     decode_path : "auto" | "standard" | "fused" | "paged" (see module
@@ -95,7 +112,9 @@ class InferenceEngine:
 
     def __init__(self, model, params, *, num_blocks: int = 64,
                  block_size: int = 16, max_batch_size: int = 8,
-                 token_budget: int = 2048, max_seq_len: Optional[int] = None,
+                 token_budget: int = 2048, chunk_size: int = 64,
+                 chunked_prefill: bool = True,
+                 max_seq_len: Optional[int] = None,
                  decode_path: str = "auto", max_queue_depth: int = 0,
                  admission_policy: str = "reject",
                  preemption_budget: Optional[int] = 16,
@@ -115,6 +134,8 @@ class InferenceEngine:
             raise ValueError("max_queue_depth must be >= 0 (0 = unbounded)")
         if preemption_budget is not None and preemption_budget < 0:
             raise ValueError("preemption_budget must be >= 0 or None")
+        if chunk_size < 1:
+            raise ValueError("chunk_size must be >= 1")
         self.max_queue_depth = int(max_queue_depth)
         self.admission_policy = admission_policy
         self.preemption_budget = preemption_budget
@@ -134,8 +155,12 @@ class InferenceEngine:
         # row (padded with scratch), so ONE compile covers all batch states
         self.blocks_per_seq = self.pool.blocks_for(self.max_seq_len)
         self.assembly_len = self.blocks_per_seq * block_size
-        self.scheduler = Scheduler(max_batch_size=max_batch_size,
-                                   token_budget=token_budget)
+        self.chunk_size = int(chunk_size)
+        self.chunked_prefill = bool(chunked_prefill)
+        self.scheduler = Scheduler(
+            max_batch_size=max_batch_size, token_budget=token_budget,
+            chunk_size=self.chunk_size if self.chunked_prefill else 0)
+        self._last_decode_emit: Optional[float] = None
         self.profiler = profiler
         self.metrics = ServingMetrics(profiler)
         self.requests: Dict[int, Request] = {}
@@ -320,8 +345,9 @@ class InferenceEngine:
     # -- engine step ----------------------------------------------------------
 
     def step(self) -> Dict[str, List]:
-        """Run one serving step: expire deadlines, admit+prefill, then one
-        batched decode.
+        """Run one serving step: expire deadlines, admit, then one mixed
+        prefill+decode step (or, in legacy whole-prompt mode, per-prompt
+        prefills followed by one batched decode).
 
         Returns the streamed increment this step produced::
 
@@ -340,13 +366,26 @@ class InferenceEngine:
             self.faults.on_step()
         self._enforce_deadlines(events)
         plan = self.scheduler.schedule(self.pool)
-        for req in plan.prefills:
-            self._prefill(req, events)
-        self._ensure_decode_capacity(events)
-        live = [r for r in self.scheduler.running
-                if r.state is RequestState.RUNNING]
-        if live:
-            self._decode(live, events)
+        if self.scheduler.chunk_size:
+            chunks = dict(plan.chunks)
+            for req in plan.prefills:
+                if not self._admit_chunked(req, events):
+                    chunks.pop(req.rid, None)
+            self._mixed_step(chunks, events)
+        else:
+            for req in plan.prefills:
+                self._prefill(req, events)
+            self._ensure_decode_capacity(events)
+            live = [r for r in self.scheduler.running
+                    if r.state is RequestState.RUNNING]
+            if live:
+                self._decode(live, events)
+        if not any(r.state is RequestState.RUNNING
+                   and r.cache_len >= r.prefill_len
+                   for r in self.scheduler.running):
+            # no decode-phase rows left: the next decode token starts a new
+            # stream, so the stall clock must not span the idle gap
+            self._last_decode_emit = None
         self.metrics.observe_gauges(self.scheduler.queue_depth,
                                     self.pool.occupancy)
         return events
@@ -489,6 +528,24 @@ class InferenceEngine:
             events["tokens"].append((req.rid, tok))
             self._maybe_finish(req, tok, events)
 
+    def _admit_chunked(self, req: Request, events) -> bool:
+        """Chunked admission: no device work — the request joins the running
+        set immediately and its prompt is pushed chunk by chunk inside the
+        mixed step (blocks are allocated per chunk, not up front)."""
+        nb_total = self.pool.blocks_for(req.prefill_len)
+        if nb_total > self.blocks_per_seq:
+            # unreachable via submit()'s validation (resume <= prompt +
+            # max_new), but a corrupted resume must not poison the batch
+            self._terminate(
+                req, RequestState.FAILED,
+                f"oversized resume: {req.prefill_len} tokens need "
+                f"{nb_total} blocks > assembly capacity "
+                f"{self.blocks_per_seq}", events, "failed")
+            return False
+        req.cache_len = 0
+        self.scheduler.admit(req)
+        return True
+
     # -- decode ---------------------------------------------------------------
 
     def _ensure_decode_capacity(self, events: Dict[str, List]) -> None:
@@ -497,40 +554,276 @@ class InferenceEngine:
         its ``preemption_budget`` FAILs instead of requeueing — its freed
         blocks break the two-large-requests livelock; and an allocation that
         still fails (injected fault) FAILs only the requesting row."""
-        bs = self.pool.block_size
         for req in list(self.scheduler.running):
             if req.state is not RequestState.RUNNING:
                 continue
-            if req.cache_len < len(req.block_table) * bs:
-                continue
-            while not self.pool.can_alloc(1):
-                victim = self.scheduler.preempt_victim()
-                if victim is None or (victim is req
-                                      and len(self.scheduler.running) == 1):
-                    # unreachable given submit()'s capacity validation
-                    raise RuntimeError(
-                        "KV pool deadlock: no preemption victim can free "
-                        "enough blocks")
-                if self.preemption_budget is not None and \
-                        victim.preemptions >= self.preemption_budget:
-                    self._terminate(
-                        victim, RequestState.FAILED,
-                        f"preemption budget exhausted "
-                        f"({victim.preemptions} recompute preemptions >= "
-                        f"budget {self.preemption_budget})",
-                        events, "failed")
-                else:
-                    self._preempt(victim)
-                if victim is req:
-                    break
+            self._grow_blocks(req, 1, events, chunk=False)
+
+    def _grow_blocks(self, req: Request, new_tokens: int, events,
+                     *, chunk: bool) -> bool:
+        """Grow ``req.block_table`` to cover ``cache_len + new_tokens``
+        positions, preempting (LIFO) when the pool runs dry. Returns True
+        when the row still runs this step; False when it was preempted,
+        budget-FAILed, or hit an allocation fault — a chunk-boundary alloc
+        failure fails ONLY this request (``chunk=True`` also routes the
+        prefill fault-injection site at the boundary)."""
+        needed = self.pool.blocks_for(req.cache_len + new_tokens)
+        grow = max(0, needed - len(req.block_table))
+        while grow and not self.pool.can_alloc(grow):
+            victim = self.scheduler.preempt_victim()
+            if victim is None or (victim is req
+                                  and len(self.scheduler.running) == 1):
+                # unreachable given submit()'s capacity validation
+                raise RuntimeError(
+                    "KV pool deadlock: no preemption victim can free "
+                    "enough blocks")
+            if self.preemption_budget is not None and \
+                    victim.preemptions >= self.preemption_budget:
+                self._terminate(
+                    victim, RequestState.FAILED,
+                    f"preemption budget exhausted "
+                    f"({victim.preemptions} recompute preemptions >= "
+                    f"budget {self.preemption_budget})",
+                    events, "failed")
+            else:
+                self._preempt(victim)
+            if victim is req:
+                return False
+        if req.state is not RequestState.RUNNING:
+            return False
+        try:
+            if chunk and self.faults is not None:
+                self.faults.on_prefill()
+            if grow:
+                req.block_table.extend(self.pool.alloc(grow))
+        except (PoolExhausted, FaultInjected) as e:
+            where = "at chunk boundary" if chunk else "mid-decode"
+            self._terminate(req, RequestState.FAILED,
+                            f"pool allocation failed {where}: {e}",
+                            events, "failed")
+            return False
+        return True
+
+    # -- mixed prefill+decode step --------------------------------------------
+
+    def _mark_decode_emit(self) -> None:
+        """Stamp a step that emitted decode-phase tokens; the gap between
+        consecutive stamps is the decode stall chunking exists to bound."""
+        now = time.perf_counter()
+        if self._last_decode_emit is not None:
+            self.metrics.observe_decode_stall(now - self._last_decode_emit)
+        self._last_decode_emit = now
+
+    def _mixed_step(self, chunks: Dict[int, int], events) -> None:
+        """One packed step: every decode-phase running row takes 1 token and
+        every mid-prefill row with a chunk grant pushes its next prompt
+        chunk, all inside ONE compiled program keyed on the power-of-two
+        bucket of the widest chunk. Steps with no chunk work delegate to the
+        legacy pure-decode program, so decode streams are bit-identical to
+        the pre-chunking engine."""
+        t0 = time.perf_counter()
+        has_chunks = any(
+            r.rid in chunks and r.state is RequestState.RUNNING
+            and r.cache_len < r.prefill_len for r in self.scheduler.running)
+        if not has_chunks:
+            self._ensure_decode_capacity(events)
+            live = [r for r in self.scheduler.running
+                    if r.state is RequestState.RUNNING]
+            if live:
+                self._decode(live, events)
+            return
+        # capacity pass in admission order: chunk rows grow by their grant
+        # (the chunk-boundary alloc fault site — fails ONLY that request),
+        # decode rows by one token, preempting LIFO as needed
+        for req in list(self.scheduler.running):
             if req.state is not RequestState.RUNNING:
                 continue
+            if req.cache_len < req.prefill_len:
+                take = chunks.get(req.rid)
+                if take and not self._grow_blocks(req, take, events,
+                                                  chunk=True):
+                    chunks.pop(req.rid, None)
+            else:
+                self._grow_blocks(req, 1, events, chunk=False)
+        live = [r for r in self.scheduler.running
+                if r.state is RequestState.RUNNING]
+        dec = [r for r in live if r.cache_len >= r.prefill_len]
+        chk = [(r, chunks[r.rid]) for r in live
+               if r.cache_len < r.prefill_len and r.rid in chunks]
+        if not chk:
+            if dec:
+                self._decode(dec, events)
+            return
+        rows = dec + [r for r, _ in chk]
+        takes = {r.rid: t for r, t in chk}
+        # compiled chunk width: next power of two over the widest grant, so
+        # N distinct chunk takes cost O(log chunk_size) compiles
+        qw = 1 << (max(takes.values()) - 1).bit_length()
+        b = self.scheduler.max_batch_size
+        nb = self.blocks_per_seq
+        toks = np.zeros((b, qw), np.int32)
+        starts = np.zeros((b,), np.int32)
+        q_lens = np.zeros((b,), np.int32)
+        tables = np.full((b, nb), PagedKVPool.SCRATCH, np.int32)
+        temps = np.zeros((b,), np.float32)
+        topks = np.zeros((b,), np.int32)
+        topps = np.zeros((b,), np.float32)
+        poison = np.zeros((b,), np.float32)
+        for i, req in enumerate(rows):
+            starts[i] = req.cache_len
+            tables[i, :len(req.block_table)] = req.block_table
+            temps[i] = req.temperature
+            topks[i] = req.top_k
+            topps[i] = req.top_p
+            if i < len(dec):
+                toks[i, 0] = req.next_token
+                q_lens[i] = 1
+            else:
+                take = takes[req.rid]
+                seq = req.resume_tokens
+                toks[i, :take] = seq[req.cache_len:req.cache_len + take]
+                q_lens[i] = take
+        if self.faults is not None:
+            if dec:
+                poison[:len(dec)][self.faults.poison_rows(len(dec))] = np.nan
+            for i in range(len(dec), len(rows)):
+                if self.faults.poison_prefill():
+                    poison[i] = np.nan
+        key = ("mixed", b, qw, nb)
+        fn = self._jit.get(key)
+        if fn is None:
+            fn = self._jit[key] = (
+                self._mixed_paged_fn(b, qw, nb) if self._paged
+                else self._mixed_standard_fn(b, qw, nb))
+        # one key per STEP (held across the retry): a transient fault retried
+        # with the same key reproduces the fault-free step bit-for-bit
+        step_key = self._next_key()
+        for attempt in (0, 1):
             try:
-                req.block_table.extend(self.pool.alloc(1))
-            except PoolExhausted as e:
-                self._terminate(req, RequestState.FAILED,
-                                f"pool allocation failed mid-decode: {e}",
-                                events, "failed")
+                if self.faults is not None:
+                    self.faults.on_decode()
+                with profiled("serve.mixed", EventType.COMPUTE,
+                              self.profiler):
+                    newtok, ok, pk, pv = fn(
+                        self.params, self.pool.pages_k, self.pool.pages_v,
+                        jnp.asarray(toks), jnp.asarray(starts),
+                        jnp.asarray(q_lens), jnp.asarray(tables),
+                        jnp.asarray(temps), jnp.asarray(topks),
+                        jnp.asarray(topps), step_key, jnp.asarray(poison))
+                    newtok = np.asarray(newtok)
+                    ok = np.asarray(ok)
+                break
+            except FaultInjected as e:
+                # injected pre-call: donated buffers untouched, retryable
+                if attempt == 0 and e.transient:
+                    self.metrics.observe_step_retry()
+                    continue
+                self._abort_batch(rows, f"decode step failed: {e}", events)
+                return
+            except Exception as e:  # noqa: BLE001 — isolate, don't crash
+                self._abort_batch(rows, f"decode step failed: {e}", events)
+                return
+        self.pool.update_pages(pk, pv)
+        now = time.perf_counter()
+        n_dec = len(dec)
+        for i, req in enumerate(rows):
+            if self.logit_guard and not bool(ok[i]):
+                self._terminate(
+                    req, RequestState.FAILED,
+                    "non-finite logits in decode step" if i < n_dec
+                    else "non-finite logits in prefill chunk",
+                    events, "failed")
+                continue
+            if i < n_dec:
+                tok = int(newtok[i])
+                req.cache_len += 1
+                req.next_token = tok
+                req.out_tokens.append(tok)
+                events["tokens"].append((req.rid, tok))
+                self._maybe_finish(req, tok, events)
+                continue
+            take = takes[req.rid]
+            req.cache_len += take
+            self.metrics.observe_prefill_chunk(take)
+            if req.cache_len < req.prefill_len:
+                continue            # more chunks to go; no token yet
+            if req.out_tokens:
+                # preemption recovery: the pending next_token survives; the
+                # final chunk's own sample is redundant (greedy: identical)
+                continue
+            tok = int(newtok[i])
+            req.next_token = tok
+            req.out_tokens.append(tok)
+            req.ttft_s = now - req.submit_time
+            self.metrics.observe_ttft(req.ttft_s, under_load=n_dec > 0)
+            events["tokens"].append((req.rid, tok))
+            self._maybe_finish(req, tok, events)
+        self.metrics.observe_mixed_step(n_dec + sum(takes.values()), b * qw)
+        if n_dec:
+            self._mark_decode_emit()
+            self.metrics.observe_decode(n_dec, time.perf_counter() - t0, b)
+
+    def _mixed_paged_fn(self, b: int, qw: int, nb: int):
+        model = self.model
+
+        def fn(params, pages_k, pages_v, toks, starts, q_lens, tables,
+               t, k, p, key, poison):
+            # the ragged paged-attention kernel takes decode rows (q_len 1)
+            # and prompt chunks (q_len up to qw) in the same launch; dead
+            # tokens scatter their KV to the scratch page and are masked
+            logits, pages_k, pages_v = model.apply_paged(
+                params, toks, pages_k, pages_v, tables, starts, q_lens)
+            last = jnp.take_along_axis(
+                logits, jnp.maximum(q_lens - 1, 0)[:, None, None],
+                axis=1)[:, 0]                                   # (B, V)
+            last = last + poison[:, None]
+            ok = jnp.isfinite(last).all(axis=-1)
+            newtok = sampling.sample_ragged(last, key, t, k, p)
+            return newtok, ok, pages_k, pages_v
+
+        return jax.jit(fn, donate_argnums=(1, 2))
+
+    def _mixed_standard_fn(self, b: int, qw: int, nb: int):
+        model = self.model
+
+        def fn(params, pages_k, pages_v, toks, starts, q_lens, tables,
+               t, k, p, key, poison):
+            kf, vf = kv_pool_lib.gather_kv(pages_k, pages_v, tables)
+            # pad the time axis by qw: apply_cached's per-row cache write
+            # CLAMPS its start, so a chunk ending at the assembly edge must
+            # have headroom — the padded tail is gathered back below only
+            # through scatter_chunk's q_lens mask, so it never leaks
+            pad = [(0, 0), (0, 0), (0, 0), (0, qw), (0, 0)]
+            kf, vf = jnp.pad(kf, pad), jnp.pad(vf, pad)
+            x, _ = model.wte.apply({"params": params["wte"], "state": {}},
+                                   toks)                        # (B, qw, D)
+            x, _ = model.wpe.apply({"params": params["wpe"], "state": {}},
+                                   x, offset=starts)
+            rows_k, rows_v = [], []
+            idx = (starts[:, None] + jnp.arange(qw))[:, None, :, None]
+            for i, block in enumerate(model.blocks):
+                cache = {"k": kf[i], "v": vf[i]}
+                x, cache = block.apply_cached(params[f"h{i}"], x, cache,
+                                              starts)
+                rows_k.append(jnp.take_along_axis(cache["k"], idx, axis=2))
+                rows_v.append(jnp.take_along_axis(cache["v"], idx, axis=2))
+            x, _ = model.ln_f.apply({"params": params["ln_f"], "state": {}}, x)
+            # project only each row's last LIVE position through the head —
+            # (B, 1, V) instead of a (B, qw, V) logits cube
+            xl = jnp.take_along_axis(
+                x, jnp.maximum(q_lens - 1, 0)[:, None, None], axis=1)
+            logits = model._head(params, xl)[:, 0] + poison[:, None]
+            ok = jnp.isfinite(logits).all(axis=-1)
+            newtok = sampling.sample_ragged(logits, key, t, k, p)
+            rows_k = jnp.stack(rows_k).transpose(0, 1, 3, 2, 4)  # (L,B,Q,H,Dh)
+            rows_v = jnp.stack(rows_v).transpose(0, 1, 3, 2, 4)
+            pages_k = kv_pool_lib.scatter_chunk(pages_k, tables, starts,
+                                                rows_k, q_lens)
+            pages_v = kv_pool_lib.scatter_chunk(pages_v, tables, starts,
+                                                rows_v, q_lens)
+            return newtok, ok, pages_k, pages_v
+
+        return jax.jit(fn, donate_argnums=(1, 2))
 
     def _preempt(self, req: Request) -> None:
         self.pool.free(req.block_table)
@@ -724,6 +1017,7 @@ class InferenceEngine:
             req.out_tokens.append(tok)
             events["tokens"].append((req.rid, tok))
             self._maybe_finish(req, tok, events)
+        self._mark_decode_emit()
         self.metrics.observe_decode(len(live), time.perf_counter() - t0, b)
 
     def _abort_batch(self, live: Sequence[Request], error: str,

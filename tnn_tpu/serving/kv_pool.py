@@ -270,3 +270,27 @@ def scatter_token(pages, block_tables, offsets, rows):
     # the two advanced indices (blk, slot) around sliced axes put the batch
     # dim first in the update operand: (B, L, H, Dh)
     return pages.at[:, blk, :, slot, :].set(rows.transpose(1, 0, 2, 3))
+
+
+def scatter_chunk(pages, block_tables, starts, rows, q_lens):
+    """Write a ragged chunk of new KV rows per sequence, all layers at once.
+
+    pages: (L, N, H, bs, Dh); block_tables: (B, nb); starts: (B,) the first
+    position each row writes; rows: (L, B, Q, H, Dh); q_lens: (B,) live
+    tokens per row. Row b's tokens t < q_lens[b] land at starts[b] + t;
+    padding tokens (t >= q_lens[b], and whole rows with q_lens == 0) are
+    redirected to SCRATCH, which is never allocated to a request. The mixed
+    prefill+decode step uses this to persist each prefill chunk's KV.
+    """
+    bs = pages.shape[3]
+    qw = rows.shape[2]
+    nbt = block_tables.shape[1]
+    pos = starts[:, None] + jnp.arange(qw)                # (B, Q)
+    live = jnp.arange(qw)[None, :] < q_lens[:, None]      # (B, Q)
+    blk = jnp.take_along_axis(block_tables,
+                              jnp.clip(pos // bs, 0, nbt - 1), axis=1)
+    blk = jnp.where(live, blk, PagedKVPool.SCRATCH)
+    slot = pos % bs
+    # advanced (blk, slot) indices broadcast to (B, Q) and lead the update
+    # operand: (B, Q, L, H, Dh)
+    return pages.at[:, blk, :, slot, :].set(rows.transpose(1, 2, 0, 3, 4))

@@ -33,11 +33,15 @@ class ServingMetrics:
     def __init__(self, profiler: Optional[Profiler] = None):
         self.profiler = profiler
         self.ttft_s: List[float] = []
+        self.ttft_under_load_s: List[float] = []
         self.token_latency_s: List[float] = []
+        self.decode_stall_s: List[float] = []
         self.queue_depth: List[int] = []
         self.pool_occupancy: List[float] = []
         self.batch_fill: List[float] = []
+        self.mixed_step_fill: List[float] = []
         self.prefill_tokens = 0
+        self.prefill_chunks = 0
         self.decode_tokens = 0
         self.preemptions = 0
         self.preemptions_by_request: Dict[int, int] = {}
@@ -64,15 +68,40 @@ class ServingMetrics:
         if self.profiler is not None:
             self.profiler.tick(key, value)
 
-    def observe_ttft(self, seconds: float) -> None:
+    def observe_ttft(self, seconds: float, under_load: bool = False) -> None:
+        """``under_load`` marks a first token produced while OTHER requests
+        were decoding in the same step — the TTFT population chunked prefill
+        exists to protect (an unloaded TTFT can't stall anyone)."""
         self._mark()
         self.ttft_s.append(seconds)
+        if under_load:
+            self.ttft_under_load_s.append(seconds)
         self._tick("serve.ttft_s", seconds)
 
     def observe_prefill(self, num_tokens: int, seconds: float) -> None:
         self._mark()
         self.prefill_tokens += num_tokens
         self._tick("serve.prefill_s", seconds)
+
+    def observe_prefill_chunk(self, num_tokens: int) -> None:
+        """One prompt chunk pushed inside a mixed step."""
+        self._mark()
+        self.prefill_chunks += 1
+        self.prefill_tokens += num_tokens
+        self._tick("serve.prefill_chunks", 1)
+
+    def observe_mixed_step(self, live_tokens: int, width: int) -> None:
+        """Packing efficiency of one mixed prefill+decode step: live tokens
+        (decode rows + live chunk tokens) over the compiled B*Q capacity."""
+        if width:
+            self.mixed_step_fill.append(live_tokens / width)
+            self._tick("serve.mixed_step_fill", live_tokens / width)
+
+    def observe_decode_stall(self, seconds: float) -> None:
+        """Wall gap between consecutive steps that emitted decode-phase
+        tokens — what a whole-prompt prefill inflates and chunking bounds."""
+        self.decode_stall_s.append(seconds)
+        self._tick("serve.decode_stall_s", seconds)
 
     def observe_decode(self, num_tokens: int, seconds: float,
                        batch_width: int) -> None:
@@ -160,10 +189,22 @@ class ServingMetrics:
             if self.ttft_s else 0.0,
             "ttft_ms_p50": ms(_percentile(self.ttft_s, 50)),
             "ttft_ms_p95": ms(_percentile(self.ttft_s, 95)),
+            "ttft_ms_p99": ms(_percentile(self.ttft_s, 99)),
+            "ttft_under_load_ms_p50": ms(_percentile(self.ttft_under_load_s,
+                                                     50)),
+            "ttft_under_load_ms_p99": ms(_percentile(self.ttft_under_load_s,
+                                                     99)),
             "token_latency_ms_p50": ms(_percentile(self.token_latency_s, 50)),
             "token_latency_ms_p95": ms(_percentile(self.token_latency_s, 95)),
+            "decode_stall_ms_p50": ms(_percentile(self.decode_stall_s, 50)),
+            "decode_stall_ms_p99": ms(_percentile(self.decode_stall_s, 99)),
+            "decode_stall_ms_max": ms(max(self.decode_stall_s, default=0.0)),
+            "prefill_chunks": self.prefill_chunks,
             "queue_depth_max": max(self.queue_depth, default=0),
             "pool_occupancy_max": max(self.pool_occupancy, default=0.0),
             "batch_fill_mean": (sum(self.batch_fill) / len(self.batch_fill))
             if self.batch_fill else 0.0,
+            "mixed_step_fill_mean": (sum(self.mixed_step_fill)
+                                     / len(self.mixed_step_fill))
+            if self.mixed_step_fill else 0.0,
         }

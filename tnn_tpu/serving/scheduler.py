@@ -23,7 +23,7 @@ import enum
 import time
 from collections import deque
 from dataclasses import dataclass, field
-from typing import Deque, List, Optional
+from typing import Deque, Dict, List, Optional
 
 import numpy as np
 
@@ -75,6 +75,11 @@ class Request:
     state: RequestState = RequestState.QUEUED
     block_table: List[int] = field(default_factory=list)
     cache_len: int = 0                  # tokens resident in the KV pool
+    prefill_len: int = 0                # total tokens the current (re-)
+    #                                     prefill must push; while cache_len
+    #                                     is short of it the row is mid-
+    #                                     prefill and takes chunks, not
+    #                                     decode tokens (set at admission)
     next_token: Optional[int] = None    # sampled but not yet fed back
     out_tokens: List[int] = field(default_factory=list)
     preemptions: int = 0
@@ -107,6 +112,9 @@ class Request:
 class StepPlan:
     prefills: List[Request]
     decodes: List[Request]
+    #: rid -> live tokens to push this step for rows still mid-prefill
+    #: (empty in legacy whole-prompt mode)
+    chunks: Dict[int, int] = field(default_factory=dict)
 
 
 class Scheduler:
@@ -116,13 +124,23 @@ class Scheduler:
     cost 1 per running request and take priority; prefills fill the rest).
     A prompt longer than the whole budget is still admitted when it is the
     only work — otherwise it could never start.
+
+    ``chunk_size`` > 0 switches to Sarathi-style chunked prefill: prompts
+    enter the running set immediately and push at most ``chunk_size`` prompt
+    tokens per step, co-scheduled with the decode rows inside the same token
+    budget, so a long prompt never stalls the decode stream for a whole
+    prompt-length forward pass. 0 keeps the legacy whole-prompt admission.
     """
 
-    def __init__(self, max_batch_size: int = 8, token_budget: int = 2048):
+    def __init__(self, max_batch_size: int = 8, token_budget: int = 2048,
+                 chunk_size: int = 0):
         if max_batch_size < 1:
             raise ValueError("max_batch_size must be >= 1")
+        if chunk_size < 0:
+            raise ValueError("chunk_size must be >= 0")
         self.max_batch_size = int(max_batch_size)
         self.token_budget = int(token_budget)
+        self.chunk_size = int(chunk_size)
         self.waiting: Deque[Request] = deque()
         self.running: List[Request] = []  # admission order (oldest first)
 
@@ -144,10 +162,12 @@ class Scheduler:
     # -- planning -------------------------------------------------------------
 
     def schedule(self, pool) -> StepPlan:
-        """Plan one engine step: which queued requests to prefill-admit, and
-        the running set to decode. Admission is strictly FCFS — a blocked
+        """Plan one engine step: which queued requests to admit, and the
+        running set to decode. Admission is strictly FCFS — a blocked
         queue head blocks everyone behind it (no out-of-order admission, so
         no starvation)."""
+        if self.chunk_size:
+            return self._schedule_chunked(pool)
         budget = self.token_budget - len(self.running)
         prefills: List[Request] = []
         planned_blocks = 0
@@ -162,8 +182,54 @@ class Scheduler:
                 break  # over budget — admissible only as the sole work
             budget -= need
             planned_blocks += nb
+            req.prefill_len = need
             prefills.append(self.waiting.popleft())
         return StepPlan(prefills=prefills, decodes=list(self.running))
+
+    def _schedule_chunked(self, pool) -> StepPlan:
+        """Sarathi-style step packing: each decode-phase running row costs 1
+        budget token; running rows still mid-prefill take up to chunk_size
+        more of their prompt; what's left admits queued requests at chunk
+        granularity (FCFS). The oldest mid-prefill row always advances at
+        least one token, so held blocks are never idle; a sole request is
+        always admitted even with budget < 1 (it could never start
+        otherwise, mirroring the legacy over-budget rule)."""
+        chunks: Dict[int, int] = {}
+        budget = self.token_budget
+        prefilling: List[Request] = []
+        for req in self.running:
+            if req.cache_len >= req.prefill_len:
+                budget -= 1          # decode-phase row: one token this step
+            else:
+                prefilling.append(req)
+        for i, req in enumerate(prefilling):
+            rem = req.prefill_len - req.cache_len
+            avail = budget if budget >= 1 else (1 if i == 0 else 0)
+            take = min(self.chunk_size, rem, avail)
+            if take <= 0:
+                continue
+            chunks[req.rid] = take
+            budget -= take
+        prefills: List[Request] = []
+        planned_blocks = 0
+        while self.waiting and \
+                len(self.running) + len(prefills) < self.max_batch_size:
+            req = self.waiting[0]
+            total = len(req.resume_tokens)
+            sole = not self.running and not prefills
+            if budget < 1 and not sole:
+                break
+            take = min(self.chunk_size, total, max(budget, 1))
+            nb = pool.blocks_for(take)
+            if planned_blocks + nb > pool.num_free:
+                break
+            req.prefill_len = total
+            chunks[req.rid] = take
+            budget -= take
+            planned_blocks += nb
+            prefills.append(self.waiting.popleft())
+        return StepPlan(prefills=prefills, decodes=list(self.running),
+                        chunks=chunks)
 
     # -- lifecycle callbacks (engine-driven) ----------------------------------
 
