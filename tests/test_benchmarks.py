@@ -119,11 +119,13 @@ def test_serve_bench_smoke():
     from benchmarks import serve_bench
 
     results = [r for r in serve_bench.main(["--smoke"]) if r]
-    assert len(results) == 4
+    assert len(results) == 6
     assert [r["bench"] for r in results] == ["serve_smoke_standard",
                                              "serve_smoke_paged",
                                              "serve_smoke_mixed_chunked",
-                                             "serve_smoke_mixed_whole"]
+                                             "serve_smoke_mixed_whole",
+                                             "serve_smoke_prefix_cached",
+                                             "serve_smoke_prefix_nocache"]
     for r in results:
         assert r["ms"] > 0
         assert r["tok_per_s"] > 0
@@ -137,6 +139,19 @@ def test_serve_bench_smoke():
     whole = next(r for r in results if r["bench"] == "serve_smoke_mixed_whole")
     assert chunked["prefill_chunks"] >= 3 * 6      # 24-token prompts, chunk 8
     assert whole["prefill_chunks"] == 0
+    # the prefix-cache A/B is live: 5 of 6 requests fork the 48-token shared
+    # prefix (the first publishes it), the nocache twin recomputes everything
+    # — and skipping that prefill must not make first tokens SLOWER
+    cached = next(r for r in results
+                  if r["bench"] == "serve_smoke_prefix_cached")
+    nocache = next(r for r in results
+                   if r["bench"] == "serve_smoke_prefix_nocache")
+    assert cached["prefill_tokens_saved"] == 5 * 48
+    assert cached["prefix_hits"] == 5 and cached["prefix_lookups"] == 6
+    assert 0 < cached["prefix_hit_rate"] < 1
+    assert nocache["prefill_tokens_saved"] == 0
+    assert nocache["prefix_lookups"] == 0
+    assert cached["ttft_ms_p50"] <= nocache["ttft_ms_p50"]
 
 
 def test_serve_bench_chaos():

@@ -17,8 +17,8 @@ import jax.numpy as jnp
 
 from tnn_tpu.serving import (TERMINAL_STATES, AdmissionRejected, FaultPlan,
                              InferenceEngine, PagedKVPool, PoolExhausted,
-                             Request, RequestState, Scheduler, gather_kv,
-                             scatter_prefill, scatter_token)
+                             PrefixCache, Request, RequestState, Scheduler,
+                             gather_kv, scatter_prefill, scatter_token)
 
 
 # -- pool bookkeeping ---------------------------------------------------------
@@ -226,7 +226,8 @@ class TestEngineTiny:
             assert out[rid] == _greedy_ref(model, params, p, 10,
                                            eng.assembly_len)
         assert eng.pool.num_allocated == 0
-        assert eng.pool.num_free == eng.pool.capacity
+        # drained: only free + prefix-cache-evictable blocks remain
+        assert eng.pool.num_free + eng.pool.num_evictable == eng.pool.capacity
 
     def test_mixed_sampling_params(self, tiny_lm):
         """Greedy and stochastic requests share one decode batch; stochastic
@@ -606,7 +607,8 @@ def test_gpt2_small_chunked_paged_matches_standard():
     assert eng_p.metrics.prefill_chunks > len(prompts), "prompts never split"
     assert paged == std
     assert eng_p.pool.num_allocated == 0
-    assert eng_p.pool.num_free == eng_p.pool.capacity
+    assert eng_p.pool.num_free + eng_p.pool.num_evictable == \
+        eng_p.pool.capacity
 
 
 # -- fault tolerance: invariants, lifecycle, backpressure, chaos --------------
@@ -614,12 +616,16 @@ def test_gpt2_small_chunked_paged_matches_standard():
 
 def _assert_drained(eng):
     """The chaos invariant: every submitted request terminal, no leaked
-    blocks, bookkeeping clean."""
+    blocks, bookkeeping clean. With the prefix cache on (the default),
+    a drained pool may hold zero-ref EVICTABLE blocks — reclaimable cached
+    KV — so the partition is free + evictable == capacity, allocated 0."""
     states = {r.rid: r.state for r in eng.requests.values()}
     assert all(s in TERMINAL_STATES for s in states.values()), states
     assert not eng.has_work
     assert eng.pool.num_allocated == 0
-    assert eng.pool.num_free == eng.pool.capacity
+    assert eng.pool.num_free + eng.pool.num_evictable == eng.pool.capacity
+    if eng.prefix_cache is None:
+        assert eng.pool.num_evictable == 0
     eng.check_invariants()
 
 
@@ -1055,3 +1061,394 @@ class TestChaos:
             if rid in out:
                 assert out[rid] == ref[ref_rid]
         _assert_drained(eng)
+
+
+# -- prefix cache: hash-chain index, evictable pool, engine-level reuse -------
+
+
+class TestPrefixCacheIndex:
+    """Host-side hash-chain unit tests — no engine, no device arrays."""
+
+    def test_chain_commits_to_whole_prefix(self):
+        pc = PrefixCache(block_size=4)
+        a = np.arange(8, dtype=np.int32)
+        b = a.copy()
+        b[0] ^= 1                       # differ only inside block 0
+        ka, kb = pc.chain_keys(a), pc.chain_keys(b)
+        assert ka[0] != kb[0]
+        assert ka[1] != kb[1], "block-1 key must commit to the whole prefix"
+
+    def test_no_false_sharing_on_divergent_prefix(self):
+        """Identical block-1 TOKENS under a different block 0 must not match
+        block 1 — the chain key commits to the entire preceding prefix."""
+        pc = PrefixCache(block_size=4)
+        a = np.arange(12, dtype=np.int32)
+        pc.publish(a, [3, 4, 5], 8)     # blocks 0 and 1 of `a` indexed
+        b = a.copy()
+        b[0] ^= 1                       # blocks 1+ identical to a's
+        assert pc.probe(b) == ([], 0, False)
+
+    def test_probe_returns_longest_indexed_chain(self):
+        pc = PrefixCache(block_size=4)
+        toks = np.arange(12, dtype=np.int32)
+        assert pc.probe(toks) == ([], 0, False)
+        pc.publish(toks, [5, 6, 7], 12)
+        ext = np.concatenate([toks, np.asarray([99], np.int32)])
+        assert pc.probe(ext) == ([5, 6, 7], 12, False)
+        div = ext.copy()
+        div[9] ^= 1                     # diverges inside block 2
+        assert pc.probe(div) == ([5, 6], 8, False)
+
+    def test_full_cover_probe_caps_for_cow(self):
+        """A fully-cached prompt still recomputes >= 1 token (it needs
+        logits to sample its first output), so probe caps cached_len at
+        total - 1 and flags that blocks[-1] needs a private COW copy."""
+        pc = PrefixCache(block_size=4)
+        toks = np.arange(8, dtype=np.int32)
+        pc.publish(toks, [3, 4], 8)
+        assert pc.probe(toks) == ([3, 4], 7, True)
+
+    def test_min_hit_blocks_filters_short_matches(self):
+        pc = PrefixCache(block_size=4, min_hit_blocks=2)
+        toks = np.arange(12, dtype=np.int32)
+        pc.publish(toks, [3, 4], 4)     # only block 0 is full-published
+        assert pc.probe(toks) == ([], 0, False)
+        pc.publish(toks, [3, 4], 8)     # now a 2-block chain
+        assert pc.probe(toks) == ([3, 4], 8, False)
+
+    def test_publish_first_wins_and_partial_excluded(self):
+        pc = PrefixCache(block_size=4)
+        toks = np.arange(10, dtype=np.int32)
+        assert pc.publish(toks, [3, 4, 5], 10) == 2  # block 2 partial: skipped
+        assert pc.publish(toks, [8, 9, 10], 10) == 0  # twin loses: dedupe
+        assert pc.probe(toks)[0] == [3, 4]
+
+    def test_drop_blocks_breaks_chain_at_parent(self):
+        pc = PrefixCache(block_size=4)
+        toks = np.arange(8, dtype=np.int32)
+        pc.publish(toks, [3, 4], 8)
+        pc.drop_blocks([3])             # parent reclaimed
+        ext = np.concatenate([toks, np.asarray([9], np.int32)])
+        assert pc.probe(ext) == ([], 0, False)   # probe walks from block 0
+        assert len(pc) == 1 and pc.contains_block(4)  # orphaned child entry
+        pc.drop_blocks([4, 99])         # unknown ids tolerated
+        assert len(pc) == 0 and not pc.contains_block(4)
+
+
+class TestEvictablePool:
+    """free() parks zero-ref cache-indexed blocks in an evictable LRU;
+    alloc() reclaims them on demand — cached KV never shrinks capacity."""
+
+    def _pool(self, **kw):
+        kw.setdefault("num_layers", 1)
+        kw.setdefault("num_kv_heads", 1)
+        kw.setdefault("head_dim", 2)
+        kw.setdefault("num_blocks", 8)
+        kw.setdefault("block_size", 4)
+        pool = PagedKVPool(**kw)
+        pool.evictable_filter = lambda b: True   # every block "indexed"
+        return pool
+
+    def test_free_parks_then_alloc_reclaims_lru(self):
+        pool = self._pool()
+        a = pool.alloc(3)
+        pool.free(a)
+        assert pool.num_evictable == 3 and pool.num_free == 4
+        assert pool.num_allocated == 0 and pool.num_allocatable == 7
+        pool.check_invariants([])
+        reclaimed = []
+        pool.reclaim_hook = reclaimed.extend
+        pool.alloc(6)                   # needs 2 beyond the free list
+        # free() parks deepest-first, so the LRU-oldest blocks are the
+        # chain TAIL: a[2] then a[1] go first, the parent a[0] survives
+        assert reclaimed == [a[2], a[1]]
+        assert pool.num_evictable == 1
+        pool.check_invariants()
+
+    def test_fork_revives_evictable(self):
+        pool = self._pool()
+        a = pool.alloc(2)
+        pool.free(a)
+        assert pool.is_evictable(a[0]) and pool.is_evictable(a[1])
+        table = pool.fork(a)            # cache hit on parked blocks
+        assert pool.num_evictable == 0 and pool.num_allocated == 2
+        pool.check_invariants([table])
+        pool.free(table)
+        assert pool.num_evictable == 2
+        pool.check_invariants([])
+
+    def test_filter_selects_which_blocks_park(self):
+        pool = self._pool()
+        a = pool.alloc(4)
+        indexed = {a[1], a[3]}
+        pool.evictable_filter = indexed.__contains__
+        pool.free(a)
+        assert pool.num_evictable == 2 and pool.num_free == 5
+        assert all(pool.is_evictable(b) for b in indexed)
+        pool.check_invariants([])
+
+    def test_exhaustion_counts_evictable_as_capacity(self):
+        pool = self._pool()
+        a = pool.alloc(7)
+        pool.free(a[:3])                # 3 evictable, 4 still held
+        assert pool.num_allocatable == 3 and pool.can_alloc(3)
+        with pytest.raises(PoolExhausted):
+            pool.alloc(4)               # beyond free + evictable
+        assert pool.num_evictable == 3, "failed alloc must reclaim nothing"
+        got = pool.alloc(3)             # exactly the cached pages
+        assert set(got) == set(a[:3])
+        pool.check_invariants()
+
+    def test_purge_evictable(self):
+        pool = self._pool()
+        dropped = []
+        pool.reclaim_hook = dropped.extend
+        a = pool.alloc(3)
+        pool.free(a)
+        assert sorted(pool.purge_evictable()) == sorted(a)
+        assert sorted(dropped) == sorted(a)
+        assert pool.num_evictable == 0 and pool.num_free == 7
+        pool.check_invariants([])
+
+    def test_invariants_catch_evictable_and_free(self):
+        pool = self._pool()
+        a = pool.alloc(2)
+        pool.free(a)
+        pool._free.append(a[0])         # corrupt: evictable AND free
+        with pytest.raises(ValueError, match="evictable and free"):
+            pool.check_invariants()
+
+    def test_invariants_catch_evictable_with_refcount(self):
+        pool = self._pool()
+        a = pool.alloc(1)
+        pool._evictable[a[0]] = None    # corrupt: allocated AND evictable
+        with pytest.raises(ValueError, match="evictable and allocated"):
+            pool.check_invariants()
+
+    def test_invariants_catch_use_after_free(self):
+        """A live table referencing an evictable block is use-after-free:
+        a reclaim would hand that page to another request mid-decode."""
+        pool = self._pool()
+        a = pool.alloc(2)
+        pool.free(a)
+        with pytest.raises(ValueError, match="use-after-free"):
+            pool.check_invariants([a])
+
+
+class TestPrefixCacheEngine:
+    """End-to-end KV reuse on the tiny model: cache-on must be token-exact
+    vs cache-off while measurably skipping prefill compute."""
+
+    KW = dict(num_blocks=32, block_size=4, max_batch_size=4, max_seq_len=32)
+
+    def _shared_prompts(self, n=4, prefix_len=12, tail_len=5, seed=0):
+        rng = np.random.default_rng(seed)
+        prefix = rng.integers(0, 128, prefix_len).astype(np.int32)
+        return [np.concatenate([prefix,
+                                rng.integers(0, 128, tail_len)
+                                .astype(np.int32)]) for _ in range(n)]
+
+    def _run(self, model, params, prompts, max_new=8, stagger=0, **kw):
+        merged = dict(self.KW)
+        merged.update(kw)
+        eng = InferenceEngine(model, params, **merged)
+        rids = []
+        for i, p in enumerate(prompts):
+            rids.append(eng.submit(p, max_new))
+            if stagger and i % stagger == stagger - 1:
+                eng.step()
+        out = eng.run_until_complete()
+        return eng, [out[r] for r in rids]
+
+    def test_cache_on_equals_cache_off_staggered(self, tiny_lm):
+        model, params = tiny_lm
+        prompts = self._shared_prompts()
+        eng_on, on = self._run(model, params, prompts, stagger=1)
+        eng_off, off = self._run(model, params, prompts, stagger=1,
+                                 prefix_cache=False)
+        assert on == off
+        assert eng_off.prefix_cache is None
+        assert eng_on.metrics.prefill_tokens_saved > 0, "cache never hit"
+        assert eng_off.metrics.prefill_tokens_saved == 0
+        s = eng_on.metrics.summary()
+        assert s["prefix_hit_rate"] > 0
+        assert s["prefill_tokens_saved"] == \
+            eng_on.metrics.prefill_tokens_saved
+        for p, toks in zip(prompts, on):
+            assert toks == _greedy_ref(model, params, p, 8, eng_on.assembly_len)
+        _assert_drained(eng_on)
+        _assert_drained(eng_off)
+
+    def test_cache_on_equals_cache_off_paged(self, tiny_lm):
+        """Same A/B over the paged decode path: forked tables must read
+        identically through the ragged paged-attention kernel."""
+        model, params = tiny_lm
+        prompts = self._shared_prompts(seed=1)
+        eng_on, on = self._run(model, params, prompts, stagger=1,
+                               decode_path="paged")
+        eng_off, off = self._run(model, params, prompts, stagger=1,
+                                 decode_path="paged", prefix_cache=False)
+        assert on == off
+        assert eng_on.metrics.prefill_tokens_saved > 0, "cache never hit"
+        _assert_drained(eng_on)
+        _assert_drained(eng_off)
+
+    def test_cache_on_equals_cache_off_under_preemption(self, tiny_lm):
+        """A pool too small for the shared-prefix batch: preemption churns
+        tables through free -> evictable -> revived, and outputs must stay
+        token-exact against cache-off AND the offline reference."""
+        model, params = tiny_lm
+        prompts = self._shared_prompts(seed=2)
+        kw = dict(num_blocks=9, block_size=4, max_batch_size=4,
+                  max_seq_len=32)
+        eng_on, on = self._run(model, params, prompts, **kw)
+        eng_off, off = self._run(model, params, prompts,
+                                 prefix_cache=False, **kw)
+        assert eng_on.metrics.preemptions > 0, "pool was never exhausted"
+        assert on == off
+        for p, toks in zip(prompts, on):
+            assert toks == _greedy_ref(model, params, p, 8, eng_on.assembly_len)
+        _assert_drained(eng_on)
+        _assert_drained(eng_off)
+
+    def test_cow_at_partial_block_boundary(self, tiny_lm):
+        """Resubmitting an identical prompt is a FULL-COVER hit: every full
+        block matches, so the matcher's first KV write (its recomputed last
+        token) would land inside the last matched block. The engine must
+        give it a private copy — and the published original must survive
+        intact for the next twin."""
+        model, params = tiny_lm
+        p = np.arange(8, dtype=np.int32)   # exactly 2 full blocks
+        eng = InferenceEngine(model, params, **self.KW)
+        ref = _greedy_ref(model, params, p, 8, eng.assembly_len)
+        r0 = eng.submit(p, 8)
+        assert eng.run_until_complete()[r0] == ref
+        assert eng.metrics.prefix_cows == 0
+        r1 = eng.submit(p, 8)
+        assert eng.run_until_complete()[r1] == ref
+        assert eng.metrics.prefix_cows == 1
+        assert eng.metrics.prefill_tokens_saved == 7  # all but the last token
+        r2 = eng.submit(p, 8)              # the COW copy stayed private:
+        assert eng.run_until_complete()[r2] == ref
+        assert eng.metrics.prefix_cows == 2
+        assert eng.metrics.prefill_tokens_saved == 14
+        _assert_drained(eng)
+
+    def test_eviction_under_pressure(self, tiny_lm):
+        """Distinct prompts through a small pool: cached blocks must be
+        reclaimed (LRU) to serve fresh allocations — the cache never
+        reduces usable capacity and never leaks."""
+        model, params = tiny_lm
+        eng = InferenceEngine(model, params, num_blocks=9, block_size=4,
+                              max_batch_size=2, max_seq_len=32)
+        dropped = []
+        inner = eng.pool.reclaim_hook
+        eng.pool.reclaim_hook = lambda bs: (dropped.extend(bs), inner(bs))
+        rng = np.random.default_rng(5)
+        for _ in range(4):
+            p = rng.integers(0, 128, 12).astype(np.int32)
+            rid = eng.submit(p, 6)
+            out = eng.run_until_complete()
+            assert out[rid] == _greedy_ref(model, params, p, 6,
+                                           eng.assembly_len)
+            eng.check_invariants()
+        assert dropped, "pool pressure never evicted a cached block"
+        assert len(eng.prefix_cache) <= eng.pool.capacity
+        _assert_drained(eng)
+
+    def test_min_hit_blocks_suppresses_short_hits(self, tiny_lm):
+        model, params = tiny_lm
+        prompts = self._shared_prompts(n=2, prefix_len=8, tail_len=5, seed=3)
+        eng, out = self._run(model, params, prompts, stagger=1,
+                             prefix_cache_min_hit_blocks=3)
+        assert eng.metrics.prefill_tokens_saved == 0  # 2-block prefix < 3
+        assert eng.metrics.prefix_hits == 0
+        for p, toks in zip(prompts, out):
+            assert toks == _greedy_ref(model, params, p, 8, eng.assembly_len)
+        _assert_drained(eng)
+
+    def test_stats_gauges(self, tiny_lm):
+        model, params = tiny_lm
+        prompts = self._shared_prompts(seed=4)
+        eng_on, _ = self._run(model, params, prompts, stagger=1)
+        s = eng_on.stats()
+        assert s["prefix_cache_enabled"]
+        assert s["prefix_indexed_blocks"] == len(eng_on.prefix_cache) > 0
+        assert s["pool_evictable_blocks"] == eng_on.pool.num_evictable > 0
+        eng_off, _ = self._run(model, params, prompts, prefix_cache=False)
+        s = eng_off.stats()
+        assert not s["prefix_cache_enabled"]
+        assert s["prefix_indexed_blocks"] == 0
+        assert s["pool_evictable_blocks"] == 0
+
+    def test_chaos_gate_shared_prefix(self, tiny_lm):
+        """The chaos gate re-run over a shared-prefix workload: alloc faults
+        and a poisoned decode row while publish/fork/COW/evict churn the
+        index. Every request terminal, survivors token-identical to the
+        fault-free run, zero leaked blocks, partition invariants clean."""
+        model, params = tiny_lm
+        rng = np.random.default_rng(11)
+        prefix = rng.integers(0, 128, 8).astype(np.int32)
+        prompts = [np.concatenate([prefix, rng.integers(0, 128, int(t))
+                                   .astype(np.int32)])
+                   for t in rng.integers(2, 8, 8)]
+        kw = dict(num_blocks=16, block_size=4, max_batch_size=4,
+                  max_seq_len=32)
+
+        def run(plan=None):
+            eng = InferenceEngine(model, params, faults=plan, **kw)
+            rids = [eng.submit(p, 8) for p in prompts]
+            eng.run_until_complete()
+            return eng, rids
+
+        ref_eng, ref_rids = run()
+        assert ref_eng.metrics.prefill_tokens_saved > 0, \
+            "workload never exercised the cache — dead test"
+        plan = FaultPlan(seed=21, alloc_fail_prob=0.12, nan_logit_calls=(5,))
+        eng, rids = run(plan)
+        assert plan.fired["pool.alloc"] >= 1, "chaos never fired — dead test"
+        states = [eng.result(r).state for r in rids]
+        assert all(s in TERMINAL_STATES for s in states)
+        assert RequestState.FINISHED in states, "no request survived"
+        out, ref = _finished(eng), _finished(ref_eng)
+        for rid, ref_rid in zip(rids, ref_rids):
+            if rid in out:
+                assert out[rid] == ref[ref_rid], f"survivor {rid} diverged"
+        _assert_drained(eng)
+        _assert_drained(ref_eng)
+
+
+def test_gpt2_small_prefix_cache_matches_uncached():
+    """Cache-on vs cache-off A/B on gpt2_small with chunk boundaries aligned
+    to the cached prefix (prefix = 1 block = 1 chunk): the sharers' uncached
+    tail chunk starts at the same position with the same width in both runs,
+    so the compiled programs match and exact token equality is well-posed
+    (the cached KV is bit-identical to what a recompute would produce — it
+    IS the publisher's pages)."""
+    from tnn_tpu.models.zoo import create
+
+    model = create("gpt2_small")
+    params = model.init(jax.random.PRNGKey(0), (1, 8))["params"]
+    rng = np.random.default_rng(0)
+    prefix = rng.integers(0, model.vocab_size, 16).astype(np.int32)
+    prompts = [np.concatenate([prefix,
+                               rng.integers(0, model.vocab_size, 8)
+                               .astype(np.int32)]) for _ in range(4)]
+
+    def run(cache):
+        eng = InferenceEngine(model, params, num_blocks=32, block_size=16,
+                              max_batch_size=4, max_seq_len=48,
+                              chunk_size=16, prefix_cache=cache)
+        rids = [eng.submit(prompts[0], 8)]
+        eng.step(); eng.step()      # r0's two chunks land; prefix published
+        rids += [eng.submit(p, 8) for p in prompts[1:]]
+        out = eng.run_until_complete()
+        return eng, [out[r] for r in rids]
+
+    eng_on, on = run(True)
+    eng_off, off = run(False)
+    assert on == off
+    assert eng_on.metrics.prefill_tokens_saved == 16 * 3  # one block each
+    assert eng_off.metrics.prefill_tokens_saved == 0
+    _assert_drained(eng_on)
+    _assert_drained(eng_off)

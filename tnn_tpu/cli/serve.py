@@ -88,7 +88,14 @@ def main(argv=None):
                          "decode rows in one compiled step)")
     ap.add_argument("--no-chunked-prefill", action="store_true",
                     help="restore the legacy whole-prompt prefill path "
-                         "(one bucketed prefill program per admitted prompt)")
+                         "(one bucketed prefill program per admitted prompt; "
+                         "also disables the prefix cache)")
+    ap.add_argument("--no-prefix-cache", action="store_true",
+                    help="disable automatic prefix caching (content-"
+                         "addressed KV block reuse across requests)")
+    ap.add_argument("--prefix-cache-min-hit-blocks", type=int, default=1,
+                    help="ignore prefix-cache matches shorter than this "
+                         "many full KV blocks")
     ap.add_argument("--max-seq-len", type=int, default=0,
                     help="per-request position cap (0 = model/pool limit)")
     ap.add_argument("--decode-path", default="auto",
@@ -125,6 +132,8 @@ def main(argv=None):
         model, params, num_blocks=args.num_blocks, block_size=args.block_size,
         max_batch_size=args.max_batch_size, chunk_size=args.chunk_size,
         chunked_prefill=not args.no_chunked_prefill,
+        prefix_cache=not args.no_prefix_cache,
+        prefix_cache_min_hit_blocks=args.prefix_cache_min_hit_blocks,
         max_seq_len=args.max_seq_len or None, decode_path=args.decode_path,
         max_queue_depth=args.max_queue_depth,
         preemption_budget=(None if args.preemption_budget < 0

@@ -16,12 +16,13 @@ from .faults import FaultInjected, FaultPlan
 from .kv_pool import (PagedKVPool, PoolExhausted, gather_kv, scatter_prefill,
                       scatter_token)
 from .metrics import ServingMetrics
+from .prefix_cache import PrefixCache
 from .scheduler import (TERMINAL_STATES, AdmissionRejected, Request,
                         RequestState, Scheduler, StepPlan)
 
 __all__ = [
     "InferenceEngine", "PagedKVPool", "PoolExhausted", "gather_kv",
-    "scatter_prefill", "scatter_token", "ServingMetrics", "Request",
-    "RequestState", "Scheduler", "StepPlan", "AdmissionRejected",
+    "scatter_prefill", "scatter_token", "ServingMetrics", "PrefixCache",
+    "Request", "RequestState", "Scheduler", "StepPlan", "AdmissionRejected",
     "TERMINAL_STATES", "FaultPlan", "FaultInjected",
 ]

@@ -42,6 +42,22 @@ DONATED through every jitted step (prefill and all decode paths), so XLA
 updates pages in place instead of copying the pool each token. See
 docs/serving.md for the full decode-path matrix.
 
+Automatic prefix caching (default on with chunked prefill; docs/serving.md
+has the full design): admission probes a content-addressed block index
+(serving/prefix_cache.py) with the request's prompt; matched blocks are
+``fork``ed into its block table — their tokens are already-resident KV and
+cost ZERO prefill compute — and only the uncached tail is chunk-prefilled.
+Full blocks completed by any prefill chunk are published back to the index.
+Released blocks whose content is still indexed park in the pool's evictable
+LRU instead of the free list and are reclaimed on demand, so the cache never
+reduces effective capacity. A fully-cached prompt keeps its last token out of
+the match (the recomputed tail produces the first-token logits) and takes a
+copy-on-write clone of the block that token writes into — indexed blocks are
+immutable. With the cache off (or legacy whole-prompt mode, which scatters
+whole prefills over its table and so cannot share blocks) behaviour and
+output streams are unchanged; with it on, outputs stay token-exact because
+matched KV is bit-identical to what the skipped prefill would have written.
+
 Fault tolerance (docs/serving.md has the full failure-mode matrix): every
 submitted request reaches a terminal state — FINISHED, FAILED, CANCELLED,
 or TIMED_OUT — and failures are isolated per request. A pool-alloc failure,
@@ -75,6 +91,7 @@ from . import kv_pool as kv_pool_lib
 from .faults import FaultInjected, FaultPlan
 from .kv_pool import PagedKVPool, PoolExhausted
 from .metrics import ServingMetrics
+from .prefix_cache import PrefixCache
 from .scheduler import (TERMINAL_STATES, AdmissionRejected, Request,
                         RequestState, Scheduler)
 
@@ -92,6 +109,12 @@ class InferenceEngine:
         widths are bucketed to powers of two for compile-cache boundedness).
     chunked_prefill : False restores the legacy whole-prompt prefill path
         (one bucketed prefill program per admitted prompt, decode separate).
+    prefix_cache : automatic prefix caching (requires chunked prefill; the
+        legacy path scatters whole prefills over its table, so it cannot
+        share blocks and silently runs uncached). False disables matching,
+        publishing, and the evictable pool entirely.
+    prefix_cache_min_hit_blocks : ignore cache matches shorter than this
+        many full blocks (a tiny hit still costs a fork + index churn).
     max_seq_len : per-request position cap (prompt + generated); defaults to
         the smaller of model.max_len and the pool's whole capacity.
     decode_path : "auto" | "standard" | "fused" | "paged" (see module
@@ -113,7 +136,8 @@ class InferenceEngine:
     def __init__(self, model, params, *, num_blocks: int = 64,
                  block_size: int = 16, max_batch_size: int = 8,
                  token_budget: int = 2048, chunk_size: int = 64,
-                 chunked_prefill: bool = True,
+                 chunked_prefill: bool = True, prefix_cache: bool = True,
+                 prefix_cache_min_hit_blocks: int = 1,
                  max_seq_len: Optional[int] = None,
                  decode_path: str = "auto", max_queue_depth: int = 0,
                  admission_policy: str = "reject",
@@ -136,6 +160,8 @@ class InferenceEngine:
             raise ValueError("preemption_budget must be >= 0 or None")
         if chunk_size < 1:
             raise ValueError("chunk_size must be >= 1")
+        if prefix_cache_min_hit_blocks < 1:
+            raise ValueError("prefix_cache_min_hit_blocks must be >= 1")
         self.max_queue_depth = int(max_queue_depth)
         self.admission_policy = admission_policy
         self.preemption_budget = preemption_budget
@@ -160,6 +186,17 @@ class InferenceEngine:
         self.scheduler = Scheduler(
             max_batch_size=max_batch_size, token_budget=token_budget,
             chunk_size=self.chunk_size if self.chunked_prefill else 0)
+        self.prefix_cache: Optional[PrefixCache] = None
+        if prefix_cache and self.chunked_prefill:
+            self.prefix_cache = PrefixCache(
+                block_size=block_size,
+                min_hit_blocks=prefix_cache_min_hit_blocks)
+            # pool.free parks still-indexed blocks in the evictable LRU;
+            # pool.alloc reports reclaimed ones so the index forgets them
+            self.pool.evictable_filter = self.prefix_cache.contains_block
+            self.pool.reclaim_hook = self.prefix_cache.drop_blocks
+        # the scheduler PROBES the cache (read-only) to budget admissions
+        self.scheduler.prefix_cache = self.prefix_cache
         self._last_decode_emit: Optional[float] = None
         self.profiler = profiler
         self.metrics = ServingMetrics(profiler)
@@ -309,6 +346,10 @@ class InferenceEngine:
             "num_running": len(self.scheduler.running),
             "pool_free_blocks": self.pool.num_free,
             "pool_allocated_blocks": self.pool.num_allocated,
+            "pool_evictable_blocks": self.pool.num_evictable,
+            "prefix_cache_enabled": self.prefix_cache is not None,
+            "prefix_indexed_blocks": (len(self.prefix_cache)
+                                      if self.prefix_cache is not None else 0),
             "decode_path": ("paged" if self._paged
                             else "fused" if self._fused is not None
                             else "standard"),
@@ -371,6 +412,12 @@ class InferenceEngine:
             for req in plan.prefills:
                 if not self._admit_chunked(req, events):
                     chunks.pop(req.rid, None)
+                elif req.rid in chunks:
+                    # the grant was budgeted against the scheduler's cache
+                    # probe; clamp to the tail actually left after the fork
+                    # (a COW alloc fault may have fallen back to uncached)
+                    chunks[req.rid] = min(chunks[req.rid],
+                                          req.prefill_len - req.cache_len)
             self._mixed_step(chunks, events)
         else:
             for req in plan.prefills:
@@ -531,7 +578,9 @@ class InferenceEngine:
     def _admit_chunked(self, req: Request, events) -> bool:
         """Chunked admission: no device work — the request joins the running
         set immediately and its prompt is pushed chunk by chunk inside the
-        mixed step (blocks are allocated per chunk, not up front)."""
+        mixed step (blocks are allocated per chunk, not up front). With the
+        prefix cache on, the cached prefix is forked first — those
+        positions are already-resident KV and are never prefilled."""
         nb_total = self.pool.blocks_for(req.prefill_len)
         if nb_total > self.blocks_per_seq:
             # unreachable via submit()'s validation (resume <= prompt +
@@ -543,8 +592,54 @@ class InferenceEngine:
                 f"{self.blocks_per_seq}", events, "failed")
             return False
         req.cache_len = 0
+        if self.prefix_cache is not None:
+            self._match_prefix(req)
         self.scheduler.admit(req)
         return True
+
+    def _cow_copy_fn(self):
+        def fn(pages_k, pages_v, src, dst):
+            return (pages_k.at[:, dst].set(pages_k[:, src]),
+                    pages_v.at[:, dst].set(pages_v[:, src]))
+
+        # donated + traced src/dst: one compile, in-place block copy
+        return jax.jit(fn, donate_argnums=(0, 1))
+
+    def _match_prefix(self, req: Request) -> None:
+        """Admission-time cache hit: fork the matched blocks into the
+        request's table and mark their positions resident, so the chunked
+        prefill pushes only the uncached tail.
+
+        A full-cover hit (``cow``) shares all but the last matched block
+        and clones that one — the recomputed last prompt token writes its
+        KV mid-block, and indexed blocks are immutable. If the clone's
+        allocation fails (pool pressure or an injected fault), the forked
+        references are released and the request admits uncached — a cache
+        miss, never a failure."""
+        seq = req.resume_tokens
+        blocks, cached, cow = self.prefix_cache.probe(seq)
+        self.metrics.observe_prefix_lookup(cached if blocks else 0, len(seq))
+        if not blocks:
+            return
+        table = self.pool.fork(blocks[:-1] if cow else blocks)
+        if cow:
+            try:
+                copy = self.pool.alloc(1)
+            except (PoolExhausted, FaultInjected):
+                if table:
+                    self.pool.free(table)
+                return
+            fn = self._jit.get(("cow",))
+            if fn is None:
+                fn = self._jit[("cow",)] = self._cow_copy_fn()
+            pk, pv = fn(self.pool.pages_k, self.pool.pages_v,
+                        jnp.asarray(blocks[-1], jnp.int32),
+                        jnp.asarray(copy[0], jnp.int32))
+            self.pool.update_pages(pk, pv)
+            table = table + copy
+            self.metrics.observe_prefix_cow()
+        req.block_table = table
+        req.cache_len = cached
 
     # -- decode ---------------------------------------------------------------
 
@@ -745,6 +840,13 @@ class InferenceEngine:
             take = takes[req.rid]
             req.cache_len += take
             self.metrics.observe_prefill_chunk(take)
+            if self.prefix_cache is not None:
+                # every block this chunk just FILLED is immutable now —
+                # index it so the next shared-prefix request forks it.
+                # Poisoned rows were terminated above, before cache_len
+                # advanced, so their blocks are never published.
+                self.prefix_cache.publish(req.resume_tokens,
+                                          req.block_table, req.cache_len)
             if req.cache_len < req.prefill_len:
                 continue            # more chunks to go; no token yet
             if req.out_tokens:
@@ -1044,6 +1146,12 @@ class InferenceEngine:
             self._terminate(req, RequestState.FAILED,
                             "KV pages lost to a failed step", events, "failed")
         self.pool.reset_pages()
+        if self.prefix_cache is not None:
+            # the re-zeroed pages no longer hold the indexed KV: purge the
+            # evictable pool (reclaim_hook unindexes) and drop any entries
+            # still covering live-at-failure blocks
+            self.pool.purge_evictable()
+            self.prefix_cache.clear()
 
     def _maybe_finish(self, req: Request, tok: int, events) -> None:
         if req.stop_token is not None and tok == req.stop_token:

@@ -44,8 +44,8 @@ from __future__ import annotations
 
 import math
 import os
-from collections import Counter
-from typing import Dict, Iterable, List, Optional, Sequence
+from collections import Counter, OrderedDict
+from typing import Callable, Dict, Iterable, List, Optional, Sequence
 
 import jax.numpy as jnp
 
@@ -77,6 +77,18 @@ class PagedKVPool:
         # are warmest); block 0 never enters it
         self._free: List[int] = list(range(self.num_blocks - 1, 0, -1))
         self._ref: Dict[int, int] = {}
+        # evictable LRU (insertion order = eviction order, oldest first):
+        # blocks whose refcount dropped to zero but whose KV content is still
+        # indexed by the prefix cache. They hold no reference, count as
+        # reclaimable capacity, and alloc() recycles them on demand — so
+        # caching never shrinks the pool, it only delays page reuse.
+        self._evictable: "OrderedDict[int, None]" = OrderedDict()
+        # prefix-cache hooks (both None when caching is off): free() parks a
+        # zero-ref block in the evictable LRU iff evictable_filter(block) is
+        # True; reclaim_hook(blocks) is told when evictable blocks are
+        # recycled so the cache can drop their index entries.
+        self.evictable_filter: Optional[Callable[[int], bool]] = None
+        self.reclaim_hook: Optional[Callable[[List[int]], None]] = None
         # chaos hook: when set (serving.faults.FaultPlan), alloc() consults
         # it and may raise an injected PoolExhausted before mutating state
         self.fault_plan = None
@@ -96,11 +108,23 @@ class PagedKVPool:
         return len(self._free)
 
     @property
+    def num_evictable(self) -> int:
+        """Zero-ref blocks parked for the prefix cache (reclaimable)."""
+        return len(self._evictable)
+
+    @property
+    def num_allocatable(self) -> int:
+        """Blocks an alloc() can take right now: free + evictable."""
+        return len(self._free) + len(self._evictable)
+
+    @property
     def num_allocated(self) -> int:
-        return self.capacity - len(self._free)
+        return self.capacity - len(self._free) - len(self._evictable)
 
     @property
     def occupancy(self) -> float:
+        """Fraction of capacity held by live requests (evictable blocks are
+        reclaimable, so they count as available, not occupied)."""
         return self.num_allocated / max(self.capacity, 1)
 
     def blocks_for(self, num_tokens: int) -> int:
@@ -108,74 +132,130 @@ class PagedKVPool:
         return max(1, math.ceil(num_tokens / self.block_size))
 
     def can_alloc(self, n: int) -> bool:
-        return n <= len(self._free)
+        return n <= len(self._free) + len(self._evictable)
+
+    def is_evictable(self, block: int) -> bool:
+        return block in self._evictable
 
     def alloc(self, n: int) -> List[int]:
-        """Take ``n`` blocks (refcount 1 each); raises PoolExhausted."""
-        if n > len(self._free):
+        """Take ``n`` blocks (refcount 1 each); raises PoolExhausted.
+
+        Under pressure the free list is topped up by reclaiming LRU-oldest
+        evictable blocks first (``reclaim_hook`` is told so the prefix cache
+        drops their index entries) — cached pages are recycled before any
+        allocation can fail."""
+        if n > len(self._free) + len(self._evictable):
             raise PoolExhausted(
-                f"need {n} blocks, {len(self._free)} free "
-                f"(capacity {self.capacity})")
+                f"need {n} blocks, {len(self._free)} free + "
+                f"{len(self._evictable)} evictable (capacity {self.capacity})")
         if self.fault_plan is not None:
             # may raise an injected PoolExhausted; fires BEFORE any state
-            # mutation so a rejected alloc never half-takes blocks
-            self.fault_plan.on_alloc(n, len(self._free))
+            # mutation so a rejected alloc never half-takes blocks (nor
+            # evicts cache entries for an allocation that never happens)
+            self.fault_plan.on_alloc(n, self.num_allocatable)
+        if n > len(self._free):
+            self._reclaim(n - len(self._free))
         blocks = [self._free.pop() for _ in range(n)]
         for b in blocks:
             self._ref[b] = 1
         return blocks
 
+    def _reclaim(self, n: int) -> List[int]:
+        """Move ``n`` LRU-oldest evictable blocks to the free list and
+        notify ``reclaim_hook`` (their cached KV is gone for good)."""
+        taken = []
+        for _ in range(n):
+            b, _ = self._evictable.popitem(last=False)
+            taken.append(b)
+            self._free.append(b)
+        if taken and self.reclaim_hook is not None:
+            self.reclaim_hook(taken)
+        return taken
+
     def fork(self, blocks: Sequence[int]) -> List[int]:
         """Share ``blocks`` with another sequence (copy-on-write prefix
-        reuse): bump each refcount; the caller stores the same ids."""
+        reuse): bump each refcount; the caller stores the same ids.
+        An EVICTABLE block is revived — a prefix-cache hit on a block no
+        live request holds pulls it back to refcount 1."""
         for b in blocks:
-            if b not in self._ref:
+            if b in self._ref:
+                self._ref[b] += 1
+            elif b in self._evictable:
+                del self._evictable[b]
+                self._ref[b] = 1
+            else:
                 raise KeyError(f"block {b} is not allocated")
-            self._ref[b] += 1
         return list(blocks)
 
     def free(self, blocks: Sequence[int]) -> None:
         """Drop one reference per block; blocks reaching zero return to the
-        free list."""
-        for b in blocks:
+        free list — unless the prefix cache still indexes their content
+        (``evictable_filter``), in which case they park in the evictable
+        LRU. Blocks are processed deepest-first so a released table's chain
+        TAIL sits nearer the LRU's reclaim end than its parents (reclaiming
+        a parent first would orphan the children's index entries)."""
+        for b in reversed(list(blocks)):
             r = self._ref.get(b)
             if r is None:
                 raise KeyError(f"block {b} is not allocated (double free?)")
             if r == 1:
                 del self._ref[b]
-                self._free.append(b)
+                if (self.evictable_filter is not None
+                        and self.evictable_filter(b)):
+                    self._evictable[b] = None    # newest = last reclaimed
+                else:
+                    self._free.append(b)
             else:
                 self._ref[b] = r - 1
         if self.debug:
             self.check_invariants()
+
+    def purge_evictable(self) -> List[int]:
+        """Reclaim EVERY evictable block (cache invalidation: page content
+        became untrustworthy, e.g. after ``reset_pages``)."""
+        return self._reclaim(len(self._evictable))
 
     def check_invariants(
             self,
             block_tables: Optional[Iterable[Sequence[int]]] = None) -> None:
         """Verify the pool's bookkeeping; raises ValueError on violation.
 
-        Always checked: free + allocated == capacity, every refcount >= 1,
-        the scratch block is neither free nor allocated, no block is both
-        free and allocated, no duplicate free-list entries, all ids in range.
+        Always checked: free + allocated + evictable == capacity (a strict
+        three-way partition — no block in two sets, each evictable block in
+        the LRU exactly once with refcount 0, i.e. absent from ``_ref``),
+        every refcount >= 1, the scratch block never in circulation, no
+        duplicate free-list entries, all ids in range. Reclaim moves blocks
+        evictable -> free, so the partition is preserved by construction and
+        re-verified here after every mutation in debug mode.
 
         With ``block_tables`` (the live tables of every running request),
         additionally checks full accounting: each allocated block appears in
         exactly ``refcount`` live tables — no leaked blocks (allocated but
-        unreferenced) and no block shared beyond its refcount.
+        unreferenced) and no block shared beyond its refcount — and no live
+        table references an evictable or free block (use-after-free).
         """
         free_set = set(self._free)
         if len(free_set) != len(self._free):
             raise ValueError(f"duplicate blocks in free list: {self._free}")
-        if self.SCRATCH in free_set or self.SCRATCH in self._ref:
+        evict_set = set(self._evictable)
+        if (self.SCRATCH in free_set or self.SCRATCH in self._ref
+                or self.SCRATCH in evict_set):
             raise ValueError("scratch block 0 entered circulation")
         if free_set & self._ref.keys():
             raise ValueError(
                 f"blocks both free and allocated: {free_set & self._ref.keys()}")
-        if len(self._free) + len(self._ref) != self.capacity:
+        if evict_set & self._ref.keys():
             raise ValueError(
-                f"free ({len(self._free)}) + allocated ({len(self._ref)}) != "
-                f"capacity ({self.capacity})")
-        bad = [b for b in (free_set | self._ref.keys())
+                f"blocks both evictable and allocated (refcount != 0): "
+                f"{evict_set & self._ref.keys()}")
+        if evict_set & free_set:
+            raise ValueError(
+                f"blocks both evictable and free: {evict_set & free_set}")
+        if len(self._free) + len(self._ref) + len(evict_set) != self.capacity:
+            raise ValueError(
+                f"free ({len(self._free)}) + allocated ({len(self._ref)}) + "
+                f"evictable ({len(evict_set)}) != capacity ({self.capacity})")
+        bad = [b for b in (free_set | self._ref.keys() | evict_set)
                if not 1 <= b < self.num_blocks]
         if bad:
             raise ValueError(f"block ids out of range: {bad}")
@@ -186,6 +266,11 @@ class PagedKVPool:
             for table in block_tables:
                 usage.update(table)
             usage.pop(self.SCRATCH, None)   # padded entries are legal
+            stale = set(usage) & (evict_set | free_set)
+            if stale:
+                raise ValueError(
+                    f"live tables reference non-allocated blocks "
+                    f"(use-after-free): {sorted(stale)}")
             if set(usage) != set(self._ref) or any(
                     usage[b] != r for b, r in self._ref.items()):
                 leaked = set(self._ref) - set(usage)
@@ -207,7 +292,9 @@ class PagedKVPool:
         """Re-zero the device pages (fresh buffers). Recovery path for a
         failed jitted step whose DONATED page buffers died with it: the
         engine fails every request that held KV first, so only bookkeeping
-        (untouched here) and empty pages remain."""
+        (untouched here) and empty pages remain. Callers running a prefix
+        cache must also ``purge_evictable()`` and clear the cache index —
+        zeroed pages must never be matchable."""
         shape = (self.num_layers, self.num_blocks, self.num_kv_heads,
                  self.block_size, self.head_dim)
         self.pages_k = jnp.zeros(shape, self.dtype)

@@ -8,19 +8,40 @@ timeline covers both a training job and the serving engine colocated with it.
 """
 from __future__ import annotations
 
+import math
 import time
 from typing import Dict, List, Optional
 
 from ..profiling.profiler import Profiler
 
 
+def _finite(xs: List[float]) -> List[float]:
+    """Drop NaN/inf samples — a poisoned or clock-skewed observation must
+    degrade one sample, not the whole aggregate."""
+    return [x for x in xs if math.isfinite(x)]
+
+
 def _percentile(xs: List[float], q: float) -> float:
-    """Nearest-rank percentile without a numpy dependency on the hot path."""
-    if not xs:
+    """Nearest-rank percentile without a numpy dependency on the hot path.
+    NaN-safe: non-finite samples are ignored and an empty (or all-NaN)
+    series reports 0.0 instead of raising/propagating NaN — a cache-only
+    run with zero decode steps must not crash ``engine.stats()``."""
+    ys = sorted(_finite(xs))
+    if not ys:
         return 0.0
-    ys = sorted(xs)
     idx = min(len(ys) - 1, max(0, int(round(q / 100.0 * (len(ys) - 1)))))
     return ys[idx]
+
+
+def _mean(xs: List[float]) -> float:
+    """NaN-safe mean over the finite samples; 0.0 when none exist."""
+    ys = _finite(xs)
+    return sum(ys) / len(ys) if ys else 0.0
+
+
+def _max(xs: List[float]) -> float:
+    """NaN-safe max over the finite samples; 0.0 when none exist."""
+    return max(_finite(xs), default=0.0)
 
 
 class ServingMetrics:
@@ -42,6 +63,12 @@ class ServingMetrics:
         self.mixed_step_fill: List[float] = []
         self.prefill_tokens = 0
         self.prefill_chunks = 0
+        # prefix cache: admission-time lookups against the block index
+        self.prefix_lookups = 0
+        self.prefix_hits = 0
+        self.prefix_tokens_total = 0     # prompt tokens covered by lookups
+        self.prefill_tokens_saved = 0    # of those, served from cached KV
+        self.prefix_cows = 0             # private copies at full-cover hits
         self.decode_tokens = 0
         self.preemptions = 0
         self.preemptions_by_request: Dict[int, int] = {}
@@ -89,6 +116,24 @@ class ServingMetrics:
         self.prefill_chunks += 1
         self.prefill_tokens += num_tokens
         self._tick("serve.prefill_chunks", 1)
+
+    def observe_prefix_lookup(self, tokens_saved: int, total: int) -> None:
+        """One admission-time prefix-cache probe over a ``total``-token
+        prompt, of which ``tokens_saved`` positions matched cached KV and
+        will never be prefilled (0 on a miss)."""
+        self._mark()
+        self.prefix_lookups += 1
+        self.prefix_tokens_total += total
+        if tokens_saved > 0:
+            self.prefix_hits += 1
+            self.prefill_tokens_saved += tokens_saved
+        self._tick("serve.prefix_tokens_saved", tokens_saved)
+
+    def observe_prefix_cow(self) -> None:
+        """A fully-cached prompt took a private copy of its last matched
+        block (copy-on-write before the recomputed-token KV write)."""
+        self.prefix_cows += 1
+        self._tick("serve.prefix_cows", 1)
 
     def observe_mixed_step(self, live_tokens: int, width: int) -> None:
         """Packing efficiency of one mixed prefill+decode step: live tokens
@@ -167,7 +212,21 @@ class ServingMetrics:
         return self.decode_tokens / el if el > 0 else 0.0
 
     def summary(self) -> Dict[str, float]:
-        """One flat dict — the shape benchmarks/serve_bench.py reports."""
+        """One flat dict — the shape benchmarks/serve_bench.py reports.
+
+        Every aggregate is NaN-safe and defined on empty series (0.0), so a
+        run with zero decode steps — e.g. every prompt fully served from the
+        prefix cache and immediately finished — still summarizes cleanly.
+
+        Prefix-cache keys:
+
+        - ``prefill_tokens_saved``: prompt positions admitted straight from
+          cached KV blocks — prefill FLOPs that never ran.
+        - ``prefix_hit_rate``: ``prefill_tokens_saved`` over all prompt
+          tokens that went through a cache lookup (token-weighted, so one
+          long cached prompt counts for more than many short misses);
+          0.0 when the cache is off or no lookups happened.
+        """
         def ms(x):
             return x * 1e3
 
@@ -185,8 +244,14 @@ class ServingMetrics:
             "failed": self.failed,
             "step_retries": self.step_retries,
             "tok_per_s": self.tokens_per_s,
-            "ttft_ms_mean": ms(sum(self.ttft_s) / len(self.ttft_s))
-            if self.ttft_s else 0.0,
+            "prefix_lookups": self.prefix_lookups,
+            "prefix_hits": self.prefix_hits,
+            "prefix_cows": self.prefix_cows,
+            "prefill_tokens_saved": self.prefill_tokens_saved,
+            "prefix_hit_rate": (self.prefill_tokens_saved
+                                / self.prefix_tokens_total)
+            if self.prefix_tokens_total else 0.0,
+            "ttft_ms_mean": ms(_mean(self.ttft_s)),
             "ttft_ms_p50": ms(_percentile(self.ttft_s, 50)),
             "ttft_ms_p95": ms(_percentile(self.ttft_s, 95)),
             "ttft_ms_p99": ms(_percentile(self.ttft_s, 99)),
@@ -198,13 +263,10 @@ class ServingMetrics:
             "token_latency_ms_p95": ms(_percentile(self.token_latency_s, 95)),
             "decode_stall_ms_p50": ms(_percentile(self.decode_stall_s, 50)),
             "decode_stall_ms_p99": ms(_percentile(self.decode_stall_s, 99)),
-            "decode_stall_ms_max": ms(max(self.decode_stall_s, default=0.0)),
+            "decode_stall_ms_max": ms(_max(self.decode_stall_s)),
             "prefill_chunks": self.prefill_chunks,
             "queue_depth_max": max(self.queue_depth, default=0),
-            "pool_occupancy_max": max(self.pool_occupancy, default=0.0),
-            "batch_fill_mean": (sum(self.batch_fill) / len(self.batch_fill))
-            if self.batch_fill else 0.0,
-            "mixed_step_fill_mean": (sum(self.mixed_step_fill)
-                                     / len(self.mixed_step_fill))
-            if self.mixed_step_fill else 0.0,
+            "pool_occupancy_max": _max(self.pool_occupancy),
+            "batch_fill_mean": _mean(self.batch_fill),
+            "mixed_step_fill_mean": _mean(self.mixed_step_fill),
         }

@@ -1,0 +1,195 @@
+"""Automatic prefix caching: a content-addressed index of full KV blocks.
+
+Thousands of requests sharing a system prompt or few-shot preamble each
+prefill the same tokens from scratch; their KV is identical (KV at position
+``p`` depends only on tokens ``[0, p]``), and the paged pool already stores
+it in relocatable fixed-size blocks with refcounts (``kv_pool.fork``). This
+module adds the missing piece: given a new prompt, find the pool blocks that
+already hold its prefix's KV, so the engine forks them into the request's
+block table and chunk-prefills only the uncached tail.
+
+Content addressing is a **rolling hash chain** at full-block granularity:
+
+    key(block 0) = H(ROOT       || tokens[0 : bs])
+    key(block i) = H(key(i - 1) || tokens[i*bs : (i+1)*bs])
+
+Block ``i``'s key commits to the *entire* prefix, not just its own tokens —
+two prompts whose block-``i`` tokens agree but whose earlier tokens differ
+get different keys, so a lookup can never false-share KV (the hash-chain
+analogue of comparing whole prefixes, at O(1) amortized state per block).
+``H`` is blake2b-128; a collision (~2^-64 per pair) is the only way a wrong
+block could match, and the chain makes even that require a collision at the
+exact divergence point.
+
+A ``probe`` walks the chain over a prompt's full blocks until the first
+unindexed key — the longest cached prefix. Only FULL blocks are ever
+indexed: a block is published once prefill has written all ``block_size``
+of its positions, after which its content is immutable (decode writes land
+in later blocks; the copy-on-write rule in ``engine._match_prefix`` keeps
+it that way for the one case where a matcher's first write would land
+inside a matched block).
+
+Lifecycle: the index maps key -> block id but holds NO reference of its
+own. While some request holds the block its refcount keeps it allocated;
+when the last reference drops, ``PagedKVPool.free`` consults
+``evictable_filter`` (wired to :meth:`PrefixCache.contains_block`) and
+parks indexed blocks in the pool's evictable LRU instead of the free list
+— cached KV survives exactly as long as nobody needs the page. Under
+allocation pressure ``PagedKVPool.alloc`` reclaims LRU-oldest evictable
+blocks and reports them through ``reclaim_hook`` (wired to
+:meth:`PrefixCache.drop_blocks`), which unindexes them. The cache therefore
+never shrinks effective pool capacity: it only recycles otherwise-dead
+pages.
+
+Eviction order note: ``free`` parks a table's blocks deepest-first, so a
+chain's tail is reclaimed before its parents. A reclaimed parent would
+orphan its children's index entries (unreachable — ``probe`` walks from
+block 0 — but still occupying evictable pages until their own reclaim);
+tail-first reclaim avoids creating orphans in the common case.
+"""
+from __future__ import annotations
+
+import hashlib
+from typing import Dict, Iterable, List, Sequence, Tuple
+
+import numpy as np
+
+#: chain seed for block 0 (any fixed byte string distinct from real digests)
+ROOT_KEY = b"tnn-prefix-root"
+
+
+def block_key(prev_key: bytes, tokens: np.ndarray) -> bytes:
+    """One link of the rolling hash chain: commits to ``prev_key`` (the
+    whole preceding prefix) plus this block's tokens."""
+    h = hashlib.blake2b(digest_size=16)
+    h.update(prev_key)
+    h.update(np.ascontiguousarray(tokens, np.int32).tobytes())
+    return h.digest()
+
+
+class PrefixCache:
+    """Content-addressed full-block index over one ``PagedKVPool``.
+
+    Pure host-side bookkeeping — never touches device arrays. The engine
+    owns the policy (fork/COW/publish); the pool owns block lifetimes; this
+    class only answers "which pool block holds the KV for this exact
+    prefix block?".
+
+    ``min_hit_blocks`` ignores matches shorter than that many blocks — a
+    one-block hit saves little prefill but still costs a fork and (on the
+    miss path) index churn.
+    """
+
+    def __init__(self, block_size: int, min_hit_blocks: int = 1):
+        if block_size < 1:
+            raise ValueError(f"block_size must be >= 1, got {block_size}")
+        if min_hit_blocks < 1:
+            raise ValueError(
+                f"min_hit_blocks must be >= 1, got {min_hit_blocks}")
+        self.block_size = int(block_size)
+        self.min_hit_blocks = int(min_hit_blocks)
+        self._index: Dict[bytes, int] = {}     # chain key -> pool block id
+        self._key_of: Dict[int, bytes] = {}    # pool block id -> chain key
+
+    def __len__(self) -> int:
+        return len(self._index)
+
+    def contains_block(self, block: int) -> bool:
+        """Is this pool block indexed? (``PagedKVPool.evictable_filter``.)"""
+        return block in self._key_of
+
+    # -- lookup ---------------------------------------------------------------
+
+    def chain_keys(self, tokens: Sequence[int]) -> List[bytes]:
+        """Chain keys for every FULL block of ``tokens`` (partial tail
+        excluded — it has no stable key until filled)."""
+        toks = np.asarray(tokens, np.int32).reshape(-1)
+        bs = self.block_size
+        keys, key = [], ROOT_KEY
+        for i in range(len(toks) // bs):
+            key = block_key(key, toks[i * bs:(i + 1) * bs])
+            keys.append(key)
+        return keys
+
+    def probe(self, tokens: Sequence[int]) -> Tuple[List[int], int, bool]:
+        """Longest cached prefix of ``tokens`` at full-block granularity.
+
+        Returns ``(blocks, cached_len, cow)``:
+
+        - ``blocks``: pool block ids of the matched chain, table order
+          (empty when the match is shorter than ``min_hit_blocks``);
+        - ``cached_len``: prompt positions whose KV those blocks cover,
+          CAPPED at ``len(tokens) - 1`` — a fully-cached prompt must still
+          recompute its last token to produce first-token logits;
+        - ``cow``: True when that cap applied, i.e. the matcher's first KV
+          write (the recomputed last token) lands INSIDE ``blocks[-1]``, so
+          the engine must give it a private copy of that block (indexed
+          blocks are immutable).
+
+        Read-only: no refcounts move until the engine forks the result.
+        """
+        toks = np.asarray(tokens, np.int32).reshape(-1)
+        total = len(toks)
+        bs = self.block_size
+        blocks: List[int] = []
+        key = ROOT_KEY
+        for i in range(total // bs):
+            key = block_key(key, toks[i * bs:(i + 1) * bs])
+            b = self._index.get(key)
+            if b is None:
+                break
+            blocks.append(b)
+        if len(blocks) < self.min_hit_blocks:
+            return [], 0, False
+        cached = len(blocks) * bs
+        cow = cached >= total
+        if cow:
+            cached = total - 1
+        return blocks, cached, cow
+
+    # -- admission ------------------------------------------------------------
+
+    def publish(self, tokens: Sequence[int], block_table: Sequence[int],
+                cached_len: int) -> int:
+        """Index every full block a prefill has completed.
+
+        ``tokens`` is the request's full (resume) sequence, ``block_table``
+        its live table, ``cached_len`` how many positions are resident —
+        blocks ``i`` with ``(i+1) * block_size <= cached_len`` are full and
+        immutable from here on. First publisher wins: a key already indexed
+        (the request forked that block, or a twin request beat it to the
+        punch) keeps its existing block, so duplicates never enter the
+        index and the loser's private block drains to the free list when
+        released. Returns the number of newly indexed blocks.
+        """
+        toks = np.asarray(tokens, np.int32).reshape(-1)
+        bs = self.block_size
+        added = 0
+        key = ROOT_KEY
+        for i in range(min(cached_len, len(toks)) // bs):
+            key = block_key(key, toks[i * bs:(i + 1) * bs])
+            if key in self._index:
+                continue
+            blk = int(block_table[i])
+            if blk in self._key_of:
+                continue            # block already serves another chain
+            self._index[key] = blk
+            self._key_of[blk] = key
+            added += 1
+        return added
+
+    # -- invalidation ---------------------------------------------------------
+
+    def drop_blocks(self, blocks: Iterable[int]) -> None:
+        """Unindex reclaimed blocks (``PagedKVPool.reclaim_hook``).
+        Tolerant of unknown ids — reclaim may outrun the index on reset."""
+        for b in blocks:
+            key = self._key_of.pop(b, None)
+            if key is not None:
+                self._index.pop(key, None)
+
+    def clear(self) -> None:
+        """Drop the whole index — page CONTENT became invalid (e.g. the
+        pool was re-zeroed after a failed donated step)."""
+        self._index.clear()
+        self._key_of.clear()

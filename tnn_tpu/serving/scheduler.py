@@ -143,6 +143,12 @@ class Scheduler:
         self.chunk_size = int(chunk_size)
         self.waiting: Deque[Request] = deque()
         self.running: List[Request] = []  # admission order (oldest first)
+        # engine-wired PrefixCache (or None): admission PROBES it — read
+        # only, no refcount moves — to budget a request's first chunk and
+        # block demand against its cached prefix; the engine performs the
+        # actual fork/COW at admit time. Nothing runs between schedule()
+        # and admit, so both see the same index and agree exactly.
+        self.prefix_cache = None
 
     # -- queue state ----------------------------------------------------------
 
@@ -176,7 +182,7 @@ class Scheduler:
             req = self.waiting[0]
             need = len(req.resume_tokens)
             nb = pool.blocks_for(need)
-            if planned_blocks + nb > pool.num_free:
+            if planned_blocks + nb > pool.num_allocatable:
                 break
             if need > budget and (prefills or self.running):
                 break  # over budget — admissible only as the sole work
@@ -193,7 +199,14 @@ class Scheduler:
         granularity (FCFS). The oldest mid-prefill row always advances at
         least one token, so held blocks are never idle; a sole request is
         always admitted even with budget < 1 (it could never start
-        otherwise, mirroring the legacy over-budget rule)."""
+        otherwise, mirroring the legacy over-budget rule).
+
+        With a prefix cache, admission probes the index first: cached
+        prompt positions cost no chunk budget (their KV is already
+        resident) and matched blocks cost no new allocation — only the
+        uncached tail is budgeted. Reviving an EVICTABLE matched block does
+        consume reclaimable capacity, so it is counted against
+        ``pool.num_allocatable`` alongside fresh blocks."""
         chunks: Dict[int, int] = {}
         budget = self.token_budget
         prefilling: List[Request] = []
@@ -219,14 +232,24 @@ class Scheduler:
             sole = not self.running and not prefills
             if budget < 1 and not sole:
                 break
-            take = min(self.chunk_size, total, max(budget, 1))
-            nb = pool.blocks_for(take)
-            if planned_blocks + nb > pool.num_free:
+            cached = forked = revive = 0
+            if self.prefix_cache is not None:
+                mb, cached, cow = self.prefix_cache.probe(req.resume_tokens)
+                if mb:
+                    # a full-cover hit forks all but the last matched block
+                    # (the engine gives that one a fresh COW copy, counted
+                    # in nb below via blocks_for - forked)
+                    shared = mb[:-1] if cow else mb
+                    forked = len(shared)
+                    revive = sum(1 for b in shared if pool.is_evictable(b))
+            take = min(self.chunk_size, total - cached, max(budget, 1))
+            nb = pool.blocks_for(cached + take) - forked
+            if planned_blocks + nb + revive > pool.num_allocatable:
                 break
             req.prefill_len = total
             chunks[req.rid] = take
             budget -= take
-            planned_blocks += nb
+            planned_blocks += nb + revive
             prefills.append(self.waiting.popleft())
         return StepPlan(prefills=prefills, decodes=list(self.running),
                         chunks=chunks)
