@@ -30,8 +30,16 @@ failures + injected NaN logits) and asserts the fault-tolerance contract:
 every request terminal, zero leaked blocks, pool invariants clean. It is a
 robustness gate shaped like a benchmark row, so regressions show up in the
 same regression.csv pipeline as performance.
+
+Both modes end with a bench_load row: sustained closed-loop users plus
+open-loop background arrivals driven through the supervised runtime
+(``EngineSupervisor``) with one injected engine-loop crash — reporting
+goodput at a TTFT SLO, shed/rejected/restart counters, and
+drain_duration_s, and self-asserting the resilience contract (all
+requests terminal, zero leaks, clean exit-0 drain).
 """
 import argparse
+import itertools
 import time
 
 
@@ -220,6 +228,151 @@ def bench_chaos(model, params, *, num_requests: int, max_new: int,
                                    if st in TERMINAL_STATES))})
 
 
+def bench_load(model, params, *, closed_users: int, closed_turns: int,
+               open_requests: int, open_rate_per_s: float, prompt_len: int,
+               max_new: int, num_blocks: int, block_size: int,
+               max_batch_size: int, max_queue_depth: int, label: str,
+               seed: int = 0, slo_ttft_s: float = 2.0,
+               slo_stall_s: float = 1.0, crash_step: int = 0):
+    """Sustained mixed load through the SUPERVISED runtime (the other rows
+    drive a bare engine): ``closed_users`` closed-loop clients that resubmit
+    the moment their previous request terminates, plus ``open_requests``
+    open-loop Poisson arrivals at background priority 2 — so under pressure
+    the bounded queue sheds/rejects the open traffic first. ``crash_step``
+    injects one engine-loop crash mid-run, so the row's throughput includes
+    the supervisor's recovery cost and ``engine_restarts`` proves it
+    happened. Reports goodput at a TTFT SLO next to raw req/s, plus shed /
+    rejected / restart counts and drain_duration_s — the operational
+    counters an overloaded deployment is actually tuned by.
+
+    The row self-asserts the resilience contract (every accepted request
+    terminal, exactly one terminal event each, zero leaked blocks, clean
+    drain) so a robustness regression fails the suite, not just a number.
+    """
+    from tnn_tpu.serving import (TERMINAL_STATES, AdmissionRejected,
+                                 EngineSupervisor, FaultPlan, InferenceEngine,
+                                 ServingMetrics, ShuttingDown,
+                                 SupervisorState)
+
+    total_closed = closed_users * closed_turns
+    print(f"{label}: {closed_users} closed-loop users x {closed_turns} turns "
+          f"+ {open_requests} open-loop @ ~{open_rate_per_s}/s (priority 2), "
+          f"queue_depth {max_queue_depth}, "
+          f"crash at step {crash_step or 'off'}")
+    rng = np.random.default_rng(seed)
+    gaps = rng.exponential(1.0 / open_rate_per_s, open_requests)
+    # pre-drawn prompt pool: mk_prompt is called from both the main thread
+    # (open loop) and the worker thread (closed-loop resubmits), and a
+    # shared Generator must not be stepped concurrently
+    pool_prompts = rng.integers(
+        0, model.vocab_size,
+        (total_closed + open_requests + 8, prompt_len)).astype(np.int32)
+    next_prompt = itertools.count()
+
+    def mk_prompt():
+        return pool_prompts[next(next_prompt) % len(pool_prompts)]
+
+    engine = InferenceEngine(
+        model, params, num_blocks=num_blocks, block_size=block_size,
+        max_batch_size=max_batch_size, max_seq_len=prompt_len + max_new,
+        seed=seed, max_queue_depth=max_queue_depth)
+
+    # warm the compile caches, then reset metrics with the SLO thresholds
+    wid = engine.submit(mk_prompt(), 1)
+    engine.run_until_complete()
+    del engine.requests[wid]
+    engine.metrics = ServingMetrics(engine.profiler, slo_ttft_s=slo_ttft_s,
+                                    slo_stall_s=slo_stall_s)
+    if crash_step:
+        engine.faults = FaultPlan(seed=seed + 1,
+                                  step_crash_calls=(crash_step,))
+
+    sup = EngineSupervisor(engine, max_restarts=3, restart_backoff_s=0.0,
+                           drain_deadline_s=60.0)
+    counters = {"terminal": 0, "not_admitted": 0}
+    rids = []
+
+    def count_terminals(ev):  # worker thread is the only mutator
+        if ev["event"] != "token":
+            counters["terminal"] += 1
+
+    sup.event_sink = count_terminals
+
+    turns = [0] * closed_users
+
+    def start_user(uid):
+        def listener(ev):
+            if ev["event"] == "token":
+                return
+            turns[uid] += 1
+            if turns[uid] < closed_turns:
+                submit()
+
+        def submit():
+            # resubmits run inline on the worker thread (from the sweep)
+            try:
+                rids.append(sup.submit(mk_prompt(), max_new,
+                                       listener=listener, priority=0))
+            except (AdmissionRejected, ShuttingDown):
+                counters["not_admitted"] += 1
+                turns[uid] = closed_turns  # user gives up, not a hang
+
+        submit()
+
+    t0 = time.perf_counter()
+    sup.start()
+    for uid in range(closed_users):
+        start_user(uid)
+    for gap in gaps:  # open loop: background traffic, sheddable
+        time.sleep(float(gap))
+        try:
+            rids.append(sup.submit(mk_prompt(), max_new, priority=2))
+        except AdmissionRejected:
+            pass  # counted by metrics.rejected
+    deadline = time.monotonic() + 120.0
+    while (counters["terminal"] < len(rids)
+           or any(t < closed_turns for t in turns)):
+        if time.monotonic() > deadline:
+            raise RuntimeError(
+                f"load bench wedged: {counters['terminal']}/{len(rids)} "
+                f"terminal, turns {turns}")
+        time.sleep(0.01)
+    sup.request_drain("bench complete")
+    if not sup.join(timeout=60):
+        raise RuntimeError("supervisor failed to drain")
+    wall = time.perf_counter() - t0
+
+    # the resilience contract IS the gate
+    assert sup.state is SupervisorState.STOPPED and sup.exit_code == 0
+    states = [engine.result(r).state for r in rids]
+    assert all(st in TERMINAL_STATES for st in states), states
+    assert counters["terminal"] == len(rids), \
+        (counters["terminal"], len(rids))
+    assert engine.pool.num_allocated == 0, "leaked KV blocks under load"
+    engine.check_invariants()
+    if crash_step:
+        assert sup.restarts >= 1, "injected crash never tripped a restart"
+
+    s = engine.metrics.summary()
+    return report(
+        label, wall, items=len(rids), item_name="req",
+        extra={"finished": s["requests_finished"],
+               "goodput_at_slo": round(s["goodput_at_slo"], 4),
+               "slo_ttft_s": slo_ttft_s,
+               "stall_slo_violations": s["stall_slo_violations"],
+               "ttft_ms_p99": s["ttft_ms_p99"],
+               "decode_stall_ms_p99": s["decode_stall_ms_p99"],
+               "shed_requests": s["shed_requests"],
+               "rejected": s["rejected"],
+               "closed_not_admitted": counters["not_admitted"],
+               "engine_restarts": s["engine_restarts"],
+               "drain_duration_s": round(s["drain_duration_s"], 4),
+               "requests_total": len(rids),
+               "terminal": counters["terminal"],
+               "leaked_blocks": int(engine.pool.num_allocated),
+               "closed_requests": total_closed})
+
+
 def _smoke_model():
     """Tiny random GPT-2 (2L/32d/2h): engine mechanics without model weight."""
     from tnn_tpu.models.gpt2 import GPT2
@@ -284,6 +437,14 @@ def main(argv=None):
                 block_size=4, max_batch_size=4, cache=c,
                 label=f"serve_smoke_prefix_{t}"),
                 label=f"bench_prefix_{tag}")
+        # sustained closed+open-loop load through the supervised runtime,
+        # with one injected engine crash: goodput at the TTFT SLO, shed /
+        # rejected / restart counters, and the zero-leak drain contract
+        rr.add(lambda: bench_load(
+            model, params, closed_users=3, closed_turns=3, open_requests=12,
+            open_rate_per_s=60.0, prompt_len=6, max_new=6, num_blocks=16,
+            block_size=4, max_batch_size=4, max_queue_depth=4, crash_step=9,
+            label="serve_smoke_load"), label="bench_load")
         return rr.results
 
     from tnn_tpu import models
@@ -315,6 +476,13 @@ def main(argv=None):
             block_size=16, max_batch_size=8, cache=c,
             label=f"serve_{args.model}_prefix_{t}"),
             label=f"bench_prefix_{tag}")
+    # supervised sustained-load row at model scale (one injected crash)
+    rr.add(lambda: bench_load(
+        model, params, closed_users=4, closed_turns=max(2, n // 8),
+        open_requests=n, open_rate_per_s=args.rate * 2, prompt_len=32,
+        max_new=max_new, num_blocks=128, block_size=16, max_batch_size=8,
+        max_queue_depth=8, crash_step=12,
+        label=f"serve_{args.model}_load"), label="bench_load")
     return rr.results
 
 

@@ -119,19 +119,32 @@ def test_serve_bench_smoke():
     from benchmarks import serve_bench
 
     results = [r for r in serve_bench.main(["--smoke"]) if r]
-    assert len(results) == 6
+    assert len(results) == 7
     assert [r["bench"] for r in results] == ["serve_smoke_standard",
                                              "serve_smoke_paged",
                                              "serve_smoke_mixed_chunked",
                                              "serve_smoke_mixed_whole",
                                              "serve_smoke_prefix_cached",
-                                             "serve_smoke_prefix_nocache"]
-    for r in results:
+                                             "serve_smoke_prefix_nocache",
+                                             "serve_smoke_load"]
+    for r in results[:6]:                   # the latency/parity A/B rows
         assert r["ms"] > 0
         assert r["tok_per_s"] > 0
         assert r["ttft_ms_mean"] > 0
         assert r["ttft_ms_p99"] >= r["ttft_ms_p50"] > 0
         assert r["requests"] == 6
+    # the supervised sustained-load row: goodput at the TTFT SLO plus the
+    # resilience counters — the injected engine crash must have tripped
+    # exactly the supervisor (restarts >= 1) without leaking a block
+    load = results[6]
+    assert load["ms"] > 0 and load["req_per_s"] > 0
+    assert load["terminal"] == load["requests_total"]
+    assert load["finished"] >= 1
+    assert 0 <= load["goodput_at_slo"]
+    assert load["engine_restarts"] >= 1
+    assert load["leaked_blocks"] == 0
+    assert load["drain_duration_s"] >= 0
+    assert load["shed_requests"] >= 0 and load["rejected"] >= 0
     # the A/B is live: chunked really split prompts, whole never did (wall-
     # clock comparisons between the rows stay informational — CI CPU noise)
     chunked = next(r for r in results

@@ -1,0 +1,427 @@
+"""HTTP/SSE front-end tests: raw-socket clients against a real
+``ServingServer`` listening on an ephemeral port.
+
+The server runs on its own event-loop thread (as ``run_server`` would run
+it), the engine on the supervisor's worker thread, and each test drives a
+short-lived client loop via ``asyncio.run`` — so every hop crosses real
+thread and socket boundaries, exactly like production.
+
+Engine steps carry a small injected delay (``FaultPlan.step_delay_s``) so
+cancellation and disconnect tests have a genuine in-flight window to race
+against.
+"""
+import asyncio
+import json
+import threading
+import time
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+import jax
+
+from tnn_tpu.serving import (EngineSupervisor, FaultPlan, InferenceEngine,
+                             RequestState, ServingServer, SupervisorState)
+
+
+@pytest.fixture(scope="module")
+def tiny_lm():
+    from tnn_tpu.models.gpt2 import GPT2
+
+    model = GPT2(vocab_size=128, max_len=64, num_layers=2, d_model=32,
+                 num_heads=2)
+    params = model.init(jax.random.PRNGKey(0), (1, 8))["params"]
+    return model, params
+
+
+def _greedy_ref(model, params, prompt, max_new, max_len):
+    from tnn_tpu.models.gpt2 import generate
+
+    return np.asarray(generate(model, params, prompt[None], max_new,
+                               max_len=max_len))[0].tolist()
+
+
+# -- stack plumbing -----------------------------------------------------------
+
+
+def _start_stack(model, params, *, plan=None, engine_kw=None, sup_kw=None,
+                 server_kw=None):
+    ekw = dict(num_blocks=32, block_size=4, max_batch_size=4, max_seq_len=32,
+               max_queue_depth=8)
+    ekw.update(engine_kw or {})
+    eng = InferenceEngine(model, params, faults=plan, **ekw)
+    sup = EngineSupervisor(eng, **(sup_kw or {})).start()
+    loop = asyncio.new_event_loop()
+    thread = threading.Thread(target=loop.run_forever,
+                              name="server-loop", daemon=True)
+    thread.start()
+    srv = ServingServer(sup, port=0, **(server_kw or {}))
+    asyncio.run_coroutine_threadsafe(srv.start(), loop).result(timeout=30)
+    return SimpleNamespace(eng=eng, sup=sup, srv=srv, loop=loop,
+                           thread=thread, port=srv.port)
+
+
+def _stop_stack(st):
+    if not st.sup.finished:
+        st.sup.request_drain("test teardown")
+    st.sup.join(timeout=120)
+    asyncio.run_coroutine_threadsafe(st.srv.stop(1.0),
+                                     st.loop).result(timeout=30)
+    st.loop.call_soon_threadsafe(st.loop.stop)
+    st.thread.join(timeout=10)
+    st.loop.close()
+
+
+@pytest.fixture(scope="module")
+def stack(tiny_lm):
+    model, params = tiny_lm
+    st = _start_stack(model, params,
+                      plan=FaultPlan(step_delay_s=0.01))
+    yield st
+    _stop_stack(st)
+
+
+# -- raw clients --------------------------------------------------------------
+
+
+def _request_bytes(method, path, body=None):
+    payload = b"" if body is None else (
+        body if isinstance(body, bytes) else json.dumps(body).encode())
+    return (f"{method} {path} HTTP/1.1\r\nHost: t\r\n"
+            f"Content-Type: application/json\r\n"
+            f"Content-Length: {len(payload)}\r\n\r\n").encode() + payload
+
+
+async def _read_head(reader):
+    status = int((await reader.readline()).split()[1])
+    while (await reader.readline()) not in (b"\r\n", b""):
+        pass
+    return status
+
+
+async def _http(port, method, path, body=None):
+    """One-shot JSON request; the server closes after each response."""
+    reader, writer = await asyncio.open_connection("127.0.0.1", port)
+    writer.write(_request_bytes(method, path, body))
+    await writer.drain()
+    status = await _read_head(reader)
+    data = await reader.read()
+    writer.close()
+    try:
+        await writer.wait_closed()
+    except (ConnectionError, OSError):
+        pass
+    return status, (json.loads(data) if data else None)
+
+
+async def _read_sse(reader, limit=10_000):
+    """Read SSE events until the terminal one (anything not start/token)."""
+    events = []
+    for _ in range(limit):
+        ln = await reader.readline()
+        if not ln:
+            break
+        if not ln.startswith(b"data: "):
+            continue
+        ev = json.loads(ln[len(b"data: "):])
+        events.append(ev)
+        if ev.get("event") not in ("start", "token"):
+            break
+    return events
+
+
+async def _open_stream(port, body):
+    reader, writer = await asyncio.open_connection("127.0.0.1", port)
+    writer.write(_request_bytes("POST", "/v1/generate", body))
+    await writer.drain()
+    status = await _read_head(reader)
+    return reader, writer, status
+
+
+def _poll_state(eng, rid, timeout_s=60.0):
+    """Wait for a request to turn terminal (dict/attr reads are GIL-atomic
+    enough for a test-side poll; the worker owns all mutation)."""
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < timeout_s:
+        req = eng.requests.get(rid)
+        if req is not None and req.is_terminal:
+            return req
+        time.sleep(0.01)
+    raise AssertionError(f"request {rid} never reached a terminal state")
+
+
+# -- endpoint behavior --------------------------------------------------------
+
+
+def test_health_and_stats(stack):
+    async def go():
+        hs, health = await _http(stack.port, "GET", "/v1/health")
+        ss, stats = await _http(stack.port, "GET", "/v1/stats")
+        return hs, health, ss, stats
+
+    hs, health, ss, stats = asyncio.run(go())
+    assert hs == 200
+    assert health["status"] == "running" and not health["draining"]
+    assert health["uptime_s"] >= 0
+    assert ss == 200
+    assert stats["supervisor_state"] == "running"
+    assert stats["server_connections"] >= 2
+    assert "uptime_s" in stats and "engine_restarts" in stats
+
+
+def test_stream_generate_token_exact(stack, tiny_lm):
+    model, params = tiny_lm
+    prompt = list(range(1, 7))
+    ref = _greedy_ref(model, params, np.asarray(prompt, np.int32), 5,
+                      stack.eng.assembly_len)
+
+    async def go():
+        reader, writer, status = await _open_stream(
+            stack.port, {"tokens": prompt, "max_new_tokens": 5})
+        events = await _read_sse(reader)
+        writer.close()
+        return status, events
+
+    status, events = asyncio.run(go())
+    assert status == 200
+    assert events[0]["event"] == "start" and isinstance(events[0]["id"], int)
+    toks = [e["token"] for e in events if e["event"] == "token"]
+    done = events[-1]
+    assert done["event"] == "done"
+    assert done["tokens"] == ref == toks
+    assert done["finish_reason"] == "length"
+    assert done["ttft_ms"] >= 0
+
+
+def test_nonstream_generate(stack, tiny_lm):
+    model, params = tiny_lm
+    prompt = list(range(2, 8))
+    ref = _greedy_ref(model, params, np.asarray(prompt, np.int32), 4,
+                      stack.eng.assembly_len)
+    status, body = asyncio.run(_http(
+        stack.port, "POST", "/v1/generate",
+        {"tokens": prompt, "max_new_tokens": 4, "stream": False}))
+    assert status == 200
+    assert body["event"] == "done" and body["tokens"] == ref
+
+
+def test_cancel_endpoint_mid_stream(stack):
+    async def go():
+        reader, writer, status = await _open_stream(
+            stack.port, {"tokens": [3, 4, 5, 6], "max_new_tokens": 25})
+        assert status == 200
+        start = (await _read_sse(reader, limit=1))[0]
+        rid = start["id"]
+        cs, cancelled = await _http(stack.port, "POST", "/v1/cancel",
+                                    {"id": rid})
+        rest = await _read_sse(reader)
+        writer.close()
+        return cs, cancelled, rest
+
+    cs, cancelled, rest = asyncio.run(go())
+    assert cs == 200 and cancelled["cancelled"] is True
+    term = rest[-1]
+    assert term["event"] == "cancelled"
+    assert "cancelled via /v1/cancel" in term["reason"]
+
+
+def test_cancel_unknown_id_is_benign(stack):
+    status, body = asyncio.run(_http(stack.port, "POST", "/v1/cancel",
+                                     {"id": 10_000_000}))
+    assert status == 200 and body["cancelled"] is False
+
+
+def test_client_disconnect_cancels_request(stack):
+    before = stack.srv.disconnect_cancels
+
+    async def go():
+        reader, writer, status = await _open_stream(
+            stack.port, {"tokens": [7, 8, 9], "max_new_tokens": 25})
+        assert status == 200
+        start = (await _read_sse(reader, limit=1))[0]
+        # drop the connection mid-stream, ungracefully
+        writer.transport.abort()
+        return start["id"]
+
+    rid = asyncio.run(go())
+    req = _poll_state(stack.eng, rid)
+    assert req.state is RequestState.CANCELLED
+    assert "client disconnected" in req.error
+    t0 = time.monotonic()
+    while stack.srv.disconnect_cancels <= before and \
+            time.monotonic() - t0 < 10:
+        time.sleep(0.01)
+    assert stack.srv.disconnect_cancels > before
+
+
+def test_malformed_payloads_rejected_cleanly(stack):
+    """A seeded FaultPlan decides which requests a chaos client corrupts;
+    corrupted ones get 400s, clean ones still stream fine — malformed
+    input never takes down the server or leaks requests."""
+    plan = FaultPlan(seed=3, malformed_request_calls=(1, 3, 4))
+    garbage = [b"{not json", json.dumps({"tokens": "abc"}).encode(),
+               json.dumps({"prompt": 7}).encode(),
+               json.dumps({"nothing": True}).encode()]
+
+    async def go():
+        results = []
+        g = 0
+        for _ in range(6):
+            if plan.malformed_request():
+                status, body = await _http(
+                    stack.port, "POST", "/v1/generate",
+                    garbage[g % len(garbage)])
+                g += 1
+                results.append(("bad", status, body))
+            else:
+                status, body = await _http(
+                    stack.port, "POST", "/v1/generate",
+                    {"tokens": [5, 6, 7], "max_new_tokens": 2,
+                     "stream": False})
+                results.append(("ok", status, body))
+        return results
+
+    results = asyncio.run(go())
+    kinds = [k for k, _, _ in results]
+    assert kinds.count("bad") == 3
+    for kind, status, body in results:
+        if kind == "bad":
+            assert status == 400 and "error" in body
+        else:
+            assert status == 200 and body["event"] == "done"
+    hs, health = asyncio.run(_http(stack.port, "GET", "/v1/health"))
+    assert hs == 200, "server unhealthy after malformed traffic"
+
+
+def test_unknown_route_404(stack):
+    status, body = asyncio.run(_http(stack.port, "GET", "/v2/nope"))
+    assert status == 404
+
+
+def test_bad_sampling_param_400(stack):
+    status, body = asyncio.run(_http(
+        stack.port, "POST", "/v1/generate",
+        {"tokens": [1, 2], "temperature": "hot"}))
+    assert status == 400 and "temperature" in body["error"]
+
+
+# -- resilience paths (dedicated stacks) --------------------------------------
+
+
+def test_read_timeout_408(tiny_lm):
+    model, params = tiny_lm
+    st = _start_stack(model, params,
+                      server_kw=dict(read_timeout_s=0.2))
+    try:
+        async def go():
+            reader, writer = await asyncio.open_connection(
+                "127.0.0.1", st.port)
+            # say nothing: the server must time the read out, not hang
+            status = await asyncio.wait_for(_read_head(reader), 30)
+            writer.close()
+            return status
+
+        assert asyncio.run(go()) == 408
+    finally:
+        _stop_stack(st)
+
+
+def test_stalled_consumer_is_cancelled(tiny_lm):
+    """A consumer that stops reading trips write_timeout_s and its request
+    is cancelled — a stalled client must not pin KV blocks."""
+    model, params = tiny_lm
+    st = _start_stack(model, params,
+                      plan=FaultPlan(step_delay_s=0.02),
+                      server_kw=dict(write_timeout_s=0.2))
+    try:
+        # simulate a consumer whose socket never drains: every SSE write
+        # hangs past write_timeout_s
+        async def _never_drains(writer):
+            await asyncio.sleep(3600)
+
+        st.srv._drain = _never_drains
+
+        async def client():
+            reader, writer, _ = await _open_stream(
+                st.port, {"tokens": [1, 2, 3, 4], "max_new_tokens": 25})
+            # wait for the server to give up on us, reading nothing
+            t0 = time.monotonic()
+            while not st.srv.stall_cancels and time.monotonic() - t0 < 60:
+                await asyncio.sleep(0.02)
+            writer.close()
+
+        asyncio.run(client())
+        assert st.srv.stall_cancels >= 1
+        rid = max(st.eng.requests)
+        req = _poll_state(st.eng, rid)
+        assert req.state is RequestState.CANCELLED
+        assert "stalled consumer" in req.error
+    finally:
+        _stop_stack(st)
+    assert st.eng.pool.num_allocated == 0
+    st.eng.check_invariants()
+
+
+def test_backpressure_503_rejected(tiny_lm):
+    """Overload maps AdmissionRejected to a clean 503 {"rejected": true}
+    instead of an error page or a hang."""
+    model, params = tiny_lm
+    st = _start_stack(model, params,
+                      plan=FaultPlan(step_delay_s=0.05),
+                      engine_kw=dict(max_queue_depth=1))
+    try:
+        async def go():
+            return await asyncio.gather(*[
+                _http(st.port, "POST", "/v1/generate",
+                      {"tokens": [1, 2, 3], "max_new_tokens": 8,
+                       "stream": False})
+                for _ in range(5)])
+
+        results = asyncio.run(go())
+        rejected = [b for s, b in results if s == 503]
+        served = [b for s, b in results if s == 200]
+        assert rejected, "no request was shed under overload"
+        assert all(b.get("rejected") for b in rejected)
+        assert served, "every request was rejected — no backpressure, just dead"
+        assert all(b["event"] == "done" for b in served)
+    finally:
+        _stop_stack(st)
+
+
+def test_drain_over_http(tiny_lm):
+    """The SIGTERM path as a client sees it: drain starts mid-stream; the
+    in-flight stream still completes, new work gets 503 {"draining": true},
+    health goes 503, and the supervisor exits 0 with drain_duration_s."""
+    model, params = tiny_lm
+    st = _start_stack(model, params, plan=FaultPlan(step_delay_s=0.01))
+    try:
+        async def go():
+            reader, writer, status = await _open_stream(
+                st.port, {"tokens": [2, 3, 4, 5], "max_new_tokens": 20})
+            assert status == 200
+            start = (await _read_sse(reader, limit=1))[0]
+            # what loop.add_signal_handler does on SIGTERM:
+            st.sup.request_drain("SIGTERM received")
+            ds, dbody = await _http(st.port, "POST", "/v1/generate",
+                                    {"tokens": [1], "stream": False})
+            hs, health = await _http(st.port, "GET", "/v1/health")
+            rest = await _read_sse(reader)
+            writer.close()
+            return start, ds, dbody, hs, health, rest
+
+        start, ds, dbody, hs, health, rest = asyncio.run(go())
+        assert ds == 503 and dbody["draining"] is True
+        assert hs == 503 and health["status"] in ("draining", "stopped")
+        assert rest[-1]["event"] == "done", rest[-1]
+        assert len(rest[-1]["tokens"]) == 20
+        assert st.sup.join(timeout=120)
+        assert st.sup.state is SupervisorState.STOPPED
+        assert st.sup.exit_code == 0
+        assert st.sup.drain_duration_s is not None
+        assert st.eng.metrics.summary()["drain_duration_s"] == \
+            st.sup.drain_duration_s
+    finally:
+        _stop_stack(st)
+    assert st.eng.pool.num_allocated == 0
+    st.eng.check_invariants()

@@ -15,10 +15,12 @@ import pytest
 import jax
 import jax.numpy as jnp
 
-from tnn_tpu.serving import (TERMINAL_STATES, AdmissionRejected, FaultPlan,
-                             InferenceEngine, PagedKVPool, PoolExhausted,
-                             PrefixCache, Request, RequestState, Scheduler,
-                             gather_kv, scatter_prefill, scatter_token)
+from tnn_tpu.serving import (TERMINAL_STATES, AdmissionRejected, EngineCrash,
+                             EngineSupervisor, FaultPlan, InferenceEngine,
+                             PagedKVPool, PoolExhausted, PrefixCache, Request,
+                             RequestState, Scheduler, ShuttingDown,
+                             SupervisorState, gather_kv, scatter_prefill,
+                             scatter_token)
 
 
 # -- pool bookkeeping ---------------------------------------------------------
@@ -734,6 +736,62 @@ class TestFaultPlan:
         mask = plan.poison_rows(3)
         assert mask.tolist() == [True, False, False]
         assert plan.fired["decode.logits"] == 1
+
+    def test_connection_sites_are_deterministic(self):
+        """The client-side sites (disconnect / slow / malformed) draw from
+        the same seeded rng as the engine sites: identical seeds produce
+        identical fire traces, so a chaos soak replays bit-for-bit."""
+        def trace(plan):
+            return [(plan.client_disconnect(), plan.slow_consumer(),
+                     plan.malformed_request()) for _ in range(48)]
+
+        kw = dict(client_disconnect_prob=0.3, slow_consumer_prob=0.25,
+                  malformed_request_prob=0.2)
+        a = trace(FaultPlan(seed=5, **kw))
+        b = trace(FaultPlan(seed=5, **kw))
+        c = trace(FaultPlan(seed=6, **kw))
+        assert a == b
+        assert a != c
+        assert any(t[0] for t in a) and any(t[1] for t in a) \
+            and any(t[2] for t in a)
+        plan = FaultPlan(seed=5, **kw)
+        trace(plan)
+        assert plan.calls["client.disconnect"] == 48
+        assert plan.fired["client.disconnect"] == sum(t[0] for t in a)
+        assert plan.fired["client.slow"] == sum(t[1] for t in a)
+        assert plan.fired["client.malformed"] == sum(t[2] for t in a)
+
+    def test_scheduled_connection_calls_fire_exactly(self):
+        plan = FaultPlan(client_disconnect_calls=(2,),
+                         malformed_request_calls=(1, 3))
+        assert [plan.client_disconnect() for _ in range(3)] == \
+            [False, True, False]
+        assert [plan.malformed_request() for _ in range(3)] == \
+            [True, False, True]
+
+    def test_step_crash_fires_at_exact_call_and_escapes(self):
+        """EngineCrash is deliberately NOT FaultInjected — nothing inside
+        the engine may catch it (only the supervisor recovers)."""
+        from tnn_tpu.serving import FaultInjected
+
+        plan = FaultPlan(step_crash_calls=(3,))
+        plan.on_step()
+        plan.on_step()
+        with pytest.raises(EngineCrash, match="step #3"):
+            plan.on_step()
+        plan.on_step()                    # call 4: passes again
+        assert plan.fired["engine.step"] == 1
+        assert not issubclass(EngineCrash, FaultInjected)
+
+    def test_step_delay_calls_select_steps(self):
+        plan = FaultPlan(step_delay_s=0.02, step_delay_calls=(2,))
+        t0 = time.perf_counter()
+        plan.on_step()
+        fast = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        plan.on_step()
+        slow = time.perf_counter() - t0
+        assert slow >= 0.02 > fast
 
 
 class TestLifecycle:
@@ -1452,3 +1510,360 @@ def test_gpt2_small_prefix_cache_matches_uncached():
     assert eng_off.metrics.prefill_tokens_saved == 0
     _assert_drained(eng_on)
     _assert_drained(eng_off)
+
+
+# -- supervised runtime -------------------------------------------------------
+
+
+class TestSupervisor:
+    """The resilience layer above the engine: graceful drain, crash
+    recovery with a bounded restart budget, step-latency watchdog,
+    disconnect-cancel, overload shedding — all driven synchronously
+    (``run_sync``/``pump``) so every schedule is deterministic."""
+
+    KW = dict(num_blocks=32, block_size=4, max_batch_size=4, max_seq_len=32)
+
+    def _sup(self, tiny_lm, plan=None, *, engine_kw=None, **kw):
+        model, params = tiny_lm
+        ekw = dict(self.KW)
+        ekw.update(engine_kw or {})
+        eng = InferenceEngine(model, params, faults=plan, **ekw)
+        events = []
+        sup = EngineSupervisor(eng, event_sink=events.append,
+                               restart_backoff_s=0.0, **kw)
+        return sup, eng, events
+
+    @staticmethod
+    def _terminals(events):
+        return [e for e in events if e["event"] != "token"]
+
+    def test_graceful_drain_finishes_inflight(self, tiny_lm):
+        model, params = tiny_lm
+        sup, eng, events = self._sup(tiny_lm)
+        rng = np.random.default_rng(3)
+        prompts = [rng.integers(0, 128, n).astype(np.int32) for n in (5, 7, 4)]
+        refs = [_greedy_ref(model, params, p, 6, eng.assembly_len)
+                for p in prompts]
+        rids = [sup.submit(p, 6) for p in prompts]
+        sup.pump(2)                           # work now genuinely in flight
+        sup.request_drain("test drain")
+        assert sup.draining
+        with pytest.raises(ShuttingDown, match="draining"):
+            sup.submit(prompts[0], 2)
+        sup.run_sync()
+        assert sup.state is SupervisorState.STOPPED
+        assert sup.exit_code == 0
+        assert sup.drain_duration_s is not None
+        assert eng.metrics.summary()["drain_duration_s"] == \
+            sup.drain_duration_s
+        done = {e["id"]: e for e in events if e["event"] == "done"}
+        assert sorted(done) == sorted(rids)
+        assert len(self._terminals(events)) == len(rids)  # exactly one each
+        for rid, ref in zip(rids, refs):
+            assert done[rid]["tokens"] == ref
+            assert done[rid]["ttft_ms"] >= 0
+        with pytest.raises(ShuttingDown, match="stopped"):
+            sup.submit(prompts[0], 2)
+        _assert_drained(eng)
+
+    def test_drain_deadline_times_out_stragglers(self, tiny_lm):
+        plan = FaultPlan(step_delay_s=0.03)
+        sup, eng, events = self._sup(tiny_lm, plan, drain_deadline_s=0.02)
+        rids = [sup.submit(np.arange(5, dtype=np.int32) + i, 8)
+                for i in range(2)]
+        sup.pump(1)
+        sup.request_drain("deadline test")
+        sup.run_sync()
+        assert sup.state is SupervisorState.STOPPED  # drain is still clean
+        assert sup.exit_code == 0
+        touts = [e for e in self._terminals(events) if e["event"] == "timeout"]
+        assert touts, "no request hit the drain deadline"
+        assert all("drain deadline" in e["reason"] for e in touts)
+        assert len(self._terminals(events)) == len(rids)
+        assert eng.pool.num_allocated == 0
+        eng.check_invariants()
+
+    def test_watchdog_trips_and_recovers(self, tiny_lm):
+        """A wedged step (injected latency) is treated like a crash. The
+        engine is warmed with the exact same shapes first so compile time
+        never reaches the watchdog — only the injected delay does."""
+        model, params = tiny_lm
+        eng = InferenceEngine(model, params, **self.KW)
+        warm = [np.arange(5, dtype=np.int32), np.arange(6, dtype=np.int32)]
+        for p in warm:
+            eng.submit(p, 4)
+        eng.run_until_complete()
+        eng.faults = FaultPlan(step_delay_s=0.2, step_delay_calls=(2,))
+        events = []
+        sup = EngineSupervisor(eng, event_sink=events.append,
+                               watchdog_step_s=0.05, max_restarts=2,
+                               restart_backoff_s=0.0)
+        rids = [sup.submit(p, 4) for p in warm]
+        sup.run_sync()
+        assert sup.restarts == 1
+        assert sup.state is SupervisorState.RUNNING   # recovered, not dead
+        errs = {e["id"]: e for e in self._terminals(events)}
+        assert sorted(errs) == sorted(rids)
+        assert all(e["event"] == "error" and "watchdog" in e["reason"]
+                   for e in errs.values())
+        assert eng.metrics.summary()["engine_restarts"] == 1
+        assert eng.pool.num_allocated == 0
+        eng.check_invariants()
+        # the recovered engine still serves: a fresh request completes
+        # (watchdog off for this leg — a solo request hits decode buckets
+        # the warmup never compiled, and compiles must not count as wedges)
+        sup.watchdog_step_s = None
+        eng.faults = None
+        ref = _greedy_ref(model, params, warm[0], 4, eng.assembly_len)
+        rid = sup.submit(warm[0], 4)
+        sup.run_sync()
+        done = [e for e in events if e["event"] == "done" and e["id"] == rid]
+        assert len(done) == 1 and done[0]["tokens"] == ref
+
+    def test_engine_crash_restart_readmits_queued(self, tiny_lm):
+        """A crash fails in-flight work but QUEUED requests hold no KV
+        state: they survive the restart and finish token-exact — that is
+        the re-admission path."""
+        model, params = tiny_lm
+        plan = FaultPlan(step_crash_calls=(2,))
+        sup, eng, events = self._sup(tiny_lm, plan, max_restarts=2,
+                                     engine_kw=dict(max_batch_size=2))
+        rng = np.random.default_rng(9)
+        prompts = [rng.integers(0, 128, n).astype(np.int32)
+                   for n in (5, 6, 7, 8)]
+        refs = [_greedy_ref(model, params, p, 5, eng.assembly_len)
+                for p in prompts]
+        rids = [sup.submit(p, 5) for p in prompts]
+        sup.run_sync()
+        assert sup.restarts == 1
+        term = {e["id"]: e for e in self._terminals(events)}
+        assert sorted(term) == sorted(rids)
+        crashed = [r for r in rids if term[r]["event"] == "error"]
+        survived = [r for r in rids if term[r]["event"] == "done"]
+        assert crashed and survived       # batch of 2 died, queued 2 lived
+        assert all("engine restarted" in term[r]["reason"] for r in crashed)
+        for r in survived:
+            assert term[r]["tokens"] == refs[rids.index(r)]
+        _assert_drained(eng)
+
+    def test_restart_budget_exhaustion_fails_everything(self, tiny_lm):
+        plan = FaultPlan(step_crash_calls=(1, 2, 3))
+        sup, eng, events = self._sup(tiny_lm, plan, max_restarts=2)
+        rids = [sup.submit(np.arange(4, dtype=np.int32) + i, 4)
+                for i in range(2)]
+        sup.run_sync()
+        assert sup.state is SupervisorState.FAILED
+        assert sup.exit_code == 1
+        assert sup.restarts == 3          # two recoveries + the fatal one
+        term = {e["id"]: e for e in self._terminals(events)}
+        assert sorted(term) == sorted(rids)
+        assert all(e["event"] == "error" and
+                   "restart budget exhausted (2)" in e["reason"]
+                   for e in term.values())
+        with pytest.raises(ShuttingDown, match="failed"):
+            sup.submit(np.arange(4, dtype=np.int32), 2)
+        assert eng.pool.num_allocated == 0
+        eng.check_invariants()
+
+    def test_client_disconnect_cancels_request(self, tiny_lm):
+        """A front end consulting plan.client_disconnect() drops a client
+        mid-stream; cancelling from inside the listener (the sweep's
+        dispatch) must be re-entrant and emit exactly one terminal."""
+        model, params = tiny_lm
+        plan = FaultPlan(client_disconnect_calls=(2,))
+        sup, eng, events = self._sup(tiny_lm)
+        p0, p1 = np.arange(5, dtype=np.int32), np.arange(6, dtype=np.int32)
+        ref = _greedy_ref(model, params, p1, 6, eng.assembly_len)
+
+        def flaky_listener(ev):
+            if ev["event"] == "token" and plan.client_disconnect():
+                sup.cancel(ev["id"], "client disconnected mid-stream")
+
+        r0 = sup.submit(p0, 6, listener=flaky_listener)
+        r1 = sup.submit(p1, 6)
+        sup.run_sync()
+        term = {e["id"]: e for e in self._terminals(events)}
+        assert term[r0]["event"] == "cancelled"
+        assert "client disconnected" in term[r0]["reason"]
+        assert term[r1]["event"] == "done" and term[r1]["tokens"] == ref
+        assert len(self._terminals(events)) == 2
+        assert plan.fired["client.disconnect"] == 1
+        _assert_drained(eng)
+
+    def test_priority_shed_under_overload(self, tiny_lm):
+        """Backpressure degrades background traffic first: a full queue
+        sheds its least-important (largest priority value, newest) member
+        for a more-important arrival; equal priority still rejects."""
+        sup, eng, events = self._sup(
+            tiny_lm, engine_kw=dict(max_queue_depth=2))
+        p = np.arange(5, dtype=np.int32)
+        bg1 = sup.submit(p, 4, priority=5)
+        bg2 = sup.submit(p + 1, 4, priority=5)
+        fg = sup.submit(p + 2, 4, priority=0)     # sheds bg2 (newest bg)
+        with pytest.raises(AdmissionRejected):
+            sup.submit(p + 3, 4, priority=5)      # no one less important
+        sup.run_sync()
+        term = {e["id"]: e for e in self._terminals(events)}
+        assert term[bg2]["event"] == "error"
+        assert "shed under overload" in term[bg2]["reason"]
+        assert "priority 5" in term[bg2]["reason"]
+        assert term[bg1]["event"] == "done"
+        assert term[fg]["event"] == "done"
+        s = sup.stats()
+        assert s["shed_requests"] == 1
+        assert s["rejected"] == 1
+        assert s["supervisor_state"] == "running"
+        _assert_drained(eng)
+
+    def test_threaded_submit_stats_and_drain(self, tiny_lm):
+        """The worker-thread path: submits/stats marshalled through the
+        command queue, drain from another thread, clean join."""
+        model, params = tiny_lm
+        sup, eng, events = self._sup(tiny_lm)
+        p = np.arange(6, dtype=np.int32)
+        ref = _greedy_ref(model, params, p, 5, eng.assembly_len)
+        sup.start()
+        import queue as _q
+        got: "_q.Queue[dict]" = _q.Queue()
+        rid = sup.submit(p, 5, listener=got.put)
+        ev = got.get(timeout=60)
+        seen = [ev]
+        while ev["event"] == "token":
+            ev = got.get(timeout=60)
+            seen.append(ev)
+        assert ev["event"] == "done" and ev["tokens"] == ref
+        assert [e["token"] for e in seen[:-1]] == ref
+        assert sup.stats()["supervisor_state"] == "running"
+        sup.request_drain("test over")
+        assert sup.join(timeout=30)
+        assert sup.state is SupervisorState.STOPPED
+        assert sup.exit_code == 0
+        with pytest.raises(ShuttingDown):
+            sup.submit(p, 2)
+        assert rid in {e["id"] for e in events}
+        _assert_drained(eng)
+
+
+class TestDegradation:
+    """Overload degradation at the engine level: prefix-cache publish
+    suspension under pool pressure (shedding is covered above)."""
+
+    def test_publish_suspension_under_pool_pressure(self, tiny_lm):
+        model, params = tiny_lm
+        rng = np.random.default_rng(4)
+        prefix = rng.integers(0, 128, 8).astype(np.int32)
+        prompts = [np.concatenate([prefix,
+                                   rng.integers(0, 128, 4).astype(np.int32)])
+                   for _ in range(3)]
+        # threshold 0.0: any live allocation counts as pressure, so every
+        # publish is suspended and the index never grows
+        eng = InferenceEngine(model, params, num_blocks=32, block_size=4,
+                              max_batch_size=4, max_seq_len=32,
+                              prefix_publish_max_occupancy=0.0)
+        rids = [eng.submit(p, 4) for p in prompts]
+        out = eng.run_until_complete()
+        s = eng.stats()
+        assert s["prefix_indexed_blocks"] == 0
+        assert s["publish_suspended"] > 0
+        assert s["prefix_hits"] == 0
+        assert all(eng.result(r).state is RequestState.FINISHED
+                   for r in rids)
+        for r, p in zip(rids, prompts):
+            assert out[r] == _greedy_ref(model, params, p, 4,
+                                         eng.assembly_len)
+        _assert_drained(eng)
+
+    def test_default_threshold_publishes_normally(self, tiny_lm):
+        model, params = tiny_lm
+        rng = np.random.default_rng(4)
+        prefix = rng.integers(0, 128, 8).astype(np.int32)
+        prompts = [np.concatenate([prefix,
+                                   rng.integers(0, 128, 4).astype(np.int32)])
+                   for _ in range(3)]
+        eng = InferenceEngine(model, params, num_blocks=32, block_size=4,
+                              max_batch_size=4, max_seq_len=32)
+        for p in prompts:
+            eng.submit(p, 4)
+        eng.run_until_complete()
+        s = eng.stats()
+        assert s["prefix_indexed_blocks"] > 0
+        assert s["publish_suspended"] == 0
+        _assert_drained(eng)
+
+
+@pytest.mark.slow
+def test_chaos_soak_supervised(tiny_lm):
+    """The soak gate: hundreds of staggered requests through a supervised
+    engine with chaos on — alloc faults, NaN rows, client disconnects, and
+    one injected engine-loop crash. Asserts the full resilience contract:
+    every request reaches exactly one terminal event, the supervisor
+    recovers from the crash (restarts == 1) and drains cleanly, zero
+    leaked blocks, and fault-free survivors are token-identical to the
+    offline greedy reference."""
+    model, params = tiny_lm
+    rng = np.random.default_rng(42)
+    uniq = [rng.integers(0, 128, int(n)).astype(np.int32)
+            for n in rng.integers(4, 14, 8)]
+    max_new = 6
+    eng = InferenceEngine(model, params, num_blocks=32, block_size=4,
+                          max_batch_size=4, max_seq_len=32,
+                          max_queue_depth=24)
+    refs = {i: _greedy_ref(model, params, p, max_new, eng.assembly_len)
+            for i, p in enumerate(uniq)}
+    plan = FaultPlan(seed=7, alloc_fail_prob=0.02, nan_logit_prob=0.01,
+                     client_disconnect_prob=0.04, step_crash_calls=(60,))
+    eng.faults = plan
+    eng.pool.fault_plan = plan
+    events = []
+    sup = EngineSupervisor(eng, event_sink=events.append, max_restarts=3,
+                           restart_backoff_s=0.0, drain_deadline_s=60.0)
+
+    def flaky_listener(ev):
+        if ev["event"] == "token" and plan.client_disconnect():
+            sup.cancel(ev["id"], "client disconnected mid-stream")
+
+    n_requests, rejected, submitted = 200, 0, {}
+    for i in range(n_requests):
+        which = int(rng.integers(0, len(uniq)))
+        try:
+            rid = sup.submit(uniq[which], max_new, priority=i % 3,
+                             listener=flaky_listener)
+            submitted[rid] = which
+        except AdmissionRejected:
+            rejected += 1
+        sup.pump(1)                        # staggered: interleave with steps
+    sup.run_sync()
+    sup.request_drain("soak complete")
+    sup.run_sync()
+
+    # lifecycle: clean drain despite the injected crash
+    assert sup.state is SupervisorState.STOPPED
+    assert sup.exit_code == 0
+    assert sup.restarts == 1, f"expected exactly one restart: {sup.restarts}"
+    # every fault site actually exercised
+    assert plan.fired["engine.step"] == 1
+    assert plan.fired["pool.alloc"] > 0
+    assert plan.fired["decode.logits"] > 0
+    assert plan.fired["client.disconnect"] > 0
+    assert rejected + len(submitted) == n_requests
+    # exactly one terminal event per admitted request
+    terminals = [e for e in events if e["event"] != "token"]
+    per_rid = {}
+    for e in terminals:
+        per_rid[e["id"]] = per_rid.get(e["id"], 0) + 1
+    assert sorted(per_rid) == sorted(submitted)
+    assert all(c == 1 for c in per_rid.values()), per_rid
+    states = {rid: eng.result(rid).state for rid in submitted}
+    assert all(st in TERMINAL_STATES for st in states.values())
+    # zero leaks after crash recovery + drain
+    assert eng.pool.num_allocated == 0
+    eng.check_invariants()
+    # survivors are token-exact against the fault-free reference
+    finished = [e for e in terminals if e["event"] == "done"]
+    assert finished, "soak finished nothing"
+    for e in finished:
+        assert e["tokens"] == refs[submitted[e["id"]]], \
+            f"rid {e['id']} diverged from fault-free reference"
+    s = eng.stats()
+    assert s["engine_restarts"] == 1
+    assert s["drain_duration_s"] >= 0.0

@@ -1,20 +1,32 @@
 #!/usr/bin/env python
-"""Serving front-end over stdin/stdout JSON lines (no HTTP — pipe-friendly).
+"""Serving front end: stdin/stdout JSON lines, or HTTP/SSE with --http.
 
     echo '{"prompt": "The meaning of life is", "max_new_tokens": 16}' | \
         python -m tnn_tpu.cli.serve --model gpt2_small
 
-Each input line is one request:
+    python -m tnn_tpu.cli.serve --model gpt2_small --http 127.0.0.1:8100
+
+Both front ends are thin clients of the same supervised runtime
+(``serving.EngineSupervisor``): the engine steps on a worker thread behind
+a thread-safe command queue, wrapped with crash recovery (bounded restart
+budget + exponential backoff), an optional step-latency watchdog, and
+graceful drain. SIGINT/SIGTERM — and EOF on stdin — trigger the drain:
+admissions close, in-flight requests finish (or deadline out after
+--drain-deadline-s), every event is flushed, and the process exits 0.
+
+Each stdin line is one request:
 
     {"id": 3, "prompt": "text", "max_new_tokens": 32,
      "temperature": 0.8, "top_k": 40, "top_p": 0.9,
-     "deadline_s": 30.0, "max_queue_s": 5.0}
+     "deadline_s": 30.0, "max_queue_s": 5.0, "priority": 1}
     {"id": 4, "tokens": [464, 3616, 286], "max_new_tokens": 8}
     {"op": "cancel", "id": 4}
 
 ``tokens`` bypasses tokenization; ``prompt`` text uses --vocab (reference
-vocab.bin) when given, else byte-level ids. ``id`` defaults to a counter.
-``op: cancel`` aborts a queued or running request by its user id.
+vocab.bin) when given, else byte-level ids. ``id`` defaults to the engine
+request id. ``priority`` (smaller = more important) controls load shedding
+under --max-queue-depth backpressure. ``op: cancel`` aborts a queued or
+running request by its user id.
 
 Responses stream as the engine produces them, one JSON object per line:
 
@@ -23,24 +35,20 @@ Responses stream as the engine produces them, one JSON object per line:
      "finish_reason": "length", "ttft_ms": 12.3}
     {"event": "error", "id": 3, "reason": "..."}       (failed / rejected)
     {"event": "timeout", "id": 3, "reason": "..."}     (deadline expired)
-    {"event": "cancelled", "id": 3}
+    {"event": "cancelled", "id": 3, "reason": "..."}
 
-The server process is fault-tolerant by construction: a bad JSON line, a
-rejected submit (queue full under --max-queue-depth), or an engine-step
-failure emits a structured event and the loop keeps serving — one poisoned
-request can never kill the process (see docs/serving.md's failure-mode
-matrix).
-
-New requests are accepted WHILE earlier ones decode (continuous batching):
-stdin is polled between engine steps, so interleaved pipes work. On stdin
-EOF the engine drains remaining work, prints a stats summary to stderr,
-and exits.
+The server process is fault-tolerant by construction: a bad JSON line or a
+rejected submit emits a structured event and the loop keeps serving, and a
+crash of the engine loop itself is caught by the supervisor, which fails
+the in-flight requests with structured errors, resets the KV pool, and
+keeps serving the queue (see docs/serving.md's Operations section).
 """
 import argparse
 import json
+import queue
 import select
+import signal
 import sys
-import time
 
 
 from tnn_tpu.utils.platform import apply_env_platform  # noqa: E402
@@ -53,12 +61,11 @@ import numpy as np  # noqa: E402
 from tnn_tpu import checkpoint as ckpt_lib  # noqa: E402
 from tnn_tpu import models  # noqa: E402
 from tnn_tpu.data.tokenizer import Tokenizer  # noqa: E402
-from tnn_tpu.serving import AdmissionRejected, InferenceEngine  # noqa: E402
+from tnn_tpu.serving import (AdmissionRejected, EngineSupervisor,  # noqa: E402
+                             InferenceEngine, ShuttingDown, run_server)
 
 
 from tnn_tpu.cli import console_entry
-
-TERMINAL_EVENT = {"failed": "error", "timed_out": "timeout"}
 
 
 def _emit(obj):
@@ -76,6 +83,9 @@ def main(argv=None):
                     help="zoo name (used when --model-file is absent)")
     ap.add_argument("--model-file", default="", help=".tnn snapshot")
     ap.add_argument("--vocab", default="", help="vocab.bin (reference format)")
+    ap.add_argument("--http", default="",
+                    help="serve HTTP+SSE on HOST:PORT instead of stdin "
+                         "JSON lines (e.g. 127.0.0.1:8100)")
     ap.add_argument("--num-blocks", type=int, default=64,
                     help="KV pool size in blocks (1 is reserved scratch)")
     ap.add_argument("--block-size", type=int, default=16,
@@ -104,12 +114,23 @@ def main(argv=None):
                     help="default for requests that omit it")
     ap.add_argument("--max-queue-depth", type=int, default=0,
                     help="bounded admission: reject submits past this many "
-                         "waiting requests (0 = unbounded)")
+                         "waiting requests (0 = unbounded); priority-aware "
+                         "shedding displaces less-important queued work")
     ap.add_argument("--preemption-budget", type=int, default=16,
                     help="recompute preemptions a request may absorb before "
                          "it fails cleanly (-1 = unlimited)")
     ap.add_argument("--deadline-s", type=float, default=0.0,
                     help="default per-request wall deadline (0 = none)")
+    ap.add_argument("--watchdog-s", type=float, default=0.0,
+                    help="step-latency watchdog: a step exceeding this wall "
+                         "time restarts the engine (0 = off; set above "
+                         "worst-case compile time — cold steps compile)")
+    ap.add_argument("--max-restarts", type=int, default=2,
+                    help="engine crash/watchdog recoveries before the "
+                         "supervisor gives up and fails all requests")
+    ap.add_argument("--drain-deadline-s", type=float, default=30.0,
+                    help="graceful-drain budget: in-flight work past this "
+                         "deadline times out (0 = wait forever)")
     ap.add_argument("--no-logit-guard", action="store_true",
                     help="disable per-row non-finite logit detection")
     ap.add_argument("--seed", type=int, default=0)
@@ -146,6 +167,29 @@ def main(argv=None):
         print(f"standard decode path: {engine.fused_fallback_reason}",
               file=sys.stderr)
 
+    supervisor = EngineSupervisor(
+        engine, watchdog_step_s=args.watchdog_s or None,
+        max_restarts=args.max_restarts,
+        drain_deadline_s=args.drain_deadline_s or None)
+
+    if args.http:
+        host, _, port = args.http.rpartition(":")
+        code = run_server(supervisor, host=host or "127.0.0.1",
+                          port=int(port), tokenizer=tokenizer,
+                          default_max_new=args.max_new_tokens)
+        _print_summary(supervisor)
+        return code
+    return _serve_stdin(supervisor, model, tokenizer, args)
+
+
+def _serve_stdin(supervisor, model, tokenizer, args):
+    """Stdin JSON-lines loop as a thin client of the supervisor: requests
+    marshal onto the worker thread, events flow back through the sink
+    queue, and SIGINT/SIGTERM/EOF all converge on one graceful drain."""
+    engine = supervisor.engine
+    out_q: "queue.Queue" = queue.Queue()
+    supervisor.event_sink = out_q.put
+
     ids_by_rid = {}
     rid_by_user = {}
 
@@ -160,12 +204,10 @@ def main(argv=None):
         if req.get("op") == "cancel":
             user_id = req.get("id")
             rid = rid_by_user.get(user_id)
-            if rid is not None and engine.cancel(rid):
-                _emit({"event": "cancelled", "id": user_id})
-            else:
+            if rid is None or not supervisor.cancel(rid):
                 _emit({"event": "error", "id": user_id,
                        "reason": "cancel: unknown or already-terminal id"})
-            return
+            return  # on success the sweep emits the cancelled event
         try:
             if "tokens" in req:
                 ids = np.asarray(req["tokens"], np.int32)
@@ -175,7 +217,7 @@ def main(argv=None):
                 ids = np.frombuffer(req["prompt"].encode(), np.uint8).astype(
                     np.int32) % model.vocab_size
             deadline = req.get("deadline_s", args.deadline_s or None)
-            rid = engine.submit(
+            rid = supervisor.submit(
                 ids, int(req.get("max_new_tokens", args.max_new_tokens)),
                 temperature=float(req.get("temperature", 0.0)),
                 top_k=int(req.get("top_k", 0)),
@@ -183,10 +225,15 @@ def main(argv=None):
                 stop_token=req.get("stop_token"),
                 deadline_s=(float(deadline) if deadline else None),
                 max_queue_s=(float(req["max_queue_s"])
-                             if req.get("max_queue_s") else None))
+                             if req.get("max_queue_s") else None),
+                priority=int(req.get("priority", 0)))
         except AdmissionRejected as e:
             _emit({"event": "error", "id": req.get("id"),
                    "reason": str(e), "rejected": True})
+            return
+        except ShuttingDown as e:
+            _emit({"event": "error", "id": req.get("id"),
+                   "reason": str(e), "draining": True})
             return
         except (ValueError, KeyError, TypeError) as e:
             _emit({"event": "error", "id": req.get("id"), "reason": str(e)})
@@ -195,49 +242,58 @@ def main(argv=None):
         ids_by_rid[rid] = user_id
         rid_by_user[user_id] = rid
 
-    def drain_events(events):
-        for rid, tok in events["tokens"]:
-            _emit({"event": "token", "id": ids_by_rid[rid], "token": int(tok)})
-        for bucket, event in TERMINAL_EVENT.items():
-            for rid, reason in events[bucket]:
-                _emit({"event": event, "id": ids_by_rid.get(rid, rid),
-                       "reason": reason})
-        for rid in events["finished"]:
-            req = engine.result(rid)
-            done = {"event": "done", "id": ids_by_rid[rid],
-                    "tokens": [int(t) for t in req.out_tokens],
-                    "finish_reason": req.finish_reason,
-                    "ttft_ms": round((req.ttft_s or 0.0) * 1e3, 3)}
-            if tokenizer is not None:
-                done["text"] = tokenizer.decode(req.out_tokens)
-            _emit(done)
+    def emit_event(ev):
+        out = dict(ev)
+        rid = out.get("id")
+        out["id"] = ids_by_rid.get(rid, rid)
+        if ev.get("event") == "done" and tokenizer is not None:
+            out["text"] = tokenizer.decode(ev["tokens"])
+        _emit(out)
 
-    eof = False
-    t0 = time.perf_counter()
-    while not eof or engine.has_work:
-        # poll stdin: block while idle, only peek while the engine has work
-        while not eof and _stdin_ready(0.0 if engine.has_work else 0.2):
-            line = sys.stdin.readline()
-            if not line:
-                eof = True
-                break
-            if line.strip():
-                handle_line(line)
-        if not engine.has_work:
-            continue
-        try:
-            events = engine.step()
-        except Exception as e:  # noqa: BLE001 — keep serving: the engine
-            # isolates per-request faults internally; anything escaping here
-            # is reported and the loop continues (terminal states guarantee
-            # forward progress, so a poisoned step cannot spin forever)
-            _emit({"event": "error", "reason": f"engine step failed: {e}"})
-            continue
-        drain_events(events)
+    def flush_events():
+        while True:
+            try:
+                emit_event(out_q.get_nowait())
+            except queue.Empty:
+                return
 
-    dt = time.perf_counter() - t0
-    summary = engine.stats()
-    summary["wall_s"] = round(dt, 3)
+    supervisor.start()
+    old_handlers = {}
+    try:
+        for signum in (signal.SIGINT, signal.SIGTERM):
+            old_handlers[signum] = signal.signal(
+                signum, lambda s, f: supervisor.request_drain(
+                    f"{signal.Signals(s).name} received"))
+    except ValueError:
+        pass  # not the main thread (embedded use): signals stay external
+
+    try:
+        eof = False
+        while not supervisor.finished:
+            flush_events()
+            if eof or supervisor.draining:
+                supervisor.join(0.05)  # drain in progress: just wait
+                continue
+            if _stdin_ready(0.05):
+                line = sys.stdin.readline()
+                if not line:
+                    eof = True
+                    # EOF drains: in-flight work finishes instead of being
+                    # dropped on the floor by a process exit
+                    supervisor.request_drain("stdin EOF")
+                elif line.strip():
+                    handle_line(line)
+        flush_events()
+    finally:
+        for signum, handler in old_handlers.items():
+            signal.signal(signum, handler)
+
+    _print_summary(supervisor)
+    return supervisor.exit_code if supervisor.exit_code is not None else 0
+
+
+def _print_summary(supervisor):
+    summary = supervisor.stats()
     print("serve summary: " + json.dumps(
         {k: round(v, 3) if isinstance(v, float) else v
          for k, v in summary.items()}), file=sys.stderr)
@@ -247,4 +303,4 @@ cli = console_entry(main)
 
 
 if __name__ == "__main__":
-    main()
+    sys.exit(main() or 0)

@@ -8,21 +8,28 @@
 Fault tolerance: requests always reach a terminal state (FINISHED / FAILED
 / CANCELLED / TIMED_OUT), failures are isolated per request, admission is
 bounded (``max_queue_depth``), and ``faults.FaultPlan`` injects
-deterministic chaos for testing. See docs/serving.md for the architecture,
-request lifecycle, and failure-mode matrix.
+deterministic chaos for testing. ``EngineSupervisor`` wraps the step loop
+with crash recovery, a step-latency watchdog, and graceful drain;
+``server.ServingServer`` puts an asyncio HTTP/SSE front end over it. See
+docs/serving.md for the architecture, request lifecycle, failure-mode
+matrix, and operations guide.
 """
 from .engine import InferenceEngine
-from .faults import FaultInjected, FaultPlan
+from .faults import EngineCrash, FaultInjected, FaultPlan
 from .kv_pool import (PagedKVPool, PoolExhausted, gather_kv, scatter_prefill,
                       scatter_token)
 from .metrics import ServingMetrics
 from .prefix_cache import PrefixCache
 from .scheduler import (TERMINAL_STATES, AdmissionRejected, Request,
                         RequestState, Scheduler, StepPlan)
+from .server import ServingServer, run_server
+from .supervisor import EngineSupervisor, ShuttingDown, SupervisorState
 
 __all__ = [
     "InferenceEngine", "PagedKVPool", "PoolExhausted", "gather_kv",
     "scatter_prefill", "scatter_token", "ServingMetrics", "PrefixCache",
     "Request", "RequestState", "Scheduler", "StepPlan", "AdmissionRejected",
-    "TERMINAL_STATES", "FaultPlan", "FaultInjected",
+    "TERMINAL_STATES", "FaultPlan", "FaultInjected", "EngineCrash",
+    "EngineSupervisor", "SupervisorState", "ShuttingDown",
+    "ServingServer", "run_server",
 ]

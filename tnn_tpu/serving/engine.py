@@ -130,6 +130,10 @@ class InferenceEngine:
     logit_guard : per-row non-finite logit detection; a poisoned row FAILs
         its request while the rest of the batch keeps its tokens.
     faults : optional ``faults.FaultPlan`` for deterministic chaos testing.
+    prefix_publish_max_occupancy : degradation mode — suspend prefix-cache
+        publishes while live-request pool occupancy exceeds this fraction
+        (growing the evictable set under pressure just churns reclaims;
+        matching stays on). Counted in ``stats()["publish_suspended"]``.
     profiler : optional profiling.Profiler for span/counter wiring.
     """
 
@@ -143,6 +147,7 @@ class InferenceEngine:
                  admission_policy: str = "reject",
                  preemption_budget: Optional[int] = 16,
                  logit_guard: bool = True, faults: Optional[FaultPlan] = None,
+                 prefix_publish_max_occupancy: float = 0.95,
                  profiler: Optional[Profiler] = None, seed: int = 0):
         if getattr(model, "kv_cache_dtype", None):
             raise ValueError(
@@ -197,6 +202,7 @@ class InferenceEngine:
             self.pool.reclaim_hook = self.prefix_cache.drop_blocks
         # the scheduler PROBES the cache (read-only) to budget admissions
         self.scheduler.prefix_cache = self.prefix_cache
+        self.prefix_publish_max_occupancy = float(prefix_publish_max_occupancy)
         self._last_decode_emit: Optional[float] = None
         self.profiler = profiler
         self.metrics = ServingMetrics(profiler)
@@ -266,7 +272,8 @@ class InferenceEngine:
                temperature: float = 0.0, top_k: int = 0, top_p: float = 0.0,
                stop_token: Optional[int] = None,
                deadline_s: Optional[float] = None,
-               max_queue_s: Optional[float] = None) -> int:
+               max_queue_s: Optional[float] = None,
+               priority: int = 0) -> int:
         """Queue a generation request; returns its request id.
 
         ``deadline_s`` bounds the request's total wall time from submit;
@@ -276,6 +283,12 @@ class InferenceEngine:
         With ``max_queue_depth`` set, a full queue makes submit apply
         backpressure: policy "reject" raises ``AdmissionRejected``; policy
         "block" drives ``step()`` until a slot opens.
+
+        ``priority`` (smaller = more important) only matters under that
+        backpressure: before rejecting, submit sheds the least-important
+        queued request (strictly larger priority value) to make room — so
+        overload degrades background traffic first instead of uniformly.
+        Equal-priority traffic keeps the plain reject/block behavior.
         """
         prompt = np.asarray(prompt_ids, np.int32).reshape(-1)
         if prompt.size == 0:
@@ -294,9 +307,17 @@ class InferenceEngine:
         if self.max_queue_depth and \
                 self.scheduler.queue_depth >= self.max_queue_depth:
             if self.admission_policy == "reject":
-                self.metrics.observe_rejected()
-                raise AdmissionRejected(self.scheduler.queue_depth,
-                                        self.max_queue_depth)
+                victim = self.scheduler.shed_victim(int(priority))
+                if victim is None:
+                    self.metrics.observe_rejected()
+                    raise AdmissionRejected(self.scheduler.queue_depth,
+                                            self.max_queue_depth)
+                self._terminate(
+                    victim, RequestState.FAILED,
+                    f"shed under overload: queued at priority "
+                    f"{victim.priority}, displaced by a priority "
+                    f"{int(priority)} arrival")
+                self.metrics.observe_shed()
             # "block": drain our own queue — each step admits/expires work,
             # and the queue head is guaranteed admissible once the pool
             # drains (submit validated it fits alone), so this terminates
@@ -308,19 +329,21 @@ class InferenceEngine:
                       temperature=float(temperature), top_k=int(top_k),
                       top_p=float(top_p), stop_token=stop_token,
                       submit_time=time.perf_counter(),
-                      deadline_s=deadline_s, max_queue_s=max_queue_s)
+                      deadline_s=deadline_s, max_queue_s=max_queue_s,
+                      priority=int(priority))
         self.requests[rid] = req
         self.scheduler.submit(req)
         return rid
 
-    def cancel(self, rid: int) -> bool:
+    def cancel(self, rid: int, reason: str = "cancelled by client") -> bool:
         """Abort a queued or running request: frees its blocks, transitions
         it to CANCELLED. Returns False when the id is unknown or already
-        terminal (cancel races are benign)."""
+        terminal (cancel races are benign). ``reason`` lands in the
+        request's structured error (e.g. "client disconnected")."""
         req = self.requests.get(rid)
         if req is None or req.state in TERMINAL_STATES:
             return False
-        self._terminate(req, RequestState.CANCELLED, "cancelled by client")
+        self._terminate(req, RequestState.CANCELLED, reason)
         return True
 
     @property
@@ -350,6 +373,9 @@ class InferenceEngine:
             "prefix_cache_enabled": self.prefix_cache is not None,
             "prefix_indexed_blocks": (len(self.prefix_cache)
                                       if self.prefix_cache is not None else 0),
+            "prefix_publish_suspended_now": (
+                self.prefix_cache is not None
+                and self.pool.occupancy > self.prefix_publish_max_occupancy),
             "decode_path": ("paged" if self._paged
                             else "fused" if self._fused is not None
                             else "standard"),
@@ -844,9 +870,15 @@ class InferenceEngine:
                 # every block this chunk just FILLED is immutable now —
                 # index it so the next shared-prefix request forks it.
                 # Poisoned rows were terminated above, before cache_len
-                # advanced, so their blocks are never published.
-                self.prefix_cache.publish(req.resume_tokens,
-                                          req.block_table, req.cache_len)
+                # advanced, so their blocks are never published. Under pool
+                # pressure publishing is suspended (degradation mode): a
+                # bigger evictable set would just churn reclaims while live
+                # requests are fighting for blocks. Matching stays on.
+                if self.pool.occupancy > self.prefix_publish_max_occupancy:
+                    self.metrics.observe_publish_suspended()
+                else:
+                    self.prefix_cache.publish(req.resume_tokens,
+                                              req.block_table, req.cache_len)
             if req.cache_len < req.prefill_len:
                 continue            # more chunks to go; no token yet
             if req.out_tokens:
@@ -1142,16 +1174,41 @@ class InferenceEngine:
         dead = getattr(self.pool.pages_k, "is_deleted", lambda: False)()
         if not (dead or force):
             return
+        ev = self.abort_all("KV pages lost to a failed step")
+        for bucket in ("failed", "timed_out"):
+            events[bucket].extend(ev[bucket])
+
+    def abort_all(self, reason: str, *,
+                  state: RequestState = RequestState.FAILED,
+                  include_queued: bool = False,
+                  reset_pages: bool = True) -> Dict[str, List]:
+        """Supervisor-facing recovery: terminate every RUNNING request (and,
+        with ``include_queued``, every QUEUED one) with the structured
+        ``reason``, then — with ``reset_pages`` — re-zero the pool pages and
+        drop the prefix index (re-zeroed pages no longer hold the indexed
+        KV). The default leaves queued requests intact: a crash of the step
+        loop only loses in-flight KV state, so queued work is salvageable
+        and simply re-prefills after recovery.
+
+        Returns step-shaped event buckets so callers can report the
+        terminations the way ``step()`` would have."""
+        events: Dict[str, List] = {"tokens": [], "finished": [],
+                                   "failed": [], "timed_out": []}
+        bucket = "timed_out" if state is RequestState.TIMED_OUT else "failed"
         for req in list(self.scheduler.running):
-            self._terminate(req, RequestState.FAILED,
-                            "KV pages lost to a failed step", events, "failed")
-        self.pool.reset_pages()
-        if self.prefix_cache is not None:
-            # the re-zeroed pages no longer hold the indexed KV: purge the
-            # evictable pool (reclaim_hook unindexes) and drop any entries
-            # still covering live-at-failure blocks
-            self.pool.purge_evictable()
-            self.prefix_cache.clear()
+            self._terminate(req, state, reason, events, bucket)
+        if include_queued:
+            for req in list(self.scheduler.waiting):
+                self._terminate(req, state, reason, events, bucket)
+        if reset_pages:
+            self.pool.reset_pages()
+            if self.prefix_cache is not None:
+                # purge the evictable pool (reclaim_hook unindexes) and drop
+                # any entries still covering live-at-failure blocks
+                self.pool.purge_evictable()
+                self.prefix_cache.clear()
+            self._last_decode_emit = None
+        return events
 
     def _maybe_finish(self, req: Request, tok: int, events) -> None:
         if req.stop_token is not None and tok == req.stop_token:
@@ -1163,5 +1220,5 @@ class InferenceEngine:
         self.pool.free(req.block_table)
         req.block_table = []
         self.scheduler.finish(req, reason)
-        self.metrics.observe_finish()
+        self.metrics.observe_finish(req.ttft_s)
         events["finished"].append(req.rid)

@@ -17,7 +17,17 @@ indices:
   step's real logits, exercising the engine's logit guard exactly as a
   genuine numeric blowup would;
 - **artificial step latency**: ``time.sleep`` at the top of every engine
-  step, for deadline/queue-timeout tests that need wall time to pass.
+  step (or only the steps named by ``step_delay_calls``), for deadline /
+  queue-timeout / watchdog tests that need wall time to pass;
+- **engine-loop crash**: ``EngineCrash`` raised at the top of scheduled
+  steps. Deliberately *not* a ``FaultInjected`` — nothing inside the
+  engine catches it, so it escapes ``engine.step()`` entirely, modelling
+  the step loop itself dying. Only a supervisor above the engine
+  (``serving/supervisor.py``) can recover;
+- **connection-level faults**: ``client_disconnect`` / ``slow_consumer`` /
+  ``malformed_request`` are consulted by front ends and chaos harnesses
+  (the engine never calls them) to decide when a simulated client drops
+  mid-stream, stalls its reads, or sends a garbage payload.
 
 Everything is driven by one ``numpy`` Generator seeded at construction:
 the same plan over the same call sequence fires the same faults, so chaos
@@ -54,6 +64,12 @@ class FaultInjected(RuntimeError):
         super().__init__(f"injected {kind} fault at {site} (call #{call})")
 
 
+class EngineCrash(RuntimeError):
+    """Injected engine-loop death. NOT a FaultInjected on purpose: the
+    engine's internal retry/isolation paths must not see it — it escapes
+    ``engine.step()`` so that only a supervisor can observe and recover."""
+
+
 @dataclass
 class FaultPlan:
     """Seeded, deterministic fault schedule. ``*_calls`` are explicit
@@ -74,8 +90,20 @@ class FaultPlan:
     nan_logit_prob: float = 0.0                   # per live row, per decode
     nan_logit_calls: Tuple[int, ...] = ()         # poisons row 0 of that call
     nan_prefill_calls: Tuple[int, ...] = ()       # site "prefill.logits"
-    # artificial latency at the top of every engine step
+    # artificial latency at the top of engine steps; empty step_delay_calls
+    # delays every step, otherwise only the listed 1-based step indices
     step_delay_s: float = 0.0
+    step_delay_calls: Tuple[int, ...] = ()
+    # engine-loop crash escaping engine.step (site "engine.step")
+    step_crash_calls: Tuple[int, ...] = ()
+    # connection-level faults, consulted by front ends / chaos clients
+    client_disconnect_prob: float = 0.0
+    client_disconnect_calls: Tuple[int, ...] = ()  # site "client.disconnect"
+    slow_consumer_prob: float = 0.0
+    slow_consumer_calls: Tuple[int, ...] = ()      # site "client.slow"
+    slow_consumer_stall_s: float = 0.05            # how long a slow read stalls
+    malformed_request_prob: float = 0.0
+    malformed_request_calls: Tuple[int, ...] = ()  # site "client.malformed"
 
     calls: Counter = field(default_factory=Counter, init=False)
     fired: Counter = field(default_factory=Counter, init=False)
@@ -141,6 +169,32 @@ class FaultPlan:
         return mask
 
     def on_step(self) -> None:
-        """Top of every engine step: artificial latency."""
-        if self.step_delay_s > 0.0:
+        """Top of every engine step: artificial latency, and the injected
+        engine-loop crash site (``EngineCrash`` escapes ``engine.step``)."""
+        crash = self._fires("engine.step", 0.0, self.step_crash_calls)
+        n = self.calls["engine.step"]
+        if self.step_delay_s > 0.0 and (
+                not self.step_delay_calls or n in self.step_delay_calls):
             time.sleep(self.step_delay_s)
+        if crash:
+            raise EngineCrash(f"injected engine-loop crash (step #{n})")
+
+    # -- connection-level sites (called by front ends, not the engine) --------
+
+    def client_disconnect(self) -> bool:
+        """One streamed event reached a chaos client: True when the client
+        drops the connection mid-stream (site "client.disconnect")."""
+        return self._fires("client.disconnect", self.client_disconnect_prob,
+                           self.client_disconnect_calls)
+
+    def slow_consumer(self) -> bool:
+        """True when a chaos client should stall its next read for
+        ``slow_consumer_stall_s`` (site "client.slow")."""
+        return self._fires("client.slow", self.slow_consumer_prob,
+                           self.slow_consumer_calls)
+
+    def malformed_request(self) -> bool:
+        """True when a chaos client should corrupt its next request payload
+        (site "client.malformed")."""
+        return self._fires("client.malformed", self.malformed_request_prob,
+                           self.malformed_request_calls)

@@ -51,8 +51,13 @@ class ServingMetrics:
     over the span from the first observation to the latest one.
     """
 
-    def __init__(self, profiler: Optional[Profiler] = None):
+    def __init__(self, profiler: Optional[Profiler] = None, *,
+                 slo_ttft_s: Optional[float] = None,
+                 slo_stall_s: Optional[float] = None):
         self.profiler = profiler
+        # SLO targets for goodput accounting (None = no SLO configured)
+        self.slo_ttft_s = slo_ttft_s
+        self.slo_stall_s = slo_stall_s
         self.ttft_s: List[float] = []
         self.ttft_under_load_s: List[float] = []
         self.token_latency_s: List[float] = []
@@ -79,6 +84,13 @@ class ServingMetrics:
         self.failed = 0
         self.step_retries = 0
         self.steps = 0
+        # runtime-resilience counters (supervisor / overload degradation)
+        self.shed = 0                 # queued requests displaced by priority
+        self.engine_restarts = 0      # supervisor-driven engine recoveries
+        self.drain_duration_s = 0.0   # wall time of the last graceful drain
+        self.publish_suspended = 0    # prefix publishes skipped under pressure
+        self.finished_ttft_s: List[float] = []  # TTFT of *finished* requests
+        self._t_created = time.perf_counter()
         self._t_first: Optional[float] = None
         self._t_last: Optional[float] = None
 
@@ -174,8 +186,30 @@ class ServingMetrics:
                 self.preemptions_by_request.get(rid, 0) + 1
         self._tick("serve.preemptions", 1)
 
-    def observe_finish(self) -> None:
+    def observe_finish(self, ttft_s: Optional[float] = None) -> None:
         self.finished += 1
+        if ttft_s is not None:
+            self.finished_ttft_s.append(ttft_s)
+
+    def observe_shed(self) -> None:
+        """A queued request was displaced by a more important arrival."""
+        self.shed += 1
+        self._tick("serve.shed", 1)
+
+    def observe_restart(self) -> None:
+        """The supervisor reset the engine after a crash or watchdog trip."""
+        self.engine_restarts += 1
+        self._tick("serve.engine_restarts", 1)
+
+    def observe_drain(self, seconds: float) -> None:
+        self.drain_duration_s = seconds
+        self._tick("serve.drain_duration_s", seconds)
+
+    def observe_publish_suspended(self) -> None:
+        """A prefix-cache publish was skipped because the pool was under
+        occupancy pressure (degradation mode, not an error)."""
+        self.publish_suspended += 1
+        self._tick("serve.publish_suspended", 1)
 
     def observe_rejected(self) -> None:
         self.rejected += 1
@@ -211,6 +245,33 @@ class ServingMetrics:
         el = self.elapsed_s
         return self.decode_tokens / el if el > 0 else 0.0
 
+    @property
+    def uptime_s(self) -> float:
+        return time.perf_counter() - self._t_created
+
+    @property
+    def goodput_at_slo(self) -> float:
+        """Finished requests per second that met the TTFT SLO — the number a
+        sustained-load harness should optimize, not raw throughput. With no
+        SLO configured, every finished request counts (plain req/s)."""
+        el = self.elapsed_s
+        if el <= 0:
+            return 0.0
+        if self.slo_ttft_s is None:
+            good = self.finished
+        else:
+            good = sum(1 for t in _finite(self.finished_ttft_s)
+                       if t <= self.slo_ttft_s)
+        return good / el
+
+    @property
+    def stall_slo_violations(self) -> int:
+        """Decode-stall samples exceeding the stall SLO (0 when unset)."""
+        if self.slo_stall_s is None:
+            return 0
+        return sum(1 for s in _finite(self.decode_stall_s)
+                   if s > self.slo_stall_s)
+
     def summary(self) -> Dict[str, float]:
         """One flat dict — the shape benchmarks/serve_bench.py reports.
 
@@ -243,6 +304,13 @@ class ServingMetrics:
             "timed_out": self.timed_out,
             "failed": self.failed,
             "step_retries": self.step_retries,
+            "uptime_s": self.uptime_s,
+            "engine_restarts": self.engine_restarts,
+            "drain_duration_s": self.drain_duration_s,
+            "shed_requests": self.shed,
+            "publish_suspended": self.publish_suspended,
+            "goodput_at_slo": self.goodput_at_slo,
+            "stall_slo_violations": self.stall_slo_violations,
             "tok_per_s": self.tokens_per_s,
             "prefix_lookups": self.prefix_lookups,
             "prefix_hits": self.prefix_hits,

@@ -70,6 +70,9 @@ class Request:
     submit_time: float = 0.0
     deadline_s: Optional[float] = None   # total wall budget from submit
     max_queue_s: Optional[float] = None  # max continuous time spent QUEUED
+    priority: int = 0                    # smaller = more important; only
+    #                                      consulted when shedding under
+    #                                      overload (admission stays FCFS)
 
     # -- engine-managed --
     state: RequestState = RequestState.QUEUED
@@ -283,6 +286,21 @@ class Scheduler:
         req.state = state
         req.finish_reason = state.value
         req.error = error
+
+    def shed_victim(self, priority: int) -> Optional[Request]:
+        """Priority-aware load shedding at admission: when the queue is full,
+        the queued request with the numerically LARGEST priority (least
+        important) makes room for an arriving request of priority
+        ``priority`` — but only when strictly less important than it, so
+        equal-priority traffic keeps the plain reject behavior. Ties among
+        candidates shed the newest (least sunk wait time). Running requests
+        are never shed — their prefill work is paid for."""
+        victim: Optional[Request] = None
+        for req in self.waiting:
+            if req.priority > priority and \
+                    (victim is None or req.priority >= victim.priority):
+                victim = req
+        return victim
 
     def preempt_victim(self) -> Optional[Request]:
         """LIFO victim choice: the latest-admitted running request loses its
