@@ -25,11 +25,20 @@ cache (default) and once without, reporting prefill_tokens_saved,
 prefix_hit_rate, and ttft_ms_p50/p99 — the automatic-prefix-caching win
 (skip recomputing shared KV) lands in the same regression.csv.
 
+Both modes also run a speculative-decoding A/B (bench_spec): repetitive
+(cyclic) prompts decoded greedily with spec off, n-gram self-drafting, and
+(smoke) the tiny draft model — reporting decode tok/s,
+token_latency_ms_p50/p99, spec_acceptance_rate, and the headline
+mean_accepted_per_step (> 1 means every verified mixed step committed more
+than one token at token-exact greedy output).
+
 --chaos runs the smoke workload under a seeded FaultPlan (pool-alloc
-failures + injected NaN logits) and asserts the fault-tolerance contract:
-every request terminal, zero leaked blocks, pool invariants clean. It is a
-robustness gate shaped like a benchmark row, so regressions show up in the
-same regression.csv pipeline as performance.
+failures + injected NaN logits + corrupted speculative drafts, spec=ngram)
+and asserts the fault-tolerance contract: every request terminal, zero
+leaked blocks, pool invariants clean, and every surviving request
+byte-identical to a fault-free spec-off run. It is a robustness gate shaped
+like a benchmark row, so regressions show up in the same regression.csv
+pipeline as performance.
 
 Both modes end with a bench_load row: sustained closed-loop users plus
 open-loop background arrivals driven through the supervised runtime
@@ -188,27 +197,118 @@ def bench_prefix(model, params, *, num_requests: int, rate_per_s: float,
                "requests": s["requests_finished"]})
 
 
+def bench_spec(model, params, *, num_requests: int, prompt_len: int,
+               max_new: int, num_blocks: int, block_size: int,
+               max_batch_size: int, label: str, seed: int = 0,
+               spec: str = "off", spec_k: int = 4, chunk_size: int = 8,
+               rate_per_s: float = 50.0):
+    """Speculative-decoding A/B row: a repetitive-text workload (each prompt
+    cycles a short random motif) drives greedy decode with spec off, n-gram
+    self-drafting, or the tiny draft model. Repetition is the representative
+    case for self-drafting — code, templated text, structured output — so
+    the ngram row's ``mean_accepted_per_step`` landing above 1 is the
+    headline: more than one verified token per mixed step at token-exact
+    greedy output (exactness itself is gated in tests/test_serving.py).
+    Compare decode tok/s and token_latency_ms_p50/p99 against the off row;
+    ``spec_acceptance_rate`` says how often drafted lookahead survived."""
+    from tnn_tpu import models
+    from tnn_tpu.serving import InferenceEngine, ServingMetrics
+
+    print(f"{label}: {num_requests} requests, cyclic prompts {prompt_len}, "
+          f"max_new {max_new}, spec={spec} k={spec_k}")
+    rng = np.random.default_rng(seed)
+    arrivals = np.cumsum(rng.exponential(1.0 / rate_per_s, num_requests))
+    prompts = []
+    for _ in range(num_requests):
+        period = int(rng.integers(2, 5))
+        motif = rng.integers(0, model.vocab_size, period).astype(np.int32)
+        prompts.append(np.tile(motif, prompt_len // period + 1)[:prompt_len])
+
+    draft_model = draft_params = None
+    if spec == "draft":
+        draft_model = models.create("gpt2_tiny", vocab_size=model.vocab_size,
+                                    max_len=model.max_len)
+        draft_params = draft_model.init(
+            jax.random.PRNGKey(seed + 2), (1, 8))["params"]
+
+    engine = InferenceEngine(
+        model, params, num_blocks=num_blocks, block_size=block_size,
+        max_batch_size=max_batch_size, max_seq_len=prompt_len + max_new,
+        seed=seed, chunk_size=chunk_size, spec=spec, spec_k=spec_k,
+        draft_model=draft_model, draft_params=draft_params)
+
+    # dedicated warmup prompt (never from the trace: see bench_serving)
+    wprompt = np.random.default_rng(seed + 1).integers(
+        0, model.vocab_size, prompt_len).astype(np.int32)
+    wid = engine.submit(wprompt, 2)
+    engine.run_until_complete()
+    del engine.requests[wid]
+    engine.metrics = ServingMetrics(engine.profiler)
+
+    t0 = time.perf_counter()
+    next_req = 0
+    while next_req < num_requests or engine.has_work:
+        now = time.perf_counter() - t0
+        while next_req < num_requests and arrivals[next_req] <= now:
+            engine.submit(prompts[next_req], max_new)
+            next_req += 1
+        if engine.has_work:
+            engine.step()
+        elif next_req < num_requests:
+            time.sleep(min(arrivals[next_req] - now, 0.05))
+    wall = time.perf_counter() - t0
+
+    engine.check_invariants()
+    s = engine.stats()
+    return report(
+        label, wall, items=s["decode_tokens"], item_name="tok",
+        extra={"spec": s["spec"], "spec_k": s["spec_k"],
+               "spec_draft_tokens": s["spec_draft_tokens"],
+               "spec_accepted_tokens": s["spec_accepted_tokens"],
+               "spec_acceptance_rate": round(s["spec_acceptance_rate"], 4),
+               "mean_accepted_per_step": round(s["mean_accepted_per_step"],
+                                               4),
+               "token_latency_ms_p50": s["token_latency_ms_p50"],
+               "token_latency_ms_p99": s["token_latency_ms_p99"],
+               "ttft_ms_p50": s["ttft_ms_p50"],
+               "compiled_step_signatures": s["compiled_step_signatures"],
+               "requests": s["requests_finished"]})
+
+
 def bench_chaos(model, params, *, num_requests: int, max_new: int,
                 label: str, seed: int = 0):
     """Smoke the fault-tolerance layer: Poisson-free back-to-back submits
     under a seeded FaultPlan, asserting the terminal-state and zero-leak
-    contracts. The row reports terminal-state counts instead of latency."""
-    from tnn_tpu.serving import TERMINAL_STATES, FaultPlan, InferenceEngine
+    contracts. Runs with speculative decoding ON (ngram) plus corrupted
+    draft proposals, so the row also gates the spec failure matrix: poisoned
+    drafts and mid-spec allocation faults must cost acceptance/latency only
+    — every surviving request's output is asserted byte-identical to a
+    fault-free spec-off run. The row reports terminal-state counts instead
+    of latency."""
+    from tnn_tpu.serving import (RequestState, TERMINAL_STATES, FaultPlan,
+                                 InferenceEngine)
 
     print(f"{label}: {num_requests} requests under seeded faults "
-          f"(alloc_fail_prob=0.1, nan logits)")
+          f"(alloc_fail_prob=0.1, nan logits, draft poison; spec=ngram)")
     rng = np.random.default_rng(seed)
     prompts = [rng.integers(0, model.vocab_size, int(l)).astype(np.int32)
                for l in rng.integers(4, 14, num_requests)]
+    # fault-free spec-off reference (serial: outputs are batch-independent)
+    ref_engine = InferenceEngine(model, params, num_blocks=16, block_size=4,
+                                 max_batch_size=4, max_seq_len=32, seed=seed)
+    ref = []
+    for p in prompts:
+        rid = ref_engine.submit(p, max_new)
+        ref.append(ref_engine.run_until_complete()[rid])
     plan = FaultPlan(seed=seed + 1, alloc_fail_prob=0.1,
-                     nan_logit_calls=(4,))
+                     nan_logit_calls=(4,), draft_poison_prob=0.25)
     engine = InferenceEngine(model, params, num_blocks=16, block_size=4,
                              max_batch_size=4, max_seq_len=32, seed=seed,
-                             faults=plan)
+                             spec="ngram", spec_k=4, faults=plan)
 
     t0 = time.perf_counter()
     rids = [engine.submit(p, max_new) for p in prompts]
-    engine.run_until_complete()
+    outs = engine.run_until_complete()
     wall = time.perf_counter() - t0
 
     states = [engine.result(r).state for r in rids]
@@ -216,12 +316,19 @@ def bench_chaos(model, params, *, num_requests: int, max_new: int,
     assert engine.pool.num_allocated == 0, "leaked KV blocks under chaos"
     engine.check_invariants()
     assert plan.fired["pool.alloc"] >= 1, "fault plan never fired"
+    survivors_exact = all(
+        outs[r] == ref[i] for i, r in enumerate(rids)
+        if engine.result(r).state is RequestState.FINISHED)
+    assert survivors_exact, \
+        "a chaos survivor's output diverged from the fault-free run"
     s = engine.stats()
     return report(
         label, wall, items=num_requests, item_name="req",
         extra={"finished": s["requests_finished"],
                "failed": s["requests_failed"],
                "faults_fired": int(sum(plan.fired.values())),
+               "draft_poison_fired": int(plan.fired["draft.poison"]),
+               "survivors_exact": int(survivors_exact),
                "leaked_blocks": int(engine.pool.num_allocated),
                "step_retries": s["step_retries"],
                "terminal": int(sum(1 for st in states
@@ -277,8 +384,14 @@ def bench_load(model, params, *, closed_users: int, closed_turns: int,
         max_batch_size=max_batch_size, max_seq_len=prompt_len + max_new,
         seed=seed, max_queue_depth=max_queue_depth)
 
-    # warm the compile caches, then reset metrics with the SLO thresholds
-    wid = engine.submit(mk_prompt(), 1)
+    # warm the compile caches, then reset metrics with the SLO thresholds.
+    # The warmup prompt is DEDICATED, not mk_prompt(): drawing from the
+    # trace pool would publish a trace prompt's KV to the prefix cache and
+    # hand one timed request a free full-cover hit — inflating goodput with
+    # work the warmup already paid for (and skewing the prompt counter)
+    wprompt = np.random.default_rng(seed + 1).integers(
+        0, model.vocab_size, prompt_len).astype(np.int32)
+    wid = engine.submit(wprompt, 1)
     engine.run_until_complete()
     del engine.requests[wid]
     engine.metrics = ServingMetrics(engine.profiler, slo_ttft_s=slo_ttft_s,
@@ -354,9 +467,14 @@ def bench_load(model, params, *, closed_users: int, closed_turns: int,
         assert sup.restarts >= 1, "injected crash never tripped a restart"
 
     s = engine.metrics.summary()
+    # every trace prompt is i.i.d. random and submitted once, so a prefix
+    # hit in the timed window can only mean warmup KV leaked into it
+    assert s["prefix_hits"] == 0, \
+        "warmup leaked prefix-cache KV into the timed window"
     return report(
         label, wall, items=len(rids), item_name="req",
         extra={"finished": s["requests_finished"],
+               "warmup_prefix_hits": s["prefix_hits"],
                "goodput_at_slo": round(s["goodput_at_slo"], 4),
                "slo_ttft_s": slo_ttft_s,
                "stall_slo_violations": s["stall_slo_violations"],
@@ -437,6 +555,17 @@ def main(argv=None):
                 block_size=4, max_batch_size=4, cache=c,
                 label=f"serve_smoke_prefix_{t}"),
                 label=f"bench_prefix_{tag}")
+        # speculative-decoding A/B: cyclic (repetitive) prompts, spec off vs
+        # n-gram self-drafting vs tiny-draft-model scoring — the ngram row's
+        # mean_accepted_per_step > 1 is the headline (gated in
+        # tests/test_benchmarks.py); the draft row proves the plumbing (a
+        # random-weight drafter buys ~0 acceptance but costs no exactness)
+        for sp in ("off", "ngram", "draft"):
+            rr.add(lambda s=sp: bench_spec(
+                model, params, num_requests=6, prompt_len=16, max_new=12,
+                num_blocks=64, block_size=4, max_batch_size=4, spec=s,
+                spec_k=4, label=f"serve_smoke_spec_{s}"),
+                label=f"bench_spec_{sp}")
         # sustained closed+open-loop load through the supervised runtime,
         # with one injected engine crash: goodput at the TTFT SLO, shed /
         # rejected / restart counters, and the zero-leak drain contract
@@ -476,6 +605,15 @@ def main(argv=None):
             block_size=16, max_batch_size=8, cache=c,
             label=f"serve_{args.model}_prefix_{t}"),
             label=f"bench_prefix_{tag}")
+    # speculative-decoding A/B at model scale: repetitive prompts, greedy;
+    # compare tok/s and token_latency_ms_p50/p99 against acceptance rate
+    for sp in ("off", "ngram"):
+        rr.add(lambda s=sp: bench_spec(
+            model, params, num_requests=n, prompt_len=32, max_new=max_new,
+            num_blocks=128, block_size=16, max_batch_size=8, spec=s,
+            spec_k=4, chunk_size=16, rate_per_s=args.rate,
+            label=f"serve_{args.model}_spec_{s}"),
+            label=f"bench_spec_{sp}")
     # supervised sustained-load row at model scale (one injected crash)
     rr.add(lambda: bench_load(
         model, params, closed_users=4, closed_turns=max(2, n // 8),

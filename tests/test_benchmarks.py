@@ -119,13 +119,16 @@ def test_serve_bench_smoke():
     from benchmarks import serve_bench
 
     results = [r for r in serve_bench.main(["--smoke"]) if r]
-    assert len(results) == 7
+    assert len(results) == 10
     assert [r["bench"] for r in results] == ["serve_smoke_standard",
                                              "serve_smoke_paged",
                                              "serve_smoke_mixed_chunked",
                                              "serve_smoke_mixed_whole",
                                              "serve_smoke_prefix_cached",
                                              "serve_smoke_prefix_nocache",
+                                             "serve_smoke_spec_off",
+                                             "serve_smoke_spec_ngram",
+                                             "serve_smoke_spec_draft",
                                              "serve_smoke_load"]
     for r in results[:6]:                   # the latency/parity A/B rows
         assert r["ms"] > 0
@@ -133,10 +136,30 @@ def test_serve_bench_smoke():
         assert r["ttft_ms_mean"] > 0
         assert r["ttft_ms_p99"] >= r["ttft_ms_p50"] > 0
         assert r["requests"] == 6
+    # the speculative-decoding A/B rows: the off row is the baseline, the
+    # ngram row's headline is > 1 verified token per decode-row step on the
+    # repetitive workload (token-exactness is gated in tests/test_serving.py)
+    off, ngram, draft = results[6:9]
+    for r in (off, ngram, draft):
+        assert r["ms"] > 0 and r["tok_per_s"] > 0
+        assert r["requests"] == 6
+        assert r["token_latency_ms_p99"] >= r["token_latency_ms_p50"] > 0
+        assert r["compiled_step_signatures"] >= 1
+    assert off["spec"] == "off" and off["spec_k"] == 0
+    assert off["spec_draft_tokens"] == 0
+    assert off["mean_accepted_per_step"] == 0.0
+    assert ngram["spec"] == "ngram" and ngram["spec_k"] == 4
+    assert ngram["spec_draft_tokens"] > 0
+    assert ngram["spec_acceptance_rate"] > 0
+    assert ngram["mean_accepted_per_step"] > 1, \
+        "self-drafting never beat sequential decode on cyclic prompts"
+    assert draft["spec"] == "draft"
+    assert draft["spec_draft_tokens"] > 0
+    assert draft["mean_accepted_per_step"] >= 1
     # the supervised sustained-load row: goodput at the TTFT SLO plus the
     # resilience counters — the injected engine crash must have tripped
     # exactly the supervisor (restarts >= 1) without leaking a block
-    load = results[6]
+    load = results[9]
     assert load["ms"] > 0 and load["req_per_s"] > 0
     assert load["terminal"] == load["requests_total"]
     assert load["finished"] >= 1
@@ -145,6 +168,9 @@ def test_serve_bench_smoke():
     assert load["leaked_blocks"] == 0
     assert load["drain_duration_s"] >= 0
     assert load["shed_requests"] >= 0 and load["rejected"] >= 0
+    # regression: the warmup request must never seed the prefix cache with
+    # trace-pool prompts — a leaked warmup hit flatters the timed window
+    assert load["warmup_prefix_hits"] == 0
     # the A/B is live: chunked really split prompts, whole never did (wall-
     # clock comparisons between the rows stay informational — CI CPU noise)
     chunked = next(r for r in results
@@ -181,6 +207,11 @@ def test_serve_bench_chaos():
     assert r["leaked_blocks"] == 0
     assert r["faults_fired"] >= 1
     assert r["finished"] + r["failed"] <= 8
+    # the row runs with spec="ngram" + corrupted draft proposals: poisoned
+    # drafts must cost acceptance only — every survivor byte-identical to
+    # the fault-free spec-off reference (asserted inside bench_chaos too)
+    assert r["draft_poison_fired"] >= 1
+    assert r["survivors_exact"] == 1
 
 
 @pytest.mark.slow

@@ -126,3 +126,64 @@ def test_ragged_jits_with_traced_params():
                         jnp.asarray([0.0, 0.8])))
     assert toks.shape == (2,)
     assert toks[0] == int(jnp.argmax(logits[0]))
+
+
+# -- filter_logits: the shared filtering core ---------------------------------
+
+
+def test_filter_logits_matches_scalar_sampler_draws():
+    """softmax(filter_logits(...)) IS the sampler's categorical
+    distribution: drawing from it with the scalar path's key must reproduce
+    make_sampler draw-for-draw (byte-identical filtered logits)."""
+    from tnn_tpu.models.sampling import filter_logits
+
+    rs = np.random.RandomState(6)
+    logits = jnp.asarray(rs.randn(4, 50) * 2)
+    for t, k, p in [(1.0, 0, 0.0), (0.8, 5, 0.0), (1.2, 0, 0.6),
+                    (0.7, 8, 0.9)]:
+        for i in range(8):
+            key = jax.random.PRNGKey(i)
+            want = np.asarray(make_sampler(t, k, p)(logits, key))
+            got = np.asarray(jax.random.categorical(
+                key, filter_logits(logits, t, k, p), axis=-1))
+            np.testing.assert_array_equal(got, want,
+                                          err_msg=f"t={t} k={k} p={p}")
+
+
+def test_filter_logits_keepall_defaults_are_identity():
+    """Out-of-range params degrade to keep-all: t<=0 scales by 1, k outside
+    [1, V) and p outside (0, 1) filter nothing."""
+    from tnn_tpu.models.sampling import filter_logits
+
+    logits = jnp.asarray(np.random.RandomState(7).randn(3, 20), jnp.float32)
+    for t, k, p in [(1.0, 0, 0.0), (0.0, 20, 1.0), (-1.0, -3, 2.0),
+                    (1.0, 50, 0.0)]:
+        np.testing.assert_array_equal(
+            np.asarray(filter_logits(logits, t, k, p)), np.asarray(logits))
+    # temperature really scales
+    np.testing.assert_allclose(
+        np.asarray(filter_logits(logits, 2.0, 0, 0.0)),
+        np.asarray(logits) / 2.0, rtol=1e-6)
+
+
+def test_filter_logits_perrow_supports():
+    """Per-row params: a top-k row keeps exactly its k best tokens, a
+    nucleus row keeps a probability-ordered prefix that includes the best
+    token, and a default row is untouched."""
+    from tnn_tpu.models.sampling import NEG_INF, filter_logits
+
+    rs = np.random.RandomState(8)
+    logits = jnp.asarray(rs.randn(3, 12))
+    out = np.asarray(filter_logits(
+        logits, jnp.asarray([1.0, 1.0, 1.0]),
+        jnp.asarray([3, 0, 0], jnp.int32), jnp.asarray([0.0, 0.7, 0.0])))
+    row0 = np.asarray(logits[0])
+    kept0 = set(np.flatnonzero(out[0] > float(NEG_INF) / 2).tolist())
+    assert kept0 == set(np.argsort(row0)[-3:].tolist())
+    row1 = np.asarray(logits[1])
+    kept1 = np.flatnonzero(out[1] > float(NEG_INF) / 2)
+    dropped1 = np.setdiff1d(np.arange(12), kept1)
+    assert int(row1.argmax()) in kept1.tolist()
+    assert 1 <= len(kept1) < 12
+    assert row1[kept1].min() > row1[dropped1].max()  # a prefix by prob
+    np.testing.assert_array_equal(out[2], np.asarray(logits[2], np.float32))

@@ -133,6 +133,16 @@ def main(argv=None):
                          "deadline times out (0 = wait forever)")
     ap.add_argument("--no-logit-guard", action="store_true",
                     help="disable per-row non-finite logit detection")
+    ap.add_argument("--spec", default="off",
+                    choices=("off", "ngram", "draft"),
+                    help="speculative decoding: 'ngram' self-drafts from the "
+                         "request's own context, 'draft' scores lookahead "
+                         "with a tiny zoo draft model (gpt2_tiny, random "
+                         "weights unless it shares the target checkpoint's "
+                         "vocab). Greedy output is token-exact either way")
+    ap.add_argument("--spec-k", type=int, default=4,
+                    help="max drafted tokens verified per decode row per "
+                         "step (the mixed step widens to k+1)")
     ap.add_argument("--seed", type=int, default=0)
     args = ap.parse_args(argv)
 
@@ -149,6 +159,15 @@ def main(argv=None):
               "(smoke/benchmark mode)", file=sys.stderr)
         params = model.init(jax.random.PRNGKey(args.seed), (1, 8))["params"]
 
+    draft_model = draft_params = None
+    if args.spec == "draft":
+        draft_model = models.create("gpt2_tiny", vocab_size=model.vocab_size,
+                                    max_len=model.max_len)
+        draft_params = draft_model.init(
+            jax.random.PRNGKey(args.seed + 1), (1, 8))["params"]
+        print("spec=draft: random-weight gpt2_tiny drafter (wire a trained "
+              "draft checkpoint for real acceptance rates)", file=sys.stderr)
+
     engine = InferenceEngine(
         model, params, num_blocks=args.num_blocks, block_size=args.block_size,
         max_batch_size=args.max_batch_size, chunk_size=args.chunk_size,
@@ -159,7 +178,9 @@ def main(argv=None):
         max_queue_depth=args.max_queue_depth,
         preemption_budget=(None if args.preemption_budget < 0
                            else args.preemption_budget),
-        logit_guard=not args.no_logit_guard, seed=args.seed)
+        logit_guard=not args.no_logit_guard,
+        spec=args.spec, spec_k=args.spec_k,
+        draft_model=draft_model, draft_params=draft_params, seed=args.seed)
     if not engine._paged and engine.paged_fallback_reason:
         print(f"paged decode unavailable: {engine.paged_fallback_reason}",
               file=sys.stderr)

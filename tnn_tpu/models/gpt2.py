@@ -264,6 +264,15 @@ def generate(model: GPT2, params, prompt_ids, max_new_tokens: int,
     return run(params, prompt_ids, rng)
 
 
+def gpt2_tiny(**kw):
+    """2L/128d/2h — draft-model config for speculative decoding (and fast
+    tests). No reference counterpart: it exists to run a cheap stand-in
+    decode whose proposals the serving engine verifies against the real
+    model (serving/spec_decode.DraftModelDrafter), so it must share the
+    target's vocab; pass ``vocab_size=``/``max_len=`` to match."""
+    return GPT2(num_layers=2, d_model=128, num_heads=2, **kw)
+
+
 def gpt2_small(**kw):
     """12L/768d/12h (parity: example_models.cpp:384-391)."""
     return GPT2(num_layers=12, d_model=768, num_heads=12, **kw)

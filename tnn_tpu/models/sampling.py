@@ -26,6 +26,56 @@ def _is_perrow(x) -> bool:
     return getattr(x, "ndim", 0) > 0
 
 
+def _top_p_filter(x, p):
+    """Nucleus filter over already-scaled logits: a token survives if the
+    probability mass BEFORE it is still below ``p`` — the highest-probability
+    token always survives. ``p`` is a python float (scalar path) or an array
+    broadcastable to x.shape[:-1] + (1,) (ragged path); values outside (0, 1)
+    must already be mapped to keep-all by the caller."""
+    down = jnp.flip(jnp.sort(x, axis=-1), axis=-1)
+    probs = jax.nn.softmax(down, axis=-1)
+    csum = jnp.cumsum(probs, axis=-1)
+    keep = (csum - probs) < p
+    cutoff = jnp.min(jnp.where(keep, down, jnp.inf), axis=-1, keepdims=True)
+    return jnp.where(x < cutoff, NEG_INF, x)
+
+
+def filter_logits(logits, temperature, top_k, top_p):
+    """Temperature-scale then top-k/top-p filter, fully vectorized with
+    per-row parameters; returns float32 filtered logits.
+
+    ``softmax(filter_logits(...))`` IS the categorical distribution the
+    sampler draws from, which is why this is a public helper: besides
+    ``sample_ragged``, the serving engine's speculative-decoding rejection
+    sampler needs the target distribution itself (to accept a drafted token
+    with its target probability and to renormalize the residual), not just
+    one draw from it.
+
+    logits: (..., V); temperature/top_k/top_p: scalars or arrays broadcastable
+    to logits.shape[:-1]. Per row: temperature<=0 -> scale by 1 (callers
+    treat those rows as greedy); top_k<=0 or >=V -> keep-all; top_p outside
+    (0, 1) -> keep-all. Filters compose (top-k first, then top-p over the
+    survivors).
+    """
+    logits = logits.astype(jnp.float32)
+    v = logits.shape[-1]
+    rows = logits.shape[:-1]
+    t = jnp.broadcast_to(jnp.asarray(temperature, jnp.float32), rows)[..., None]
+    k = jnp.broadcast_to(jnp.asarray(top_k, jnp.int32), rows)[..., None]
+    p = jnp.broadcast_to(jnp.asarray(top_p, jnp.float32), rows)[..., None]
+
+    x = logits / jnp.where(t > 0.0, t, 1.0)
+    # top-k: the kth-largest value is the row's cutoff; k outside [1, V)
+    # degrades to keep-all (cutoff = the minimum)
+    k_eff = jnp.where((k > 0) & (k < v), k, v)
+    down = jnp.flip(jnp.sort(x, axis=-1), axis=-1)
+    kth = jnp.take_along_axis(down, k_eff - 1, axis=-1)
+    x = jnp.where(x < kth, NEG_INF, x)
+    # top-p over the top-k survivors
+    p_eff = jnp.where((p > 0.0) & (p < 1.0), p, 1.0)
+    return _top_p_filter(x, p_eff)
+
+
 def sample_ragged(logits, key, temperature, top_k, top_p):
     """Vectorized sampling with per-row parameters.
 
@@ -35,31 +85,13 @@ def sample_ragged(logits, key, temperature, top_k, top_p):
     in the scalar path (top-k first, then top-p over the survivors).
     """
     logits = logits.astype(jnp.float32)
-    v = logits.shape[-1]
     rows = logits.shape[:-1]
-    t = jnp.broadcast_to(jnp.asarray(temperature, jnp.float32), rows)[..., None]
-    k = jnp.broadcast_to(jnp.asarray(top_k, jnp.int32), rows)[..., None]
-    p = jnp.broadcast_to(jnp.asarray(top_p, jnp.float32), rows)[..., None]
+    t = jnp.broadcast_to(jnp.asarray(temperature, jnp.float32), rows)
 
     greedy = jnp.argmax(logits, axis=-1)
-    x = logits / jnp.where(t > 0.0, t, 1.0)
-    # top-k: the kth-largest value is the row's cutoff; k outside [1, V)
-    # degrades to keep-all (cutoff = the minimum)
-    k_eff = jnp.where((k > 0) & (k < v), k, v)
-    down = jnp.flip(jnp.sort(x, axis=-1), axis=-1)
-    kth = jnp.take_along_axis(down, k_eff - 1, axis=-1)
-    x = jnp.where(x < kth, NEG_INF, x)
-    # top-p over the top-k survivors: a token survives if the mass BEFORE it
-    # is still below top_p — the highest-probability token always survives
-    p_eff = jnp.where((p > 0.0) & (p < 1.0), p, 1.0)
-    down = jnp.flip(jnp.sort(x, axis=-1), axis=-1)
-    probs = jax.nn.softmax(down, axis=-1)
-    csum = jnp.cumsum(probs, axis=-1)
-    keep = (csum - probs) < p_eff
-    cutoff = jnp.min(jnp.where(keep, down, jnp.inf), axis=-1, keepdims=True)
-    x = jnp.where(x < cutoff, NEG_INF, x)
+    x = filter_logits(logits, temperature, top_k, top_p)
     sampled = jax.random.categorical(key, x, axis=-1)
-    return jnp.where(jnp.squeeze(t, -1) > 0.0, sampled, greedy)
+    return jnp.where(t > 0.0, sampled, greedy)
 
 
 def make_sampler(temperature=0.0, top_k=0, top_p=0.0):
@@ -102,15 +134,7 @@ def make_sampler(temperature=0.0, top_k=0, top_p=0.0):
             kth = jax.lax.top_k(logits, k)[0][..., -1:]
             logits = jnp.where(logits < kth, NEG_INF, logits)
         if top_p > 0.0:
-            down = jnp.flip(jnp.sort(logits, axis=-1), axis=-1)
-            probs = jax.nn.softmax(down, axis=-1)
-            csum = jnp.cumsum(probs, axis=-1)
-            # a token survives if the mass BEFORE it is still below top_p —
-            # the highest-probability token always survives
-            keep = (csum - probs) < top_p
-            cutoff = jnp.min(jnp.where(keep, down, jnp.inf), axis=-1,
-                             keepdims=True)
-            logits = jnp.where(logits < cutoff, NEG_INF, logits)
+            logits = _top_p_filter(logits, top_p)
         return jax.random.categorical(key, logits, axis=-1)
 
     return sample

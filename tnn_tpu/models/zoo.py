@@ -60,6 +60,7 @@ register("flash_vit")(
 
 # -- language (parity: example_models.cpp:384-504) ---------------------------
 
+register("gpt2_tiny")(lambda **kw: gpt2_lib.gpt2_tiny(**kw))
 register("gpt2_small")(lambda **kw: gpt2_lib.gpt2_small(**kw))
 register("gpt2_small_hd128")(lambda **kw: gpt2_lib.gpt2_small_hd128(**kw))
 register("flash_gpt2_small_hd128")(

@@ -58,6 +58,20 @@ whole prefills over its table and so cannot share blocks) behaviour and
 output streams are unchanged; with it on, outputs stay token-exact because
 matched KV is bit-identical to what the skipped prefill would have written.
 
+Speculative decoding (``spec="ngram"`` / ``"draft"`` / a custom
+``spec_decode.Drafter``; docs/serving.md has the full design): each decode
+row packs its pending token plus up to ``spec_k`` drafted candidates as a
+ragged ``q_lens = k+1`` row into the SAME mixed step — the multi-token
+scoring primitive chunked prefill already compiled — and one forward
+verifies all of them. Greedy rows accept the longest draft prefix matching
+the per-position argmax; stochastic rows run standard rejection sampling
+against the filtered target distribution. Accepted tokens commit through the
+existing chunk scatter; rejected tails roll back by truncating the row's
+block table to its verified length (``pool.truncate``). Greedy output
+streams are token-exact vs spec-off by construction — every committed token
+is one the sequential decode would have produced — and unverified draft KV
+is never published to the prefix cache (decode rows never publish at all).
+
 Fault tolerance (docs/serving.md has the full failure-mode matrix): every
 submitted request reaches a terminal state — FINISHED, FAILED, CANCELLED,
 or TIMED_OUT — and failures are isolated per request. A pool-alloc failure,
@@ -88,6 +102,7 @@ import numpy as np
 from ..models import sampling
 from ..profiling.profiler import EventType, Profiler, profiled
 from . import kv_pool as kv_pool_lib
+from . import spec_decode
 from .faults import FaultInjected, FaultPlan
 from .kv_pool import PagedKVPool, PoolExhausted
 from .metrics import ServingMetrics
@@ -129,6 +144,15 @@ class InferenceEngine:
         two-large-requests livelock).
     logit_guard : per-row non-finite logit detection; a poisoned row FAILs
         its request while the rest of the batch keeps its tokens.
+    spec : speculative decoding — "off", "ngram" (self-speculative n-gram
+        lookup over each row's own context), "draft" (a small stand-in model
+        proposes; needs ``draft_model``/``draft_params``), or any
+        ``spec_decode.Drafter`` instance. Requires chunked prefill (the
+        mixed step is the verification primitive).
+    spec_k : max drafted tokens per decode row per step (the verified step
+        scores ``k+1`` positions).
+    draft_model, draft_params : the stand-in model for ``spec="draft"``;
+        must share the target model's vocabulary.
     faults : optional ``faults.FaultPlan`` for deterministic chaos testing.
     prefix_publish_max_occupancy : degradation mode — suspend prefix-cache
         publishes while live-request pool occupancy exceeds this fraction
@@ -148,6 +172,8 @@ class InferenceEngine:
                  preemption_budget: Optional[int] = 16,
                  logit_guard: bool = True, faults: Optional[FaultPlan] = None,
                  prefix_publish_max_occupancy: float = 0.95,
+                 spec: Any = "off", spec_k: int = 4,
+                 draft_model=None, draft_params=None,
                  profiler: Optional[Profiler] = None, seed: int = 0):
         if getattr(model, "kv_cache_dtype", None):
             raise ValueError(
@@ -167,6 +193,35 @@ class InferenceEngine:
             raise ValueError("chunk_size must be >= 1")
         if prefix_cache_min_hit_blocks < 1:
             raise ValueError("prefix_cache_min_hit_blocks must be >= 1")
+        self.drafter: Optional[spec_decode.Drafter] = None
+        self.spec_mode = spec if isinstance(spec, str) else \
+            getattr(spec, "name", "custom")
+        self.spec_k = int(spec_k)
+        if isinstance(spec, spec_decode.Drafter):
+            self.drafter = spec
+        elif spec == "ngram":
+            self.drafter = spec_decode.NGramDrafter()
+        elif spec == "draft":
+            if draft_model is None or draft_params is None:
+                raise ValueError("spec='draft' needs draft_model and "
+                                 "draft_params")
+            if draft_model.vocab_size != model.vocab_size:
+                raise ValueError(
+                    f"draft model vocab {draft_model.vocab_size} != target "
+                    f"vocab {model.vocab_size} — drafted token ids must be "
+                    "meaningful to the target")
+            self.drafter = spec_decode.DraftModelDrafter(draft_model,
+                                                         draft_params)
+        elif spec != "off":
+            raise ValueError(f"unknown spec {spec!r} (off | ngram | draft | "
+                             "a spec_decode.Drafter)")
+        if self.drafter is not None:
+            if not chunked_prefill:
+                raise ValueError(
+                    "speculative decoding requires chunked_prefill — the "
+                    "ragged mixed step is its verification primitive")
+            if self.spec_k < 1:
+                raise ValueError("spec_k must be >= 1")
         self.max_queue_depth = int(max_queue_depth)
         self.admission_policy = admission_policy
         self.preemption_budget = preemption_budget
@@ -190,7 +245,8 @@ class InferenceEngine:
         self.chunked_prefill = bool(chunked_prefill)
         self.scheduler = Scheduler(
             max_batch_size=max_batch_size, token_budget=token_budget,
-            chunk_size=self.chunk_size if self.chunked_prefill else 0)
+            chunk_size=self.chunk_size if self.chunked_prefill else 0,
+            spec_tokens=self.spec_k if self.drafter is not None else 0)
         self.prefix_cache: Optional[PrefixCache] = None
         if prefix_cache and self.chunked_prefill:
             self.prefix_cache = PrefixCache(
@@ -379,6 +435,9 @@ class InferenceEngine:
             "decode_path": ("paged" if self._paged
                             else "fused" if self._fused is not None
                             else "standard"),
+            "compiled_step_signatures": len(self._jit),
+            "spec": self.spec_mode,
+            "spec_k": self.spec_k if self.drafter is not None else 0,
         })
         return s
 
@@ -386,9 +445,10 @@ class InferenceEngine:
         """Pool bookkeeping + full block accounting against every running
         request's live table (only running requests hold blocks). Raises
         ValueError on any violation — the chaos suite's leak detector."""
-        tables = [r.block_table for r in self.scheduler.running
-                  if r.block_table]
-        self.pool.check_invariants(tables)
+        pairs = [(r.block_table, r.cache_len)
+                 for r in self.scheduler.running if r.block_table]
+        self.pool.check_invariants([t for t, _ in pairs],
+                                   [n for _, n in pairs])
 
     def _terminate(self, req: Request, state: RequestState, error: str,
                    events: Optional[Dict[str, List]] = None,
@@ -735,27 +795,67 @@ class InferenceEngine:
             self.metrics.observe_decode_stall(now - self._last_decode_emit)
         self._last_decode_emit = now
 
+    def _propose_drafts(self) -> Dict[int, List[int]]:
+        """Ask the drafter for up to ``spec_k`` lookahead tokens per
+        decode-phase row. Each draft is clamped so the accepted prefix plus
+        the verifier's bonus token can never overshoot ``max_new_tokens`` or
+        the position cap; empty proposals are dropped (those rows ride the
+        same step as plain single-token decode rows). Also routes the
+        ``draft.poison`` chaos site — a corrupted draft must cost acceptance
+        rate only, never output exactness."""
+        drafts: Dict[int, List[int]] = {}
+        vocab = self.model.vocab_size
+        for req in self.scheduler.running:
+            if req.state is not RequestState.RUNNING or \
+                    req.cache_len < req.prefill_len:
+                continue
+            rem = req.max_new_tokens - req.num_generated
+            k = min(self.spec_k, rem - 1,
+                    self.max_seq_len - req.cache_len - 1)
+            if k < 1:
+                continue
+            d = [int(t) % vocab for t in self.drafter.draft(req, k)][:k]
+            if not d:
+                continue
+            if self.faults is not None and self.faults.poison_draft():
+                d = [(t + 1) % vocab for t in d]
+            drafts[req.rid] = d
+        return drafts
+
     def _mixed_step(self, chunks: Dict[int, int], events) -> None:
         """One packed step: every decode-phase running row takes 1 token and
         every mid-prefill row with a chunk grant pushes its next prompt
         chunk, all inside ONE compiled program keyed on the power-of-two
         bucket of the widest chunk. Steps with no chunk work delegate to the
         legacy pure-decode program, so decode streams are bit-identical to
-        the pre-chunking engine."""
+        the pre-chunking engine.
+
+        With a drafter installed, decode rows additionally carry their
+        speculative lookahead as extra ragged positions (``q_len = 1 + k``)
+        through the SAME launch; verification, accept/rollback, and the
+        spec-off paths below stay byte-identical to the non-speculative
+        engine for greedy requests."""
         t0 = time.perf_counter()
+        spec_on = self.drafter is not None
         has_chunks = any(
             r.rid in chunks and r.state is RequestState.RUNNING
             and r.cache_len < r.prefill_len for r in self.scheduler.running)
-        if not has_chunks:
+        if not has_chunks and not spec_on:
             self._ensure_decode_capacity(events)
             live = [r for r in self.scheduler.running
                     if r.state is RequestState.RUNNING]
             if live:
                 self._decode(live, events)
             return
+        # drafts are proposed BEFORE the capacity pass so decode rows can
+        # reserve KV headroom for every drafted position up front
+        drafts = self._propose_drafts() if spec_on else {}
         # capacity pass in admission order: chunk rows grow by their grant
         # (the chunk-boundary alloc fault site — fails ONLY that request),
-        # decode rows by one token, preempting LIFO as needed
+        # decode rows by one token plus their draft width, preempting LIFO
+        # as needed. Under pool pressure speculation degrades FIRST: a draft
+        # whose headroom is not free is shed before the row would have to
+        # preempt a peer just to gamble on lookahead.
         for req in list(self.scheduler.running):
             if req.state is not RequestState.RUNNING:
                 continue
@@ -765,26 +865,47 @@ class InferenceEngine:
                                                   chunk=True):
                     chunks.pop(req.rid, None)
             else:
-                self._grow_blocks(req, 1, events, chunk=False)
+                d = drafts.get(req.rid)
+                if d:
+                    grow = self.pool.blocks_for(
+                        req.cache_len + 1 + len(d)) - len(req.block_table)
+                    if grow > 0 and not self.pool.can_alloc(grow):
+                        drafts.pop(req.rid, None)
+                        d = None
+                if not self._grow_blocks(req, 1 + (len(d) if d else 0),
+                                         events, chunk=False):
+                    drafts.pop(req.rid, None)
         live = [r for r in self.scheduler.running
                 if r.state is RequestState.RUNNING]
         dec = [r for r in live if r.cache_len >= r.prefill_len]
         chk = [(r, chunks[r.rid]) for r in live
                if r.cache_len < r.prefill_len and r.rid in chunks]
-        if not chk:
+        n_spec = sum(len(drafts.get(r.rid, ())) for r in dec)
+        if not chk and not n_spec:
+            # nothing ragged this step: the legacy pure-decode program is
+            # bit-identical and cheaper. Zero-draft rows still count in the
+            # spec denominator so acceptance stats stay honest.
             if dec:
+                before = len(events["tokens"])
                 self._decode(dec, events)
+                if spec_on:
+                    self.metrics.observe_spec(
+                        0, 0, len(events["tokens"]) - before, rows=len(dec))
             return
         rows = dec + [r for r, _ in chk]
         takes = {r.rid: t for r, t in chk}
-        # compiled chunk width: next power of two over the widest grant, so
-        # N distinct chunk takes cost O(log chunk_size) compiles
-        qw = 1 << (max(takes.values()) - 1).bit_length()
+        # compiled chunk width: next power of two over the widest row (chunk
+        # grant or drafted decode row), so N distinct widths cost
+        # O(log chunk_size) compiles
+        widest = max([t for _, t in chk]
+                     + [1 + len(drafts.get(r.rid, ())) for r in dec])
+        qw = 1 << (widest - 1).bit_length()
         b = self.scheduler.max_batch_size
         nb = self.blocks_per_seq
         toks = np.zeros((b, qw), np.int32)
         starts = np.zeros((b,), np.int32)
         q_lens = np.zeros((b,), np.int32)
+        n_draft = np.zeros((b,), np.int32)
         tables = np.full((b, nb), PagedKVPool.SCRATCH, np.int32)
         temps = np.zeros((b,), np.float32)
         topks = np.zeros((b,), np.int32)
@@ -797,8 +918,12 @@ class InferenceEngine:
             topks[i] = req.top_k
             topps[i] = req.top_p
             if i < len(dec):
+                d = drafts.get(req.rid, []) if spec_on else []
                 toks[i, 0] = req.next_token
-                q_lens[i] = 1
+                if d:
+                    toks[i, 1:1 + len(d)] = d
+                q_lens[i] = 1 + len(d)
+                n_draft[i] = len(d)
             else:
                 take = takes[req.rid]
                 seq = req.resume_tokens
@@ -810,12 +935,17 @@ class InferenceEngine:
             for i in range(len(dec), len(rows)):
                 if self.faults.poison_prefill():
                     poison[i] = np.nan
-        key = ("mixed", b, qw, nb)
+        key = ("mixed", b, qw, nb, "spec") if spec_on else ("mixed", b, qw, nb)
         fn = self._jit.get(key)
         if fn is None:
-            fn = self._jit[key] = (
-                self._mixed_paged_fn(b, qw, nb) if self._paged
-                else self._mixed_standard_fn(b, qw, nb))
+            if spec_on:
+                fn = self._jit[key] = (
+                    self._spec_paged_fn(b, qw, nb) if self._paged
+                    else self._spec_standard_fn(b, qw, nb))
+            else:
+                fn = self._jit[key] = (
+                    self._mixed_paged_fn(b, qw, nb) if self._paged
+                    else self._mixed_standard_fn(b, qw, nb))
         # one key per STEP (held across the retry): a transient fault retried
         # with the same key reproduces the fault-free step bit-for-bit
         step_key = self._next_key()
@@ -825,12 +955,22 @@ class InferenceEngine:
                     self.faults.on_decode()
                 with profiled("serve.mixed", EventType.COMPUTE,
                               self.profiler):
-                    newtok, ok, pk, pv = fn(
-                        self.params, self.pool.pages_k, self.pool.pages_v,
-                        jnp.asarray(toks), jnp.asarray(starts),
-                        jnp.asarray(q_lens), jnp.asarray(tables),
-                        jnp.asarray(temps), jnp.asarray(topks),
-                        jnp.asarray(topps), step_key, jnp.asarray(poison))
+                    if spec_on:
+                        accepts, newtok, ok, pk, pv = fn(
+                            self.params, self.pool.pages_k, self.pool.pages_v,
+                            jnp.asarray(toks), jnp.asarray(starts),
+                            jnp.asarray(q_lens), jnp.asarray(tables),
+                            jnp.asarray(n_draft), jnp.asarray(temps),
+                            jnp.asarray(topks), jnp.asarray(topps), step_key,
+                            jnp.asarray(poison))
+                        accepts = np.asarray(accepts)
+                    else:
+                        newtok, ok, pk, pv = fn(
+                            self.params, self.pool.pages_k, self.pool.pages_v,
+                            jnp.asarray(toks), jnp.asarray(starts),
+                            jnp.asarray(q_lens), jnp.asarray(tables),
+                            jnp.asarray(temps), jnp.asarray(topks),
+                            jnp.asarray(topps), step_key, jnp.asarray(poison))
                     newtok = np.asarray(newtok)
                     ok = np.asarray(ok)
                 break
@@ -847,6 +987,7 @@ class InferenceEngine:
         self.pool.update_pages(pk, pv)
         now = time.perf_counter()
         n_dec = len(dec)
+        n_committed = 0
         for i, req in enumerate(rows):
             if self.logit_guard and not bool(ok[i]):
                 self._terminate(
@@ -856,12 +997,39 @@ class InferenceEngine:
                     events, "failed")
                 continue
             if i < n_dec:
-                tok = int(newtok[i])
-                req.cache_len += 1
-                req.next_token = tok
-                req.out_tokens.append(tok)
-                events["tokens"].append((req.rid, tok))
-                self._maybe_finish(req, tok, events)
+                if not spec_on:
+                    tok = int(newtok[i])
+                    req.cache_len += 1
+                    req.next_token = tok
+                    req.out_tokens.append(tok)
+                    events["tokens"].append((req.rid, tok))
+                    self._maybe_finish(req, tok, events)
+                    n_committed += 1
+                    continue
+                # accepted-prefix commit: replay the sequential emit for the
+                # a accepted drafts plus the verifier's bonus/correction
+                # token, stopping at the first finish exactly where
+                # token-by-token decode would have stopped
+                d = drafts.get(req.rid, [])
+                a = int(accepts[i])
+                emitted = 0
+                for tok in [int(x) for x in d[:a]] + [int(newtok[i])]:
+                    req.cache_len += 1
+                    req.next_token = tok
+                    req.out_tokens.append(tok)
+                    events["tokens"].append((req.rid, tok))
+                    emitted += 1
+                    self._maybe_finish(req, tok, events)
+                    if req.state is not RequestState.RUNNING:
+                        break
+                self.metrics.observe_spec(len(d), a, emitted)
+                n_committed += emitted
+                if req.state is RequestState.RUNNING and req.block_table:
+                    # rejected-draft rollback: free the KV blocks past the
+                    # committed length (slots past kv_len inside a kept
+                    # block are garbage by contract and simply overwritten)
+                    req.block_table = self.pool.truncate(
+                        req.block_table, req.cache_len)
                 continue
             take = takes[req.rid]
             req.cache_len += take
@@ -892,10 +1060,13 @@ class InferenceEngine:
             self.metrics.observe_ttft(req.ttft_s, under_load=n_dec > 0)
             events["tokens"].append((req.rid, tok))
             self._maybe_finish(req, tok, events)
-        self.metrics.observe_mixed_step(n_dec + sum(takes.values()), b * qw)
+        self.metrics.observe_mixed_step(
+            n_dec + n_spec + sum(takes.values()), b * qw)
         if n_dec:
             self._mark_decode_emit()
-            self.metrics.observe_decode(n_dec, time.perf_counter() - t0, b)
+            self.metrics.observe_decode(
+                n_committed if spec_on else n_dec,
+                time.perf_counter() - t0, b)
 
     def _mixed_paged_fn(self, b: int, qw: int, nb: int):
         model = self.model
@@ -956,6 +1127,119 @@ class InferenceEngine:
             pages_v = kv_pool_lib.scatter_chunk(pages_v, tables, starts,
                                                 rows_v, q_lens)
             return newtok, ok, pages_k, pages_v
+
+        return jax.jit(fn, donate_argnums=(1, 2))
+
+    # -- speculative verification ----------------------------------------------
+
+    def _spec_verify(self, logits, toks, q_lens, n_draft, t, k, p, key,
+                     poison):
+        """Token-exact verification of a ragged speculative step from the
+        FULL ``(B, Q, V)`` logits cube.
+
+        Row layout: ``toks[i] = [x_0, d_1..d_k, pad]`` with ``q_lens[i] =
+        1 + n_draft[i]`` — position ``j``'s logits predict token ``j+1``, so
+        drafted token ``toks[:, j+1]`` is judged by ``logits[:, j]``. Greedy
+        rows (t<=0) accept the longest prefix where argmax matches the draft,
+        byte-identical to token-by-token decode. Stochastic rows run exact
+        rejection sampling: the drafters are DETERMINISTIC (propose with
+        probability 1), so accepting ``d`` with probability ``p_target(d)``
+        and re-drawing rejections from the residual — the target distribution
+        with ``d`` masked out, renormalized — leaves the output distribution
+        exactly the target's. Chunk rows (``n_draft = 0``) collapse to the
+        plain last-live-position sample. Returns per-row
+        ``(accepts, next_token, finite_ok)``."""
+        logits = logits.astype(jnp.float32) + poison[:, None, None]
+        B, Q, V = logits.shape
+        pos = jnp.arange(Q)[None, :]
+        is_live = pos < q_lens[:, None]
+        ok = jnp.where(is_live[:, :, None],
+                       jnp.isfinite(logits), True).all((-2, -1))
+        greedy_tok = jnp.argmax(logits, axis=-1)                   # (B, Q)
+        # drafted[:, j] = the token position j's logits must predict
+        drafted = jnp.concatenate(
+            [toks[:, 1:], jnp.zeros((B, 1), toks.dtype)], axis=1)
+        is_draft = pos < n_draft[:, None]
+        key_u, key_c = jax.random.split(key)
+        filtered = sampling.filter_logits(logits, t[:, None], k[:, None],
+                                          p[:, None])
+        probs = jax.nn.softmax(filtered, axis=-1)
+        p_draft = jnp.take_along_axis(probs, drafted[..., None],
+                                      axis=-1)[..., 0]             # (B, Q)
+        u = jax.random.uniform(key_u, p_draft.shape)
+        match = jnp.where(t[:, None] > 0.0, u < p_draft,
+                          greedy_tok == drafted) & is_draft
+        accepts = jnp.cumprod(match.astype(jnp.int32), axis=1).sum(axis=1)
+        # the bonus/correction token samples at the first unaccepted
+        # position: a + (q_len - 1 - n_draft) is ``a`` for decode rows and
+        # the last live position for chunk rows
+        s = jnp.clip(accepts + q_lens - 1 - n_draft, 0, Q - 1)
+        sel = jnp.take_along_axis(logits, s[:, None, None], axis=1)[:, 0]
+        fsel = jnp.take_along_axis(filtered, s[:, None, None], axis=1)[:, 0]
+        # rejection residual: mask the refused draft out of the target and
+        # renormalize before the correction draw
+        rejected = accepts < n_draft
+        rej_tok = jnp.take_along_axis(
+            toks, jnp.minimum(s + 1, Q - 1)[:, None], axis=1)[:, 0]
+        res_mask = jnp.arange(V)[None, :] == rej_tok[:, None]
+        fres = jnp.where(rejected[:, None] & res_mask, sampling.NEG_INF, fsel)
+        newtok = jnp.where(t > 0.0,
+                           jax.random.categorical(key_c, fres, axis=-1),
+                           jnp.argmax(sel, axis=-1))
+        return accepts, newtok, ok
+
+    def _spec_paged_fn(self, b: int, qw: int, nb: int):
+        model = self.model
+        verify = self._spec_verify
+
+        def fn(params, pages_k, pages_v, toks, starts, q_lens, tables,
+               n_draft, t, k, p, key, poison):
+            # the same ragged launch as the plain mixed step, but the FULL
+            # (B, Q, V) logits cube feeds verification — every drafted
+            # position is judged inside the one program
+            logits, pages_k, pages_v = model.apply_paged(
+                params, toks, pages_k, pages_v, tables, starts, q_lens)
+            accepts, newtok, ok = verify(logits, toks, q_lens, n_draft,
+                                         t, k, p, key, poison)
+            return accepts, newtok, ok, pages_k, pages_v
+
+        return jax.jit(fn, donate_argnums=(1, 2))
+
+    def _spec_standard_fn(self, b: int, qw: int, nb: int):
+        model = self.model
+        verify = self._spec_verify
+
+        def fn(params, pages_k, pages_v, toks, starts, q_lens, tables,
+               n_draft, t, k, p, key, poison):
+            kf, vf = kv_pool_lib.gather_kv(pages_k, pages_v, tables)
+            # same assembly-edge headroom rationale as _mixed_standard_fn
+            pad = [(0, 0), (0, 0), (0, 0), (0, qw), (0, 0)]
+            kf, vf = jnp.pad(kf, pad), jnp.pad(vf, pad)
+            x, _ = model.wte.apply({"params": params["wte"], "state": {}},
+                                   toks)
+            x, _ = model.wpe.apply({"params": params["wpe"], "state": {}},
+                                   x, offset=starts)
+            rows_k, rows_v = [], []
+            idx = (starts[:, None] + jnp.arange(qw))[:, None, :, None]
+            for i, block in enumerate(model.blocks):
+                cache = {"k": kf[i], "v": vf[i]}
+                x, cache = block.apply_cached(params[f"h{i}"], x, cache,
+                                              starts)
+                rows_k.append(jnp.take_along_axis(cache["k"], idx, axis=2))
+                rows_v.append(jnp.take_along_axis(cache["v"], idx, axis=2))
+            x, _ = model.ln_f.apply({"params": params["ln_f"], "state": {}}, x)
+            # verification needs every position's logits, so the whole row
+            # goes through the head — (B, qw, V), the price of lookahead
+            logits = model._head(params, x)
+            accepts, newtok, ok = verify(logits, toks, q_lens, n_draft,
+                                         t, k, p, key, poison)
+            rows_k = jnp.stack(rows_k).transpose(0, 1, 3, 2, 4)  # (L,B,Q,H,Dh)
+            rows_v = jnp.stack(rows_v).transpose(0, 1, 3, 2, 4)
+            pages_k = kv_pool_lib.scatter_chunk(pages_k, tables, starts,
+                                                rows_k, q_lens)
+            pages_v = kv_pool_lib.scatter_chunk(pages_v, tables, starts,
+                                                rows_v, q_lens)
+            return accepts, newtok, ok, pages_k, pages_v
 
         return jax.jit(fn, donate_argnums=(1, 2))
 

@@ -16,6 +16,9 @@ indices:
 - **NaN logits**: per-row poison values that flow through the compiled
   step's real logits, exercising the engine's logit guard exactly as a
   genuine numeric blowup would;
+- **draft poisoning**: corrupts a decode row's speculative-draft proposal
+  before it is packed (``draft.poison``), proving token-exact verification
+  rejects garbage drafts at the cost of acceptance rate only;
 - **artificial step latency**: ``time.sleep`` at the top of every engine
   step (or only the steps named by ``step_delay_calls``), for deadline /
   queue-timeout / watchdog tests that need wall time to pass;
@@ -90,6 +93,12 @@ class FaultPlan:
     nan_logit_prob: float = 0.0                   # per live row, per decode
     nan_logit_calls: Tuple[int, ...] = ()         # poisons row 0 of that call
     nan_prefill_calls: Tuple[int, ...] = ()       # site "prefill.logits"
+    # corrupted speculative-draft proposals (site "draft.poison"): the engine
+    # replaces a row's drafted tokens with garbage BEFORE packing them, so the
+    # verifier must reject them — proving corrupted drafts cost acceptance,
+    # never correctness. Consulted once per non-empty draft.
+    draft_poison_prob: float = 0.0
+    draft_poison_calls: Tuple[int, ...] = ()
     # artificial latency at the top of engine steps; empty step_delay_calls
     # delays every step, otherwise only the listed 1-based step indices
     step_delay_s: float = 0.0
@@ -154,6 +163,14 @@ class FaultPlan:
     def poison_prefill(self) -> bool:
         """True when this prefill's logits should be poisoned to NaN."""
         return self._fires("prefill.logits", 0.0, self.nan_prefill_calls)
+
+    def poison_draft(self) -> bool:
+        """True when this row's speculative-draft proposal should be
+        corrupted before packing (site "draft.poison"). Verification must
+        reject the garbage tokens — the request's output stream stays exact,
+        only the acceptance rate pays."""
+        return self._fires("draft.poison", self.draft_poison_prob,
+                           self.draft_poison_calls)
 
     def poison_rows(self, num_live: int) -> np.ndarray:
         """Boolean ``(num_live,)`` mask of decode rows whose logits this

@@ -210,6 +210,27 @@ class PagedKVPool:
         if self.debug:
             self.check_invariants()
 
+    def truncate(self, block_table: Sequence[int],
+                 num_tokens: int) -> List[int]:
+        """Shrink one sequence's table to exactly the blocks covering its
+        first ``num_tokens`` cache positions, freeing the tail.
+
+        This is the speculative-decoding rollback primitive: a decode row
+        grows blocks for ``1 + k`` candidate positions up front, and when the
+        verifier rejects a draft suffix the row keeps only its verified
+        length. The freed tail goes through ``free()``, so the
+        free/allocated/evictable partition (and prefix-cache parking) is
+        preserved; tail blocks of a decode row are always refcount-1 and
+        unpublished, but shared blocks would be handled correctly too — a
+        fork survivor just drops one reference. Returns the kept prefix as a
+        new list (the caller replaces its table with it).
+        """
+        keep = self.blocks_for(num_tokens) if num_tokens > 0 else 0
+        if keep >= len(block_table):
+            return list(block_table)
+        self.free(block_table[keep:])
+        return list(block_table[:keep])
+
     def purge_evictable(self) -> List[int]:
         """Reclaim EVERY evictable block (cache invalidation: page content
         became untrustworthy, e.g. after ``reset_pages``)."""
@@ -217,7 +238,8 @@ class PagedKVPool:
 
     def check_invariants(
             self,
-            block_tables: Optional[Iterable[Sequence[int]]] = None) -> None:
+            block_tables: Optional[Iterable[Sequence[int]]] = None,
+            seq_lens: Optional[Sequence[int]] = None) -> None:
         """Verify the pool's bookkeeping; raises ValueError on violation.
 
         Always checked: free + allocated + evictable == capacity (a strict
@@ -233,6 +255,16 @@ class PagedKVPool:
         exactly ``refcount`` live tables — no leaked blocks (allocated but
         unreferenced) and no block shared beyond its refcount — and no live
         table references an evictable or free block (use-after-free).
+
+        With ``seq_lens`` (parallel to ``block_tables``: each row's resident
+        token count), additionally checks the truncate-path contract per row:
+        the table covers every resident position (a rollback that cut too
+        deep leaves tokens with no backing block), and carries no stale tail
+        — at most ``blocks_for(seq_len + 1)`` blocks, i.e. nothing beyond
+        what the pending next single-token write may legitimately pre-own
+        (a full-cover prefix hit re-derives its last token copy-on-write and
+        briefly holds that one extra block). A rejected draft suffix whose
+        blocks were never truncated shows up here as a longer tail.
         """
         free_set = set(self._free)
         if len(free_set) != len(self._free):
@@ -262,6 +294,24 @@ class PagedKVPool:
         if any(r < 1 for r in self._ref.values()):
             raise ValueError(f"refcount < 1: {self._ref}")
         if block_tables is not None:
+            block_tables = [list(t) for t in block_tables]
+            if seq_lens is not None:
+                if len(list(seq_lens)) != len(block_tables):
+                    raise ValueError(
+                        f"seq_lens ({len(list(seq_lens))}) not parallel to "
+                        f"block_tables ({len(block_tables)})")
+                for i, (table, n) in enumerate(zip(block_tables, seq_lens)):
+                    if n > len(table) * self.block_size:
+                        raise ValueError(
+                            f"row {i}: {n} resident tokens exceed table "
+                            f"coverage ({len(table)} blocks x "
+                            f"{self.block_size}) — truncated too deep")
+                    if len(table) > self.blocks_for(n + 1):
+                        raise ValueError(
+                            f"row {i}: stale tail — {len(table)} blocks for "
+                            f"{n} resident tokens (max "
+                            f"{self.blocks_for(n + 1)}); a rejected draft "
+                            f"suffix was not truncated")
             usage: Counter = Counter()
             for table in block_tables:
                 usage.update(table)

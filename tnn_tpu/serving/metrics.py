@@ -75,6 +75,13 @@ class ServingMetrics:
         self.prefill_tokens_saved = 0    # of those, served from cached KV
         self.prefix_cows = 0             # private copies at full-cover hits
         self.decode_tokens = 0
+        # speculative decoding: drafted vs verifier-accepted candidate
+        # tokens, and committed tokens (accepted + the bonus sample) per
+        # decode-row step — the headline accepted-tokens-per-step number
+        self.spec_draft_tokens = 0
+        self.spec_accepted_tokens = 0
+        self.spec_committed_tokens = 0
+        self.spec_row_steps = 0
         self.preemptions = 0
         self.preemptions_by_request: Dict[int, int] = {}
         self.finished = 0
@@ -174,6 +181,21 @@ class ServingMetrics:
         if batch_width:
             self.batch_fill.append(num_tokens / batch_width)
         self._tick("serve.decode_s", seconds)
+
+    def observe_spec(self, drafted: int, accepted: int, committed: int,
+                     rows: int = 1) -> None:
+        """Speculative-decoding outcome for ``rows`` decode-row steps:
+        ``drafted`` candidate tokens proposed, ``accepted`` of them verified,
+        ``committed`` tokens actually emitted (accepted prefix + the bonus
+        sample, clipped by stop-token/length finishes). Rows that drafted
+        nothing still count — a drafter that never fires must show a
+        mean-accepted-per-step of ~1, not a flattering NaN."""
+        self._mark()
+        self.spec_draft_tokens += drafted
+        self.spec_accepted_tokens += accepted
+        self.spec_committed_tokens += committed
+        self.spec_row_steps += rows
+        self._tick("serve.spec_accepted", accepted)
 
     def observe_gauges(self, queue_depth: int, pool_occupancy: float) -> None:
         self.queue_depth.append(queue_depth)
@@ -312,6 +334,14 @@ class ServingMetrics:
             "goodput_at_slo": self.goodput_at_slo,
             "stall_slo_violations": self.stall_slo_violations,
             "tok_per_s": self.tokens_per_s,
+            "spec_draft_tokens": self.spec_draft_tokens,
+            "spec_accepted_tokens": self.spec_accepted_tokens,
+            "spec_acceptance_rate": (self.spec_accepted_tokens
+                                     / self.spec_draft_tokens)
+            if self.spec_draft_tokens else 0.0,
+            "mean_accepted_per_step": (self.spec_committed_tokens
+                                       / self.spec_row_steps)
+            if self.spec_row_steps else 0.0,
             "prefix_lookups": self.prefix_lookups,
             "prefix_hits": self.prefix_hits,
             "prefix_cows": self.prefix_cows,
@@ -329,6 +359,7 @@ class ServingMetrics:
                                                      99)),
             "token_latency_ms_p50": ms(_percentile(self.token_latency_s, 50)),
             "token_latency_ms_p95": ms(_percentile(self.token_latency_s, 95)),
+            "token_latency_ms_p99": ms(_percentile(self.token_latency_s, 99)),
             "decode_stall_ms_p50": ms(_percentile(self.decode_stall_s, 50)),
             "decode_stall_ms_p99": ms(_percentile(self.decode_stall_s, 99)),
             "decode_stall_ms_max": ms(_max(self.decode_stall_s)),

@@ -133,17 +133,27 @@ class Scheduler:
     tokens per step, co-scheduled with the decode rows inside the same token
     budget, so a long prompt never stalls the decode stream for a whole
     prompt-length forward pass. 0 keeps the legacy whole-prompt admission.
+
+    ``spec_tokens`` > 0 (the engine sets it when speculative decoding is on)
+    charges each decode-phase row ``1 + spec_tokens`` budget per step: a
+    spec row scores its pending token PLUS up to ``spec_tokens`` drafted
+    candidates in one forward, and the budget must reflect that worst case
+    even when a drafter proposes fewer (admission is planned before drafts
+    are computed).
     """
 
     def __init__(self, max_batch_size: int = 8, token_budget: int = 2048,
-                 chunk_size: int = 0):
+                 chunk_size: int = 0, spec_tokens: int = 0):
         if max_batch_size < 1:
             raise ValueError("max_batch_size must be >= 1")
         if chunk_size < 0:
             raise ValueError("chunk_size must be >= 0")
+        if spec_tokens < 0:
+            raise ValueError("spec_tokens must be >= 0")
         self.max_batch_size = int(max_batch_size)
         self.token_budget = int(token_budget)
         self.chunk_size = int(chunk_size)
+        self.spec_tokens = int(spec_tokens)
         self.waiting: Deque[Request] = deque()
         self.running: List[Request] = []  # admission order (oldest first)
         # engine-wired PrefixCache (or None): admission PROBES it — read
@@ -215,7 +225,9 @@ class Scheduler:
         prefilling: List[Request] = []
         for req in self.running:
             if req.cache_len >= req.prefill_len:
-                budget -= 1          # decode-phase row: one token this step
+                # decode-phase row: one token this step, plus up to
+                # spec_tokens drafted candidates scored alongside it
+                budget -= 1 + self.spec_tokens
             else:
                 prefilling.append(req)
         for i, req in enumerate(prefilling):

@@ -1,0 +1,143 @@
+"""Speculative decoding drafters for the serving engine.
+
+A drafter proposes up to ``k`` candidate continuation tokens for one decode
+row; the engine packs ``[next_token, d_1, ..., d_k]`` as a ragged
+``q_lens = k+1`` row into the EXISTING mixed prefill+decode step, scores all
+k+1 positions in one compiled forward, and commits the longest verified
+prefix plus one bonus token sampled from the first unverified position
+(classic speculative sampling: greedy mode accepts a draft iff it equals the
+argmax, stochastic mode runs rejection sampling against the target
+distribution — see engine._spec_verify). A good drafter turns one model step
+into several committed tokens; a bad one costs only the wasted tail
+positions, never correctness.
+
+Two implementations, the two cheap rungs of the drafting ladder:
+
+``NGramDrafter``
+    Self-speculative n-gram lookup: no second model at all. The row's own
+    context (prompt + generated tokens, including the pending next_token) is
+    scanned for the most recent earlier occurrence of its length-n suffix
+    (n = max_n down to min_n), and the tokens that followed that occurrence
+    are proposed verbatim. Repetitive text — code, templated prose, lists,
+    any loop the model has fallen into — drafts itself; novel text simply
+    returns no proposal and the row decodes normally.
+
+``DraftModelDrafter``
+    A small stand-in model (e.g. the zoo's ``gpt2_tiny``) autoregressively
+    greedy-decodes k tokens from the row's context with its own plain
+    ``apply_cached`` stack — single row, no pool, context width bucketed to
+    powers of two so the jit cache stays O(log max_len * k). The draft model
+    MUST share the target's vocabulary (token ids are proposed directly).
+
+Both drafters are deterministic given the context, which is what makes
+stochastic verification exact: the proposal distribution is a point mass, so
+accepting draft d with probability p_target(d) and renormalizing the residual
+with d removed is the textbook rejection-sampling recipe.
+"""
+from __future__ import annotations
+
+from typing import List
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class Drafter:
+    """Interface: propose up to ``k`` continuation tokens for a decode row.
+
+    ``draft(req, k)`` sees the request mid-decode — its context is
+    ``req.prompt`` followed by ``req.out_tokens`` (whose last element is the
+    pending ``next_token`` the engine is about to feed) — and returns 0..k
+    proposed token ids. Returning fewer (or none) is always legal: the row
+    just runs a narrower (or plain) decode step.
+    """
+
+    name = "base"
+
+    def draft(self, req, k: int) -> List[int]:
+        raise NotImplementedError
+
+
+def _context(req) -> np.ndarray:
+    out = np.asarray(req.out_tokens, np.int32)
+    return np.concatenate([req.prompt, out]) if out.size else req.prompt
+
+
+class NGramDrafter(Drafter):
+    """Prompt+output suffix lookup: propose the tokens that followed the most
+    recent earlier occurrence of the context's length-n suffix."""
+
+    name = "ngram"
+
+    def __init__(self, max_n: int = 3, min_n: int = 1):
+        if not 1 <= min_n <= max_n:
+            raise ValueError(f"need 1 <= min_n <= max_n, got "
+                             f"[{min_n}, {max_n}]")
+        self.max_n = int(max_n)
+        self.min_n = int(min_n)
+
+    def draft(self, req, k: int) -> List[int]:
+        ctx = _context(req)
+        for n in range(min(self.max_n, len(ctx) - 1), self.min_n - 1, -1):
+            suffix = ctx[-n:]
+            # windows over ctx[:-1]: every start i has at least one token
+            # after the match (i + n <= len - 1); the suffix's own start
+            # (len - n) is excluded by construction
+            windows = np.lib.stride_tricks.sliding_window_view(ctx[:-1], n)
+            hits = np.flatnonzero((windows == suffix).all(axis=1))
+            if hits.size:
+                j = int(hits[-1]) + n     # most recent repetition wins
+                return [int(t) for t in ctx[j:j + k]]
+        return []
+
+
+class DraftModelDrafter(Drafter):
+    """Tiny stand-in model running its own single-row greedy decode."""
+
+    name = "draft"
+
+    def __init__(self, model, params):
+        self.model = model
+        self.params = params
+        self._jit = {}
+
+    def draft(self, req, k: int) -> List[int]:
+        ctx = _context(req)
+        # the draft model's own position cap: it may be shorter than the
+        # target's — clamp rather than fail, a shorter draft is still useful
+        k = min(k, self.model.max_len - len(ctx))
+        if k < 1 or len(ctx) < 1:
+            return []
+        width = 1 << (len(ctx) - 1).bit_length()
+        if width + k > self.model.max_len:
+            width = len(ctx)              # no pow2 headroom near the cap
+        key = (width, k)
+        fn = self._jit.get(key)
+        if fn is None:
+            fn = self._jit[key] = self._draft_fn(width, k)
+        ids = np.zeros((1, width), np.int32)
+        ids[0, :len(ctx)] = ctx
+        toks = fn(self.params, jnp.asarray(ids),
+                  jnp.asarray(len(ctx), jnp.int32))
+        return [int(t) for t in np.asarray(toks)]
+
+    def _draft_fn(self, width: int, k: int):
+        model = self.model
+
+        def fn(params, ids, length):
+            # prefill the padded context in one pass; positions past
+            # ``length`` hold garbage KV but the causal mask keeps every
+            # attended position < the query offset, so they are never read
+            caches = model.init_cache(1, width + k)
+            logits, caches = model.apply_cached(params, ids, caches, 0)
+            tok = jnp.argmax(logits[0, length - 1]).astype(jnp.int32)
+            drafts = [tok]
+            for j in range(k - 1):
+                logits, caches = model.apply_cached(
+                    params, tok[None, None], caches, length + j)
+                tok = jnp.argmax(logits[0, -1]).astype(jnp.int32)
+                drafts.append(tok)
+            return jnp.stack(drafts)
+
+        return jax.jit(fn)
