@@ -14,6 +14,9 @@ pip install -e . --no-build-isolation -q
 echo "== native release build =="
 make -C native -j
 
+echo "== tnnlint (serving-contract static checks, docs/lint.md) =="
+python -m tools.tnnlint
+
 echo "== CPU test suite (virtual 8-device mesh) =="
 python -m pytest tests/ -q
 

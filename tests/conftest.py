@@ -68,6 +68,8 @@ _SLOW_TESTS = {
     "test_loss_decreases_and_checkpoints",
     "test_nested_blocks_config_roundtrip", "test_wrn16_8_param_count",
     "test_gpt2_param_count_small",
+    "test_serve_bench_smoke", "test_serve_bench_chaos",
+    "test_tp_llama_matches_single_device",
 }
 
 

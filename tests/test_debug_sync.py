@@ -1,0 +1,135 @@
+"""TNN_DEBUG_SYNC transfer-guard tests.
+
+Under ``TNN_DEBUG_SYNC=1`` the engine wraps every ``step()`` in
+``jax.transfer_guard("disallow")``: all host<->device traffic inside the
+step must flow through the explicit ``_put`` / ``jax.device_get`` points,
+and any implicit transfer (a raw numpy array or Python scalar committed at
+jit dispatch, an implicit fetch) raises at the exact line that caused it.
+
+Two directions, both required:
+
+* a CLEAN step runs unchanged under the guard — same tokens, no errors —
+  proving the hot path really is transfer-explicit, and
+* a PLANTED implicit transfer (``_put`` monkeypatched back to the raw
+  host array it used to pass) trips the guard and fails the request with
+  a "transfer" error, proving the guard actually has teeth.
+"""
+import importlib
+import threading
+
+import numpy as np
+import pytest
+
+import jax
+
+from tnn_tpu.serving import InferenceEngine, RequestState
+
+KW = dict(num_blocks=32, block_size=4, max_batch_size=4, max_seq_len=32)
+
+
+@pytest.fixture(scope="module")
+def tiny_lm():
+    from tnn_tpu.models.gpt2 import GPT2
+
+    model = GPT2(vocab_size=128, max_len=64, num_layers=2, d_model=32,
+                 num_heads=2)
+    params = model.init(jax.random.PRNGKey(0), (1, 8))["params"]
+    return model, params
+
+
+def _run(model, params, **kw):
+    eng = InferenceEngine(model, params, **{**KW, **kw})
+    rng = np.random.default_rng(7)
+    prompts = [rng.integers(0, 128, p).astype(np.int32) for p in (5, 9, 12)]
+    rids = [eng.submit(p, 8) for p in prompts]
+    out = eng.run_until_complete()
+    return [out[r] for r in rids]
+
+
+@pytest.fixture(scope="module")
+def baseline(tiny_lm):
+    """Guard-off greedy reference, shared across the parity tests (each
+    engine run recompiles the step shapes — one reference run, not three).
+    Spec-on greedy output equals spec-off (test_serving's parity gates), so
+    this one baseline serves the spec test too."""
+    model, params = tiny_lm
+    return _run(model, params)
+
+
+class TestDebugSync:
+    def test_guard_off_by_default(self, tiny_lm):
+        model, params = tiny_lm
+        eng = InferenceEngine(model, params, **KW)
+        assert eng.debug_sync is False
+
+    def test_clean_step_token_exact_under_guard(self, tiny_lm, baseline,
+                                                monkeypatch):
+        """The guarded step is a no-op for correct code: token-for-token
+        identical to the unguarded run, nothing raises."""
+        model, params = tiny_lm
+        monkeypatch.setenv("TNN_DEBUG_SYNC", "1")
+        assert _run(model, params) == baseline
+
+    def test_spec_decode_clean_under_guard(self, tiny_lm, baseline,
+                                           monkeypatch):
+        """Drafters run INSIDE the step's guard; the draft-model drafter's
+        own dispatch/fetch must therefore be explicit too."""
+        model, params = tiny_lm
+        monkeypatch.setenv("TNN_DEBUG_SYNC", "1")
+        got = _run(model, params, spec="draft", draft_model=model,
+                   draft_params=params, spec_k=3)
+        assert got == baseline
+
+    def test_planted_transfer_trips_guard(self, tiny_lm, monkeypatch):
+        """Reintroduce the implicit host->device commit the explicit _put
+        replaced: under the guard the step must fail the request with a
+        transfer error rather than silently syncing."""
+        model, params = tiny_lm
+        monkeypatch.setenv("TNN_DEBUG_SYNC", "1")
+        monkeypatch.setattr(InferenceEngine, "_put",
+                            lambda self, x, dtype=None: np.asarray(x, dtype))
+        eng = InferenceEngine(model, params, **KW)
+        rid = eng.submit(np.arange(5, dtype=np.int32), 4)
+        eng.run_until_complete()
+        res = eng.result(rid)
+        assert res.state is RequestState.FAILED
+        assert "transfer" in res.error.lower()
+
+    def test_planted_transfer_harmless_without_guard(self, tiny_lm, baseline,
+                                                     monkeypatch):
+        """Negative control: the same plant without TNN_DEBUG_SYNC decodes
+        normally — the guard, not the plant, is what raises."""
+        model, params = tiny_lm
+        monkeypatch.setattr(InferenceEngine, "_put",
+                            lambda self, x, dtype=None: np.asarray(x, dtype))
+        assert _run(model, params) == baseline
+
+
+class TestWorkerOnlyRuntime:
+    """TNN_DEBUG_THREADS=1 arms @worker_only's owning-thread assert (the
+    static side of the contract is the cross-thread-engine-access lint
+    rule, tests/test_lint.py)."""
+
+    def test_assert_fires_only_cross_thread(self, monkeypatch):
+        from tnn_tpu.serving import ownership
+
+        monkeypatch.setenv("TNN_DEBUG_THREADS", "1")
+        mod = importlib.reload(ownership)  # the knob is read at import
+        try:
+            class Owner:
+                _thread = None
+
+                @mod.worker_only
+                def poke(self):
+                    return 1
+
+            o = Owner()
+            assert o.poke() == 1                  # no worker: caller owns
+            o._thread = threading.current_thread()
+            assert o.poke() == 1                  # on the owning thread
+            o._thread = threading.Thread(name="worker-0")
+            with pytest.raises(AssertionError, match="owned by"):
+                o.poke()
+        finally:
+            monkeypatch.delenv("TNN_DEBUG_THREADS")
+            importlib.reload(mod)

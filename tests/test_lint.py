@@ -1,0 +1,363 @@
+"""tnnlint tests: one positive and one negative fixture per rule, the
+suppression/baseline machinery, and the repo-wide tier-1 gate.
+
+The fixtures are the executable spec of each contract: the positive shows
+the exact anti-pattern the rule exists to catch, the negative shows the
+blessed idiom that must stay clean. The gate at the bottom is the real
+enforcement: ``tnn_tpu/`` lints to zero findings against an EMPTY baseline,
+so any new violation fails tier-1 until it is fixed or suppressed with an
+inline justification.
+"""
+from pathlib import Path
+
+import pytest
+
+from tools.tnnlint import lint_source, lint_paths, rule_registry
+from tools.tnnlint.baseline import compare, read_baseline, write_baseline
+from tools.tnnlint.cli import main
+from tools.tnnlint.config import load_config
+from tools.tnnlint.core import BARE_SUPPRESSION
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def _rules(src, select):
+    return [v.rule for v in lint_source(src, select=[select])]
+
+
+# -- rule fixtures: positive (must flag) / negative (must stay clean) ---------
+
+
+class TestUnboundedCompileKey:
+    def test_raw_length_in_key_flags(self):
+        assert _rules('''
+class E:
+    def step(self, n):
+        key = (n, self.mode)
+        fn = self._jit.get(key)
+''', "unbounded-compile-key") == ["unbounded-compile-key"]
+
+    def test_bucketed_key_clean(self):
+        assert _rules('''
+from tnn_tpu.utils.bucketing import pow2_bucket
+class E:
+    def step(self, n):
+        key = (pow2_bucket(n), self.mode)
+        fn = self._jit.get(key)
+''', "unbounded-compile-key") == []
+
+    def test_min_against_fixed_geometry_clean(self):
+        # min() has bounded range as soon as ONE argument is bounded
+        assert _rules('''
+class E:
+    def step(self, n):
+        key = (min(n, self.max_batch_size),)
+        fn = self._jit[key]
+''', "unbounded-compile-key") == []
+
+
+class TestUseAfterDonate:
+    BUILDER = '''
+import jax
+class E:
+    def _step_fn(self):
+        def fn(pages_k, pages_v):
+            return pages_k, pages_v
+        return jax.jit(fn, donate_argnums=(0, 1))
+
+    def step(self):
+        fn = self._jit.get(key)
+        if fn is None:
+            fn = self._jit[key] = self._step_fn()
+        pk, pv = fn(self.pool.pages_k, self.pool.pages_v)
+'''
+
+    def test_read_after_donation_flags(self):
+        src = self.BUILDER + '''
+        shape = self.pool.pages_k.shape
+        self.pool.update_pages(pk, pv)
+'''
+        assert _rules(src, "use-after-donate") == ["use-after-donate"]
+
+    def test_read_after_readoption_clean(self):
+        src = self.BUILDER + '''
+        self.pool.update_pages(pk, pv)
+        shape = self.pool.pages_k.shape
+'''
+        assert _rules(src, "use-after-donate") == []
+
+
+class TestHostSyncInStepPath:
+    def test_int_on_device_value_flags(self):
+        assert _rules('''
+class InferenceEngine:
+    def step(self):
+        fn = self._jit[("d", 4)]
+        tok = fn(self.params)
+        return int(tok)
+''', "host-sync-in-step-path") == ["host-sync-in-step-path"]
+
+    def test_branch_on_device_value_flags(self):
+        assert _rules('''
+class InferenceEngine:
+    def step(self):
+        out = self._decode_fn(self.params)
+        if out:
+            return 1
+''', "host-sync-in-step-path") == ["host-sync-in-step-path"]
+
+    def test_batched_device_get_clean(self):
+        assert _rules('''
+import jax
+class InferenceEngine:
+    def step(self):
+        fn = self._jit[("d", 4)]
+        tok = fn(self.params)
+        tok = jax.device_get(tok)
+        return int(tok)
+''', "host-sync-in-step-path") == []
+
+    def test_off_step_path_clean(self):
+        # same sync pattern outside the configured roots: not a finding
+        assert _rules('''
+class Offline:
+    def generate(self):
+        tok = self._decode_fn(self.params)
+        return int(tok)
+''', "host-sync-in-step-path") == []
+
+
+class TestPrngKeyReuse:
+    def test_double_consumption_flags(self):
+        assert _rules('''
+def sample(key):
+    a = draw(key)
+    b = draw(key)
+''', "prng-key-reuse") == ["prng-key-reuse"]
+
+    def test_split_between_uses_clean(self):
+        assert _rules('''
+import jax
+def sample(key):
+    k1, k2 = jax.random.split(key)
+    a = draw(k1)
+    b = draw(k2)
+''', "prng-key-reuse") == []
+
+    def test_exclusive_branches_clean(self):
+        # if/else arms never both execute: one consumption per trace
+        assert _rules('''
+def sample(key, fast):
+    if fast:
+        return draw(key)
+    else:
+        return draw2(key)
+''', "prng-key-reuse") == []
+
+
+class TestCrossThreadEngineAccess:
+    def test_unmarked_owner_method_flags(self):
+        assert _rules('''
+class EngineSupervisor:
+    def stats(self):
+        return self.engine.metrics.snapshot()
+''', "cross-thread-engine-access") == ["cross-thread-engine-access"]
+
+    def test_worker_only_method_clean(self):
+        assert _rules('''
+from tnn_tpu.serving.ownership import worker_only
+class EngineSupervisor:
+    @worker_only
+    def _tick(self):
+        return self.engine.metrics.snapshot()
+''', "cross-thread-engine-access") == []
+
+    def test_reach_through_flags(self):
+        # any class reaching THROUGH an engine reference is a violation
+        assert _rules('''
+class Server:
+    def health(self):
+        return self.sup.engine.scheduler.queue_depth
+''', "cross-thread-engine-access") == ["cross-thread-engine-access"]
+
+    def test_passing_engine_reference_clean(self):
+        # handing the reference around is fine; dereferencing it is not
+        assert _rules('''
+class EngineSupervisor:
+    def attach(self, sink):
+        sink.register(self.engine)
+''', "cross-thread-engine-access") == []
+
+
+class TestUnpairedPoolMutation:
+    def test_unchecked_mutation_flags(self):
+        assert _rules('''
+class PagedKVPool:
+    def alloc(self, n):
+        block = self._free.pop()
+        return block
+''', "unpaired-pool-mutation") == ["unpaired-pool-mutation"]
+
+    def test_checked_mutation_clean(self):
+        assert _rules('''
+class PagedKVPool:
+    def alloc(self, n):
+        block = self._free.pop()
+        self._debug_check()
+        return block
+
+    def _debug_check(self):
+        if self.debug:
+            self.check_invariants()
+''', "unpaired-pool-mutation") == []
+
+
+# -- framework machinery ------------------------------------------------------
+
+
+POS = '''
+class E:
+    def step(self, n):
+        key = (n,)  {sup}
+        fn = self._jit.get(key)
+'''
+
+
+class TestSuppressions:
+    def test_justified_suppression_drops_finding(self):
+        src = POS.format(
+            sup="# tnnlint: disable=unbounded-compile-key -- n is clamped "
+                "by the caller")
+        assert lint_source(src) == []
+
+    def test_preceding_comment_line_covers_next_line(self):
+        src = ('class E:\n'
+               '    def step(self, n):\n'
+               '        # tnnlint: disable=unbounded-compile-key -- clamped\n'
+               '        key = (n,)\n'
+               '        fn = self._jit.get(key)\n')
+        assert lint_source(src) == []
+
+    def test_bare_suppression_is_itself_a_violation(self):
+        src = POS.format(sup="# tnnlint: disable=unbounded-compile-key")
+        rules = [v.rule for v in lint_source(src)]
+        assert rules == [BARE_SUPPRESSION]
+
+    def test_bare_suppression_cannot_be_suppressed(self):
+        src = "x = 1  # tnnlint: disable=bare-suppression -- nice try\n"
+        assert [v.rule for v in lint_source(src)] == [BARE_SUPPRESSION]
+
+    def test_unrelated_rule_suppression_does_not_mask(self):
+        src = POS.format(sup="# tnnlint: disable=prng-key-reuse -- wrong one")
+        assert [v.rule for v in lint_source(src)] == ["unbounded-compile-key"]
+
+
+class TestDriver:
+    def test_all_six_rules_registered(self):
+        assert set(rule_registry()) == {
+            "unbounded-compile-key", "use-after-donate",
+            "host-sync-in-step-path", "prng-key-reuse",
+            "cross-thread-engine-access", "unpaired-pool-mutation"}
+
+    def test_unknown_rule_name_rejected(self):
+        with pytest.raises(ValueError, match="unknown rule"):
+            lint_source("x = 1", select=["no-such-rule"])
+
+    def test_syntax_error_reported_not_raised(self):
+        vs = lint_source("def f(:\n")
+        assert [v.rule for v in vs] == ["parse-error"]
+
+
+class TestBaseline:
+    def _findings(self):
+        return lint_source(POS.format(sup=""), path="fake.py")
+
+    def test_round_trip(self, tmp_path):
+        vs = self._findings()
+        assert vs
+        bl = tmp_path / "baseline.json"
+        write_baseline(bl, vs)
+        fresh, stale = compare(vs, read_baseline(bl))
+        assert fresh == [] and stale == []
+
+    def test_new_finding_is_fresh(self, tmp_path):
+        bl = tmp_path / "baseline.json"
+        write_baseline(bl, [])
+        fresh, stale = compare(self._findings(), read_baseline(bl))
+        assert [v.rule for v in fresh] == ["unbounded-compile-key"]
+        assert stale == []
+
+    def test_fixed_finding_goes_stale(self, tmp_path):
+        bl = tmp_path / "baseline.json"
+        write_baseline(bl, self._findings())
+        fresh, stale = compare([], read_baseline(bl))
+        assert fresh == [] and len(stale) == 1
+
+    def test_fingerprint_survives_line_shift(self):
+        a = lint_source(POS.format(sup=""), path="fake.py")[0]
+        b = lint_source("\n\n" + POS.format(sup=""), path="fake.py")[0]
+        assert a.line != b.line
+        assert a.fingerprint() == b.fingerprint()
+
+
+class TestCli:
+    def test_clean_file_exits_zero(self, tmp_path, capsys):
+        f = tmp_path / "ok.py"
+        f.write_text("x = 1\n")
+        assert main([str(f), "--no-baseline"]) == 0
+        assert "clean" in capsys.readouterr().out
+
+    def test_violation_exits_one(self, tmp_path, capsys):
+        f = tmp_path / "bad.py"
+        f.write_text(POS.format(sup=""))
+        assert main([str(f), "--no-baseline"]) == 1
+        assert "unbounded-compile-key" in capsys.readouterr().out
+
+    def test_write_then_check_baseline(self, tmp_path, capsys):
+        f = tmp_path / "bad.py"
+        f.write_text(POS.format(sup=""))
+        bl = tmp_path / "bl.json"
+        assert main([str(f), "--baseline", str(bl), "--write-baseline"]) == 0
+        capsys.readouterr()
+        # baselined: same findings no longer fail the run
+        assert main([str(f), "--baseline", str(bl)]) == 0
+
+    def test_stale_baseline_entry_exits_one(self, tmp_path, capsys):
+        f = tmp_path / "bad.py"
+        f.write_text(POS.format(sup=""))
+        bl = tmp_path / "bl.json"
+        assert main([str(f), "--baseline", str(bl), "--write-baseline"]) == 0
+        f.write_text("x = 1\n")  # fixed: baseline entry is now stale
+        capsys.readouterr()
+        assert main([str(f), "--baseline", str(bl)]) == 1
+        assert "stale" in capsys.readouterr().out
+
+    def test_unknown_rule_exits_two(self, tmp_path, capsys):
+        f = tmp_path / "ok.py"
+        f.write_text("x = 1\n")
+        assert main([str(f), "--select", "bogus", "--no-baseline"]) == 2
+
+
+# -- the tier-1 gate ----------------------------------------------------------
+
+
+class TestRepoGate:
+    def test_tnn_tpu_lints_clean(self):
+        """The enforced contract: zero findings over the whole package with
+        the committed pyproject config. New violations fail here until fixed
+        or suppressed with an inline justification."""
+        cfg = load_config(REPO)
+        vs = lint_paths([str(REPO / p) for p in cfg["paths"]],
+                        options=cfg["rules"], ignore=cfg["ignore"],
+                        exclude=cfg["exclude"])
+        assert vs == [], "\n" + "\n".join(v.render() for v in vs)
+
+    def test_committed_baseline_is_empty(self):
+        baseline = read_baseline(REPO / "tools" / "tnnlint" / "baseline.json")
+        assert baseline == {}, (
+            "the baseline must stay empty — fix new findings or add an "
+            "inline justified suppression instead of baselining them")
+
+    def test_cli_default_invocation_clean(self, monkeypatch, capsys):
+        monkeypatch.chdir(REPO)
+        assert main([]) == 0

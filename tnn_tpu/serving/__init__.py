@@ -19,6 +19,7 @@ from .faults import EngineCrash, FaultInjected, FaultPlan
 from .kv_pool import (PagedKVPool, PoolExhausted, gather_kv, scatter_prefill,
                       scatter_token)
 from .metrics import ServingMetrics
+from .ownership import worker_only
 from .prefix_cache import PrefixCache
 from .scheduler import (TERMINAL_STATES, AdmissionRejected, Request,
                         RequestState, Scheduler, StepPlan)
@@ -31,5 +32,5 @@ __all__ = [
     "Request", "RequestState", "Scheduler", "StepPlan", "AdmissionRejected",
     "TERMINAL_STATES", "FaultPlan", "FaultInjected", "EngineCrash",
     "EngineSupervisor", "SupervisorState", "ShuttingDown",
-    "ServingServer", "run_server",
+    "ServingServer", "run_server", "worker_only",
 ]

@@ -92,7 +92,9 @@ tests.
 from __future__ import annotations
 
 import itertools
+import os
 import time
+from contextlib import nullcontext
 from typing import Any, Dict, List, Optional, Sequence
 
 import jax
@@ -101,6 +103,7 @@ import numpy as np
 
 from ..models import sampling
 from ..profiling.profiler import EventType, Profiler, profiled
+from ..utils.bucketing import pow2_bucket
 from . import kv_pool as kv_pool_lib
 from . import spec_decode
 from .faults import FaultInjected, FaultPlan
@@ -266,6 +269,13 @@ class InferenceEngine:
         self._rid = itertools.count()
         self._key = jax.random.PRNGKey(seed)
         self._jit: Dict[Any, Any] = {}
+        # TNN_DEBUG_SYNC=1: run every step under jax.transfer_guard
+        # ("disallow") — the dynamic complement to tnnlint's static
+        # host-sync-in-step-path rule. All intentional step inputs go
+        # through _put (explicit device_put) and all fetches through
+        # jax.device_get, so any implicit transfer left on the step path
+        # raises instead of silently stalling the pipeline.
+        self.debug_sync = os.environ.get("TNN_DEBUG_SYNC", "") == "1"
         self.paged_fallback_reason: Optional[str] = None
         self.fused_fallback_reason: Optional[str] = None
         self._paged = False
@@ -487,6 +497,23 @@ class InferenceEngine:
         logits, oversized resume, exhausted preemption budget) lands in
         ``failed`` and the rest of the batch keeps decoding.
         """
+        with self._sync_guard():
+            return self._step_inner()
+
+    def _sync_guard(self):
+        """``jax.transfer_guard("disallow")`` under TNN_DEBUG_SYNC=1: every
+        implicit host<->device transfer inside the step raises.  _put and
+        jax.device_get are explicit, so a clean step runs unchanged."""
+        if self.debug_sync:
+            return jax.transfer_guard("disallow")
+        return nullcontext()
+
+    def _put(self, x, dtype=None):
+        """Explicit host->device transfer for step inputs (guard-proof
+        replacement for the implicit jnp.asarray commit at dispatch)."""
+        return jax.device_put(np.asarray(x, dtype))
+
+    def _step_inner(self) -> Dict[str, List]:
         events: Dict[str, List] = {"tokens": [], "finished": [],
                                    "failed": [], "timed_out": []}
         if self.faults is not None:
@@ -610,7 +637,7 @@ class InferenceEngine:
         # assembly width) so N distinct prompt lengths cost O(log N) compiles,
         # not one each; only the nb real blocks are allocated — the bucket's
         # tail rows scatter into the reserved scratch block and vanish
-        nb_bucket = min(self.blocks_per_seq, 1 << (nb - 1).bit_length())
+        nb_bucket = pow2_bucket(nb, cap=self.blocks_per_seq)
         padded = nb_bucket * bs
         blocks = req.block_table
         ids = np.zeros((1, padded), np.int32)
@@ -627,13 +654,15 @@ class InferenceEngine:
                           self.profiler):
                 tok, ok, pk, pv = fn(
                     self.params, self.pool.pages_k, self.pool.pages_v,
-                    jnp.asarray(ids), jnp.asarray(len(seq), jnp.int32),
-                    jnp.asarray(self.pool.padded_table(blocks, nb_bucket),
-                                jnp.int32),
-                    jnp.asarray(req.temperature, jnp.float32),
-                    jnp.asarray(req.top_k, jnp.int32),
-                    jnp.asarray(req.top_p, jnp.float32), self._next_key(),
-                    jnp.asarray(poison))
+                    self._put(ids), self._put(len(seq), jnp.int32),
+                    self._put(self.pool.padded_table(blocks, nb_bucket),
+                              jnp.int32),
+                    self._put(req.temperature, jnp.float32),
+                    self._put(req.top_k, jnp.int32),
+                    self._put(req.top_p, jnp.float32), self._next_key(),
+                    self._put(poison))
+                # one explicit batched fetch instead of two implicit syncs
+                tok, ok = jax.device_get((tok, ok))
                 tok, ok = int(tok), bool(ok)
         except Exception as e:  # noqa: BLE001 — isolate, don't crash serving
             self._terminate(req, RequestState.FAILED,
@@ -719,8 +748,8 @@ class InferenceEngine:
             if fn is None:
                 fn = self._jit[("cow",)] = self._cow_copy_fn()
             pk, pv = fn(self.pool.pages_k, self.pool.pages_v,
-                        jnp.asarray(blocks[-1], jnp.int32),
-                        jnp.asarray(copy[0], jnp.int32))
+                        self._put(blocks[-1], jnp.int32),
+                        self._put(copy[0], jnp.int32))
             self.pool.update_pages(pk, pv)
             table = table + copy
             self.metrics.observe_prefix_cow()
@@ -899,7 +928,7 @@ class InferenceEngine:
         # O(log chunk_size) compiles
         widest = max([t for _, t in chk]
                      + [1 + len(drafts.get(r.rid, ())) for r in dec])
-        qw = 1 << (widest - 1).bit_length()
+        qw = pow2_bucket(widest)
         b = self.scheduler.max_batch_size
         nb = self.blocks_per_seq
         toks = np.zeros((b, qw), np.int32)
@@ -958,21 +987,22 @@ class InferenceEngine:
                     if spec_on:
                         accepts, newtok, ok, pk, pv = fn(
                             self.params, self.pool.pages_k, self.pool.pages_v,
-                            jnp.asarray(toks), jnp.asarray(starts),
-                            jnp.asarray(q_lens), jnp.asarray(tables),
-                            jnp.asarray(n_draft), jnp.asarray(temps),
-                            jnp.asarray(topks), jnp.asarray(topps), step_key,
-                            jnp.asarray(poison))
-                        accepts = np.asarray(accepts)
+                            self._put(toks), self._put(starts),
+                            self._put(q_lens), self._put(tables),
+                            self._put(n_draft), self._put(temps),
+                            self._put(topks), self._put(topps), step_key,
+                            self._put(poison))
+                        # one explicit batched fetch instead of three syncs
+                        accepts, newtok, ok = jax.device_get(
+                            (accepts, newtok, ok))
                     else:
                         newtok, ok, pk, pv = fn(
                             self.params, self.pool.pages_k, self.pool.pages_v,
-                            jnp.asarray(toks), jnp.asarray(starts),
-                            jnp.asarray(q_lens), jnp.asarray(tables),
-                            jnp.asarray(temps), jnp.asarray(topks),
-                            jnp.asarray(topps), step_key, jnp.asarray(poison))
-                    newtok = np.asarray(newtok)
-                    ok = np.asarray(ok)
+                            self._put(toks), self._put(starts),
+                            self._put(q_lens), self._put(tables),
+                            self._put(temps), self._put(topks),
+                            self._put(topps), step_key, self._put(poison))
+                        newtok, ok = jax.device_get((newtok, ok))
                 break
             except FaultInjected as e:
                 # injected pre-call: donated buffers untouched, retryable
@@ -1392,20 +1422,20 @@ class InferenceEngine:
                         newtok, ok, pk, pv = fn(
                             self.params, self._fused["stacks"],
                             self.pool.pages_k, self.pool.pages_v,
-                            jnp.asarray(toks),
-                            jnp.asarray(int(offsets[0]), jnp.int32),
-                            jnp.asarray(tables), jnp.asarray(temps),
-                            jnp.asarray(topks), jnp.asarray(topps), step_key,
-                            jnp.asarray(poison))
+                            self._put(toks),
+                            self._put(int(offsets[0]), jnp.int32),
+                            self._put(tables), self._put(temps),
+                            self._put(topks), self._put(topps), step_key,
+                            self._put(poison))
                     else:
                         newtok, ok, pk, pv = fn(
                             self.params, self.pool.pages_k, self.pool.pages_v,
-                            jnp.asarray(toks), jnp.asarray(offsets),
-                            jnp.asarray(tables), jnp.asarray(temps),
-                            jnp.asarray(topks), jnp.asarray(topps), step_key,
-                            jnp.asarray(poison))
-                    newtok = np.asarray(newtok)
-                    ok = np.asarray(ok)
+                            self._put(toks), self._put(offsets),
+                            self._put(tables), self._put(temps),
+                            self._put(topks), self._put(topps), step_key,
+                            self._put(poison))
+                    # one explicit batched fetch instead of two syncs
+                    newtok, ok = jax.device_get((newtok, ok))
                 break
             except FaultInjected as e:
                 # injected pre-call: donated buffers untouched, retryable

@@ -47,7 +47,9 @@ import os
 from collections import Counter, OrderedDict
 from typing import Callable, Dict, Iterable, List, Optional, Sequence
 
+import jax
 import jax.numpy as jnp
+import numpy as np
 
 
 class PoolExhausted(RuntimeError):
@@ -158,6 +160,7 @@ class PagedKVPool:
         blocks = [self._free.pop() for _ in range(n)]
         for b in blocks:
             self._ref[b] = 1
+        self._debug_check()
         return blocks
 
     def _reclaim(self, n: int) -> List[int]:
@@ -170,6 +173,7 @@ class PagedKVPool:
             self._free.append(b)
         if taken and self.reclaim_hook is not None:
             self.reclaim_hook(taken)
+        self._debug_check()
         return taken
 
     def fork(self, blocks: Sequence[int]) -> List[int]:
@@ -185,6 +189,7 @@ class PagedKVPool:
                 self._ref[b] = 1
             else:
                 raise KeyError(f"block {b} is not allocated")
+        self._debug_check()
         return list(blocks)
 
     def free(self, blocks: Sequence[int]) -> None:
@@ -207,6 +212,12 @@ class PagedKVPool:
                     self._free.append(b)
             else:
                 self._ref[b] = r - 1
+        self._debug_check()
+
+    def _debug_check(self) -> None:
+        """Partition self-check after every bookkeeping mutation, active
+        under TNN_POOL_DEBUG=1 — so a broken free/allocated/evictable
+        partition raises at the mutation that broke it, not at decode."""
         if self.debug:
             self.check_invariants()
 
@@ -347,8 +358,11 @@ class PagedKVPool:
         zeroed pages must never be matchable."""
         shape = (self.num_layers, self.num_blocks, self.num_kv_heads,
                  self.block_size, self.head_dim)
-        self.pages_k = jnp.zeros(shape, self.dtype)
-        self.pages_v = jnp.zeros(shape, self.dtype)
+        # explicit puts, not jnp.zeros: recovery runs inside the step's
+        # TNN_DEBUG_SYNC transfer guard, where eager jnp ops (which commit
+        # their scalar operands implicitly) are disallowed
+        self.pages_k = jax.device_put(np.zeros(shape, np.dtype(self.dtype)))
+        self.pages_v = jax.device_put(np.zeros(shape, np.dtype(self.dtype)))
 
     def padded_table(self, block_table: Sequence[int], width: int):
         """Right-pad a block table with SCRATCH to a fixed ``width``."""

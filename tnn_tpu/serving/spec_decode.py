@@ -42,6 +42,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..utils.bucketing import pow2_bucket
+
 
 class Drafter:
     """Interface: propose up to ``k`` continuation tokens for a decode row.
@@ -109,18 +111,22 @@ class DraftModelDrafter(Drafter):
         k = min(k, self.model.max_len - len(ctx))
         if k < 1 or len(ctx) < 1:
             return []
-        width = 1 << (len(ctx) - 1).bit_length()
-        if width + k > self.model.max_len:
-            width = len(ctx)              # no pow2 headroom near the cap
-        key = (width, k)
+        # cap keeps width + k <= max_len (the clamp above guarantees
+        # len(ctx) <= max_len - k, so the bucket never undershoots ctx)
+        width = pow2_bucket(len(ctx), cap=self.model.max_len - k)
+        # k <= engine.spec_k (small, fixed per engine) and is further
+        # clamped to the draft model's position budget just above
+        key = (width, k)  # tnnlint: disable=unbounded-compile-key -- k is bounded by engine.spec_k and the max_len clamp
         fn = self._jit.get(key)
         if fn is None:
             fn = self._jit[key] = self._draft_fn(width, k)
         ids = np.zeros((1, width), np.int32)
         ids[0, :len(ctx)] = ctx
-        toks = fn(self.params, jnp.asarray(ids),
-                  jnp.asarray(len(ctx), jnp.int32))
-        return [int(t) for t in np.asarray(toks)]
+        # explicit transfers both ways: draft() runs inside the engine step's
+        # TNN_DEBUG_SYNC transfer guard
+        toks = fn(self.params, jax.device_put(ids),
+                  jax.device_put(np.int32(len(ctx))))
+        return [int(t) for t in jax.device_get(toks)]
 
     def _draft_fn(self, width: int, k: int):
         model = self.model

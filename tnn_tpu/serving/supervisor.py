@@ -59,6 +59,7 @@ from concurrent.futures import Future
 from enum import Enum
 from typing import Any, Callable, Dict, List, Optional
 
+from .ownership import worker_only
 from .scheduler import Request, RequestState
 
 
@@ -217,10 +218,13 @@ class EngineSupervisor:
 
     # -- synchronous drivers (tests / single-threaded harnesses) --------------
 
+    @worker_only
     def run_sync(self, max_steps: int = 100_000) -> None:
         """Drive the loop inline on the calling thread until the engine is
         idle (or, when draining, until the drain completes). Deterministic —
-        the chaos suite's harness. Incompatible with ``start()``."""
+        the chaos suite's harness. Incompatible with ``start()`` (the
+        ``@worker_only`` contract: with no worker thread, the caller IS the
+        engine's owning thread)."""
         if self._thread is not None:
             raise RuntimeError("run_sync is for unstarted supervisors")
         if self._state is SupervisorState.NEW:
@@ -233,6 +237,7 @@ class EngineSupervisor:
                 return
         raise RuntimeError(f"run_sync exceeded {max_steps} steps")
 
+    @worker_only
     def pump(self, max_steps: int = 1) -> None:
         """Process pending commands and at most ``max_steps`` engine steps
         inline — fine-grained deterministic control for tests."""
@@ -310,6 +315,7 @@ class EngineSupervisor:
 
     # -- engine-thread internals ----------------------------------------------
 
+    @worker_only
     def _do_submit(self, prompt_ids, max_new_tokens,
                    listener: Optional[EventListener], kwargs) -> int:
         if self._state in (SupervisorState.DRAINING, SupervisorState.STOPPED,
@@ -322,6 +328,7 @@ class EngineSupervisor:
             self._listeners[rid] = listener
         return rid
 
+    @worker_only
     def _stats(self) -> Dict[str, Any]:
         s = self.engine.stats()
         s["supervisor_state"] = self._state.value
@@ -366,6 +373,7 @@ class EngineSupervisor:
                 except Exception:  # noqa: BLE001 — a bad listener can't
                     pass           # take down the loop
 
+    @worker_only
     def _restart(self, reason: str) -> None:
         self.restarts += 1
         self.engine.metrics.observe_restart()
@@ -388,6 +396,7 @@ class EngineSupervisor:
         if backoff > 0:
             time.sleep(backoff)
 
+    @worker_only
     def _finish_drain(self) -> None:
         started = self._drain_started
         self.drain_duration_s = (
@@ -402,6 +411,7 @@ class EngineSupervisor:
                 and time.perf_counter() - self._drain_started
                 > self.drain_deadline_s)
 
+    @worker_only
     def _tick(self, *, block: bool) -> None:
         """One supervision quantum: run queued commands, then one
         watchdog-timed, crash-supervised engine step when there is work."""
@@ -436,6 +446,7 @@ class EngineSupervisor:
                 f"step-latency watchdog tripped: step took {dt:.3f}s "
                 f"(threshold {self.watchdog_step_s}s)")
 
+    @worker_only
     def _run(self) -> None:
         try:
             while not self.finished:

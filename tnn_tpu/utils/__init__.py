@@ -5,6 +5,7 @@ Parity targets (capabilities, not designs): ``Env`` typed getter + ``.env`` load
 spdlog ``Logger`` (include/logging/logger.hpp:16), ``HardwareInfo``
 (include/utils/hardware_info.hpp:126) and RSS query (include/utils/memory.hpp).
 """
+from .bucketing import pow2_bucket
 from .env import Env, load_env_file
 from .config import TrainingConfig
 from .logging import Logger, get_logger
@@ -19,4 +20,5 @@ __all__ = [
     "device_info",
     "hbm_stats",
     "memory_usage_kb",
+    "pow2_bucket",
 ]

@@ -1,0 +1,27 @@
+"""Power-of-two bucketing for jit-cache keys.
+
+Every compiled-program cache in the serving stack keys on static shape
+parameters; any such parameter that tracked a raw request quantity would
+make the cache unbounded (one compile per distinct prompt length).  Routing
+the quantity through :func:`pow2_bucket` caps the key space at O(log N) —
+and gives the ``unbounded-compile-key`` lint rule a single helper to
+recognize as the sanctioned path.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+
+def pow2_bucket(n: int, cap: Optional[int] = None) -> int:
+    """Smallest power of two >= ``n``, clamped to ``cap`` when given.
+
+    ``pow2_bucket(5) == 8``; ``pow2_bucket(5, cap=6) == 6``.  The clamp
+    keeps buckets from overshooting a fixed geometry bound (e.g. the
+    per-sequence block budget) — past the cap the exact bound is the bucket.
+    """
+    if n < 1:
+        raise ValueError(f"pow2_bucket needs n >= 1, got {n}")
+    bucket = 1 << (n - 1).bit_length()
+    if cap is not None:
+        bucket = min(bucket, cap)
+    return bucket

@@ -1,0 +1,48 @@
+"""tnnlint: project-specific static analysis for the TNN-TPU serving stack.
+
+The serving engine's correctness rests on a handful of *contracts* that
+Python will happily let you break and that only show up at runtime — as a
+silent retrace storm, a use-after-donate crash, a host sync stalling the
+step loop, a statistically-wrong sample, or a cross-thread data race.  Each
+tnnlint rule machine-checks one of those contracts at commit time:
+
+======================== =====================================================
+rule                     contract
+======================== =====================================================
+unbounded-compile-key    every shape-determining component of a jit-cache key
+                         is routed through ``utils.bucketing.pow2_bucket`` (or
+                         is fixed engine geometry), so N distinct request
+                         shapes cost O(log N) compiles, never one each
+use-after-donate         a buffer passed at a ``donate_argnums`` position of a
+                         jitted call is never read again before reassignment
+                         (donated buffers are deleted by XLA)
+host-sync-in-step-path   functions reachable from ``engine.step`` fetch device
+                         values only through explicit, batched
+                         ``jax.device_get`` — no stray ``int()`` / ``float()``
+                         / ``bool()`` / ``.item()`` / ``np.asarray`` syncs
+prng-key-reuse           a PRNG key is consumed at most once per
+                         ``split``/``fold_in`` generation
+cross-thread-engine-acc. only ``@worker_only`` methods (or closures marshalled
+                         through the command queue) touch the supervised
+                         engine; nothing reaches through ``*.engine.*``
+unpaired-pool-mutation   every KV-pool bookkeeping mutator runs under
+                         ``check_invariants`` debug coverage
+======================== =====================================================
+
+Usage::
+
+    tnn-lint tnn_tpu/                    # lint (exit 1 on violations)
+    tnn-lint --format json tnn_tpu/      # machine-readable report
+    tnn-lint --write-baseline tnn_tpu/   # accept current findings
+
+Suppress a single finding on its line (or the line above) with a mandatory
+justification::
+
+    key = (width, k)  # tnnlint: disable=unbounded-compile-key -- k <= spec_k
+
+Configuration lives in ``pyproject.toml`` under ``[tool.tnnlint]``; see
+docs/lint.md for the rule catalog with bad/good examples.
+"""
+from .core import Rule, Violation, lint_paths, lint_source, rule_registry
+
+__all__ = ["Rule", "Violation", "lint_paths", "lint_source", "rule_registry"]

@@ -1,0 +1,6 @@
+"""``python -m tools.tnnlint`` — fallback when the console script is absent."""
+import sys
+
+from .cli import main
+
+sys.exit(main())

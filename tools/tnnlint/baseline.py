@@ -1,0 +1,61 @@
+"""Baseline: a committed ledger of accepted pre-existing findings.
+
+The baseline is a JSON map ``fingerprint -> count`` (plus a human-readable
+sample line per fingerprint so reviewers can tell what was grandfathered).
+``compare`` drops up to ``count`` occurrences of each baselined fingerprint
+and reports what remains — so new instances of an old finding still fail,
+and fixed findings surface as stale entries the CLI can prune.
+
+The repo's own baseline is intentionally empty: ISSUE 8 lands the linter
+enforcing a clean tree.  The mechanism exists for downstream forks and for
+staging future rules.
+"""
+from __future__ import annotations
+
+import json
+from collections import Counter
+from pathlib import Path
+from typing import Dict, List, Tuple
+
+from .core import Violation
+
+_VERSION = 1
+
+
+def write_baseline(path: Path, violations: List[Violation]) -> None:
+    counts: Counter = Counter(v.fingerprint() for v in violations)
+    samples: Dict[str, str] = {}
+    for v in violations:
+        samples.setdefault(v.fingerprint(), v.render())
+    payload = {
+        "version": _VERSION,
+        "entries": {fp: {"count": n, "sample": samples[fp]}
+                    for fp, n in sorted(counts.items())},
+    }
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(json.dumps(payload, indent=2) + "\n", encoding="utf-8")
+
+
+def read_baseline(path: Path) -> Dict[str, int]:
+    if not path.is_file():
+        return {}
+    payload = json.loads(path.read_text(encoding="utf-8"))
+    entries = payload.get("entries", {})
+    return {fp: int(meta.get("count", 1)) for fp, meta in entries.items()}
+
+
+def compare(violations: List[Violation],
+            baseline: Dict[str, int]) -> Tuple[List[Violation], List[str]]:
+    """-> (new violations not covered by the baseline, stale fingerprints
+    present in the baseline but no longer found)."""
+    budget = dict(baseline)
+    fresh: List[Violation] = []
+    for v in violations:
+        fp = v.fingerprint()
+        if budget.get(fp, 0) > 0:
+            budget[fp] -= 1
+        else:
+            fresh.append(v)
+    seen = Counter(v.fingerprint() for v in violations)
+    stale = [fp for fp, n in sorted(baseline.items()) if seen[fp] < n]
+    return fresh, stale

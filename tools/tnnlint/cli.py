@@ -1,0 +1,113 @@
+"""``tnn-lint`` entry point.
+
+Exit status: 0 clean (or everything baselined), 1 violations, 2 usage error.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+from typing import List, Optional
+
+from .baseline import compare, read_baseline, write_baseline
+from .config import load_config
+from .core import Violation, lint_paths, rule_registry
+
+
+def _report_text(fresh: List[Violation], stale: List[str],
+                 total: int, out) -> None:
+    for v in fresh:
+        print(v.render(), file=out)
+    for fp in stale:
+        print(f"stale baseline entry {fp}: finding no longer present — "
+              f"rerun with --write-baseline to prune", file=out)
+    if fresh or stale:
+        suppressed = total - len(fresh)
+        tail = f" ({suppressed} baselined)" if suppressed else ""
+        print(f"{len(fresh)} violation(s){tail}, "
+              f"{len(stale)} stale baseline entr(y/ies)", file=out)
+    else:
+        print("clean", file=out)
+
+
+def _report_json(fresh: List[Violation], stale: List[str],
+                 total: int, out) -> None:
+    payload = {
+        "violations": [
+            {"rule": v.rule, "path": v.path, "line": v.line,
+             "col": v.col + 1, "message": v.message,
+             "fingerprint": v.fingerprint()}
+            for v in fresh
+        ],
+        "stale_baseline": stale,
+        "baselined": total - len(fresh),
+    }
+    json.dump(payload, out, indent=2)
+    out.write("\n")
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="tnn-lint",
+        description="Static contract checks for the TNN-TPU serving stack "
+                    "(see docs/lint.md).")
+    p.add_argument("paths", nargs="*",
+                   help="files or directories (default: [tool.tnnlint] paths)")
+    p.add_argument("--format", choices=("text", "json"), default="text")
+    p.add_argument("--select", action="append", default=None, metavar="RULE",
+                   help="run only these rules (repeatable)")
+    p.add_argument("--ignore", action="append", default=[], metavar="RULE",
+                   help="skip these rules (repeatable)")
+    p.add_argument("--baseline", default=None, metavar="FILE",
+                   help="baseline file (default: [tool.tnnlint] baseline)")
+    p.add_argument("--no-baseline", action="store_true",
+                   help="report everything, ignoring any baseline file")
+    p.add_argument("--write-baseline", action="store_true",
+                   help="accept all current findings into the baseline")
+    p.add_argument("--list-rules", action="store_true",
+                   help="print the rule catalog and exit")
+    return p
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    out = sys.stdout
+
+    if args.list_rules:
+        registry = rule_registry()
+        width = max(len(n) for n in registry)
+        for name in sorted(registry):
+            print(f"{name:<{width}}  {registry[name].description}", file=out)
+        return 0
+
+    cfg = load_config()
+    root = Path(cfg.get("_pyproject_dir", "."))
+    paths = args.paths or [str(root / p) for p in cfg["paths"]]
+    baseline_path = Path(args.baseline) if args.baseline \
+        else root / cfg["baseline"]
+
+    try:
+        violations = lint_paths(
+            paths, options=cfg["rules"], select=args.select,
+            ignore=list(cfg["ignore"]) + args.ignore,
+            exclude=cfg["exclude"])
+    except ValueError as e:
+        print(f"tnn-lint: {e}", file=sys.stderr)
+        return 2
+
+    if args.write_baseline:
+        write_baseline(baseline_path, violations)
+        print(f"wrote {len(violations)} finding(s) to {baseline_path}",
+              file=out)
+        return 0
+
+    baseline = {} if args.no_baseline else read_baseline(baseline_path)
+    fresh, stale = compare(violations, baseline)
+    reporter = _report_json if args.format == "json" else _report_text
+    reporter(fresh, stale, len(violations), out)
+    return 1 if (fresh or stale) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

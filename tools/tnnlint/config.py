@@ -1,0 +1,138 @@
+"""Configuration: ``[tool.tnnlint]`` in pyproject.toml.
+
+Layout::
+
+    [tool.tnnlint]
+    paths = ["tnn_tpu"]
+    exclude = ["__pycache__"]
+    baseline = "tools/tnnlint/baseline.json"
+    ignore = []                       # rule names to skip entirely
+
+    [tool.tnnlint.rules.unbounded-compile-key]
+    bucket_helpers = ["pow2_bucket"]
+
+Loading prefers :mod:`tomllib` (3.11+) / :mod:`tomli`; on the 3.10 base
+image neither ships, so a minimal TOML-subset parser below handles exactly
+what this file needs — ``[section]`` headers, string/int/float/bool scalars
+and (possibly multi-line) homogeneous string lists.  Anything fancier in
+*other* sections of pyproject is skipped, not parsed.
+"""
+from __future__ import annotations
+
+import json
+import re
+from pathlib import Path
+from typing import Any, Dict, Optional
+
+DEFAULTS: Dict[str, Any] = {
+    "paths": ["tnn_tpu"],
+    "exclude": [r"__pycache__"],
+    "baseline": "tools/tnnlint/baseline.json",
+    "ignore": [],
+    "rules": {},
+}
+
+
+def _parse_scalar(text: str) -> Any:
+    text = text.strip()
+    if text.startswith("[") and text.endswith("]"):
+        # homogeneous list of scalars; JSON accepts the common cases once
+        # single quotes are normalized and trailing commas removed
+        body = re.sub(r",\s*]", "]", text.replace("'", '"'))
+        return json.loads(body)
+    if text in ("true", "false"):
+        return text == "true"
+    if (text.startswith('"') and text.endswith('"')) or \
+            (text.startswith("'") and text.endswith("'")):
+        return text[1:-1]
+    try:
+        return int(text)
+    except ValueError:
+        pass
+    try:
+        return float(text)
+    except ValueError:
+        return text
+
+
+def _parse_toml_subset(source: str) -> Dict[str, Any]:
+    """Section -> {key: value} for the sections this tool reads."""
+    out: Dict[str, Dict[str, Any]] = {}
+    section = ""
+    pending_key, pending_val = None, ""
+    for raw in source.splitlines():
+        line = raw.strip()
+        if pending_key is not None:
+            pending_val += " " + line
+            if pending_val.count("[") == pending_val.count("]"):
+                out[section][pending_key] = _parse_scalar(pending_val)
+                pending_key = None
+            continue
+        if not line or line.startswith("#"):
+            continue
+        m = re.match(r"^\[(?P<name>[^\]]+)\]$", line)
+        if m:
+            section = m.group("name").strip()
+            out.setdefault(section, {})
+            continue
+        m = re.match(r"^(?P<key>[\w.-]+|\"[^\"]+\")\s*=\s*(?P<val>.*)$", line)
+        if not m or section not in out:
+            continue
+        key = m.group("key").strip('"')
+        val = m.group("val").split("#")[0].rstrip() \
+            if not m.group("val").lstrip().startswith("[") else m.group("val")
+        if val.count("[") != val.count("]"):
+            pending_key, pending_val = key, val
+            continue
+        out[section][key] = _parse_scalar(val)
+    return out
+
+
+def _load_toml(path: Path) -> Dict[str, Any]:
+    data = path.read_text(encoding="utf-8")
+    try:
+        import tomllib                              # 3.11+
+        return tomllib.loads(data)
+    except ImportError:
+        pass
+    try:
+        import tomli                                # optional backport
+        return tomli.loads(data)
+    except ImportError:
+        pass
+    # flatten the subset parse back into a nested dict
+    flat = _parse_toml_subset(data)
+    nested: Dict[str, Any] = {}
+    for section, values in flat.items():
+        node = nested
+        for part in section.split("."):
+            node = node.setdefault(part, {})
+        node.update(values)
+    return nested
+
+
+def find_pyproject(start: Optional[Path] = None) -> Optional[Path]:
+    d = Path(start).resolve() if start is not None else Path.cwd()
+    for parent in [d, *d.parents]:
+        p = parent / "pyproject.toml"
+        if p.is_file():
+            return p
+    return None
+
+
+def load_config(start: Optional[Path] = None) -> Dict[str, Any]:
+    """DEFAULTS overlaid with ``[tool.tnnlint]`` from the nearest
+    pyproject.toml (searched upward from ``start``/cwd)."""
+    cfg = {k: (dict(v) if isinstance(v, dict) else list(v)
+               if isinstance(v, list) else v) for k, v in DEFAULTS.items()}
+    pyproject = find_pyproject(start)
+    if pyproject is None:
+        return cfg
+    section = _load_toml(pyproject).get("tool", {}).get("tnnlint", {})
+    for key, value in section.items():
+        if key == "rules":
+            cfg["rules"].update(value)
+        else:
+            cfg[key] = value
+    cfg["_pyproject_dir"] = str(pyproject.parent)
+    return cfg

@@ -1,0 +1,314 @@
+"""Rule framework: registry, per-file driver, suppressions, shared AST utils.
+
+A rule is a class with a ``name``, a ``description``, and a
+``check_module(ctx)`` returning :class:`Violation` s.  The driver parses each
+file once, hands every registered rule the same :class:`ModuleContext`, then
+applies line suppressions (``# tnnlint: disable=<rule>[, <rule>...] --
+<justification>``) before reporting.  A ``disable`` with no justification is
+itself a violation (``bare-suppression``) — the whole point of suppressing a
+contract check is recording *why* the contract does not apply.
+"""
+from __future__ import annotations
+
+import ast
+import hashlib
+import re
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Dict, Iterable, List, Optional, Sequence, Type
+
+#: the framework's own meta-rule: a suppression that records no justification.
+BARE_SUPPRESSION = "bare-suppression"
+
+# "# tnnlint: disable=a,b -- reason"  (em-dash accepted too)
+_SUPPRESS_RE = re.compile(
+    r"#\s*tnnlint:\s*disable=(?P<rules>[\w,\s-]+?)"
+    r"(?:\s*(?:--|—)\s*(?P<reason>.*\S))?\s*$")
+
+
+@dataclass(frozen=True)
+class Violation:
+    rule: str
+    path: str          # as given to the driver (relative paths stay relative)
+    line: int          # 1-based
+    col: int           # 0-based
+    message: str
+
+    def fingerprint(self) -> str:
+        """Line-independent identity used by the baseline: the same finding
+        survives unrelated edits that only shift it vertically."""
+        h = hashlib.sha1(
+            f"{self.path}\0{self.rule}\0{self.message}".encode()).hexdigest()
+        return h[:16]
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}:{self.col + 1}: " \
+               f"{self.rule}: {self.message}"
+
+
+@dataclass
+class Suppression:
+    line: int
+    rules: List[str]
+    reason: Optional[str]
+    used: bool = False
+
+
+@dataclass
+class ModuleContext:
+    """Everything a rule sees for one file."""
+    path: str
+    source: str
+    tree: ast.Module
+    options: Dict[str, dict] = field(default_factory=dict)
+
+    def rule_options(self, rule_name: str) -> dict:
+        return self.options.get(rule_name, {})
+
+
+class Rule:
+    """Base class; subclasses register via :func:`register`."""
+
+    name: str = ""
+    description: str = ""
+
+    def __init__(self) -> None:
+        if not self.name:
+            raise ValueError(f"{type(self).__name__} has no rule name")
+
+    def check_module(self, ctx: ModuleContext) -> List[Violation]:
+        raise NotImplementedError
+
+    def violation(self, ctx: ModuleContext, node: ast.AST,
+                  message: str) -> Violation:
+        return Violation(rule=self.name, path=ctx.path,
+                         line=getattr(node, "lineno", 1),
+                         col=getattr(node, "col_offset", 0), message=message)
+
+
+_REGISTRY: Dict[str, Type[Rule]] = {}
+
+
+def register(cls: Type[Rule]) -> Type[Rule]:
+    if cls.name in _REGISTRY:
+        raise ValueError(f"duplicate rule name {cls.name!r}")
+    _REGISTRY[cls.name] = cls
+    return cls
+
+
+def rule_registry() -> Dict[str, Type[Rule]]:
+    from . import rules  # noqa: F401 — importing registers the built-ins
+    return dict(_REGISTRY)
+
+
+# -- suppressions --------------------------------------------------------------
+
+
+def parse_suppressions(source: str) -> List[Suppression]:
+    out = []
+    for i, text in enumerate(source.splitlines(), start=1):
+        m = _SUPPRESS_RE.search(text)
+        if not m:
+            continue
+        names = [r.strip() for r in m.group("rules").split(",") if r.strip()]
+        out.append(Suppression(line=i, rules=names, reason=m.group("reason")))
+    return out
+
+
+def _comment_only(line_text: str) -> bool:
+    return line_text.lstrip().startswith("#")
+
+
+def apply_suppressions(violations: List[Violation], source: str,
+                       path: str) -> List[Violation]:
+    """Drop violations covered by a same-line suppression (or one on a
+    directly preceding comment-only line); emit ``bare-suppression`` for any
+    disable comment that carries no justification."""
+    sups = parse_suppressions(source)
+    lines = source.splitlines()
+    by_line: Dict[int, List[Suppression]] = {}
+    for s in sups:
+        by_line.setdefault(s.line, []).append(s)
+        # a suppression on its own comment line covers the line below
+        if _comment_only(lines[s.line - 1]):
+            by_line.setdefault(s.line + 1, []).append(s)
+    kept = []
+    for v in violations:
+        hit = None
+        for s in by_line.get(v.line, []):
+            if v.rule in s.rules:
+                hit = s
+                break
+        if hit is None:
+            kept.append(v)
+        else:
+            hit.used = True
+    for s in sups:
+        if not s.reason:
+            kept.append(Violation(
+                rule=BARE_SUPPRESSION, path=path, line=s.line, col=0,
+                message="suppression without justification — write "
+                        "'# tnnlint: disable=<rule> -- <why the contract "
+                        "does not apply here>'"))
+        if BARE_SUPPRESSION in s.rules:
+            kept.append(Violation(
+                rule=BARE_SUPPRESSION, path=path, line=s.line, col=0,
+                message="bare-suppression cannot itself be suppressed"))
+    return kept
+
+
+# -- driver --------------------------------------------------------------------
+
+
+def lint_source(source: str, path: str = "<string>", *,
+                options: Optional[Dict[str, dict]] = None,
+                select: Optional[Sequence[str]] = None,
+                ignore: Sequence[str] = ()) -> List[Violation]:
+    """Lint one in-memory module; the primitive the fixture tests drive."""
+    registry = rule_registry()
+    names = list(select) if select is not None else list(registry)
+    unknown = [n for n in list(names) + list(ignore) if n not in registry]
+    if unknown:
+        raise ValueError(f"unknown rule(s): {', '.join(sorted(unknown))} "
+                         f"(known: {', '.join(sorted(registry))})")
+    try:
+        tree = ast.parse(source)
+    except SyntaxError as e:
+        return [Violation(rule="parse-error", path=path,
+                          line=e.lineno or 1, col=(e.offset or 1) - 1,
+                          message=f"syntax error: {e.msg}")]
+    ctx = ModuleContext(path=path, source=source, tree=tree,
+                        options=options or {})
+    violations: List[Violation] = []
+    for name in names:
+        if name in ignore:
+            continue
+        violations.extend(registry[name]().check_module(ctx))
+    violations = apply_suppressions(violations, source, path)
+    return sorted(violations, key=lambda v: (v.line, v.col, v.rule))
+
+
+def iter_python_files(paths: Sequence[str],
+                      exclude: Sequence[str] = ()) -> Iterable[Path]:
+    seen = set()
+    for raw in paths:
+        p = Path(raw)
+        candidates = [p] if p.is_file() else sorted(p.rglob("*.py"))
+        for f in candidates:
+            if f.suffix != ".py" or f in seen:
+                continue
+            rel = f.as_posix()
+            if any(re.search(pat, rel) for pat in exclude):
+                continue
+            seen.add(f)
+            yield f
+
+
+def lint_paths(paths: Sequence[str], *,
+               options: Optional[Dict[str, dict]] = None,
+               select: Optional[Sequence[str]] = None,
+               ignore: Sequence[str] = (),
+               exclude: Sequence[str] = ()) -> List[Violation]:
+    out: List[Violation] = []
+    for f in iter_python_files(paths, exclude):
+        out.extend(lint_source(f.read_text(encoding="utf-8"),
+                               path=f.as_posix(), options=options,
+                               select=select, ignore=ignore))
+    return out
+
+
+# -- shared AST helpers (used by several rules) --------------------------------
+
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """'self.pool.pages_k' for a Name/Attribute chain, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def call_name(call: ast.Call) -> Optional[str]:
+    """Dotted callee of a Call ('jax.random.split'), else None."""
+    return dotted_name(call.func)
+
+
+def func_defs(tree: ast.Module):
+    """Yield (qualname, FunctionDef, class_name_or_None) for every function,
+    including methods; qualname is 'Class.method' / 'outer.inner'."""
+    def walk(node, prefix, cls):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                q = f"{prefix}{child.name}"
+                yield q, child, cls
+                yield from walk(child, q + ".", cls)
+            elif isinstance(child, ast.ClassDef):
+                yield from walk(child, f"{prefix}{child.name}.", child.name)
+            else:
+                yield from walk(child, prefix, cls)
+    yield from walk(tree, "", None)
+
+
+def own_nodes(fn: ast.AST) -> Iterable[ast.AST]:
+    """Walk a function's body EXCLUDING nested function/class scopes."""
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        n = stack.pop(0)
+        yield n
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.ClassDef, ast.Lambda)):
+            continue
+        stack[0:0] = list(ast.iter_child_nodes(n))
+
+
+def branch_path(fn: ast.AST, target: ast.AST) -> tuple:
+    """The chain of (If/Try-node-id, arm) containers above ``target`` inside
+    ``fn``.  Two nodes conflict on a linear path only when one's chain is a
+    prefix of the other's — nodes in sibling arms can never both execute."""
+    result: List[tuple] = []
+
+    def search(node, path):
+        nonlocal result
+        if node is target:
+            result = path
+            return True
+        if isinstance(node, ast.If):
+            arms = [("body", node.body), ("orelse", node.orelse)]
+        elif isinstance(node, ast.Try):
+            arms = [("body", node.body + node.finalbody),
+                    ("handlers", [h for h in node.handlers])]
+        else:
+            arms = None
+        if arms is not None:
+            # the test expression is on the shared path
+            for c in ast.iter_child_nodes(node):
+                in_arm = any(c in members or c in getattr(node, "handlers", ())
+                             for _, members in arms)
+                if not in_arm and search(c, path):
+                    return True
+            for arm_name, members in arms:
+                for c in members:
+                    if search(c, path + [(id(node), arm_name)]):
+                        return True
+            return False
+        for c in ast.iter_child_nodes(node):
+            if search(c, path):
+                return True
+        return False
+
+    search(fn, [])
+    return tuple(result)
+
+
+def exclusive(path_a: tuple, path_b: tuple) -> bool:
+    """True when two branch paths are in sibling arms (mutually exclusive)."""
+    for (ida, arma), (idb, armb) in zip(path_a, path_b):
+        if ida == idb and arma != armb:
+            return True
+        if ida != idb:
+            return False
+    return False

@@ -1,0 +1,170 @@
+"""unbounded-compile-key — jit cache keys must have bounded cardinality.
+
+The engine caches compiled functions in ``self._jit`` keyed on tuples of
+static shape parameters.  Any component of such a key that tracks a raw
+request quantity (sequence length, batch width, block count) makes the cache
+unbounded: N distinct requests -> N recompiles, the retrace storm the Ragged
+Paged Attention paper warns about.  The fix is always the same — route the
+quantity through ``tnn_tpu.utils.bucketing.pow2_bucket`` so the key takes
+O(log N) values, or derive it from fixed engine geometry (``self.*``).
+
+A key component is *bounded* when it is: a constant; a ``self.*`` attribute
+chain; a call to a configured bucket helper; ``min(...)`` with at least one
+bounded arg (min against fixed geometry has bounded range); ``max``/arith of
+bounded parts; or a local name whose every visible assignment is bounded.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from ..core import (ModuleContext, Rule, Violation, call_name, dotted_name,
+                    func_defs, own_nodes, register)
+
+_DEF_CACHE_ATTRS = ["_jit"]
+_DEF_HELPERS = ["pow2_bucket"]
+
+Assigns = Dict[str, List[Tuple[Optional[ast.expr], ast.AST]]]
+
+
+def _record_assign(target: ast.expr, value: Optional[ast.expr],
+                   stmt: ast.AST, assigns: Assigns) -> None:
+    if isinstance(target, ast.Name):
+        assigns.setdefault(target.id, []).append((value, stmt))
+    elif isinstance(target, (ast.Tuple, ast.List)):
+        if isinstance(value, (ast.Tuple, ast.List)) and \
+                len(value.elts) == len(target.elts):
+            for t, v in zip(target.elts, value.elts):
+                _record_assign(t, v, stmt, assigns)
+        else:
+            for t in target.elts:
+                _record_assign(t, None, stmt, assigns)  # opaque
+
+
+def _collect_assigns(fn: ast.AST) -> Assigns:
+    assigns: Assigns = {}
+    for n in own_nodes(fn):
+        if isinstance(n, ast.Assign):
+            for tgt in n.targets:
+                _record_assign(tgt, n.value, n, assigns)
+        elif isinstance(n, (ast.AnnAssign,)) and n.value is not None:
+            _record_assign(n.target, n.value, n, assigns)
+        elif isinstance(n, ast.AugAssign) and isinstance(n.target, ast.Name):
+            # x += v is bounded only if both the prior x and v are; model it
+            # as a BinOp over the existing name and the increment
+            combo = ast.BinOp(left=ast.Name(id=n.target.id, ctx=ast.Load()),
+                              op=n.op, right=n.value)
+            assigns.setdefault(n.target.id, []).append((combo, n))
+    return assigns
+
+
+@register
+class UnboundedCompileKey(Rule):
+    name = "unbounded-compile-key"
+    description = ("jit-cache key components must be pow2-bucketed, constant, "
+                   "or fixed engine geometry (self.*)")
+
+    def check_module(self, ctx: ModuleContext) -> List[Violation]:
+        opts = ctx.rule_options(self.name)
+        cache_attrs = set(opts.get("jit_cache_attrs", _DEF_CACHE_ATTRS))
+        helpers = set(opts.get("bucket_helpers", _DEF_HELPERS))
+        out: List[Violation] = []
+        seen: Set[Tuple[int, str]] = set()
+
+        def emit(node: ast.AST, msg: str) -> None:
+            key = (getattr(node, "lineno", 0), msg)
+            if key not in seen:
+                seen.add(key)
+                out.append(self.violation(ctx, node, msg))
+
+        for _qual, fn, _cls in func_defs(ctx.tree):
+            assigns = _collect_assigns(fn)
+
+            def bounded(expr: Optional[ast.expr],
+                        visiting: Set[str]) -> bool:
+                if expr is None:
+                    return False
+                if isinstance(expr, ast.Constant):
+                    return True
+                if isinstance(expr, ast.Attribute):
+                    dn = dotted_name(expr)
+                    return dn is not None and dn.startswith("self.")
+                if isinstance(expr, (ast.Tuple, ast.List)):
+                    return all(bounded(e, visiting) for e in expr.elts)
+                if isinstance(expr, ast.IfExp):
+                    return bounded(expr.body, visiting) and \
+                        bounded(expr.orelse, visiting)
+                if isinstance(expr, ast.BinOp):
+                    return bounded(expr.left, visiting) and \
+                        bounded(expr.right, visiting)
+                if isinstance(expr, ast.UnaryOp):
+                    return bounded(expr.operand, visiting)
+                if isinstance(expr, ast.Call):
+                    cn = (call_name(expr) or "").split(".")[-1]
+                    if cn in helpers:
+                        return True
+                    if cn == "min":
+                        return any(bounded(a, visiting) for a in expr.args)
+                    if cn == "max":
+                        return all(bounded(a, visiting) for a in expr.args)
+                    return False
+                if isinstance(expr, ast.Name):
+                    if expr.id in visiting:
+                        return False
+                    entries = assigns.get(expr.id)
+                    if not entries:
+                        return False  # parameter / free variable: unbounded
+                    return all(bounded(v, visiting | {expr.id})
+                               for v, _ in entries)
+                return False
+
+            def check_key(expr: ast.expr, usage: ast.AST) -> None:
+                """Report the specific unbounded pieces of a key expression,
+                at the assignment that introduced them when resolvable."""
+                if isinstance(expr, ast.Name) and not bounded(expr, set()):
+                    entries = assigns.get(expr.id)
+                    if not entries:
+                        emit(usage,
+                             f"jit cache key '{expr.id}' has no visible "
+                             f"bounded assignment in this function")
+                        return
+                    for value, stmt in entries:
+                        if value is None:
+                            emit(stmt,
+                                 f"jit cache key '{expr.id}' is assigned "
+                                 f"from an opaque unpacking here")
+                        elif not bounded(value, {expr.id}):
+                            check_key_parts(value, stmt, expr.id)
+                    return
+                if not bounded(expr, set()):
+                    check_key_parts(expr, usage, None)
+
+            def check_key_parts(expr: ast.expr, site: ast.AST,
+                                via: Optional[str]) -> None:
+                if isinstance(expr, ast.IfExp):
+                    check_key_parts(expr.body, site, via)
+                    check_key_parts(expr.orelse, site, via)
+                    return
+                elts = expr.elts if isinstance(expr, (ast.Tuple, ast.List)) \
+                    else [expr]
+                prefix = f"(via '{via}') " if via else ""
+                for e in elts:
+                    if not bounded(e, {via} if via else set()):
+                        emit(site,
+                             f"jit cache key component {prefix}"
+                             f"'{ast.unparse(e)}' is not bounded — route it "
+                             f"through pow2_bucket() or derive it from "
+                             f"fixed engine geometry")
+
+            for n in own_nodes(fn):
+                if isinstance(n, ast.Subscript):
+                    base = dotted_name(n.value)
+                    if base and base.split(".")[-1] in cache_attrs:
+                        check_key(n.slice, n)
+                elif isinstance(n, ast.Call) and \
+                        isinstance(n.func, ast.Attribute) and \
+                        n.func.attr == "get" and n.args:
+                    base = dotted_name(n.func.value)
+                    if base and base.split(".")[-1] in cache_attrs:
+                        check_key(n.args[0], n)
+        return out

@@ -1,0 +1,235 @@
+"""use-after-donate — never read a buffer after passing it at a donated slot.
+
+``jax.jit(..., donate_argnums=...)`` tells XLA it may reuse the input
+buffer's memory for outputs; after the call the Python object is a husk and
+touching it raises the ``is_deleted`` RuntimeError the serving supervisor
+only recovers from at runtime.  This rule links the engine's jit *builder*
+methods (``return jax.jit(fn, donate_argnums=D)``) to the call sites that
+fetch compiled callables out of the jit cache, then checks that every name
+passed at a donated position is reassigned (or re-adopted via a configured
+reassigner such as ``pool.update_pages``) before its next read.
+
+The scan is lexical-forward inside one function: reads reached only by
+looping back are out of scope (the engine's retry loop is safe because the
+fault fires before re-entry, not after donation).
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from ..core import (ModuleContext, Rule, Violation, call_name, dotted_name,
+                    func_defs, own_nodes, register)
+
+_DEF_CACHE_ATTRS = ["_jit"]
+_DEF_REASSIGNERS = ["update_pages"]
+
+
+def _donate_positions(jit_call: ast.Call) -> Set[int]:
+    for kw in jit_call.keywords:
+        if kw.arg != "donate_argnums":
+            continue
+        vals = [kw.value.body, kw.value.orelse] \
+            if isinstance(kw.value, ast.IfExp) else [kw.value]
+        positions: Set[int] = set()
+        for v in vals:
+            if isinstance(v, ast.Constant) and isinstance(v.value, int):
+                positions.add(v.value)
+            elif isinstance(v, (ast.Tuple, ast.List)):
+                positions.update(e.value for e in v.elts
+                                 if isinstance(e, ast.Constant)
+                                 and isinstance(e.value, int))
+        return positions
+    return set()
+
+
+def _inner_arity(jit_call: ast.Call, scope: ast.AST) -> Optional[int]:
+    if not jit_call.args:
+        return None
+    target = jit_call.args[0]
+    if isinstance(target, ast.Lambda):
+        return len(target.args.args)
+    if isinstance(target, ast.Name):
+        for n in ast.walk(scope):
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)) and \
+                    n.name == target.id:
+                return len(n.args.args)
+    return None
+
+
+def _is_jit_call(call: ast.Call) -> bool:
+    return (call_name(call) or "").split(".")[-1] == "jit"
+
+
+def _stmt_exprs(stmt: ast.stmt):
+    """Expression nodes belonging directly to ``stmt`` — excludes nested
+    statements (which get their own list entry) and Lambda bodies (their own
+    scope).  Every expression therefore maps to exactly one statement."""
+    todo: List[ast.AST] = []
+    for _field, value in ast.iter_fields(stmt):
+        vals = value if isinstance(value, list) else [value]
+        todo.extend(v for v in vals if isinstance(v, ast.expr))
+    while todo:
+        n = todo.pop()
+        yield n
+        if not isinstance(n, ast.Lambda):
+            todo.extend(ast.iter_child_nodes(n))
+
+
+@register
+class UseAfterDonate(Rule):
+    name = "use-after-donate"
+    description = ("a name passed at a donate_argnums position of a jitted "
+                   "call must be reassigned before it is read again")
+
+    def check_module(self, ctx: ModuleContext) -> List[Violation]:
+        opts = ctx.rule_options(self.name)
+        cache_attrs = set(opts.get("jit_cache_attrs", _DEF_CACHE_ATTRS))
+        reassigners = set(opts.get("reassigners", _DEF_REASSIGNERS))
+        out: List[Violation] = []
+
+        # pass 1: builder methods -> (inner arity, donated positions)
+        builders: Dict[str, Tuple[Optional[int], Set[int]]] = {}
+        for _qual, fn, _cls in func_defs(ctx.tree):
+            for n in own_nodes(fn):
+                if isinstance(n, ast.Return) and \
+                        isinstance(n.value, ast.Call) and \
+                        _is_jit_call(n.value):
+                    positions = _donate_positions(n.value)
+                    if positions:
+                        builders[fn.name] = (_inner_arity(n.value, fn),
+                                             positions)
+
+        # pass 2: call sites
+        for _qual, fn, _cls in func_defs(ctx.tree):
+            out.extend(self._check_function(ctx, fn, builders, cache_attrs,
+                                            reassigners))
+        return out
+
+    # -- per-function analysis -------------------------------------------------
+
+    def _check_function(self, ctx, fn, builders, cache_attrs,
+                        reassigners) -> List[Violation]:
+        out: List[Violation] = []
+        # name -> donated positions (None = unknown builder: match by arity)
+        jit_names: Dict[str, Optional[Set[int]]] = {}
+
+        def source_positions(value: ast.expr) -> Optional[object]:
+            """What a name assigned from ``value`` is, jit-wise.
+            Returns a set of positions, None for cache-fetch of unknown
+            builder, or the sentinel ``_not`` when not a jit callable."""
+            if isinstance(value, ast.Call):
+                cn = call_name(value) or ""
+                last = cn.split(".")[-1]
+                if _is_jit_call(value):
+                    return _donate_positions(value) or _not
+                if cn.startswith("self.") and last in builders:
+                    return builders[last][1]
+                if last == "get" and isinstance(value.func, ast.Attribute):
+                    base = dotted_name(value.func.value)
+                    if base and base.split(".")[-1] in cache_attrs:
+                        return None
+                return _not
+            if isinstance(value, ast.Subscript):
+                base = dotted_name(value.value)
+                if base and base.split(".")[-1] in cache_attrs:
+                    return None
+            return _not
+
+        _not = object()
+
+        stmts = sorted(
+            (n for n in own_nodes(fn) if isinstance(n, ast.stmt)),
+            key=lambda n: (n.lineno, n.col_offset))
+
+        for i, stmt in enumerate(stmts):
+            # track names bound to jit callables
+            if isinstance(stmt, ast.Assign):
+                src = source_positions(stmt.value)
+                for tgt in stmt.targets:
+                    if isinstance(tgt, ast.Name):
+                        if src is _not:
+                            jit_names.pop(tgt.id, None)
+                        else:
+                            jit_names[tgt.id] = src
+
+            for call in _stmt_exprs(stmt):
+                if not (isinstance(call, ast.Call) and
+                        isinstance(call.func, ast.Name) and
+                        call.func.id in jit_names):
+                    continue
+                positions = jit_names[call.func.id]
+                if positions is None:  # unknown builder: arity match
+                    arity = len(call.args)
+                    matched = [p for a, p in builders.values()
+                               if a == arity]
+                    positions = set().union(*matched) if matched else \
+                        set().union(*(p for _, p in builders.values())) \
+                        if builders else set()
+                donated = []
+                for pos in sorted(positions):
+                    if pos < len(call.args):
+                        chain = dotted_name(call.args[pos])
+                        if chain:
+                            donated.append(chain)
+                out.extend(self._scan_after(ctx, stmts, i, stmt, call,
+                                            donated, reassigners))
+        return out
+
+    def _scan_after(self, ctx, stmts, i, stmt, call, donated,
+                    reassigners) -> List[Violation]:
+        out: List[Violation] = []
+        live = set(donated)
+        # the statement holding the call reassigns its own targets first
+        if isinstance(stmt, ast.Assign) and stmt.value is call:
+            for tgt in stmt.targets:
+                live -= self._killed_by_target(tgt, live)
+        for later in stmts[i + 1:]:
+            if not live:
+                break
+            for node in _stmt_exprs(later):
+                if not live:
+                    break
+                # kill via configured reassigner on the parent chain
+                if isinstance(node, ast.Call):
+                    cn = call_name(node)
+                    if cn:
+                        parts = cn.rsplit(".", 1)
+                        if len(parts) == 2 and parts[1] in reassigners:
+                            live = {c for c in live
+                                    if not c.startswith(parts[0] + ".")}
+                            continue
+                chain = dotted_name(node)
+                if chain is None:
+                    continue
+                ctx_kind = getattr(node, "ctx", None)
+                hit = {c for c in live
+                       if chain == c or chain.startswith(c + ".")
+                       or c.startswith(chain + ".")}
+                if not hit:
+                    continue
+                if isinstance(ctx_kind, (ast.Store, ast.Del)):
+                    live -= {c for c in live
+                             if c == chain or c.startswith(chain + ".")}
+                elif isinstance(ctx_kind, ast.Load):
+                    reads = {c for c in hit
+                             if chain == c or chain.startswith(c + ".")}
+                    for c in sorted(reads):
+                        out.append(self.violation(
+                            ctx, node,
+                            f"'{c}' was donated to a jitted call on line "
+                            f"{call.lineno} and is read here before "
+                            f"reassignment — its buffer belongs to XLA now"))
+                    live -= reads  # one report per donation is enough
+        return out
+
+    @staticmethod
+    def _killed_by_target(tgt: ast.expr, live: Set[str]) -> Set[str]:
+        killed: Set[str] = set()
+        targets = tgt.elts if isinstance(tgt, (ast.Tuple, ast.List)) else [tgt]
+        for t in targets:
+            chain = dotted_name(t)
+            if chain:
+                killed |= {c for c in live
+                           if c == chain or c.startswith(chain + ".")}
+        return killed

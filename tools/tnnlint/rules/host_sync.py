@@ -1,0 +1,246 @@
+"""host-sync-in-step-path — device values leave the step path only through
+explicit ``jax.device_get``.
+
+``int()``/``float()``/``bool()``/``.item()``/``np.asarray`` on a device
+array each force a blocking device->host sync; sprinkled through the step
+loop they serialize the pipeline one scalar at a time.  The contract: batch
+everything you need into one ``jax.device_get`` (and the engine's
+``TNN_DEBUG_SYNC=1`` transfer guard enforces the same thing dynamically).
+
+Mechanics: build the intra-file call graph from the configured step roots
+(``self._helper()`` and module-function edges), skip nested defs handed to
+``jax.jit`` (device code), taint values produced by jit-cache callables and
+``jnp.*``/``jax.*`` calls, propagate through unpacking/subscripts/arith, and
+flag host-forcing sinks on tainted values.  ``jax.device_get`` both
+sanctions the fetch and untaints its result.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set
+
+from ..core import (ModuleContext, Rule, Violation, call_name, dotted_name,
+                    func_defs, own_nodes, register)
+
+_DEF_ROOTS = ["InferenceEngine.step"]
+_HOST_CASTS = {"int", "float", "bool"}
+_NP_SINKS = {"asarray", "array"}
+_METHOD_SINKS = {"item", "tolist"}
+_UNTAINT_CALLS = {"device_get"}
+
+
+def _jitted_inner_defs(tree: ast.Module) -> Set[int]:
+    """ids of FunctionDef nodes whose name is passed to jax.jit in the same
+    enclosing function — device code, exempt from host-sync checks."""
+    exempt: Set[int] = set()
+    for _qual, fn, _cls in func_defs(tree):
+        jitted_names = set()
+        for n in own_nodes(fn):
+            if isinstance(n, ast.Call) and \
+                    (call_name(n) or "").split(".")[-1] == "jit" and n.args \
+                    and isinstance(n.args[0], ast.Name):
+                jitted_names.add(n.args[0].id)
+        for n in own_nodes(fn):
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)) and \
+                    n.name in jitted_names:
+                exempt.add(id(n))
+    return exempt
+
+
+@register
+class HostSyncInStepPath(Rule):
+    name = "host-sync-in-step-path"
+    description = ("no implicit device->host syncs (int/float/bool/.item/"
+                   "np.asarray on device values) in functions reachable "
+                   "from engine.step — batch through jax.device_get")
+
+    def check_module(self, ctx: ModuleContext) -> List[Violation]:
+        opts = ctx.rule_options(self.name)
+        roots = set(opts.get("step_roots", _DEF_ROOTS))
+        all_defs = list(func_defs(ctx.tree))
+        by_qual = {q: (fn, cls) for q, fn, cls in all_defs}
+
+        # class -> {method name -> qualname} for self.* edge resolution
+        methods_of: Dict[str, Dict[str, str]] = {}
+        module_funcs: Dict[str, str] = {}
+        for q, fn, cls in all_defs:
+            if cls is not None and q.count(".") == 1:
+                methods_of.setdefault(cls, {})[fn.name] = q
+            elif cls is None and "." not in q:
+                module_funcs[fn.name] = q
+
+        exempt = _jitted_inner_defs(ctx.tree)
+
+        def edges(qual: str) -> List[str]:
+            fn, cls = by_qual[qual]
+            targets: List[str] = []
+            for n in own_nodes(fn):
+                if not isinstance(n, ast.Call):
+                    continue
+                cn = call_name(n)
+                if cn is None:
+                    continue
+                if cn.startswith("self.") and cn.count(".") == 1 and cls:
+                    m = methods_of.get(cls, {}).get(cn.split(".")[1])
+                    if m:
+                        targets.append(m)
+                elif "." not in cn and cn in module_funcs:
+                    targets.append(module_funcs[cn])
+            return targets
+
+        reachable: Set[str] = set()
+        frontier = [q for q in by_qual if q in roots]
+        while frontier:
+            q = frontier.pop()
+            if q in reachable:
+                continue
+            reachable.add(q)
+            frontier.extend(edges(q))
+
+        out: List[Violation] = []
+        for q in sorted(reachable):
+            fn, _cls = by_qual[q]
+            if id(fn) in exempt:
+                continue
+            out.extend(self._check_function(ctx, fn, q))
+        return out
+
+    # -- per-function taint ----------------------------------------------------
+
+    def _check_function(self, ctx, fn, qual) -> List[Violation]:
+        out: List[Violation] = []
+        tainted: Set[str] = set()
+        jit_names: Set[str] = set()
+        reported: Set[int] = set()
+
+        def is_device_call(call: ast.Call) -> bool:
+            cn = call_name(call) or ""
+            head, _, _tail = cn.partition(".")
+            last = cn.split(".")[-1]
+            if isinstance(call.func, ast.Name) and call.func.id in jit_names:
+                return True
+            if head in ("jnp", "jax") and last not in _UNTAINT_CALLS:
+                return True
+            if cn.startswith("self.") and cn.endswith("_fn"):
+                return True
+            return False
+
+        def expr_tainted(expr: ast.AST) -> bool:
+            if isinstance(expr, ast.Call):
+                cn = call_name(expr) or ""
+                if cn.split(".")[-1] in _UNTAINT_CALLS:
+                    return False
+                if is_device_call(expr):
+                    return True
+                return False
+            if isinstance(expr, ast.Name):
+                return expr.id in tainted
+            if isinstance(expr, ast.Attribute):
+                dn = dotted_name(expr)
+                if dn and dn in tainted:
+                    return True
+                return expr_tainted(expr.value)
+            if isinstance(expr, ast.Subscript):
+                return expr_tainted(expr.value)
+            if isinstance(expr, ast.BinOp):
+                return expr_tainted(expr.left) or expr_tainted(expr.right)
+            if isinstance(expr, ast.UnaryOp):
+                return expr_tainted(expr.operand)
+            if isinstance(expr, ast.Compare):
+                return expr_tainted(expr.left) or \
+                    any(expr_tainted(c) for c in expr.comparators)
+            if isinstance(expr, (ast.Tuple, ast.List)):
+                return any(expr_tainted(e) for e in expr.elts)
+            if isinstance(expr, ast.IfExp):
+                return expr_tainted(expr.body) or expr_tainted(expr.orelse)
+            return False
+
+        def taint_target(tgt: ast.expr, value_tainted: bool,
+                         value: Optional[ast.expr]) -> None:
+            if isinstance(tgt, (ast.Tuple, ast.List)):
+                if isinstance(value, (ast.Tuple, ast.List)) and \
+                        len(value.elts) == len(tgt.elts):
+                    for t, v in zip(tgt.elts, value.elts):
+                        taint_target(t, expr_tainted(v), v)
+                else:
+                    for t in tgt.elts:
+                        taint_target(t, value_tainted, None)
+                return
+            chain = dotted_name(tgt)
+            if chain is None:
+                return
+            if value_tainted:
+                tainted.add(chain)
+            else:
+                tainted.discard(chain)
+
+        def sink(node: ast.AST, what: str) -> None:
+            if id(node) in reported:
+                return
+            reported.add(id(node))
+            out.append(self.violation(
+                ctx, node,
+                f"{what} forces a device->host sync on the step path "
+                f"({qual}) — batch the fetch through jax.device_get"))
+
+        def check_call_sink(n: ast.AST) -> None:
+            if isinstance(n, ast.Call):
+                cn = call_name(n) or ""
+                last = cn.split(".")[-1]
+                if cn in _HOST_CASTS and n.args and \
+                        expr_tainted(n.args[0]):
+                    sink(n, f"{cn}() on a device value")
+                elif cn.split(".")[0] in ("np", "numpy") and \
+                        last in _NP_SINKS and n.args and \
+                        expr_tainted(n.args[0]):
+                    sink(n, f"{cn}() on a device value")
+                elif isinstance(n.func, ast.Attribute) and \
+                        n.func.attr in _METHOD_SINKS and \
+                        expr_tainted(n.func.value):
+                    sink(n, f".{n.func.attr}() on a device value")
+
+        def scan_expr_sinks(expr: ast.AST) -> None:
+            """Sink-check an expression *before* its enclosing assignment
+            updates the taint state (x = int(x) must still flag)."""
+            todo = [expr]
+            while todo:
+                n = todo.pop()
+                check_call_sink(n)
+                if not isinstance(n, ast.Lambda):
+                    todo.extend(ast.iter_child_nodes(n))
+
+        # two passes so taint assigned later in loops still propagates
+        for _pass in (0, 1):
+            for n in own_nodes(fn):
+                if isinstance(n, (ast.Assign, ast.AugAssign)) and _pass == 1:
+                    scan_expr_sinks(n.value)
+                if isinstance(n, ast.Assign):
+                    # record jit-callable names for is_device_call
+                    if isinstance(n.value, (ast.Subscript, ast.Call)):
+                        base = None
+                        if isinstance(n.value, ast.Subscript):
+                            base = dotted_name(n.value.value)
+                        elif isinstance(n.value.func, ast.Attribute) and \
+                                n.value.func.attr == "get":
+                            base = dotted_name(n.value.func.value)
+                        if base and base.split(".")[-1] == "_jit":
+                            for t in n.targets:
+                                if isinstance(t, ast.Name):
+                                    jit_names.add(t.id)
+                    for t in n.targets:
+                        taint_target(t, expr_tainted(n.value), n.value)
+                elif isinstance(n, ast.AugAssign):
+                    chain = dotted_name(n.target)
+                    if chain and expr_tainted(n.value):
+                        tainted.add(chain)
+                if _pass == 0:
+                    continue
+
+                # sinks (second pass only, with full taint knowledge)
+                if isinstance(n, ast.Call):
+                    check_call_sink(n)
+                elif isinstance(n, (ast.If, ast.While)):
+                    if expr_tainted(n.test):
+                        sink(n.test, "branching on a device value "
+                                     "(implicit bool())")
+        return out

@@ -1,0 +1,107 @@
+"""unpaired-pool-mutation — KV-pool bookkeeping mutators self-check.
+
+The pool's three-way block partition (``_free`` / ``_ref`` / ``_evictable``)
+is the serving stack's most corruption-prone invariant: a block leaked
+between sets surfaces requests later as silent KV corruption.  The
+contract: every method that mutates partition state runs (transitively)
+through ``check_invariants`` debug coverage, so ``TNN_POOL_DEBUG=1`` soaks
+catch a broken partition at the mutation that broke it, not at decode time.
+
+``__init__`` (building the partition from scratch) and the checker methods
+themselves are exempt.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Set
+
+from ..core import (ModuleContext, Rule, Violation, call_name, dotted_name,
+                    func_defs, own_nodes, register)
+
+_DEF_POOL_CLASSES = ["PagedKVPool"]
+_DEF_STATE_ATTRS = ["_free", "_ref", "_evictable"]
+_DEF_CHECKERS = ["check_invariants", "_debug_check"]
+_MUTATING_METHODS = {"pop", "popitem", "append", "extend", "clear", "update",
+                     "remove", "insert", "setdefault", "add", "discard",
+                     "appendleft", "popleft"}
+
+
+@register
+class UnpairedPoolMutation(Rule):
+    name = "unpaired-pool-mutation"
+    description = ("pool-partition mutators must run under check_invariants "
+                   "debug coverage")
+
+    def check_module(self, ctx: ModuleContext) -> List[Violation]:
+        opts = ctx.rule_options(self.name)
+        pool_classes = set(opts.get("pool_classes", _DEF_POOL_CLASSES))
+        state_attrs = set(opts.get("state_attrs", _DEF_STATE_ATTRS))
+        checkers = set(opts.get("checkers", _DEF_CHECKERS))
+        out: List[Violation] = []
+
+        methods: Dict[str, ast.AST] = {}
+        for qual, fn, cls in func_defs(ctx.tree):
+            if cls in pool_classes and qual.count(".") == 1:
+                methods[fn.name] = fn
+
+        def state_chain(node: ast.AST) -> bool:
+            """node roots at self.<state attr> (possibly subscripted)."""
+            while isinstance(node, ast.Subscript):
+                node = node.value
+            chain = dotted_name(node)
+            if not chain:
+                return False
+            parts = chain.split(".")
+            return len(parts) >= 2 and parts[0] == "self" and \
+                parts[1] in state_attrs
+
+        def mutates(fn: ast.AST) -> List[ast.AST]:
+            sites = []
+            for n in own_nodes(fn):
+                if isinstance(n, (ast.Assign, ast.AugAssign, ast.Delete)):
+                    targets = n.targets if isinstance(n, ast.Assign) else \
+                        n.targets if isinstance(n, ast.Delete) else [n.target]
+                    for t in targets:
+                        # rebinding the whole set in __init__-style code is
+                        # still a mutation of the partition
+                        if state_chain(t):
+                            sites.append(n)
+                elif isinstance(n, ast.Call) and \
+                        isinstance(n.func, ast.Attribute) and \
+                        n.func.attr in _MUTATING_METHODS and \
+                        state_chain(n.func.value):
+                    sites.append(n)
+            return sites
+
+        def callees(fn: ast.AST) -> Set[str]:
+            names: Set[str] = set()
+            for n in own_nodes(fn):
+                if isinstance(n, ast.Call):
+                    cn = call_name(n) or ""
+                    if cn.startswith("self.") and cn.count(".") == 1:
+                        names.add(cn.split(".")[1])
+            return names
+
+        # fixpoint: a method is covered if it calls a checker, directly or
+        # through other pool methods
+        covered = {name for name, fn in methods.items()
+                   if callees(fn) & checkers}
+        changed = True
+        while changed:
+            changed = False
+            for name, fn in methods.items():
+                if name not in covered and callees(fn) & covered:
+                    covered.add(name)
+                    changed = True
+
+        for name, fn in sorted(methods.items()):
+            if name == "__init__" or name in checkers:
+                continue
+            sites = mutates(fn)
+            if sites and name not in covered:
+                out.append(self.violation(
+                    ctx, sites[0],
+                    f"'{name}' mutates pool partition state without "
+                    f"check_invariants coverage — call the debug checker "
+                    f"(or a method that does) before returning"))
+        return out

@@ -1,0 +1,103 @@
+"""prng-key-reuse — a PRNG key is consumed at most once per generation.
+
+JAX keys are not stateful RNGs: feeding the same key to two samplers yields
+correlated (often identical) draws, which in a serving engine means
+statistically-wrong decodes that no test notices.  The contract: every
+consumption (passing a key to anything other than ``split``/``fold_in``)
+must be followed by a ``split``/``fold_in``-based reassignment before the
+key is consumed again.
+
+``split``/``fold_in`` are *derivations* — they start a new generation for
+the name they assign and do not count as consumptions of their input.
+Consumptions in sibling ``if``/``else`` (or ``try``/``except``) arms are
+mutually exclusive and never flagged.  A single consumption *site* inside a
+loop is deliberate-reuse territory (e.g. a bit-exact retry) and is also not
+flagged — the rule fires only on two distinct sites in one generation.
+"""
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Tuple
+
+from ..core import (ModuleContext, Rule, Violation, branch_path, call_name,
+                    dotted_name, exclusive, func_defs, own_nodes, register)
+
+_DEF_KEY_PARAM_RE = r"^(rng|key|.*_rng|.*_key)$"
+#: callee last-components that produce/derive PRNG keys.  Deliberately a
+#: closed set (plus config ``extra_derivers``) — substring matching on "key"
+#: would swallow dict-key helpers like ``_child_key``.
+_DERIVERS = {"split", "fold_in", "PRNGKey", "key"}  # jax.random.key too
+_DEF_EXTRA_DERIVERS = ["_next_key", "split_for"]
+
+
+@register
+class PrngKeyReuse(Rule):
+    name = "prng-key-reuse"
+    description = ("a PRNG key must not be consumed twice without an "
+                   "intervening split/fold_in")
+
+    def check_module(self, ctx: ModuleContext) -> List[Violation]:
+        opts = ctx.rule_options(self.name)
+        param_re = re.compile(opts.get("key_param_regex", _DEF_KEY_PARAM_RE))
+        derivers = _DERIVERS | set(opts.get("extra_derivers",
+                                            _DEF_EXTRA_DERIVERS))
+        out: List[Violation] = []
+        for _qual, fn, _cls in func_defs(ctx.tree):
+            out.extend(self._check_function(ctx, fn, param_re, derivers))
+        return out
+
+    @staticmethod
+    def _is_deriver(call: ast.Call, derivers) -> bool:
+        return (call_name(call) or "").split(".")[-1] in derivers
+
+    def _check_function(self, ctx, fn, param_re, derivers) -> List[Violation]:
+        out: List[Violation] = []
+        gen: Dict[str, int] = {}
+        key_names = set()
+
+        for arg in list(fn.args.args) + list(fn.args.kwonlyargs):
+            if param_re.match(arg.arg):
+                key_names.add(arg.arg)
+                gen[arg.arg] = 0
+
+        # consumption events: (name, generation) -> [(node, branch path)]
+        events: Dict[Tuple[str, int], List[Tuple[ast.AST, tuple]]] = {}
+
+        def new_generation(chain: str) -> None:
+            key_names.add(chain)
+            gen[chain] = gen.get(chain, 0) + 1
+
+        for n in own_nodes(fn):
+            if isinstance(n, ast.Assign) and isinstance(n.value, ast.Call) \
+                    and self._is_deriver(n.value, derivers):
+                for tgt in n.targets:
+                    elts = tgt.elts if isinstance(tgt, (ast.Tuple, ast.List)) \
+                        else [tgt]
+                    for t in elts:
+                        chain = dotted_name(t)
+                        if chain:
+                            new_generation(chain)
+            elif isinstance(n, ast.Call) and \
+                    not self._is_deriver(n, derivers):
+                for a in list(n.args) + [kw.value for kw in n.keywords]:
+                    chain = dotted_name(a)
+                    if chain in key_names:
+                        g = gen.get(chain, 0)
+                        events.setdefault((chain, g), []).append(
+                            (a, branch_path(fn, a)))
+
+        for (name, _g), sites in events.items():
+            for i in range(1, len(sites)):
+                node, path = sites[i]
+                prior = [s for s in sites[:i]
+                         if not exclusive(path, s[1])]
+                if prior:
+                    first = prior[0][0]
+                    out.append(self.violation(
+                        ctx, node,
+                        f"PRNG key '{name}' already consumed on line "
+                        f"{first.lineno} in this generation — split/fold_in "
+                        f"before consuming it again"))
+                    break  # one report per (name, generation)
+        return out

@@ -1,0 +1,149 @@
+"""cross-thread-engine-access — the engine has exactly one owning thread.
+
+The supervisor (PR 6) owns the engine on its worker thread; every other
+thread must marshal through the command queue (``self._execute(lambda:
+...)``) instead of poking engine state directly — the engine and KV pool
+have no locks by design.  This rule enforces the annotation side of that
+contract:
+
+* Inside an owner class (``EngineSupervisor``), only methods decorated
+  ``@worker_only`` may touch ``self.engine`` — except closures passed to a
+  configured marshal method, which are the sanctioned vector, and the plain
+  ``self.engine = ...`` rebinding in construction/restart paths.
+* Anywhere else, reaching *through* an engine attribute
+  (``something.engine.x``) is flagged: the holder of a supervisor reference
+  does not know what thread the engine is on.
+"""
+from __future__ import annotations
+
+import ast
+from typing import List, Set
+
+from ..core import (ModuleContext, Rule, Violation, call_name, dotted_name,
+                    func_defs, register)
+
+_DEF_OWNER_CLASSES = ["EngineSupervisor"]
+_DEF_MARSHAL = ["_execute"]
+_DEF_DECORATOR = "worker_only"
+_DEF_OWNED_ATTRS = ["engine"]
+
+
+def _has_decorator(fn: ast.AST, name: str) -> bool:
+    for d in getattr(fn, "decorator_list", []):
+        target = d.func if isinstance(d, ast.Call) else d
+        dn = dotted_name(target) or ""
+        if dn.split(".")[-1] == name:
+            return True
+    return False
+
+
+def _sanctioned_nodes(method: ast.AST, marshal: Set[str]) -> Set[int]:
+    """ids of lambda/def subtrees passed into a marshal call — the command
+    queue runs them on the worker thread, so engine access inside is fine."""
+    sanctioned: Set[int] = set()
+    local_defs = {n.name: n for n in ast.walk(method)
+                  if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))}
+    for n in ast.walk(method):
+        if not isinstance(n, ast.Call):
+            continue
+        cn = call_name(n) or ""
+        if not (cn.startswith("self.") and cn.split(".")[-1] in marshal):
+            continue
+        for a in list(n.args) + [kw.value for kw in n.keywords]:
+            if isinstance(a, ast.Lambda):
+                sanctioned.add(id(a))
+            elif isinstance(a, ast.Name) and a.id in local_defs:
+                sanctioned.add(id(local_defs[a.id]))
+    return sanctioned
+
+
+def _walk_skipping(root: ast.AST, skip: Set[int]):
+    stack = list(ast.iter_child_nodes(root))
+    while stack:
+        n = stack.pop()
+        if id(n) in skip:
+            continue
+        yield n
+        stack.extend(ast.iter_child_nodes(n))
+
+
+@register
+class CrossThreadEngineAccess(Rule):
+    name = "cross-thread-engine-access"
+    description = ("engine state may only be touched by @worker_only methods "
+                   "or closures marshalled through the command queue")
+
+    def check_module(self, ctx: ModuleContext) -> List[Violation]:
+        opts = ctx.rule_options(self.name)
+        owner_classes = set(opts.get("owner_classes", _DEF_OWNER_CLASSES))
+        marshal = set(opts.get("marshal_methods", _DEF_MARSHAL))
+        decorator = opts.get("decorator", _DEF_DECORATOR)
+        owned = set(opts.get("owned_attrs", _DEF_OWNED_ATTRS))
+        out: List[Violation] = []
+
+        for qual, fn, cls in func_defs(ctx.tree):
+            if qual.count(".") != (1 if cls else 0):
+                continue  # nested defs are scanned via their parent
+            if _has_decorator(fn, decorator):
+                continue
+            if cls in owner_classes:
+                out.extend(self._check_owner_method(
+                    ctx, fn, qual, marshal, owned))
+            out.extend(self._check_reach_through(ctx, fn, qual, owned,
+                                                 cls in owner_classes))
+        return out
+
+    def _check_owner_method(self, ctx, fn, qual, marshal,
+                            owned) -> List[Violation]:
+        out = []
+        skip = _sanctioned_nodes(fn, marshal)
+        nodes = [n for n in _walk_skipping(fn, skip)
+                 if isinstance(n, ast.Attribute)]
+        inner = {id(n.value) for n in nodes}  # report outermost chains only
+        for n in nodes:
+            if id(n) in inner:
+                continue
+            chain = dotted_name(n)
+            if chain is None:
+                continue
+            parts = chain.split(".")
+            if len(parts) < 2 or parts[0] != "self" or parts[1] not in owned:
+                continue
+            if len(parts) == 2 and isinstance(n.ctx, ast.Store):
+                continue  # self.engine = ... (construction / restart rebind)
+            if len(parts) == 2 and isinstance(n.ctx, ast.Load):
+                continue  # passing the reference along is not an access
+            out.append(self.violation(
+                ctx, n,
+                f"'{chain}' accessed in {qual} without @worker_only — "
+                f"marshal through the command queue or mark the method "
+                f"worker-only"))
+        return out
+
+    def _check_reach_through(self, ctx, fn, qual, owned,
+                             is_owner) -> List[Violation]:
+        out = []
+        reported: Set[str] = set()
+        nodes = [n for n in ast.walk(fn) if isinstance(n, ast.Attribute)]
+        inner = {id(n.value) for n in nodes}  # report outermost chains only
+        for n in nodes:
+            if id(n) in inner:
+                continue
+            chain = dotted_name(n)
+            if chain is None:
+                continue
+            parts = chain.split(".")
+            for i, part in enumerate(parts):
+                if part in owned and 0 < i < len(parts) - 1:
+                    if is_owner and i == 1 and parts[0] == "self":
+                        break  # handled (with exemptions) above
+                    if chain not in reported:
+                        reported.add(chain)
+                        out.append(self.violation(
+                            ctx, n,
+                            f"'{chain}' reaches through an engine reference "
+                            f"from {qual or '<module>'} — the engine belongs "
+                            f"to its worker thread; marshal the query "
+                            f"instead"))
+                    break
+        return out
