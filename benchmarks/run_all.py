@@ -36,9 +36,11 @@ def main(argv=None):
         results.extend(ab_bench.main(list(quick)))
     if args.suite in ("serving", "all"):
         results.extend(serve_bench.main(list(quick)))
-        # chaos gate rides along: fault-tolerance regressions surface in the
-        # same results stream as performance regressions
+        # chaos + availability gates ride along: fault-tolerance and
+        # failover regressions surface in the same results stream as
+        # performance regressions
         results.extend(serve_bench.main(["--chaos"]))
+        results.extend(serve_bench.main(["--avail"]))
     results = [r for r in results if r]
 
     print("\n== results ==")

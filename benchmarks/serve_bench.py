@@ -40,6 +40,15 @@ byte-identical to a fault-free spec-off run. It is a robustness gate shaped
 like a benchmark row, so regressions show up in the same regression.csv
 pipeline as performance.
 
+--avail runs a replicated-availability A/B (bench_availability): the same
+Poisson trace through a ``Router`` over N supervised replicas, once
+untouched and once with one replica hard-killed mid-run — the
+goodput_at_slo / ttft_ms_p99 delta between the twin rows is the measured
+cost of losing 1 of N replicas, and the killed row self-asserts the
+failover contract (exactly one terminal per request, token-exact resumed
+streams, survivor pools zero-leak, clean drain). The full-model mode adds
+the same A/B at 3 replicas.
+
 Both modes end with a bench_load row: sustained closed-loop users plus
 open-loop background arrivals driven through the supervised runtime
 (``EngineSupervisor``) with one injected engine-loop crash — reporting
@@ -491,6 +500,169 @@ def bench_load(model, params, *, closed_users: int, closed_turns: int,
                "closed_requests": total_closed})
 
 
+def bench_availability(model, params, *, replicas: int, num_requests: int,
+                       rate_per_s: float, prompt_len: int, max_new: int,
+                       num_blocks: int, block_size: int, max_batch_size: int,
+                       label: str, kill: bool, kill_after: int = 0,
+                       check_exact: bool = True, seed: int = 0,
+                       slo_ttft_s: float = 2.0):
+    """Replicated-availability row: one Poisson trace through a ``Router``
+    over ``replicas`` supervised engines. With ``kill`` set, the busiest
+    replica is hard-killed mid-run (after ``kill_after`` submissions) — its
+    in-flight streams fail over and resume token-exact on the survivors.
+    Run once with ``kill=False`` and once with ``kill=True`` on the same
+    trace: the delta in goodput_at_slo and ttft_ms_p99 between the twin rows
+    IS the cost of losing 1 of N replicas mid-run.
+
+    Goodput and TTFT are computed at the bench level from the router's
+    ``done`` events (not engine metrics): a migrated request's TTFT spans
+    replicas, which only the router-side clock sees. The row self-asserts
+    the failover contract — exactly one terminal per request, every request
+    FINISHED, migrated streams byte-identical to a single-engine reference
+    (``check_exact``), survivor pools zero-leak, clean exit-0 drain.
+    """
+    import threading
+
+    from tnn_tpu.serving import (EngineSupervisor, InferenceEngine, Router,
+                                 ServingMetrics, SupervisorState)
+
+    kill_after = kill_after or num_requests // 2
+    print(f"{label}: {num_requests} requests @ ~{rate_per_s}/s across "
+          f"{replicas} replicas"
+          + (f", killing the busiest after {kill_after} submits" if kill
+             else " (unkilled baseline)"))
+    rng = np.random.default_rng(seed)
+    prompts = [rng.integers(0, model.vocab_size, prompt_len).astype(np.int32)
+               for _ in range(num_requests)]
+    gaps = rng.exponential(1.0 / rate_per_s, num_requests)
+
+    ref = None
+    if check_exact:
+        # single-engine greedy reference: outputs are batch-independent, so
+        # a migrated stream reassembled across two replicas must match it
+        ref_engine = InferenceEngine(
+            model, params, num_blocks=num_blocks, block_size=block_size,
+            max_batch_size=max_batch_size, max_seq_len=prompt_len + max_new,
+            seed=seed)
+        ref = []
+        for p in prompts:
+            rid = ref_engine.submit(p, max_new)
+            ref.append(ref_engine.run_until_complete()[rid])
+
+    # dedicated warmup prompt per replica (same rationale as bench_load:
+    # a trace prompt in the prefix cache would hand one timed request a
+    # free hit), then reset metrics so the timed window starts clean
+    wprompt = np.random.default_rng(seed + 1).integers(
+        0, model.vocab_size, prompt_len).astype(np.int32)
+
+    def mk_engine():
+        eng = InferenceEngine(
+            model, params, num_blocks=num_blocks, block_size=block_size,
+            max_batch_size=max_batch_size, max_seq_len=prompt_len + max_new,
+            seed=seed)
+        wid = eng.submit(wprompt, 1)
+        eng.run_until_complete()
+        del eng.requests[wid]
+        eng.metrics = ServingMetrics(eng.profiler, slo_ttft_s=slo_ttft_s)
+        return eng
+
+    engines = [mk_engine() for _ in range(replicas)]
+    sups = [EngineSupervisor(e, max_restarts=3, restart_backoff_s=0.0,
+                             drain_deadline_s=60.0) for e in engines]
+    router = Router(sups, seed=seed)
+
+    lock = threading.Lock()
+    terminals = {}   # gid -> terminal event count (exactly-once gate)
+    done = {}        # gid -> done event (tokens, ttft_ms)
+
+    def mk_listener():
+        def listener(ev):
+            if ev["event"] == "token":
+                return
+            with lock:
+                terminals[ev["id"]] = terminals.get(ev["id"], 0) + 1
+                if ev["event"] == "done":
+                    done[ev["id"]] = ev
+        return listener
+
+    t0 = time.perf_counter()
+    router.start()
+    victim = None
+    gids = []
+    for i, (p, gap) in enumerate(zip(prompts, gaps)):
+        time.sleep(float(gap))
+        gids.append(router.submit(p, max_new, listener=mk_listener()))
+        if kill and victim is None and i + 1 >= kill_after:
+            # pick the busiest replica WITH live streams — killing an idle
+            # one would prove nothing about mid-stream migration
+            for _ in range(400):
+                live = [r for r in router.stats()["replicas"]
+                        if not r["killed"] and r["live_requests"] > 0]
+                if live:
+                    victim = max(live,
+                                 key=lambda r: r["live_requests"])["replica"]
+                    break
+                time.sleep(0.005)
+            assert victim is not None, \
+                "no in-flight stream to interrupt — workload too light"
+            router.kill_replica(victim)
+    deadline = time.monotonic() + 120.0
+    while sum(terminals.values()) < len(gids):
+        if time.monotonic() > deadline:
+            raise RuntimeError(
+                f"availability bench wedged: "
+                f"{sum(terminals.values())}/{len(gids)} terminal")
+        time.sleep(0.01)
+    hg = router.health_gauges()
+    st = router.stats()
+    router.request_drain("bench complete")
+    if not router.join(timeout=60):
+        raise RuntimeError("router failed to drain")
+    wall = time.perf_counter() - t0
+
+    # the failover contract IS the gate
+    assert router.state is SupervisorState.STOPPED and router.exit_code == 0
+    assert all(terminals.get(g, 0) == 1 for g in gids), \
+        "duplicated or missing terminal events"
+    assert len(done) == len(gids), \
+        f"only {len(done)}/{len(gids)} requests FINISHED"
+    exact = -1
+    if check_exact:
+        exact = int(all(done[g]["tokens"] == ref[i]
+                        for i, g in enumerate(gids)))
+        assert exact, "a failed-over stream diverged from the reference"
+    if kill:
+        assert st["migrated_requests"] >= 1, \
+            "the kill interrupted nothing — no stream migrated"
+    else:
+        assert st["migrated_requests"] == 0
+    for i, eng in enumerate(engines):
+        if kill and i == victim:
+            continue  # the killed replica's pool died with it
+        assert eng.pool.num_allocated == 0, f"survivor {i} leaked KV blocks"
+        eng.check_invariants()
+
+    ttfts = np.array([done[g]["ttft_ms"] for g in gids], dtype=float)
+    within = int(np.sum(ttfts <= slo_ttft_s * 1e3))
+    return report(
+        label, wall, items=num_requests, item_name="req",
+        extra={"requests": num_requests,
+               "replicas": replicas,
+               "killed_replica": int(victim) if kill else -1,
+               "finished": len(done),
+               "goodput_at_slo": round(within / wall, 4),
+               "slo_ttft_s": slo_ttft_s,
+               "ttft_ms_p50": round(float(np.percentile(ttfts, 50)), 3),
+               "ttft_ms_p99": round(float(np.percentile(ttfts, 99)), 3),
+               "migrated_requests": st["migrated_requests"],
+               "migration_resume_tokens": st["migration_resume_tokens"],
+               "router_retries": st["router_retries"],
+               "replica_restarts": st["replica_restarts"],
+               "replicas_healthy": hg["replicas_healthy"],
+               "exact_vs_ref": exact,
+               "terminal": int(sum(terminals.values()))})
+
+
 def _smoke_model():
     """Tiny random GPT-2 (2L/32d/2h): engine mechanics without model weight."""
     from tnn_tpu.models.gpt2 import GPT2
@@ -511,6 +683,11 @@ def main(argv=None):
                     help="tiny model under a seeded FaultPlan: asserts the "
                          "fault-tolerance contract (terminal states, zero "
                          "leaked blocks) and reports it as a bench row")
+    ap.add_argument("--avail", action="store_true",
+                    help="tiny model through the replicated Router: baseline "
+                         "vs one-replica-killed-mid-run A/B, asserting the "
+                         "token-exact failover contract and reporting "
+                         "goodput-at-SLO + p99 TTFT for both rows")
     ap.add_argument("--model", default="gpt2_small")
     ap.add_argument("--rate", type=float, default=4.0,
                     help="mean request arrivals per second")
@@ -522,6 +699,21 @@ def main(argv=None):
         rr.add(lambda: bench_chaos(model, params, num_requests=8, max_new=8,
                                    label="serve_chaos"),
                label="bench_chaos")
+        return rr.results
+    if args.avail:
+        # replicated-availability A/B: the same Poisson trace through a
+        # 2-replica Router, untouched vs one replica hard-killed mid-run —
+        # the goodput_at_slo / ttft_ms_p99 delta between the rows is the
+        # measured cost of losing 1 of N replicas, and the killed row
+        # self-asserts token-exact mid-stream migration
+        model, params = _smoke_model()
+        for tag, kill in (("baseline", False), ("killed", True)):
+            rr.add(lambda t=tag, k=kill: bench_availability(
+                model, params, replicas=2, num_requests=10,
+                rate_per_s=100.0, prompt_len=6, max_new=8, num_blocks=16,
+                block_size=4, max_batch_size=4, kill=k,
+                label=f"serve_avail_{t}"),
+                label=f"bench_availability_{tag}")
         return rr.results
     if args.smoke:
         # standard/paged A/B even in smoke: the decode_path column is the
@@ -621,6 +813,16 @@ def main(argv=None):
         max_new=max_new, num_blocks=128, block_size=16, max_batch_size=8,
         max_queue_depth=8, crash_step=12,
         label=f"serve_{args.model}_load"), label="bench_load")
+    # replicated-availability A/B at model scale: 3 replicas, one killed
+    # mid-run in the second row (exactness is gated at smoke scale where a
+    # serial reference is cheap; here the rows measure goodput under loss)
+    for tag, kill in (("baseline", False), ("killed", True)):
+        rr.add(lambda t=tag, k=kill: bench_availability(
+            model, params, replicas=3, num_requests=n,
+            rate_per_s=args.rate * 2, prompt_len=32, max_new=max_new,
+            num_blocks=128, block_size=16, max_batch_size=8, kill=k,
+            check_exact=False, label=f"serve_{args.model}_avail_{t}"),
+            label=f"bench_availability_{tag}")
     return rr.results
 
 

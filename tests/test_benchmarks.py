@@ -215,6 +215,38 @@ def test_serve_bench_chaos():
 
 
 @pytest.mark.slow
+def test_serve_bench_availability():
+    """The --avail A/B is the benchmark-shaped failover gate: the same
+    Poisson trace through a 2-replica Router, untouched vs one replica
+    hard-killed mid-run. bench_availability self-asserts the contract
+    (exactly one terminal each, token-exact resumed streams, survivor
+    zero-leak, exit-0 drain); here we gate the row shape and that the kill
+    really migrated streams. Slow lane: two router runs with per-replica
+    engine warmups."""
+    from benchmarks import serve_bench
+
+    results = [r for r in serve_bench.main(["--avail"]) if r]
+    assert [r["bench"] for r in results] == ["serve_avail_baseline",
+                                             "serve_avail_killed"]
+    base, killed = results
+    for r in (base, killed):
+        assert r["ms"] > 0 and r["req_per_s"] > 0
+        assert r["requests"] == 10
+        assert r["finished"] == 10 and r["terminal"] == 10
+        assert r["goodput_at_slo"] >= 0
+        assert r["ttft_ms_p99"] >= r["ttft_ms_p50"] > 0
+        assert r["exact_vs_ref"] == 1  # token-exact even across a failover
+        assert r["replicas"] == 2
+    assert base["migrated_requests"] == 0
+    assert base["killed_replica"] == -1
+    assert base["replicas_healthy"] == 2
+    assert killed["migrated_requests"] >= 1
+    assert killed["migration_resume_tokens"] >= 1
+    assert killed["killed_replica"] in (0, 1)
+    assert killed["replicas_healthy"] == 1
+
+
+@pytest.mark.slow
 def test_paged_attention_bench_quick():
     """The paged-vs-gather ops bench must verify and report its speedup
     column (quick sweep; off-TPU the speedup is informational only)."""

@@ -212,6 +212,57 @@ class PagedKVPool:
 ''', "unpaired-pool-mutation") == []
 
 
+class TestUnboundedRetry:
+    def test_unbounded_retry_loop_flags(self):
+        assert _rules('''
+class Router:
+    def dispatch(self, rec):
+        while True:
+            try:
+                return self._call(lambda: self.sup.submit(rec))
+            except ConnectionError:
+                continue
+''', "unbounded-retry") == ["unbounded-retry"]
+
+    def test_budget_in_condition_clean(self):
+        assert _rules('''
+class Router:
+    def dispatch(self, rec):
+        attempt = 0
+        while attempt <= self.max_retries:
+            attempt += 1
+            try:
+                return self._call(lambda: self.sup.submit(rec))
+            except ConnectionError:
+                continue
+''', "unbounded-retry") == []
+
+    def test_for_loop_retry_is_inherently_bounded(self):
+        # the engine's one-shot decode retry idiom: never flagged
+        assert _rules('''
+class Engine:
+    def decode(self):
+        for attempt in (0, 1):
+            try:
+                return self.engine_step()
+            except RuntimeError:
+                continue
+''', "unbounded-retry") == []
+
+    def test_poll_loop_without_engine_call_clean(self):
+        # deadline-bounded queue polls are not retry-around-replica loops
+        assert _rules('''
+def gather(q, want, deadline):
+    got = []
+    while len(got) < want:
+        try:
+            got.append(q.get(timeout=0.5))
+        except TimeoutError:
+            continue
+    return got
+''', "unbounded-retry") == []
+
+
 # -- framework machinery ------------------------------------------------------
 
 
@@ -253,11 +304,12 @@ class TestSuppressions:
 
 
 class TestDriver:
-    def test_all_six_rules_registered(self):
+    def test_all_seven_rules_registered(self):
         assert set(rule_registry()) == {
             "unbounded-compile-key", "use-after-donate",
             "host-sync-in-step-path", "prng-key-reuse",
-            "cross-thread-engine-access", "unpaired-pool-mutation"}
+            "cross-thread-engine-access", "unpaired-pool-mutation",
+            "unbounded-retry"}
 
     def test_unknown_rule_name_rejected(self):
         with pytest.raises(ValueError, match="unknown rule"):

@@ -15,12 +15,12 @@ import pytest
 import jax
 import jax.numpy as jnp
 
-from tnn_tpu.serving import (TERMINAL_STATES, AdmissionRejected, EngineCrash,
-                             EngineSupervisor, FaultPlan, InferenceEngine,
-                             PagedKVPool, PoolExhausted, PrefixCache, Request,
-                             RequestState, Scheduler, ShuttingDown,
-                             SupervisorState, gather_kv, scatter_prefill,
-                             scatter_token)
+from tnn_tpu.serving import (TERMINAL_STATES, AdmissionRejected, BreakerState,
+                             CircuitBreaker, EngineCrash, EngineSupervisor,
+                             FaultPlan, InferenceEngine, PagedKVPool,
+                             PoolExhausted, PrefixCache, Request, RequestState,
+                             Router, Scheduler, ShuttingDown, SupervisorState,
+                             gather_kv, scatter_prefill, scatter_token)
 
 
 # -- pool bookkeeping ---------------------------------------------------------
@@ -771,6 +771,38 @@ class TestFaultPlan:
             [False, True, False]
         assert [plan.malformed_request() for _ in range(3)] == \
             [True, False, True]
+
+    def test_replica_sites_are_deterministic(self):
+        """The router-side sites (replica.kill / net.delay / net.drop) draw
+        from the same seeded rng: identical seeds replay identical kill and
+        network-fault schedules, so a failover soak is reproducible."""
+        def trace(plan):
+            return [(plan.replica_kill(), plan.net_delay(), plan.net_drop())
+                    for _ in range(48)]
+
+        kw = dict(replica_kill_prob=0.2, net_delay_prob=0.3,
+                  net_drop_prob=0.25)
+        a = trace(FaultPlan(seed=5, **kw))
+        b = trace(FaultPlan(seed=5, **kw))
+        c = trace(FaultPlan(seed=6, **kw))
+        assert a == b
+        assert a != c
+        assert any(t[0] for t in a) and any(t[1] for t in a) \
+            and any(t[2] for t in a)
+        plan = FaultPlan(seed=5, **kw)
+        trace(plan)
+        assert plan.calls["replica.kill"] == 48
+        assert plan.fired["replica.kill"] == sum(t[0] for t in a)
+        assert plan.fired["net.delay"] == sum(t[1] for t in a)
+        assert plan.fired["net.drop"] == sum(t[2] for t in a)
+
+    def test_scheduled_replica_calls_fire_exactly(self):
+        plan = FaultPlan(replica_kill_calls=(3,), net_drop_calls=(1, 2))
+        assert [plan.replica_kill() for _ in range(4)] == \
+            [False, False, True, False]
+        assert [plan.net_drop() for _ in range(3)] == [True, True, False]
+        assert plan.fired["replica.kill"] == 1
+        assert plan.fired["net.drop"] == 2
 
     def test_step_crash_fires_at_exact_call_and_escapes(self):
         """EngineCrash is deliberately NOT FaultInjected — nothing inside
@@ -1602,14 +1634,28 @@ class TestSupervisor:
         sup = EngineSupervisor(eng, event_sink=events.append,
                                watchdog_step_s=0.05, max_restarts=2,
                                restart_backoff_s=0.0)
+        refs = [_greedy_ref(model, params, p, 4, eng.assembly_len)
+                for p in warm]
         rids = [sup.submit(p, 4) for p in warm]
+        for _ in range(200):
+            sup.pump(1)
+            if sup.restarts:
+                break
+        # disarm for the recovery leg: the resumed requests re-prefill at
+        # new lengths, and a compile there must not count as a wedge (same
+        # caveat as the fresh-request leg below)
+        sup.watchdog_step_s = None
         sup.run_sync()
         assert sup.restarts == 1
         assert sup.state is SupervisorState.RUNNING   # recovered, not dead
-        errs = {e["id"]: e for e in self._terminals(events)}
-        assert sorted(errs) == sorted(rids)
-        assert all(e["event"] == "error" and "watchdog" in e["reason"]
-                   for e in errs.values())
+        term = {e["id"]: e for e in self._terminals(events)}
+        assert sorted(term) == sorted(rids)
+        # the wedged step cost the requests their KV, not their lives: both
+        # migrate through the resume path and finish token-exact
+        for rid, ref in zip(rids, refs):
+            assert term[rid]["event"] == "done"
+            assert term[rid]["tokens"] == ref
+        assert eng.metrics.migrated_requests == 2
         assert eng.metrics.summary()["engine_restarts"] == 1
         assert eng.pool.num_allocated == 0
         eng.check_invariants()
@@ -1624,10 +1670,12 @@ class TestSupervisor:
         done = [e for e in events if e["event"] == "done" and e["id"] == rid]
         assert len(done) == 1 and done[0]["tokens"] == ref
 
-    def test_engine_crash_restart_readmits_queued(self, tiny_lm):
-        """A crash fails in-flight work but QUEUED requests hold no KV
-        state: they survive the restart and finish token-exact — that is
-        the re-admission path."""
+    def test_engine_crash_restart_resumes_inflight(self, tiny_lm):
+        """A crash no longer fails in-flight work: RUNNING requests lose
+        their KV pages but keep their committed tokens, migrate through
+        the recompute-resume path on restart, and finish token-exact —
+        indistinguishable (to the client stream) from an uninterrupted
+        run. QUEUED requests survive as before."""
         model, params = tiny_lm
         plan = FaultPlan(step_crash_calls=(2,))
         sup, eng, events = self._sup(tiny_lm, plan, max_restarts=2,
@@ -1642,12 +1690,14 @@ class TestSupervisor:
         assert sup.restarts == 1
         term = {e["id"]: e for e in self._terminals(events)}
         assert sorted(term) == sorted(rids)
-        crashed = [r for r in rids if term[r]["event"] == "error"]
-        survived = [r for r in rids if term[r]["event"] == "done"]
-        assert crashed and survived       # batch of 2 died, queued 2 lived
-        assert all("engine restarted" in term[r]["reason"] for r in crashed)
-        for r in survived:
-            assert term[r]["tokens"] == refs[rids.index(r)]
+        assert eng.metrics.migrated_requests == 2   # the in-flight batch
+        for rid, ref in zip(rids, refs):
+            assert term[rid]["event"] == "done"
+            assert term[rid]["tokens"] == ref
+            # the client stream never saw a duplicated or dropped token
+            streamed = [e["token"] for e in events
+                        if e["event"] == "token" and e["id"] == rid]
+            assert streamed == ref
         _assert_drained(eng)
 
     def test_restart_budget_exhaustion_fails_everything(self, tiny_lm):
@@ -1668,6 +1718,60 @@ class TestSupervisor:
             sup.submit(np.arange(4, dtype=np.int32), 2)
         assert eng.pool.num_allocated == 0
         eng.check_invariants()
+
+    def test_migration_budget_exhaustion_fails_poison(self, tiny_lm):
+        """A request that keeps crashing its engine is FAILED with a
+        structured reason once its migration budget is spent — poison
+        isolation, so one bad request cannot wedge the restart loop.
+        The supervisor stays RUNNING and keeps serving."""
+        model, params = tiny_lm
+        # crashes spaced so the victim is re-admitted (RUNNING, charged a
+        # migration) before each one — back-to-back crashes would only ever
+        # see it QUEUED
+        plan = FaultPlan(step_crash_calls=(2, 4, 6))
+        sup, eng, events = self._sup(tiny_lm, plan, max_restarts=10,
+                                     engine_kw=dict(migration_budget=2))
+        sup.submit(np.arange(1, 6, dtype=np.int32), 8)
+        sup.run_sync()
+        term = self._terminals(events)
+        assert len(term) == 1 and term[0]["event"] == "error"
+        assert "migration budget exhausted (2)" in term[0]["reason"]
+        assert sup.state is SupervisorState.RUNNING
+        assert eng.metrics.migrated_requests == 2
+        # the engine still serves a fresh request token-exact
+        p = np.arange(6, dtype=np.int32)
+        ref = _greedy_ref(model, params, p, 4, eng.assembly_len)
+        rid2 = sup.submit(p, 4)
+        sup.run_sync()
+        done = [e for e in events
+                if e["event"] == "done" and e["id"] == rid2]
+        assert len(done) == 1 and done[0]["tokens"] == ref
+        _assert_drained(eng)
+
+    def test_restart_backoff_interruptible_by_drain(self, tiny_lm):
+        """The restart backoff must not block shutdown: a drain arriving
+        mid-backoff wakes the worker immediately instead of letting the
+        process hang for the remaining (possibly seconds-long) sleep."""
+        model, params = tiny_lm
+        plan = FaultPlan(step_crash_calls=(1,))
+        eng = InferenceEngine(model, params, faults=plan, **self.KW)
+        events = []
+        sup = EngineSupervisor(eng, event_sink=events.append,
+                               max_restarts=2, restart_backoff_s=30.0,
+                               restart_backoff_max_s=30.0).start()
+        rid = sup.submit(np.arange(5, dtype=np.int32), 4)
+        deadline = time.monotonic() + 10.0
+        while sup.restarts < 1 and time.monotonic() < deadline:
+            time.sleep(0.005)
+        assert sup.restarts == 1, "crash never landed"
+        sup.request_drain("test drain")       # worker is in its backoff
+        assert sup.join(timeout=10.0), \
+            "drain blocked behind the restart backoff sleep"
+        assert sup.state is SupervisorState.STOPPED
+        assert sup.exit_code == 0
+        term = {e["id"]: e for e in self._terminals(events)}
+        assert term[rid]["event"] == "done"
+        _assert_drained(eng)
 
     def test_client_disconnect_cancels_request(self, tiny_lm):
         """A front end consulting plan.client_disconnect() drops a client
@@ -1745,6 +1849,63 @@ class TestSupervisor:
         with pytest.raises(ShuttingDown):
             sup.submit(p, 2)
         assert rid in {e["id"] for e in events}
+        _assert_drained(eng)
+
+
+class TestCrashResumeExactness:
+    """The in-flight crash-survival contract, exhaustively: an engine
+    crash at ANY point in a request's life — mid-prefill-chunk,
+    mid-decode, mid-spec-draft — loses KV pages but never committed
+    tokens. After the supervisor restart, every request migrates through
+    the recompute-resume path and both the final output and the streamed
+    token sequence are byte-identical to an uninterrupted run, across
+    decode paths and with the prefix cache on or off."""
+
+    @pytest.mark.parametrize("cache", [True, False],
+                             ids=["cache", "nocache"])
+    @pytest.mark.parametrize("path", ["standard", "paged"])
+    @pytest.mark.parametrize(
+        "site", ["prefill_chunk", "decode", "spec_draft"])
+    def test_crash_resume_token_exact(self, tiny_lm, site, path, cache):
+        model, params = tiny_lm
+        kw = dict(num_blocks=32, block_size=4, max_batch_size=4,
+                  max_seq_len=32, decode_path=path, prefix_cache=cache)
+        if site == "spec_draft":
+            kw.update(spec="ngram", spec_k=3)
+            prompts = _cyclic_prompts(2, seed=3)
+            crash_at = (4,)   # decode steps have drafts in flight
+        elif site == "prefill_chunk":
+            kw.update(chunk_size=4)
+            rng = np.random.default_rng(1)
+            prompts = [rng.integers(0, 128, n).astype(np.int32)
+                       for n in (10, 9)]
+            crash_at = (2,)   # first chunk landed; prompts mid-prefill
+        else:
+            rng = np.random.default_rng(2)
+            prompts = [rng.integers(0, 128, n).astype(np.int32)
+                       for n in (5, 7)]
+            crash_at = (4,)   # several decode tokens already committed
+        max_new = 6
+        plan = FaultPlan(step_crash_calls=crash_at)
+        eng = InferenceEngine(model, params, faults=plan, **kw)
+        refs = [_greedy_ref(model, params, p, max_new, eng.assembly_len)
+                for p in prompts]
+        events = []
+        sup = EngineSupervisor(eng, event_sink=events.append,
+                               restart_backoff_s=0.0, max_restarts=2)
+        rids = [sup.submit(p, max_new) for p in prompts]
+        sup.run_sync()
+        assert sup.restarts == 1
+        assert plan.fired["engine.step"] == 1
+        assert eng.metrics.migrated_requests >= 1
+        term = {e["id"]: e for e in events if e["event"] != "token"}
+        assert sorted(term) == sorted(rids)
+        for rid, ref in zip(rids, refs):
+            assert term[rid]["event"] == "done"
+            assert term[rid]["tokens"] == ref
+            streamed = [e["token"] for e in events
+                        if e["event"] == "token" and e["id"] == rid]
+            assert streamed == ref    # no token duplicated or dropped
         _assert_drained(eng)
 
 
@@ -1871,6 +2032,385 @@ def test_chaos_soak_supervised(tiny_lm):
     s = eng.stats()
     assert s["engine_restarts"] == 1
     assert s["drain_duration_s"] >= 0.0
+
+
+# -- replicated failover router -----------------------------------------------
+
+
+class TestCircuitBreaker:
+    """Pure state-machine tests: CLOSED → OPEN on consecutive failures,
+    OPEN → HALF_OPEN after cooldown, one probe decides re-CLOSE/re-OPEN."""
+
+    def test_opens_after_consecutive_failures(self):
+        b = CircuitBreaker(threshold=3, cooldown_s=60.0)
+        assert b.state is BreakerState.CLOSED
+        b.record_failure()
+        b.record_failure()
+        assert b.state is BreakerState.CLOSED and b.allows()
+        b.record_failure()
+        assert b.state is BreakerState.OPEN and not b.allows()
+
+    def test_success_resets_consecutive_count(self):
+        b = CircuitBreaker(threshold=2, cooldown_s=60.0)
+        b.record_failure()
+        b.record_success()
+        b.record_failure()          # not consecutive: stays closed
+        assert b.state is BreakerState.CLOSED and b.allows()
+
+    def test_half_open_probe_success_recloses(self):
+        b = CircuitBreaker(threshold=1, cooldown_s=0.0)
+        b.record_failure()
+        assert b.state is BreakerState.OPEN
+        assert b.allows()                     # cooldown 0: probe admitted
+        assert b.state is BreakerState.HALF_OPEN
+        b.on_dispatch()
+        assert not b.allows()                 # a single probe at a time
+        b.record_success()
+        assert b.state is BreakerState.CLOSED and b.allows()
+
+    def test_half_open_probe_failure_reopens(self):
+        b = CircuitBreaker(threshold=1, cooldown_s=0.0)
+        b.trip()
+        assert b.allows()
+        b.on_dispatch()
+        b.record_failure()                    # the probe failed
+        assert b.state is BreakerState.OPEN
+
+
+class TestRouter:
+    """The failover front end over N supervised replicas, driven through
+    the deterministic sync harness (``pump``/``run_sync``): placement,
+    retries, mid-stream migration, breaker integration, cascade drain."""
+
+    KW = dict(num_blocks=32, block_size=4, max_batch_size=4, max_seq_len=32)
+
+    def _router(self, tiny_lm, n=3, *, plans=None, router_kw=None,
+                engine_kw=None, sup_kw=None):
+        model, params = tiny_lm
+        ekw = dict(self.KW)
+        ekw.update(engine_kw or {})
+        skw = dict(restart_backoff_s=0.0)
+        skw.update(sup_kw or {})
+        plans = plans or [None] * n
+        sups = [EngineSupervisor(
+                    InferenceEngine(model, params, faults=plans[i], **ekw),
+                    **skw)
+                for i in range(n)]
+        events = []
+        router = Router(sups, event_sink=events.append, seed=0,
+                        **(router_kw or {}))
+        return router, sups, events
+
+    @staticmethod
+    def _terminals(events):
+        return [e for e in events if e["event"] != "token"]
+
+    def test_jsq_placement_spreads_load(self, tiny_lm):
+        model, params = tiny_lm
+        router, sups, events = self._router(tiny_lm, n=2)
+        rng = np.random.default_rng(5)
+        prompts = [rng.integers(0, 128, n).astype(np.int32)
+                   for n in (5, 6, 7, 8)]
+        refs = [_greedy_ref(model, params, p, 5,
+                            sups[0].engine.assembly_len) for p in prompts]
+        gids = [router.submit(p, 5) for p in prompts]
+        # join-shortest-queue: 4 submits over 2 replicas → 2 each
+        assert [len(h.live) for h in router.replicas] == [2, 2]
+        router.run_sync()
+        term = {e["id"]: e for e in self._terminals(events)}
+        for gid, ref in zip(gids, refs):
+            assert term[gid]["event"] == "done"
+            assert term[gid]["tokens"] == ref
+        assert router.stats()["router_retries"] == 0
+
+    def test_kill_replica_midstream_migrates_token_exact(self, tiny_lm):
+        """The headline failover: a replica is hard-killed with requests
+        mid-decode; its live streams re-dispatch to the survivors and the
+        client sees an uninterrupted token-exact stream."""
+        model, params = tiny_lm
+        router, sups, events = self._router(tiny_lm, n=3)
+        rng = np.random.default_rng(6)
+        prompts = [rng.integers(0, 128, n).astype(np.int32)
+                   for n in (5, 6, 7, 8)]
+        refs = [_greedy_ref(model, params, p, 8,
+                            sups[0].engine.assembly_len) for p in prompts]
+        gids = [router.submit(p, 8) for p in prompts]
+        router.pump(3)                 # streams genuinely mid-flight
+        victim = max(router.replicas, key=lambda h: len(h.live)).idx
+        assert len(router.replicas[victim].live) > 0
+        router.kill_replica(victim)
+        router.run_sync()
+        term = {e["id"]: e for e in self._terminals(events)}
+        assert sorted(term) == sorted(gids)
+        for gid, ref in zip(gids, refs):
+            assert term[gid]["event"] == "done"
+            assert term[gid]["tokens"] == ref
+            streamed = [e["token"] for e in events
+                        if e["event"] == "token" and e["id"] == gid]
+            assert streamed == ref     # no token duplicated or dropped
+        assert router.metrics.migrated_requests > 0
+        st = router.stats()
+        assert st["replicas"][victim]["killed"]
+        assert st["replicas"][victim]["breaker_state"] == "open"
+        # survivors leak nothing
+        for h in router.replicas:
+            if h.idx != victim:
+                assert h.sup.engine.pool.num_allocated == 0
+                h.sup.engine.check_invariants()
+
+    def test_replica_internal_restart_is_invisible(self, tiny_lm):
+        """An engine crash INSIDE a replica is the supervisor's problem:
+        it restarts, migrates its own requests, and the router never even
+        sees an error — no router-level migration, just replica_restarts
+        in the stats."""
+        model, params = tiny_lm
+        plans = [FaultPlan(step_crash_calls=(2,)), None]
+        router, sups, events = self._router(tiny_lm, n=2, plans=plans)
+        rng = np.random.default_rng(7)
+        prompts = [rng.integers(0, 128, n).astype(np.int32)
+                   for n in (5, 6, 7, 8)]
+        refs = [_greedy_ref(model, params, p, 5,
+                            sups[0].engine.assembly_len) for p in prompts]
+        gids = [router.submit(p, 5) for p in prompts]
+        router.run_sync()
+        term = {e["id"]: e for e in self._terminals(events)}
+        for gid, ref in zip(gids, refs):
+            assert term[gid]["event"] == "done"
+            assert term[gid]["tokens"] == ref
+        assert router.metrics.migrated_requests == 0
+        st = router.stats()
+        assert st["replica_restarts"] == 1
+        assert all(r["breaker_state"] == "closed" for r in st["replicas"])
+
+    def test_restart_budget_exhaustion_fails_over(self, tiny_lm):
+        """A replica that crashes until its supervisor gives up emits
+        'restart budget exhausted' for its requests — a replica-level
+        failure the router turns into migration, not client errors."""
+        model, params = tiny_lm
+        plans = [FaultPlan(step_crash_calls=(1, 2, 3, 4, 5, 6)), None]
+        router, sups, events = self._router(
+            tiny_lm, n=2, plans=plans, sup_kw=dict(max_restarts=1))
+        rng = np.random.default_rng(8)
+        prompts = [rng.integers(0, 128, n).astype(np.int32)
+                   for n in (5, 6)]
+        refs = [_greedy_ref(model, params, p, 5,
+                            sups[0].engine.assembly_len) for p in prompts]
+        gids = [router.submit(p, 5) for p in prompts]
+        router.run_sync()
+        assert sups[0].state is SupervisorState.FAILED
+        term = {e["id"]: e for e in self._terminals(events)}
+        for gid, ref in zip(gids, refs):
+            assert term[gid]["event"] == "done"
+            assert term[gid]["tokens"] == ref
+        assert router.metrics.migrated_requests >= 1
+
+    def test_router_migration_budget_exhausts_poison(self, tiny_lm):
+        """migration_budget=0: the first failover attempt FAILs the
+        request with a structured reason instead of bouncing it around
+        the fleet forever."""
+        router, sups, events = self._router(
+            tiny_lm, n=2, router_kw=dict(migration_budget=0))
+        gid = router.submit(np.arange(5, dtype=np.int32), 8)
+        router.pump(2)
+        victim = next(h.idx for h in router.replicas if h.live)
+        router.kill_replica(victim)
+        router.run_sync()
+        term = self._terminals(events)
+        assert len(term) == 1 and term[0]["event"] == "error"
+        assert term[0]["id"] == gid
+        assert "router migration budget exhausted (0)" in term[0]["reason"]
+
+    def test_net_drop_retries_then_succeeds(self, tiny_lm):
+        model, params = tiny_lm
+        router, sups, events = self._router(
+            tiny_lm, n=2,
+            router_kw=dict(faults=FaultPlan(net_drop_calls=(1,)),
+                           retry_backoff_s=0.0, retry_jitter_s=0.0))
+        p = np.arange(6, dtype=np.int32)
+        ref = _greedy_ref(model, params, p, 5, sups[0].engine.assembly_len)
+        gid = router.submit(p, 5)       # first call dropped, retry lands
+        assert router.metrics.router_retries == 1
+        router.run_sync()
+        term = {e["id"]: e for e in self._terminals(events)}
+        assert term[gid]["event"] == "done" and term[gid]["tokens"] == ref
+        assert router.stats()["router_retries"] == 1
+
+    def test_net_drop_exhausts_retries_and_raises(self, tiny_lm):
+        router, sups, events = self._router(
+            tiny_lm, n=2,
+            router_kw=dict(faults=FaultPlan(net_drop_prob=1.0),
+                           max_retries=2, retry_backoff_s=0.0,
+                           retry_jitter_s=0.0))
+        with pytest.raises(ConnectionError):
+            router.submit(np.arange(5, dtype=np.int32), 4)
+        assert router.metrics.router_retries == 2
+        assert router.stats()["router_open_requests"] == 0
+
+    def test_deadline_respected_during_retries(self, tiny_lm):
+        """A retry whose backoff would overshoot the request deadline
+        fails the request as a timeout instead of burning the budget."""
+        router, sups, events = self._router(
+            tiny_lm, n=2,
+            router_kw=dict(faults=FaultPlan(net_drop_prob=1.0),
+                           retry_backoff_s=5.0, retry_jitter_s=0.0))
+        gid = router.submit(np.arange(5, dtype=np.int32), 4,
+                            deadline_s=0.05)
+        term = self._terminals(events)
+        assert len(term) == 1 and term[0]["id"] == gid
+        assert term[0]["event"] == "timeout"
+        assert "deadline exceeded during failover" in term[0]["reason"]
+
+    def test_all_replicas_dead_fails_cleanly(self, tiny_lm):
+        router, sups, events = self._router(
+            tiny_lm, n=2, router_kw=dict(retry_backoff_s=0.0,
+                                         retry_jitter_s=0.0))
+        gids = [router.submit(np.arange(5, dtype=np.int32) + i, 8)
+                for i in range(2)]
+        router.pump(1)
+        router.kill_replica(0)
+        router.kill_replica(1)
+        router.run_sync()
+        term = {e["id"]: e for e in self._terminals(events)}
+        assert sorted(term) == sorted(gids)
+        assert all(e["event"] == "error" and "replica" in e["reason"]
+                   for e in term.values())
+        assert router.state is SupervisorState.FAILED
+        assert router.exit_code == 1
+        with pytest.raises(ShuttingDown):
+            router.submit(np.arange(5, dtype=np.int32), 2)
+
+    def test_cascade_drain_stops_everything(self, tiny_lm):
+        model, params = tiny_lm
+        router, sups, events = self._router(tiny_lm, n=3)
+        p = np.arange(6, dtype=np.int32)
+        ref = _greedy_ref(model, params, p, 5, sups[0].engine.assembly_len)
+        gid = router.submit(p, 5)
+        router.pump(1)
+        router.request_drain("test over")
+        assert router.draining
+        with pytest.raises(ShuttingDown):
+            router.submit(p, 2)
+        router.run_sync()
+        assert router.state is SupervisorState.STOPPED
+        assert router.exit_code == 0
+        assert router.drain_duration_s is not None
+        assert all(s.state is SupervisorState.STOPPED for s in sups)
+        term = {e["id"]: e for e in self._terminals(events)}
+        assert term[gid]["event"] == "done" and term[gid]["tokens"] == ref
+
+    def test_stats_and_health_gauges_shape(self, tiny_lm):
+        router, sups, _ = self._router(tiny_lm, n=2)
+        router.submit(np.arange(5, dtype=np.int32), 4)
+        st = router.stats()
+        assert st["router_replicas"] == 2
+        assert st["router_open_requests"] == 1
+        assert len(st["replicas"]) == 2
+        for r in st["replicas"]:
+            assert r["breaker_state"] == "closed"
+            assert not r["killed"]
+        g = router.health_gauges()
+        assert g["replicas_total"] == 2
+        assert g["replicas_healthy"] == 2
+        assert g["num_running"] == 1
+        router.run_sync()
+        assert router.stats()["router_open_requests"] == 0
+
+    def test_threaded_router_submit_and_drain(self, tiny_lm):
+        """The started (threaded) path: every replica on its own worker,
+        the monitor probing health, drain from the outside."""
+        model, params = tiny_lm
+        router, sups, events = self._router(tiny_lm, n=2)
+        p = np.arange(6, dtype=np.int32)
+        ref = _greedy_ref(model, params, p, 5, sups[0].engine.assembly_len)
+        router.start()
+        import queue as _q
+        got: "_q.Queue[dict]" = _q.Queue()
+        gid = router.submit(p, 5, listener=got.put)
+        ev = got.get(timeout=60)
+        seen = [ev]
+        while ev["event"] == "token":
+            ev = got.get(timeout=60)
+            seen.append(ev)
+        assert ev["event"] == "done" and ev["tokens"] == ref
+        assert [e["token"] for e in seen[:-1]] == ref
+        router.request_drain("test over")
+        assert router.join(timeout=30)
+        assert router.state is SupervisorState.STOPPED
+        assert router.exit_code == 0
+        assert gid in {e["id"] for e in events}
+
+
+@pytest.mark.slow
+def test_chaos_soak_router(tiny_lm):
+    """The replicated soak gate: 3 replicas behind the router with chaos
+    at every layer — alloc faults and NaN rows inside each replica, one
+    replica hard-killed mid-run on a seeded schedule. Asserts the full
+    failover contract: exactly one terminal event per request, finished
+    streams (migrants included) token-exact against the fault-free
+    reference, zero leaked blocks on the survivors, clean cascade drain."""
+    model, params = tiny_lm
+    rng = np.random.default_rng(21)
+    uniq = [rng.integers(0, 128, int(n)).astype(np.int32)
+            for n in rng.integers(4, 14, 8)]
+    max_new = 6
+    sups = []
+    for i in range(3):
+        plan = FaultPlan(seed=100 + i, alloc_fail_prob=0.02,
+                         nan_logit_prob=0.01)
+        eng = InferenceEngine(model, params, num_blocks=32, block_size=4,
+                              max_batch_size=4, max_seq_len=32,
+                              max_queue_depth=24, faults=plan)
+        eng.pool.fault_plan = plan
+        sups.append(EngineSupervisor(eng, restart_backoff_s=0.0,
+                                     max_restarts=5))
+    refs = {i: _greedy_ref(model, params, p, max_new,
+                           sups[0].engine.assembly_len)
+            for i, p in enumerate(uniq)}
+    events = []
+    router = Router(sups, event_sink=events.append, seed=3)
+    kill_plan = FaultPlan(seed=9, replica_kill_calls=(40,))
+    n_requests, rejected, submitted = 120, 0, {}
+    victim = None
+    for i in range(n_requests):
+        which = int(rng.integers(0, len(uniq)))
+        try:
+            gid = router.submit(uniq[which], max_new, priority=i % 3)
+            submitted[gid] = which
+        except (AdmissionRejected, ShuttingDown, ConnectionError):
+            rejected += 1
+        router.pump(1)
+        if victim is None and kill_plan.replica_kill():
+            victim = max((h for h in router.replicas if not h.killed),
+                         key=lambda h: len(h.live)).idx
+            router.kill_replica(victim)
+    router.run_sync()
+    router.request_drain("soak complete")
+    router.run_sync()
+
+    assert victim is not None, "the seeded kill never fired"
+    assert kill_plan.fired["replica.kill"] == 1
+    assert router.state is SupervisorState.STOPPED
+    assert router.exit_code == 0
+    assert rejected + len(submitted) == n_requests
+    # exactly one terminal event per admitted request
+    terminals = [e for e in events if e["event"] != "token"]
+    per_gid = {}
+    for e in terminals:
+        per_gid[e["id"]] = per_gid.get(e["id"], 0) + 1
+    assert sorted(per_gid) == sorted(submitted)
+    assert all(c == 1 for c in per_gid.values()), per_gid
+    # the kill migrated live work, and the migrants landed
+    assert router.metrics.migrated_requests > 0
+    finished = [e for e in terminals if e["event"] == "done"]
+    assert finished, "soak finished nothing"
+    for e in finished:
+        assert e["tokens"] == refs[submitted[e["id"]]], \
+            f"gid {e['id']} diverged from fault-free reference"
+    # zero leaked blocks on the survivors
+    for h in router.replicas:
+        if h.idx != victim:
+            assert h.sup.engine.pool.num_allocated == 0
+            h.sup.engine.check_invariants()
 
 
 # -- speculative decoding: drafters, rollback, token-exact verification -------

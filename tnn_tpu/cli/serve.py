@@ -62,7 +62,8 @@ from tnn_tpu import checkpoint as ckpt_lib  # noqa: E402
 from tnn_tpu import models  # noqa: E402
 from tnn_tpu.data.tokenizer import Tokenizer  # noqa: E402
 from tnn_tpu.serving import (AdmissionRejected, EngineSupervisor,  # noqa: E402
-                             InferenceEngine, ShuttingDown, run_server)
+                             InferenceEngine, Router, ShuttingDown,
+                             run_server)
 
 
 from tnn_tpu.cli import console_entry
@@ -128,6 +129,16 @@ def main(argv=None):
     ap.add_argument("--max-restarts", type=int, default=2,
                     help="engine crash/watchdog recoveries before the "
                          "supervisor gives up and fails all requests")
+    ap.add_argument("--replicas", type=int, default=1,
+                    help="run N supervised engine replicas behind a failover "
+                         "router: join-shortest-queue placement, per-replica "
+                         "circuit breakers, bounded retries, and token-exact "
+                         "mid-stream migration when a replica dies")
+    ap.add_argument("--migration-budget", type=int, default=3,
+                    help="crash migrations one request may absorb — engine "
+                         "restart re-admissions and router failovers each "
+                         "spend from their own budget of this size — before "
+                         "it fails as poison (-1 = unlimited)")
     ap.add_argument("--drain-deadline-s", type=float, default=30.0,
                     help="graceful-drain budget: in-flight work past this "
                          "deadline times out (0 = wait forever)")
@@ -168,19 +179,33 @@ def main(argv=None):
         print("spec=draft: random-weight gpt2_tiny drafter (wire a trained "
               "draft checkpoint for real acceptance rates)", file=sys.stderr)
 
-    engine = InferenceEngine(
-        model, params, num_blocks=args.num_blocks, block_size=args.block_size,
-        max_batch_size=args.max_batch_size, chunk_size=args.chunk_size,
-        chunked_prefill=not args.no_chunked_prefill,
-        prefix_cache=not args.no_prefix_cache,
-        prefix_cache_min_hit_blocks=args.prefix_cache_min_hit_blocks,
-        max_seq_len=args.max_seq_len or None, decode_path=args.decode_path,
-        max_queue_depth=args.max_queue_depth,
-        preemption_budget=(None if args.preemption_budget < 0
-                           else args.preemption_budget),
-        logit_guard=not args.no_logit_guard,
-        spec=args.spec, spec_k=args.spec_k,
-        draft_model=draft_model, draft_params=draft_params, seed=args.seed)
+    def build_engine():
+        return InferenceEngine(
+            model, params, num_blocks=args.num_blocks,
+            block_size=args.block_size,
+            max_batch_size=args.max_batch_size, chunk_size=args.chunk_size,
+            chunked_prefill=not args.no_chunked_prefill,
+            prefix_cache=not args.no_prefix_cache,
+            prefix_cache_min_hit_blocks=args.prefix_cache_min_hit_blocks,
+            max_seq_len=args.max_seq_len or None,
+            decode_path=args.decode_path,
+            max_queue_depth=args.max_queue_depth,
+            preemption_budget=(None if args.preemption_budget < 0
+                               else args.preemption_budget),
+            migration_budget=(None if args.migration_budget < 0
+                              else args.migration_budget),
+            logit_guard=not args.no_logit_guard,
+            spec=args.spec, spec_k=args.spec_k,
+            draft_model=draft_model, draft_params=draft_params,
+            seed=args.seed)
+
+    def build_supervisor(eng):
+        return EngineSupervisor(
+            eng, watchdog_step_s=args.watchdog_s or None,
+            max_restarts=args.max_restarts,
+            drain_deadline_s=args.drain_deadline_s or None)
+
+    engine = build_engine()
     if not engine._paged and engine.paged_fallback_reason:
         print(f"paged decode unavailable: {engine.paged_fallback_reason}",
               file=sys.stderr)
@@ -188,16 +213,28 @@ def main(argv=None):
         print(f"standard decode path: {engine.fused_fallback_reason}",
               file=sys.stderr)
 
-    supervisor = EngineSupervisor(
-        engine, watchdog_step_s=args.watchdog_s or None,
-        max_restarts=args.max_restarts,
-        drain_deadline_s=args.drain_deadline_s or None)
+    if args.replicas > 1:
+        # replicas share read-only params; each gets its own KV pool,
+        # scheduler, and supervised worker thread
+        sups = [build_supervisor(engine)] + [
+            build_supervisor(build_engine())
+            for _ in range(args.replicas - 1)]
+        supervisor = Router(
+            sups,
+            migration_budget=(10 ** 9 if args.migration_budget < 0
+                              else args.migration_budget),
+            seed=args.seed)
+        print(f"router: {args.replicas} supervised replicas",
+              file=sys.stderr)
+    else:
+        supervisor = build_supervisor(engine)
 
     if args.http:
         host, _, port = args.http.rpartition(":")
         code = run_server(supervisor, host=host or "127.0.0.1",
                           port=int(port), tokenizer=tokenizer,
                           default_max_new=args.max_new_tokens)
+        supervisor.join(10.0)  # let worker threads exit before teardown
         _print_summary(supervisor)
         return code
     return _serve_stdin(supervisor, model, tokenizer, args)
@@ -207,7 +244,6 @@ def _serve_stdin(supervisor, model, tokenizer, args):
     """Stdin JSON-lines loop as a thin client of the supervisor: requests
     marshal onto the worker thread, events flow back through the sink
     queue, and SIGINT/SIGTERM/EOF all converge on one graceful drain."""
-    engine = supervisor.engine
     out_q: "queue.Queue" = queue.Queue()
     supervisor.event_sink = out_q.put
 
@@ -305,6 +341,11 @@ def _serve_stdin(supervisor, model, tokenizer, args):
                 elif line.strip():
                     handle_line(line)
         flush_events()
+        # finished flips before the worker threads (replicas + router
+        # monitor) run their last instructions; exiting the interpreter
+        # under a daemon thread still inside its final jitted call aborts
+        # in native XLA teardown. Bounded join before we let Python die.
+        supervisor.join(10.0)
     finally:
         for signum, handler in old_handlers.items():
             signal.signal(signum, handler)

@@ -21,6 +21,7 @@ from .kv_pool import (PagedKVPool, PoolExhausted, gather_kv, scatter_prefill,
 from .metrics import ServingMetrics
 from .ownership import worker_only
 from .prefix_cache import PrefixCache
+from .router import BreakerState, CircuitBreaker, NetDrop, Router
 from .scheduler import (TERMINAL_STATES, AdmissionRejected, Request,
                         RequestState, Scheduler, StepPlan)
 from .server import ServingServer, run_server
@@ -32,5 +33,6 @@ __all__ = [
     "Request", "RequestState", "Scheduler", "StepPlan", "AdmissionRejected",
     "TERMINAL_STATES", "FaultPlan", "FaultInjected", "EngineCrash",
     "EngineSupervisor", "SupervisorState", "ShuttingDown",
+    "Router", "CircuitBreaker", "BreakerState", "NetDrop",
     "ServingServer", "run_server", "worker_only",
 ]

@@ -173,6 +173,7 @@ class InferenceEngine:
                  decode_path: str = "auto", max_queue_depth: int = 0,
                  admission_policy: str = "reject",
                  preemption_budget: Optional[int] = 16,
+                 migration_budget: Optional[int] = 3,
                  logit_guard: bool = True, faults: Optional[FaultPlan] = None,
                  prefix_publish_max_occupancy: float = 0.95,
                  spec: Any = "off", spec_k: int = 4,
@@ -192,6 +193,8 @@ class InferenceEngine:
             raise ValueError("max_queue_depth must be >= 0 (0 = unbounded)")
         if preemption_budget is not None and preemption_budget < 0:
             raise ValueError("preemption_budget must be >= 0 or None")
+        if migration_budget is not None and migration_budget < 0:
+            raise ValueError("migration_budget must be >= 0 or None")
         if chunk_size < 1:
             raise ValueError("chunk_size must be >= 1")
         if prefix_cache_min_hit_blocks < 1:
@@ -228,6 +231,7 @@ class InferenceEngine:
         self.max_queue_depth = int(max_queue_depth)
         self.admission_policy = admission_policy
         self.preemption_budget = preemption_budget
+        self.migration_budget = migration_budget
         self.logit_guard = bool(logit_guard)
         self.faults = faults
         self.model = model
@@ -339,7 +343,8 @@ class InferenceEngine:
                stop_token: Optional[int] = None,
                deadline_s: Optional[float] = None,
                max_queue_s: Optional[float] = None,
-               priority: int = 0) -> int:
+               priority: int = 0,
+               migration_budget: Optional[int] = None) -> int:
         """Queue a generation request; returns its request id.
 
         ``deadline_s`` bounds the request's total wall time from submit;
@@ -355,6 +360,10 @@ class InferenceEngine:
         queued request (strictly larger priority value) to make room — so
         overload degrades background traffic first instead of uniformly.
         Equal-priority traffic keeps the plain reject/block behavior.
+
+        ``migration_budget`` caps how many crash/failover re-admissions
+        (``migrate_running``) this request may take before it is FAILED as
+        poison; None inherits the engine default.
         """
         prompt = np.asarray(prompt_ids, np.int32).reshape(-1)
         if prompt.size == 0:
@@ -396,7 +405,10 @@ class InferenceEngine:
                       top_p=float(top_p), stop_token=stop_token,
                       submit_time=time.perf_counter(),
                       deadline_s=deadline_s, max_queue_s=max_queue_s,
-                      priority=int(priority))
+                      priority=int(priority),
+                      migration_budget=(self.migration_budget
+                                        if migration_budget is None
+                                        else int(migration_budget)))
         self.requests[rid] = req
         self.scheduler.submit(req)
         return rid
@@ -1522,6 +1534,42 @@ class InferenceEngine:
                 self.pool.purge_evictable()
                 self.prefix_cache.clear()
             self._last_decode_emit = None
+        return events
+
+    def migrate_running(self, reason: str) -> Dict[str, List]:
+        """Crash-survival re-admission: every RUNNING request loses its KV
+        (the restart re-zeroes the pages) but NOT its progress — committed
+        tokens ride along as an extended prompt through the scheduler's
+        preemption-resume path, so the stream continues from the last
+        emitted token, token-exact under greedy decoding. A request whose
+        ``migration_budget`` is exhausted is FAILED instead: a poison
+        request that keeps crashing the engine is isolated rather than
+        wedging the restart loop. Pages are re-zeroed and the prefix index
+        dropped exactly as in ``abort_all``.
+
+        Returns step-shaped event buckets holding only the budget-exhausted
+        terminations — migrated requests emit nothing; their streams simply
+        continue after the re-prefill."""
+        events: Dict[str, List] = {"tokens": [], "finished": [],
+                                   "failed": [], "timed_out": []}
+        for req in list(self.scheduler.running):
+            budget = req.migration_budget
+            if budget is not None and req.migrations >= budget:
+                self._terminate(
+                    req, RequestState.FAILED,
+                    f"migration budget exhausted ({budget}) — "
+                    f"last failure: {reason}", events, "failed")
+                continue
+            self.pool.free(req.block_table)
+            req.block_table = []
+            req.cache_len = 0
+            self.scheduler.migrate(req)
+            self.metrics.observe_migration(len(req.resume_tokens))
+        self.pool.reset_pages()
+        if self.prefix_cache is not None:
+            self.pool.purge_evictable()
+            self.prefix_cache.clear()
+        self._last_decode_emit = None
         return events
 
     def _maybe_finish(self, req: Request, tok: int, events) -> None:

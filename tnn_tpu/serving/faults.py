@@ -30,7 +30,11 @@ indices:
 - **connection-level faults**: ``client_disconnect`` / ``slow_consumer`` /
   ``malformed_request`` are consulted by front ends and chaos harnesses
   (the engine never calls them) to decide when a simulated client drops
-  mid-stream, stalls its reads, or sends a garbage payload.
+  mid-stream, stalls its reads, or sends a garbage payload;
+- **replica-level faults**: ``replica_kill`` (site "replica.kill") is
+  consulted by the router's chaos harness to hard-kill a chosen replica
+  mid-step; ``net_delay`` / ``net_drop`` (sites "net.delay" / "net.drop")
+  model router↔replica call latency and loss at the router's call seam.
 
 Everything is driven by one ``numpy`` Generator seeded at construction:
 the same plan over the same call sequence fires the same faults, so chaos
@@ -113,6 +117,14 @@ class FaultPlan:
     slow_consumer_stall_s: float = 0.05            # how long a slow read stalls
     malformed_request_prob: float = 0.0
     malformed_request_calls: Tuple[int, ...] = ()  # site "client.malformed"
+    # replica-level faults, consulted by the router / its chaos harness
+    replica_kill_prob: float = 0.0
+    replica_kill_calls: Tuple[int, ...] = ()       # site "replica.kill"
+    net_delay_prob: float = 0.0
+    net_delay_calls: Tuple[int, ...] = ()          # site "net.delay"
+    net_delay_s: float = 0.01                      # injected call latency
+    net_drop_prob: float = 0.0
+    net_drop_calls: Tuple[int, ...] = ()           # site "net.drop"
 
     calls: Counter = field(default_factory=Counter, init=False)
     fired: Counter = field(default_factory=Counter, init=False)
@@ -215,3 +227,26 @@ class FaultPlan:
         (site "client.malformed")."""
         return self._fires("client.malformed", self.malformed_request_prob,
                            self.malformed_request_calls)
+
+    # -- replica-level sites (called by the router / its chaos harness) -------
+
+    def replica_kill(self) -> bool:
+        """Consulted once per router pump round (or harness-defined tick):
+        True when the chosen replica should be hard-killed mid-step (site
+        "replica.kill"). WHICH replica dies is the harness's choice — the
+        plan only decides WHEN, keeping the schedule seed-deterministic."""
+        return self._fires("replica.kill", self.replica_kill_prob,
+                           self.replica_kill_calls)
+
+    def net_delay(self) -> bool:
+        """True when a router↔replica call should stall ``net_delay_s``
+        before dispatch (site "net.delay")."""
+        return self._fires("net.delay", self.net_delay_prob,
+                           self.net_delay_calls)
+
+    def net_drop(self) -> bool:
+        """True when a router↔replica call should be dropped — the router
+        sees a connection failure and must retry/fail over (site
+        "net.drop")."""
+        return self._fires("net.drop", self.net_drop_prob,
+                           self.net_drop_calls)

@@ -96,6 +96,10 @@ class ServingMetrics:
         self.engine_restarts = 0      # supervisor-driven engine recoveries
         self.drain_duration_s = 0.0   # wall time of the last graceful drain
         self.publish_suspended = 0    # prefix publishes skipped under pressure
+        # crash-migration counters (in-flight survival + router failover)
+        self.migrated_requests = 0       # re-admissions after a crash/failover
+        self.migration_resume_tokens = 0  # tokens re-prefilled by migrations
+        self.router_retries = 0          # router-level dispatch retries
         self.finished_ttft_s: List[float] = []  # TTFT of *finished* requests
         self._t_created = time.perf_counter()
         self._t_first: Optional[float] = None
@@ -223,6 +227,20 @@ class ServingMetrics:
         self.engine_restarts += 1
         self._tick("serve.engine_restarts", 1)
 
+    def observe_migration(self, resume_tokens: int) -> None:
+        """One RUNNING request re-admitted through the resume path after an
+        engine restart or replica failover; ``resume_tokens`` is the length
+        of the extended prompt its next (re-)prefill must push."""
+        self.migrated_requests += 1
+        self.migration_resume_tokens += resume_tokens
+        self._tick("serve.migrated_requests", 1)
+
+    def observe_router_retry(self) -> None:
+        """The router re-dispatched a request after a replica-level failure
+        (backoff retry or mid-stream migration to another replica)."""
+        self.router_retries += 1
+        self._tick("serve.router_retries", 1)
+
     def observe_drain(self, seconds: float) -> None:
         self.drain_duration_s = seconds
         self._tick("serve.drain_duration_s", seconds)
@@ -331,6 +349,9 @@ class ServingMetrics:
             "drain_duration_s": self.drain_duration_s,
             "shed_requests": self.shed,
             "publish_suspended": self.publish_suspended,
+            "migrated_requests": self.migrated_requests,
+            "migration_resume_tokens": self.migration_resume_tokens,
+            "router_retries": self.router_retries,
             "goodput_at_slo": self.goodput_at_slo,
             "stall_slo_violations": self.stall_slo_violations,
             "tok_per_s": self.tokens_per_s,

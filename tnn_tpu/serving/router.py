@@ -1,0 +1,747 @@
+"""Replicated failover router: one front end over N supervised replicas.
+
+``EngineSupervisor`` makes a single engine's crash survivable (PR:
+resilient serving runtime; in-flight requests now *migrate* through the
+scheduler's resume path instead of failing). This module decouples request
+failure from *replica* failure: a request outlives the death of the entire
+engine+supervisor stack serving it.
+
+The router fronts N ``EngineSupervisor`` instances (in-process here, but
+every router↔replica interaction goes through process-shaped seams — the
+supervisor's thread-safe public API via the ``_call`` seam — so swapping a
+replica handle for an RPC stub changes no control flow):
+
+- **Join-shortest-queue placement.** New requests go to the healthy
+  replica with the fewest router-assigned live requests. "Healthy" means
+  not killed, not finished, and its circuit breaker admits traffic.
+- **Circuit breaker per replica.** CLOSED → OPEN after
+  ``breaker_threshold`` consecutive failures (failed dispatches, dropped
+  calls, replica-level request failures); OPEN → HALF_OPEN after
+  ``breaker_cooldown_s``, admitting a single probe dispatch; the probe's
+  success re-CLOSEs, its failure re-OPENs. An open breaker removes the
+  replica from placement without declaring it dead.
+- **Bounded retries with backoff + jitter.** A failed dispatch retries on
+  another replica up to ``max_retries`` times with exponential backoff
+  (``retry_backoff_s * 2**(n-1)``, capped, plus seeded jitter), always
+  respecting the request's ``deadline_s`` — a retry that cannot complete
+  before the deadline fails the request as TIMED_OUT instead of burning
+  the budget.
+- **Token-exact mid-stream migration.** The router records every token it
+  streams. When a replica dies — hard kill (``kill_replica`` /
+  ``EngineSupervisor.kill``), restart-budget exhaustion, supervisor loop
+  crash — its live requests re-dispatch to a healthy replica with the
+  committed prefix as an extended prompt (``prompt + emitted``) and
+  ``max_new - len(emitted)`` tokens to go. The new replica's prefill
+  samples the *successor* of the last emitted token, so the client stream
+  continues with no token duplicated or dropped — byte-identical to an
+  uninterrupted run under greedy decoding. Per-request router migrations
+  are bounded by ``migration_budget`` (poison isolation: a request that
+  keeps killing replicas FAILs with a structured reason). Engine-level
+  failures that name an exhausted *engine* migration budget pass through
+  unmigrated for the same reason.
+- **Cascading drain.** ``request_drain`` closes router admissions and
+  drains every replica; the router parks STOPPED (exit_code 0) once all
+  replicas finish and every routed request has reached exactly one
+  terminal event.
+
+Chaos seams: the router's optional ``FaultPlan`` fires ``net.delay`` /
+``net.drop`` inside ``_call`` (injected router↔replica latency and loss)
+and the harness consults ``replica.kill`` to schedule ``kill_replica``.
+
+Like the supervisor, an unstarted router doubles as a deterministic
+synchronous harness: ``pump`` round-robins one step across live replicas
+and runs the health probe; ``run_sync`` drives to quiescence. ``start()``
+spawns every replica's worker plus a monitor thread running the probe.
+
+Events mirror the supervisor's shapes with router-global ids; the router
+is the single emitter of terminal events for routed requests (a stale
+replica epoch — e.g. a killed replica's last sweep — is dropped, so
+listeners can never see zero or two terminal events).
+"""
+from __future__ import annotations
+
+import functools
+import itertools
+import threading
+import time
+from dataclasses import dataclass, field
+from enum import Enum
+from typing import Any, Callable, Dict, List, Optional, Sequence, Set
+
+import numpy as np
+
+from .metrics import ServingMetrics
+from .scheduler import AdmissionRejected
+from .supervisor import (EngineSupervisor, EventListener, ShuttingDown,
+                         SupervisorState)
+
+
+class NetDrop(ConnectionError):
+    """Injected router↔replica call loss (fault site "net.drop")."""
+
+
+class BreakerState(Enum):
+    CLOSED = "closed"          # healthy: traffic flows
+    OPEN = "open"              # tripped: no traffic until cooldown
+    HALF_OPEN = "half_open"    # cooldown elapsed: one probe in flight
+
+
+class CircuitBreaker:
+    """Per-replica failure gate: CLOSED → OPEN after ``threshold``
+    consecutive failures, OPEN → HALF_OPEN after ``cooldown_s``, where a
+    single probe dispatch decides between re-CLOSE and re-OPEN."""
+
+    def __init__(self, threshold: int = 3, cooldown_s: float = 0.25):
+        if threshold < 1:
+            raise ValueError("breaker threshold must be >= 1")
+        self.threshold = int(threshold)
+        self.cooldown_s = float(cooldown_s)
+        self.state = BreakerState.CLOSED
+        self.failures = 0          # consecutive
+        self._opened_at: Optional[float] = None
+        self._probing = False
+
+    def allows(self) -> bool:
+        """May a dispatch go to this replica right now? (Advances
+        OPEN → HALF_OPEN when the cooldown has elapsed.)"""
+        if self.state is BreakerState.CLOSED:
+            return True
+        if self.state is BreakerState.OPEN:
+            if self._opened_at is not None and \
+                    time.monotonic() - self._opened_at >= self.cooldown_s:
+                self.state = BreakerState.HALF_OPEN
+                self._probing = False
+            else:
+                return False
+        return not self._probing   # HALF_OPEN: exactly one probe at a time
+
+    def on_dispatch(self) -> None:
+        if self.state is BreakerState.HALF_OPEN:
+            self._probing = True
+
+    def record_success(self) -> None:
+        self.state = BreakerState.CLOSED
+        self.failures = 0
+        self._probing = False
+        self._opened_at = None
+
+    def record_failure(self) -> None:
+        self.failures += 1
+        if self.state is BreakerState.HALF_OPEN or \
+                self.failures >= self.threshold:
+            self.trip()
+
+    def trip(self) -> None:
+        self.state = BreakerState.OPEN
+        self._opened_at = time.monotonic()
+        self._probing = False
+
+
+@dataclass
+class _Replica:
+    """One supervised replica plus the router's view of it."""
+    idx: int
+    sup: EngineSupervisor
+    breaker: CircuitBreaker
+    live: Set[int] = field(default_factory=set)   # router gids assigned here
+    killed: bool = False
+
+    @property
+    def available(self) -> bool:
+        return (not self.killed and not self.sup.finished
+                and self.breaker.allows())
+
+
+@dataclass
+class _Routed:
+    """Router-side record of one request: everything needed to re-dispatch
+    it mid-stream — the original prompt, every token already streamed to
+    the client, and the submit kwargs."""
+    gid: int
+    prompt: np.ndarray
+    max_new: int
+    kwargs: Dict[str, Any]
+    listener: Optional[EventListener]
+    t_submit: float
+    emitted: List[int] = field(default_factory=list)
+    replica: Optional[int] = None
+    local_rid: Optional[int] = None
+    epoch: int = 0            # bumped on every failover; stale-event guard
+    migrations: int = 0
+    ttft_s: Optional[float] = None
+    done: bool = False
+
+
+#: substrings identifying a terminal error as the REPLICA dying (migrate)
+#: rather than the request itself failing (pass through). Checked only
+#: after the engine-level poison marker "migration budget exhausted".
+_REPLICA_FAILURE_MARKERS = (
+    "replica killed",
+    "restart budget exhausted",
+    "supervisor loop crashed",
+    "engine restarted",
+    "KV pages lost",
+)
+
+
+class Router:
+    """Failover front end over N supervised engine replicas (module doc).
+
+    Duck-types the supervisor surface ``server.ServingServer`` and
+    ``cli/serve`` consume — ``submit`` / ``cancel`` / ``stats`` /
+    ``request_drain`` / ``start`` / ``join`` / ``state`` / ``draining`` /
+    ``finished`` / ``exit_code`` / ``restarts`` / ``event_sink`` — so one
+    ``--replicas N`` flag swaps it in above the existing front ends.
+    """
+
+    def __init__(self, supervisors: Sequence[EngineSupervisor], *,
+                 faults=None, max_retries: int = 3,
+                 retry_backoff_s: float = 0.02,
+                 retry_backoff_max_s: float = 0.5,
+                 retry_jitter_s: float = 0.01,
+                 migration_budget: int = 3,
+                 breaker_threshold: int = 3,
+                 breaker_cooldown_s: float = 0.25,
+                 probe_interval_s: float = 0.05,
+                 event_sink: Optional[EventListener] = None,
+                 seed: int = 0):
+        if not supervisors:
+            raise ValueError("router needs at least one replica")
+        if max_retries < 0:
+            raise ValueError("max_retries must be >= 0")
+        if migration_budget < 0:
+            raise ValueError("migration_budget must be >= 0")
+        self._handles = [
+            _Replica(idx=i, sup=s,
+                     breaker=CircuitBreaker(breaker_threshold,
+                                            breaker_cooldown_s))
+            for i, s in enumerate(supervisors)]
+        self.faults = faults
+        self.max_retries = int(max_retries)
+        self.retry_backoff_s = float(retry_backoff_s)
+        self.retry_backoff_max_s = float(retry_backoff_max_s)
+        self.retry_jitter_s = float(retry_jitter_s)
+        self.migration_budget = int(migration_budget)
+        self.probe_interval_s = float(probe_interval_s)
+        self.event_sink = event_sink
+        self.metrics = ServingMetrics(None)
+        self.drain_duration_s: Optional[float] = None
+        self.exit_code: Optional[int] = None
+        self._rng = np.random.default_rng(seed)
+        self._gid = itertools.count()
+        self._open: Dict[int, _Routed] = {}
+        self._submitted = 0
+        self._state = SupervisorState.NEW
+        self._lock = threading.RLock()
+        self._thread: Optional[threading.Thread] = None
+        self._drain_started: Optional[float] = None
+        self._wake = threading.Event()
+
+    # -- lifecycle surface (supervisor-compatible) -----------------------------
+
+    @property
+    def state(self) -> SupervisorState:
+        return self._state
+
+    @property
+    def draining(self) -> bool:
+        return self._state is SupervisorState.DRAINING
+
+    @property
+    def finished(self) -> bool:
+        return self._state in (SupervisorState.STOPPED,
+                               SupervisorState.FAILED)
+
+    @property
+    def restarts(self) -> int:
+        """Total engine restarts across replicas (``replica_restarts``)."""
+        return sum(h.sup.restarts for h in self._handles)
+
+    @property
+    def replicas(self) -> List[_Replica]:
+        return list(self._handles)
+
+    def start(self) -> "Router":
+        """Start every replica's worker thread plus the router's health
+        monitor (runs the probe every ``probe_interval_s``)."""
+        if self._thread is not None:
+            raise RuntimeError("router already started")
+        if self._state is SupervisorState.NEW:
+            self._state = SupervisorState.RUNNING
+        for h in self._handles:
+            h.sup.start()
+        self._thread = threading.Thread(
+            target=self._monitor, name="replica-router", daemon=True)
+        self._thread.start()
+        return self
+
+    def join(self, timeout: Optional[float] = None) -> bool:
+        """Wait for the monitor thread AND every replica worker to exit.
+
+        Joining only the monitor is not enough: replica workers are daemon
+        threads, and an interpreter that finalizes while one is still inside
+        its last jitted call aborts in native XLA teardown. Callers that need
+        a clean process exit (the CLI) must see True here first.
+        """
+        t = self._thread
+        if t is None:
+            return self.finished
+        deadline = None if timeout is None else time.monotonic() + timeout
+        t.join(timeout)
+        done = not t.is_alive()
+        for h in self._handles:
+            left = None if deadline is None \
+                else max(0.0, deadline - time.monotonic())
+            done = h.sup.join(left) and done
+        return done
+
+    def request_drain(self, reason: str = "drain requested") -> None:
+        """Close router admissions and cascade the drain to every replica;
+        the monitor/probe parks the router STOPPED once all replicas finish
+        and every routed request has its terminal event."""
+        with self._lock:
+            if self._state in (SupervisorState.DRAINING,
+                               SupervisorState.STOPPED,
+                               SupervisorState.FAILED):
+                return
+            self._state = SupervisorState.DRAINING
+            self._drain_started = time.perf_counter()
+        for h in self._handles:
+            if not h.killed:
+                try:
+                    h.sup.request_drain(reason)
+                except Exception:  # noqa: BLE001 — a dead replica can't veto
+                    pass
+        self._wake.set()
+
+    # -- synchronous drivers (tests / single-threaded harnesses) --------------
+
+    def pump(self, rounds: int = 1) -> None:
+        """Deterministic inline drive: one engine step round-robined across
+        live replicas, then the health probe. Incompatible with start()."""
+        if self._thread is not None:
+            raise RuntimeError("pump is for unstarted routers")
+        if self._state is SupervisorState.NEW:
+            self._state = SupervisorState.RUNNING
+        for _ in range(rounds):
+            for h in list(self._handles):
+                if h.killed or h.sup.finished:
+                    continue
+                h.sup.pump(1)
+            self._probe()
+
+    def run_sync(self, max_rounds: int = 100_000) -> None:
+        """Drive inline until every routed request is terminal (and, when
+        draining, until every replica has finished draining)."""
+        for _ in range(max_rounds):
+            self.pump(1)
+            if self.finished:
+                return
+            with self._lock:
+                idle = not self._open
+            if idle and not self.draining:
+                return
+        raise RuntimeError(f"run_sync exceeded {max_rounds} rounds")
+
+    # -- request surface -------------------------------------------------------
+
+    def submit(self, prompt_ids, max_new_tokens: int, *,
+               listener: Optional[EventListener] = None, **kwargs) -> int:
+        """Place a request on the shortest-queue healthy replica; returns a
+        router-global id. Raises ``ShuttingDown`` once draining and, when
+        no replica can admit after the bounded retries, the last
+        ``AdmissionRejected``/``ShuttingDown`` — the server maps both to
+        structured 503s exactly as for a single supervisor."""
+        if self._state in (SupervisorState.DRAINING, SupervisorState.STOPPED,
+                           SupervisorState.FAILED):
+            raise ShuttingDown(self._state.value)
+        if self._state is SupervisorState.NEW and self._thread is None:
+            self._state = SupervisorState.RUNNING
+        prompt = np.asarray(prompt_ids, np.int32).reshape(-1)
+        rec = _Routed(gid=next(self._gid), prompt=prompt,
+                      max_new=int(max_new_tokens), kwargs=dict(kwargs),
+                      listener=listener, t_submit=time.perf_counter())
+        with self._lock:
+            self._open[rec.gid] = rec
+            self._submitted += 1
+        try:
+            self._dispatch(rec, raising=True)
+        except BaseException:
+            with self._lock:
+                self._close(rec, None)
+            raise
+        return rec.gid
+
+    def cancel(self, gid: int, reason: str = "cancelled by client") -> bool:
+        """Cancel a routed request wherever it currently lives; mid-failover
+        (unassigned) requests are terminalized at the router."""
+        with self._lock:
+            rec = self._open.get(gid)
+            if rec is None or rec.done:
+                return False
+            h = (self._handles[rec.replica]
+                 if rec.replica is not None else None)
+            lrid = rec.local_rid
+        if h is not None and not h.killed and not h.sup.finished and \
+                lrid is not None:
+            try:
+                return bool(self._call(
+                    h, functools.partial(h.sup.cancel, lrid, reason)))
+            except Exception:  # noqa: BLE001 — dead replica: fall through
+                pass           # to router-side cancellation
+        with self._lock:
+            if rec.done:
+                return False
+            self._close(rec, h)
+        self._emit(rec, {"event": "cancelled", "id": gid, "reason": reason})
+        return True
+
+    def stats(self) -> Dict[str, Any]:
+        """Router-level stats plus per-replica health and aggregated engine
+        counters — the dict ``GET /v1/stats`` serves in router mode."""
+        with self._lock:
+            per_replica = [{
+                "replica": h.idx,
+                "state": h.sup.state.value,
+                "breaker_state": h.breaker.state.value,
+                "restarts": h.sup.restarts,
+                "live_requests": len(h.live),
+                "killed": h.killed,
+            } for h in self._handles]
+            s: Dict[str, Any] = {
+                "supervisor_state": self._state.value,
+                "router_replicas": len(self._handles),
+                "router_open_requests": len(self._open),
+                "router_submitted": self._submitted,
+                "router_retries": self.metrics.router_retries,
+                "migrated_requests": self.metrics.migrated_requests,
+                "migration_resume_tokens":
+                    self.metrics.migration_resume_tokens,
+                "replica_restarts": sum(h.sup.restarts
+                                        for h in self._handles),
+                "replicas": per_replica,
+            }
+        # engine-level aggregation, marshalled per live replica (outside the
+        # router lock — sup.stats() may block behind a step)
+        agg_keys = ("requests_finished", "failed", "cancelled", "timed_out",
+                    "decode_tokens", "migrated_requests",
+                    "migration_resume_tokens", "preemptions")
+        for k in agg_keys:
+            s.setdefault(k, 0)
+        for h in list(self._handles):
+            if h.sup.finished and not h.sup.join(0):
+                continue  # worker mid-exit: don't race the closing cmd queue
+            try:
+                rs = h.sup.stats()
+            except Exception:  # noqa: BLE001 — a dying replica yields no stats
+                continue
+            for k in agg_keys:
+                s[k] = s.get(k, 0) + rs.get(k, 0)
+        return s
+
+    def health_gauges(self) -> Dict[str, Any]:
+        """Scalar health gauges for ``GET /v1/health`` — router-side
+        bookkeeping only, never touching a replica's engine."""
+        with self._lock:
+            healthy = sum(1 for h in self._handles if h.available)
+            return {
+                "queue_depth": 0,   # the router places immediately
+                "num_running": len(self._open),
+                "replicas_total": len(self._handles),
+                "replicas_healthy": healthy,
+            }
+
+    def kill_replica(self, idx: int,
+                     reason: str = "replica killed") -> None:
+        """Chaos actuator for the ``replica.kill`` fault site: hard-kill
+        one replica as if its process died mid-step. Its live requests fail
+        over to healthy replicas, streams resuming token-exact."""
+        h = self._handles[idx]
+        if h.killed:
+            return
+        h.killed = True
+        h.breaker.trip()
+        try:
+            # the supervisor fails everything NOW; the resulting
+            # "replica killed" error events drive the listeners' migration
+            h.sup.kill(reason)
+        except Exception:  # noqa: BLE001 — it was dying anyway
+            pass
+        self._probe()
+
+    # -- internals -------------------------------------------------------------
+
+    def _call(self, h: _Replica, fn: Callable[[], Any]) -> Any:
+        """Process-shaped seam for every router→replica data-plane call;
+        the chaos plan's ``net.delay`` / ``net.drop`` sites fire here."""
+        if self.faults is not None:
+            if self.faults.net_delay():
+                time.sleep(self.faults.net_delay_s)
+            if self.faults.net_drop():
+                raise NetDrop(
+                    f"injected net drop on call to replica {h.idx}")
+        return fn()
+
+    def _pick(self) -> Optional[_Replica]:
+        """Join-shortest-queue over available replicas (router-assigned
+        live-request counts, so no cross-thread engine reads)."""
+        with self._lock:
+            best: Optional[_Replica] = None
+            for h in self._handles:
+                if not h.available:
+                    continue
+                if best is None or len(h.live) < len(best.live):
+                    best = h
+            if best is not None:
+                best.breaker.on_dispatch()
+            return best
+
+    def _deadline_left(self, rec: _Routed) -> Optional[float]:
+        dl = rec.kwargs.get("deadline_s")
+        if dl is None:
+            return None
+        return float(dl) - (time.perf_counter() - rec.t_submit)
+
+    def _resume_args(self, rec: _Routed):
+        """(prompt, max_new, kwargs) for (re-)dispatch: the committed
+        prefix becomes an extended prompt and the generation budget shrinks
+        by what was already streamed — the new replica's prefill samples
+        the successor of the last emitted token (token-exact for greedy)."""
+        prompt = (np.concatenate(
+            [rec.prompt, np.asarray(rec.emitted, np.int32)])
+            if rec.emitted else rec.prompt)
+        kwargs = dict(rec.kwargs)
+        left = self._deadline_left(rec)
+        if left is not None:
+            kwargs["deadline_s"] = max(left, 1e-3)
+        return prompt, rec.max_new - len(rec.emitted), kwargs
+
+    def _dispatch(self, rec: _Routed, *, raising: bool = False) -> None:
+        """Bounded placement: up to ``max_retries`` re-attempts with
+        exponential backoff + seeded jitter, each respecting the request
+        deadline. With ``raising`` (the synchronous submit path) a final
+        admission failure propagates to the caller; otherwise (migration)
+        it becomes a terminal error event."""
+        last: Optional[BaseException] = None
+        attempt = 0
+        while attempt <= self.max_retries:   # explicit retry budget
+            if attempt:
+                self.metrics.observe_router_retry()
+                delay = min(self.retry_backoff_s * (2 ** (attempt - 1)),
+                            self.retry_backoff_max_s)
+                delay += float(self._rng.random()) * self.retry_jitter_s
+                left = self._deadline_left(rec)
+                if left is not None and delay >= left:
+                    self._finish_failed(
+                        rec, "timeout",
+                        f"deadline exceeded during failover retries "
+                        f"(attempt {attempt}/{self.max_retries})")
+                    return
+                if delay > 0:
+                    time.sleep(delay)
+            attempt += 1
+            h = self._pick()
+            if h is None:
+                last = ShuttingDown("no healthy replica "
+                                    "(all dead or breakers open)")
+                continue
+            epoch = rec.epoch
+            listener = self._listener_for(rec, epoch, h)
+            prompt, max_new, kwargs = self._resume_args(rec)
+            try:
+                lrid = self._call(h, functools.partial(
+                    h.sup.submit, prompt, max_new,
+                    listener=listener, **kwargs))
+            except AdmissionRejected as e:
+                # backpressure, not failure: the replica is healthy, just
+                # full — retry elsewhere without charging its breaker
+                last = e
+                continue
+            except (NetDrop, ShuttingDown) as e:
+                h.breaker.record_failure()
+                last = e
+                continue
+            except (ValueError, TypeError) as e:
+                # a malformed request is the REQUEST's fault, not the
+                # replica's: no breaker hit, no retry
+                if raising:
+                    raise
+                self._finish_failed(rec, "error", str(e))
+                return
+            with self._lock:
+                rec.replica = h.idx
+                rec.local_rid = lrid
+                h.live.add(rec.gid)
+                h.breaker.record_success()
+            return
+        if raising and last is not None:
+            raise last
+        self._finish_failed(
+            rec, "error",
+            f"router retries exhausted ({self.max_retries}) — "
+            f"last failure: {last}")
+
+    # -- event plumbing --------------------------------------------------------
+
+    def _listener_for(self, rec: _Routed, epoch: int,
+                      h: _Replica) -> EventListener:
+        def listener(ev: dict) -> None:
+            self._on_event(rec, epoch, h, ev)
+        return listener
+
+    def _on_event(self, rec: _Routed, epoch: int, h: _Replica,
+                  ev: dict) -> None:
+        kind = ev.get("event")
+        migrate_reason: Optional[str] = None
+        out: Optional[dict] = None
+        with self._lock:
+            if rec.done or rec.epoch != epoch:
+                return  # stale epoch: a failed-over replica still talking
+            if kind == "token":
+                rec.emitted.append(int(ev["token"]))
+                if rec.ttft_s is None:
+                    rec.ttft_s = time.perf_counter() - rec.t_submit
+                out = {"event": "token", "id": rec.gid,
+                       "token": int(ev["token"])}
+            elif kind == "done":
+                self._close(rec, h)
+                h.breaker.record_success()
+                out = {"event": "done", "id": rec.gid,
+                       "tokens": list(rec.emitted),
+                       "finish_reason": ev.get("finish_reason", ""),
+                       "ttft_ms": round((rec.ttft_s or 0.0) * 1e3, 3)}
+            elif kind == "error" and \
+                    self._replica_level(ev.get("reason", "")):
+                migrate_reason = ev.get("reason", "replica failure")
+            else:  # request-level error / cancelled / timeout: pass through
+                self._close(rec, h)
+                out = {"event": kind, "id": rec.gid,
+                       "reason": ev.get("reason", "")}
+        if migrate_reason is not None:
+            self._migrate(rec, epoch, h, migrate_reason)
+            return
+        if out is not None:
+            self._emit(rec, out)
+
+    @staticmethod
+    def _replica_level(reason: str) -> bool:
+        """Is this terminal error the replica dying (migrate) rather than
+        the request failing (pass through)? The engine-level poison marker
+        wins: a request that exhausted its ENGINE migration budget must
+        fail cleanly, not bounce to the next replica."""
+        if "migration budget exhausted" in reason:
+            return False
+        return any(m in reason for m in _REPLICA_FAILURE_MARKERS)
+
+    def _migrate(self, rec: _Routed, epoch: int, h: _Replica,
+                 reason: str) -> None:
+        """Fail one routed request over to another replica, mid-stream."""
+        with self._lock:
+            if rec.done or rec.epoch != epoch:
+                return
+            h.breaker.record_failure()
+            h.live.discard(rec.gid)
+            rec.epoch += 1
+            rec.replica = None
+            rec.local_rid = None
+            if rec.migrations >= self.migration_budget:
+                self._close(rec, None)
+                out = {"event": "error", "id": rec.gid,
+                       "reason": f"router migration budget exhausted "
+                                 f"({self.migration_budget}) — "
+                                 f"last failure: {reason}"}
+            else:
+                rec.migrations += 1
+                out = None
+            remaining = rec.max_new - len(rec.emitted)
+        if out is not None:
+            self._emit(rec, out)
+            return
+        if remaining <= 0:
+            # everything was streamed before the replica died; only the
+            # terminal event was lost — synthesize it
+            with self._lock:
+                if rec.done:
+                    return
+                self._close(rec, None)
+            self._emit(rec, {"event": "done", "id": rec.gid,
+                             "tokens": list(rec.emitted),
+                             "finish_reason": "length",
+                             "ttft_ms": round((rec.ttft_s or 0.0) * 1e3, 3)})
+            return
+        self.metrics.observe_migration(len(rec.prompt) + len(rec.emitted))
+        self._dispatch(rec)   # failure here emits the terminal error event
+
+    def _finish_failed(self, rec: _Routed, kind: str, reason: str) -> None:
+        with self._lock:
+            if rec.done:
+                return
+            self._close(rec, None)
+        self._emit(rec, {"event": kind, "id": rec.gid, "reason": reason})
+
+    def _close(self, rec: _Routed, h: Optional[_Replica]) -> None:
+        """Caller holds the lock."""
+        rec.done = True
+        self._open.pop(rec.gid, None)
+        if h is not None:
+            h.live.discard(rec.gid)
+        elif rec.replica is not None:
+            self._handles[rec.replica].live.discard(rec.gid)
+
+    def _emit(self, rec: _Routed, ev: dict) -> None:
+        for sink in (rec.listener, self.event_sink):
+            if sink is None:
+                continue
+            try:
+                sink(ev)
+            except Exception:  # noqa: BLE001 — a bad listener can't kill us
+                pass
+
+    # -- health probe / lifecycle convergence ----------------------------------
+
+    def _probe(self) -> None:
+        """Health probe: migrate requests stranded on dead replicas (belt
+        and braces over the event path), then converge the router's
+        lifecycle state."""
+        with self._lock:
+            stranded = [
+                (r, r.epoch, self._handles[r.replica])
+                for r in list(self._open.values())
+                if not r.done and r.replica is not None
+                and (self._handles[r.replica].killed
+                     or self._handles[r.replica].sup.finished)]
+        for r, epoch, h in stranded:
+            self._migrate(r, epoch, h,
+                          f"replica {h.idx} dead ({h.sup.state.value})")
+        with self._lock:
+            all_dead = all(h.killed or h.sup.finished
+                           for h in self._handles)
+            leftovers = ([r for r in self._open.values() if not r.done]
+                         if all_dead else [])
+        for r in leftovers:
+            self._finish_failed(r, "error",
+                                "no healthy replica left to serve request")
+        with self._lock:
+            if self.finished:
+                return
+            all_dead = all(h.killed or h.sup.finished
+                           for h in self._handles)
+            if not all_dead or self._open:
+                return
+            if self._state is SupervisorState.DRAINING:
+                started = self._drain_started
+                self.drain_duration_s = (
+                    time.perf_counter() - started
+                    if started is not None else 0.0)
+                self._state = SupervisorState.STOPPED
+                self.exit_code = 0
+            elif self._state is SupervisorState.RUNNING:
+                # every replica died out from under a running router
+                self._state = SupervisorState.FAILED
+                self.exit_code = 1
+
+    def _monitor(self) -> None:
+        while not self.finished:
+            self._probe()
+            self._wake.wait(self.probe_interval_s)
+            self._wake.clear()

@@ -86,6 +86,12 @@ class Request:
     next_token: Optional[int] = None    # sampled but not yet fed back
     out_tokens: List[int] = field(default_factory=list)
     preemptions: int = 0
+    migrations: int = 0                 # crash/failover re-admissions
+    migration_budget: Optional[int] = None  # max migrations before the
+    #                                     request is FAILED as poison — a
+    #                                     request that keeps crashing its
+    #                                     engine must not wedge the restart
+    #                                     loop (None = engine default)
     ttft_s: Optional[float] = None
     finish_reason: str = ""
     error: str = ""                     # detail for FAILED/CANCELLED/TIMED_OUT
@@ -326,4 +332,17 @@ class Scheduler:
         req.state = RequestState.QUEUED
         req.queued_time = time.perf_counter()
         req.preemptions += 1
+        self.waiting.appendleft(req)
+
+    def migrate(self, req: Request) -> None:
+        """Crash-migration re-admission: identical motion to ``requeue`` but
+        charged to the per-request migration budget, not ``preemptions`` —
+        the request lost its KV to an engine restart (or replica failover),
+        not to pool pressure. The engine frees the blocks first, exactly as
+        for preemption; ``resume_tokens`` + the pending ``next_token`` make
+        the resumed stream token-exact under greedy decoding."""
+        self.running.remove(req)
+        req.state = RequestState.QUEUED
+        req.queued_time = time.perf_counter()
+        req.migrations += 1
         self.waiting.appendleft(req)

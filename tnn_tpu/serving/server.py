@@ -198,11 +198,18 @@ class ServingServer:
             "draining": st is SupervisorState.DRAINING,
             "uptime_s": time.perf_counter() - self._t0,
             "engine_restarts": self.sup.restarts,
-            # tnnlint: disable=cross-thread-engine-access -- GIL-atomic scalar reads; health must answer while the worker is mid-step
-            "queue_depth": self.sup.engine.scheduler.queue_depth,
-            # tnnlint: disable=cross-thread-engine-access -- GIL-atomic scalar read, same rationale as queue_depth above
-            "num_running": len(self.sup.engine.scheduler.running),
         }
+        gauges = getattr(self.sup, "health_gauges", None)
+        if gauges is not None:
+            # router front: scalar router-side gauges, no engine access
+            body.update(gauges())
+        else:
+            body.update({
+                # tnnlint: disable=cross-thread-engine-access -- GIL-atomic scalar reads; health must answer while the worker is mid-step
+                "queue_depth": self.sup.engine.scheduler.queue_depth,
+                # tnnlint: disable=cross-thread-engine-access -- GIL-atomic scalar read, same rationale as queue_depth above
+                "num_running": len(self.sup.engine.scheduler.running),
+            })
         await self._respond_json(writer, 200 if serving else 503, body)
 
     async def _stats(self, writer: asyncio.StreamWriter) -> None:

@@ -5,14 +5,18 @@ serving); this module makes the *loop around it* survivable. The
 supervisor owns the engine on a single worker thread and layers four
 guarantees on top:
 
-- **Crash recovery.** An exception escaping ``engine.step()`` (the one
-  class of failure the engine cannot isolate — modelled by
-  ``faults.EngineCrash``) fails the in-flight requests with a structured
-  error, resets the pool pages and prefix index via the engine's existing
-  ``abort_all`` recovery, and keeps serving. QUEUED requests hold no KV
-  state, so they survive the restart untouched and simply re-prefill —
-  that *is* the re-admission path. Restarts are budgeted
-  (``max_restarts``) with exponential backoff; exhausting the budget
+- **Crash recovery with request migration.** An exception escaping
+  ``engine.step()`` (the one class of failure the engine cannot isolate —
+  modelled by ``faults.EngineCrash``) resets the pool pages and prefix
+  index, then re-admits the in-flight requests through the scheduler's
+  preemption-resume path (``engine.migrate_running``): committed tokens
+  become an extended prompt and each stream continues from its last
+  emitted token, token-exact under greedy decoding. A request whose
+  per-request ``migration_budget`` is exhausted is FAILED with a
+  structured reason instead — poison isolation. QUEUED requests hold no
+  KV state and simply re-prefill. Restarts are budgeted
+  (``max_restarts``) with exponential backoff (interruptible: a drain or
+  command arriving mid-backoff wakes the loop); exhausting the budget
   fails everything and parks the supervisor in ``FAILED``.
 - **Step-latency watchdog.** A synchronous step cannot be preempted, so
   the watchdog measures each step after the fact: a step exceeding
@@ -136,6 +140,7 @@ class EngineSupervisor:
         self._state_lock = threading.Lock()
         self._cmds: "queue.Queue" = queue.Queue()
         self._cmds_closed = False
+        self._wake = threading.Event()  # interrupts the restart backoff
         self._thread: Optional[threading.Thread] = None
         self._listeners: Dict[int, EventListener] = {}
         self._open: Dict[int, Request] = {}
@@ -215,6 +220,16 @@ class EngineSupervisor:
             self._drain_reason = reason
             self._drain_started = time.perf_counter()
         self._cmds.put(None)  # wake an idle worker
+        self._wake.set()      # ...and one sleeping in restart backoff
+
+    def kill(self, reason: str = "replica killed") -> None:
+        """Hard-kill — the in-process analogue of the replica's process
+        dying mid-step: every request FAILs NOW with ``reason``, the
+        supervisor parks in FAILED (exit_code 1), and the worker exits.
+        Unlike ``request_drain``, in-flight work does not get to finish. A
+        router above treats this replica as dead and fails its requests
+        over. Safe from any thread; idempotent once finished."""
+        self._execute(lambda: self._do_kill(reason))
 
     # -- synchronous drivers (tests / single-threaded harnesses) --------------
 
@@ -263,6 +278,7 @@ class EngineSupervisor:
             if not closed:
                 fut: Future = Future()
                 self._cmds.put((fn, fut))
+                self._wake.set()  # command arrival interrupts a backoff
         if closed:
             # the worker has exited; no concurrency left, run inline (a
             # submit will see STOPPED/FAILED and raise ShuttingDown)
@@ -334,6 +350,15 @@ class EngineSupervisor:
         s["supervisor_state"] = self._state.value
         return s
 
+    @worker_only
+    def _do_kill(self, reason: str) -> None:
+        if self.finished:
+            return
+        self.engine.abort_all(reason, include_queued=True, reset_pages=True)
+        self._sweep_terminals()
+        self._set_state(SupervisorState.FAILED)
+        self.exit_code = 1
+
     def _emit(self, rid: int, ev: dict) -> None:
         listener = self._listeners.get(rid)
         for sink in (listener, self.event_sink):
@@ -376,6 +401,7 @@ class EngineSupervisor:
     @worker_only
     def _restart(self, reason: str) -> None:
         self.restarts += 1
+        self._wake.clear()
         self.engine.metrics.observe_restart()
         if self.restarts > self.max_restarts:
             self.engine.abort_all(
@@ -386,15 +412,19 @@ class EngineSupervisor:
             self._set_state(SupervisorState.FAILED)
             self.exit_code = 1
             return
-        # in-flight requests lost their KV; queued ones survive and simply
-        # re-prefill once the loop resumes — that IS the re-admission path
-        self.engine.abort_all(f"engine restarted: {reason}",
-                              include_queued=False, reset_pages=True)
+        # in-flight requests lost their KV but NOT their progress: they
+        # re-admit through the scheduler's resume path (committed tokens
+        # become an extended prompt, streams continue token-exact), unless
+        # their migration_budget is exhausted — then they FAIL as poison.
+        # Queued requests hold no KV and simply re-prefill.
+        self.engine.migrate_running(f"engine restarted: {reason}")
         self._sweep_terminals()
         backoff = min(self.restart_backoff_s * (2 ** (self.restarts - 1)),
                       self.restart_backoff_max_s)
-        if backoff > 0:
-            time.sleep(backoff)
+        if backoff > 0 and self._cmds.empty():
+            # interruptible: request_drain / command arrival sets _wake, so
+            # a drain never waits out the exponential backoff
+            self._wake.wait(backoff)
 
     @worker_only
     def _finish_drain(self) -> None:

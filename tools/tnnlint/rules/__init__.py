@@ -4,4 +4,5 @@ from . import donation       # noqa: F401
 from . import host_sync      # noqa: F401
 from . import pool           # noqa: F401
 from . import prng           # noqa: F401
+from . import retry          # noqa: F401
 from . import thread_owner   # noqa: F401

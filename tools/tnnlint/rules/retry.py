@@ -1,0 +1,126 @@
+"""unbounded-retry — retry loops around engine/replica calls carry a budget.
+
+The serving stack retries at several layers: the engine retries a
+transient decode fault, the supervisor restarts a crashed engine loop, the
+router re-dispatches a request to another replica. Every one of those
+loops is bounded — ``max_restarts``, ``migration_budget``,
+``max_retries`` — because an unbounded retry around a failing replica is
+an outage generator: it wedges the caller, hammers the dying backend, and
+hides the failure from the operator.
+
+This rule enforces the shape. A ``while`` loop is a *retry loop around an
+engine/replica call* when its body (not counting nested loops or function
+definitions) contains a ``try`` whose handler ``continue``s the loop and
+whose guarded body references an engine/replica-ish target (default
+substrings: ``submit``, ``engine``, ``replica``, ``.sup.``, ``dispatch``).
+Such a loop must carry its budget *reachable in the loop condition* — a
+name matching the budget pattern (default
+``max_|budget|retr|attempt|tries``) appearing in the ``while`` test:
+
+    while attempt <= self.max_retries:   # OK: budget in the condition
+        try:
+            return self._call(h, lambda: h.sup.submit(...))
+        except ConnectionError:
+            attempt += 1
+            continue
+
+    while True:                          # flagged: nothing bounds this
+        try:
+            return self._call(h, lambda: h.sup.submit(...))
+        except ConnectionError:
+            continue
+
+``for`` loops are inherently bounded by their iterable (the engine's
+one-shot decode retry is ``for attempt in (0, 1)``) and are never flagged.
+Deadline-bounded poll loops that never touch an engine/replica target
+(queue drains, barrier waits) are out of scope by the target filter.
+"""
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterable, List
+
+from ..core import ModuleContext, Rule, Violation, dotted_name, register
+
+_DEF_TARGETS = ["submit", "engine", "replica", ".sup.", "dispatch"]
+_DEF_BUDGET_PATTERN = r"max_|budget|retr|attempt|tries"
+
+
+def _own_nodes(body: Iterable[ast.AST]):
+    """Walk statements belonging to ONE loop level: nested loops and
+    function definitions keep their own ``continue``/``try`` semantics."""
+    stack = list(body)
+    while stack:
+        n = stack.pop()
+        yield n
+        if not isinstance(n, (ast.While, ast.For, ast.AsyncFor,
+                              ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.Lambda)):
+            stack.extend(ast.iter_child_nodes(n))
+
+
+def _mentions_target(nodes: Iterable[ast.AST], targets: List[str]) -> bool:
+    for n in nodes:
+        name = None
+        if isinstance(n, ast.Attribute):
+            name = dotted_name(n)
+        elif isinstance(n, ast.Name):
+            name = n.id
+        if name and any(t in f".{name}." for t in targets):
+            return True
+    return False
+
+
+@register
+class UnboundedRetry(Rule):
+    name = "unbounded-retry"
+    description = ("a retry loop around engine/replica calls must carry its "
+                   "budget in the loop condition")
+
+    def check_module(self, ctx: ModuleContext) -> List[Violation]:
+        opts = ctx.rule_options(self.name)
+        targets = list(opts.get("targets", _DEF_TARGETS))
+        budget = re.compile(opts.get("budget_pattern", _DEF_BUDGET_PATTERN),
+                            re.IGNORECASE)
+        out: List[Violation] = []
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.While):
+                continue
+            if not self._is_retry_around_target(node, targets):
+                continue
+            if self._condition_bounded(node.test, budget):
+                continue
+            out.append(self.violation(
+                ctx, node,
+                "retry loop around an engine/replica call has no budget in "
+                "its condition — bound it (e.g. 'while attempt <= "
+                "self.max_retries:') so a dead backend cannot wedge the "
+                "caller"))
+        return out
+
+    @staticmethod
+    def _is_retry_around_target(loop: ast.While,
+                                targets: List[str]) -> bool:
+        for t in _own_nodes(loop.body):
+            if not isinstance(t, ast.Try):
+                continue
+            retries = any(
+                isinstance(x, ast.Continue)
+                for h in t.handlers for x in _own_nodes(h.body))
+            if retries and _mentions_target(
+                    (n for s in t.body for n in ast.walk(s)), targets):
+                return True
+        return False
+
+    @staticmethod
+    def _condition_bounded(test: ast.AST, budget: re.Pattern) -> bool:
+        for n in ast.walk(test):
+            name = None
+            if isinstance(n, ast.Attribute):
+                name = dotted_name(n)
+            elif isinstance(n, ast.Name):
+                name = n.id
+            if name and budget.search(name):
+                return True
+        return False
