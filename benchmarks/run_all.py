@@ -41,6 +41,9 @@ def main(argv=None):
         # performance regressions
         results.extend(serve_bench.main(["--chaos"]))
         results.extend(serve_bench.main(["--avail"]))
+        # observability gate: traced replicas must keep producing the
+        # merged trace / flight-recorder / Prometheus artifacts
+        results.extend(serve_bench.main(["--trace"]))
     results = [r for r in results if r]
 
     print("\n== results ==")

@@ -663,6 +663,113 @@ def bench_availability(model, params, *, replicas: int, num_requests: int,
                "terminal": int(sum(terminals.values()))})
 
 
+def bench_trace(model, params, *, num_requests: int = 6, prompt_len: int = 6,
+                max_new: int = 8, replicas: int = 2, num_blocks: int = 16,
+                block_size: int = 4, max_batch_size: int = 4,
+                out_dir: str = "benchmarks/results",
+                label: str = "serve_trace", seed: int = 0):
+    """Observability gate shaped like a bench row: drive a traced 2-replica
+    Router inline, drain, and persist the artifacts under ``out_dir`` —
+    one merged Chrome/Perfetto trace (router + every replica on its own
+    track), per-replica flight-recorder drain dumps, and a parsed
+    Prometheus exposition. The row self-asserts that every artifact
+    exists and parses, so a broken span/recorder/exposition pipeline
+    fails CI the same way a perf regression would."""
+    import json as json_lib
+    import os
+
+    from tnn_tpu.profiling.profiler import Profiler
+    from tnn_tpu.serving import (EngineSupervisor, InferenceEngine, Router,
+                                 render_prometheus)
+
+    print(f"{label}: {num_requests} requests across {replicas} traced "
+          f"replicas, artifacts under {out_dir}/")
+    os.makedirs(out_dir, exist_ok=True)
+    rng = np.random.default_rng(seed)
+    prompts = [rng.integers(0, model.vocab_size, prompt_len).astype(np.int32)
+               for _ in range(num_requests)]
+
+    profilers, sups = [], []
+    for i in range(replicas):
+        prof = Profiler(source=f"replica{i}")
+        profilers.append(prof)
+        eng = InferenceEngine(
+            model, params, num_blocks=num_blocks, block_size=block_size,
+            max_batch_size=max_batch_size, max_seq_len=prompt_len + max_new,
+            seed=seed, profiler=prof, trace=True)
+        sups.append(EngineSupervisor(
+            eng, drain_deadline_s=60.0,
+            flight_dir=os.path.join(out_dir, f"flight_r{i}")))
+    router_prof = Profiler(source="router")
+    router = Router(sups, seed=seed, profiler=router_prof)
+
+    terminals = {}
+
+    def mk_listener():
+        def listener(ev):
+            if ev["event"] != "token":
+                terminals[ev["id"]] = ev
+        return listener
+
+    t0 = time.perf_counter()
+    gids = [router.submit(p, max_new, listener=mk_listener())
+            for p in prompts]
+    router.run_sync(max_rounds=10_000)
+    router.request_drain("bench complete")
+    router.run_sync(max_rounds=10_000)
+    wall = time.perf_counter() - t0
+
+    assert len(terminals) == len(gids), \
+        f"only {len(terminals)}/{len(gids)} requests terminal"
+    assert all(ev["event"] == "done" for ev in terminals.values())
+    assert all("trace_id" in ev and "latency_breakdown" in ev
+               for ev in terminals.values()), \
+        "terminal events lack observability fields"
+
+    # artifact 1: merged Perfetto trace, one track per source
+    trace_path = os.path.join(out_dir, "serve_trace.trace.json")
+    for prof in profilers:
+        router_prof.merge(prof)
+    router_prof.to_chrome_trace(trace_path)
+    with open(trace_path) as f:
+        trace = json_lib.load(f)["traceEvents"]
+    span_events = [e for e in trace if e.get("ph") == "X"]
+    tracks = {e["args"]["name"] for e in trace if e.get("ph") == "M"}
+    assert span_events, "merged trace has no span events"
+    assert "router" in tracks and len(tracks) >= replicas + 1, \
+        f"expected router + {replicas} replica tracks, got {tracks}"
+
+    # artifact 2: per-replica flight-recorder drain dumps (JSONL)
+    flight_records = 0
+    for i, sup in enumerate(sups):
+        assert sup.flight_dumps, f"replica {i} dumped no flight recordings"
+        for path in sup.flight_dumps:
+            with open(path) as f:
+                lines = [json_lib.loads(ln) for ln in f if ln.strip()]
+            assert lines[0]["kind"] == "flight_recorder_meta"
+            flight_records += len(lines) - 1
+
+    # artifact 3: Prometheus exposition with per-replica labels
+    prom_path = os.path.join(out_dir, "serve_trace.metrics.prom")
+    text = render_prometheus(router.prometheus_series())
+    with open(prom_path, "w") as f:
+        f.write(text)
+    assert 'replica="router"' in text and 'replica="0"' in text, \
+        "exposition lacks per-replica labels"
+
+    return report(
+        label, wall, items=num_requests, item_name="req",
+        extra={"requests": num_requests,
+               "replicas": replicas,
+               "trace_events": len(span_events),
+               "trace_tracks": len(tracks),
+               "flight_dumps": sum(len(s.flight_dumps) for s in sups),
+               "flight_records": flight_records,
+               "prometheus_lines": len(text.splitlines()),
+               "trace_path": trace_path,
+               "metrics_path": prom_path})
+
+
 def _smoke_model():
     """Tiny random GPT-2 (2L/32d/2h): engine mechanics without model weight."""
     from tnn_tpu.models.gpt2 import GPT2
@@ -688,12 +795,22 @@ def main(argv=None):
                          "vs one-replica-killed-mid-run A/B, asserting the "
                          "token-exact failover contract and reporting "
                          "goodput-at-SLO + p99 TTFT for both rows")
+    ap.add_argument("--trace", action="store_true",
+                    help="tiny model through a traced 2-replica Router: "
+                         "persists the merged Perfetto trace, per-replica "
+                         "flight-recorder dumps, and a Prometheus scrape "
+                         "under benchmarks/results/, self-asserting that "
+                         "each artifact exists and parses")
     ap.add_argument("--model", default="gpt2_small")
     ap.add_argument("--rate", type=float, default=4.0,
                     help="mean request arrivals per second")
     args = ap.parse_args(argv)
 
     rr = RowRunner()
+    if args.trace:
+        model, params = _smoke_model()
+        rr.add(lambda: bench_trace(model, params), label="bench_trace")
+        return rr.results
     if args.chaos:
         model, params = _smoke_model()
         rr.add(lambda: bench_chaos(model, params, num_requests=8, max_new=8,

@@ -10,6 +10,19 @@ TNN_TEST_PLATFORM overrides for running the suite on hardware.
 """
 import os
 
+# XLA compile effort: the suite is compile-bound on its 1-CPU CI host
+# (hundreds of tiny-model jit programs, each engine/test rebuilding its
+# own), and backend optimization buys nothing for correctness gates —
+# parity tests compare two runs under the same flags. O0 halves the
+# suite's wall time. Scoped to the forced-CPU test platform; hardware
+# runs (TNN_TEST_PLATFORM=tpu) and any operator-provided setting keep
+# XLA's defaults.
+if os.environ.get("TNN_TEST_PLATFORM", "cpu") == "cpu" and \
+        "--xla_backend_optimization_level" not in \
+        os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") +
+                               " --xla_backend_optimization_level=0").strip()
+
 # repo root reaches sys.path via pyproject's `pythonpath = ["."]` (or an
 # editable install); no path munging needed here
 from tnn_tpu.utils.platform import force_platform

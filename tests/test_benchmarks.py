@@ -215,6 +215,37 @@ def test_serve_bench_chaos():
 
 
 @pytest.mark.slow
+def test_serve_bench_trace():
+    """The --trace row is the benchmark-shaped observability gate: a traced
+    2-replica Router run that persists the merged Perfetto trace, flight-
+    recorder dumps, and a Prometheus scrape under benchmarks/results/.
+    bench_trace self-asserts the artifacts exist; here we gate the row
+    shape and re-parse the persisted files from their reported paths."""
+    import json
+    import os
+
+    from benchmarks import serve_bench
+
+    results = [r for r in serve_bench.main(["--trace"]) if r]
+    assert len(results) == 1
+    r = results[0]
+    assert r["bench"] == "serve_trace"
+    assert r["replicas"] == 2
+    assert r["trace_events"] > 0 and r["trace_tracks"] >= 3
+    assert r["flight_dumps"] >= 2          # one drain dump per replica
+    assert r["flight_records"] >= 1
+    assert r["prometheus_lines"] > 0
+    # the persisted artifacts parse from their reported paths
+    with open(r["trace_path"]) as f:
+        trace = json.load(f)["traceEvents"]
+    assert any(e.get("ph") == "X" for e in trace)
+    with open(r["metrics_path"]) as f:
+        text = f.read()
+    assert 'replica="router"' in text and "# TYPE" in text
+    assert os.path.getsize(r["trace_path"]) > 0
+
+
+@pytest.mark.slow
 def test_serve_bench_availability():
     """The --avail A/B is the benchmark-shaped failover gate: the same
     Poisson trace through a 2-replica Router, untouched vs one replica

@@ -263,6 +263,53 @@ def gather(q, want, deadline):
 ''', "unbounded-retry") == []
 
 
+class TestUnregisteredMetricKey:
+    REGISTRY = '''
+EXPOSITION = {
+    "serve.ttft_s": ("tnn_serve_ttft_seconds", "histogram",
+                     "Time to first token", "ttft_ms_p50"),
+}
+'''
+
+    def test_unregistered_tick_flags(self):
+        assert _rules(self.REGISTRY + '''
+class M:
+    def observe(self, s):
+        self._tick("serve.ghost_s", s)
+''', "unregistered-metric-key") == ["unregistered-metric-key"]
+
+    def test_registered_tick_clean(self):
+        assert _rules(self.REGISTRY + '''
+class M:
+    def observe(self, s):
+        self._tick("serve.ttft_s", s)
+''', "unregistered-metric-key") == []
+
+    def test_stale_summary_key_flags(self):
+        # the registry names a summary field that summary() no longer has
+        assert _rules(self.REGISTRY + '''
+class M:
+    def summary(self):
+        return {"renamed_ttft_p50": 1.0}
+''', "unregistered-metric-key") == ["unregistered-metric-key"]
+
+    def test_live_summary_key_clean(self):
+        assert _rules(self.REGISTRY + '''
+class M:
+    def summary(self):
+        return {"ttft_ms_p50": 1.0}
+''', "unregistered-metric-key") == []
+
+    def test_module_without_registry_ignored(self):
+        # engines/supervisors tick through observe_*; only the module
+        # owning the registry dict is cross-checked
+        assert _rules('''
+class Engine:
+    def step(self):
+        self.metrics._tick("serve.anything", 1.0)
+''', "unregistered-metric-key") == []
+
+
 # -- framework machinery ------------------------------------------------------
 
 
@@ -304,12 +351,12 @@ class TestSuppressions:
 
 
 class TestDriver:
-    def test_all_seven_rules_registered(self):
+    def test_all_eight_rules_registered(self):
         assert set(rule_registry()) == {
             "unbounded-compile-key", "use-after-donate",
             "host-sync-in-step-path", "prng-key-reuse",
             "cross-thread-engine-access", "unpaired-pool-mutation",
-            "unbounded-retry"}
+            "unbounded-retry", "unregistered-metric-key"}
 
     def test_unknown_rule_name_rejected(self):
         with pytest.raises(ValueError, match="unknown rule"):

@@ -1,0 +1,375 @@
+"""End-to-end serving observability tests (PR 10).
+
+Four contracts:
+
+* Tracing is FREE of semantic effect: a traced engine (spans + instants
+  flowing into a ``Profiler``) produces token-identical output to an
+  untraced one, on both decode paths, with speculative decoding and the
+  prefix cache on — and stays clean under ``TNN_DEBUG_SYNC=1`` (tracing
+  is host-side bookkeeping, never a device sync).
+* The crash flight recorder: a bounded ring of per-step records owned by
+  the supervisor, dumped as JSONL on crash/drain; the LAST record of a
+  crash dump identifies the crashing step's batch.
+* ``ServingMetrics`` sample series are bounded (fixed-size reservoir) —
+  a week-long serve must not grow per-request lists without bound.
+* The Prometheus text exposition parses: HELP/TYPE headers, cumulative
+  histogram buckets, labeled per-replica series through the Router.
+"""
+import json
+
+import numpy as np
+import pytest
+
+import jax
+
+from tnn_tpu.profiling.profiler import Profiler
+from tnn_tpu.serving import (EngineSupervisor, FaultPlan, InferenceEngine,
+                             Router, ServingMetrics, SupervisorState,
+                             render_prometheus)
+from tnn_tpu.serving.metrics import (EXPOSITION, Reservoir, label_series,
+                                     merge_series)
+from tnn_tpu.serving.tracing import FlightRecorder, Tracer, span_name
+
+KW = dict(num_blocks=32, block_size=4, max_batch_size=4, max_seq_len=48)
+
+
+@pytest.fixture(scope="module")
+def tiny_lm():
+    from tnn_tpu.models.gpt2 import GPT2
+
+    model = GPT2(vocab_size=128, max_len=64, num_layers=2, d_model=32,
+                 num_heads=2)
+    params = model.init(jax.random.PRNGKey(0), (1, 8))["params"]
+    return model, params
+
+
+def _spec_run(model, params, *, trace, decode_path="auto"):
+    """Spec-decode + prefix-cache workload: shared 12-token prefix so the
+    second wave forks cached KV, ngram drafting so the mixed step runs the
+    verify path — the two features whose step shapes tracing must not
+    perturb."""
+    eng = InferenceEngine(model, params, spec="ngram", spec_k=3,
+                          decode_path=decode_path, trace=trace, **KW)
+    rng = np.random.default_rng(11)
+    prefix = rng.integers(0, 128, 12).astype(np.int32)
+    prompts = [np.concatenate([prefix, rng.integers(0, 128, n).astype(
+        np.int32)]) for n in (3, 5, 2, 4)]
+    rids = [eng.submit(p, 8) for p in prompts[:2]]
+    eng.run_until_complete()                  # publishes the prefix
+    rids += [eng.submit(p, 8) for p in prompts[2:]]
+    out = eng.run_until_complete()
+    assert eng.metrics.prefix_hits >= 1, "workload never hit the cache"
+    return [out[r] for r in rids], eng
+
+
+class TestSpanName:
+    def test_attrs_appended_in_order(self):
+        assert span_name("serve.step", trace="t3", rid=7, step=12) == \
+            "serve.step trace=t3 rid=7 step=12"
+
+    def test_none_attrs_dropped(self):
+        assert span_name("serve.step", trace=None, rid=1) == "serve.step rid=1"
+
+    def test_bare_base(self):
+        assert span_name("serve.step") == "serve.step"
+
+
+class TestTracer:
+    def test_disabled_without_profiler(self):
+        tr = Tracer()
+        assert not tr.enabled
+        with tr.span("serve.step", rid=1):
+            pass
+        tr.instant("serve.submit", rid=1)  # no-ops, nothing raised
+
+    def test_span_and_instant_record_events(self):
+        prof = Profiler(source="engine")
+        tr = Tracer(prof)
+        assert tr.enabled
+        with tr.span("serve.step", trace="t0", step=1):
+            pass
+        tr.instant("serve.submit", trace="t0", rid=4)
+        names = [ev.name for ev in prof.events]
+        assert "serve.step trace=t0 step=1" in names
+        assert "serve.submit trace=t0 rid=4" in names
+        inst = [ev for ev in prof.events if ev.name.startswith("serve.submit")]
+        assert inst[0].duration == 0.0
+
+
+@pytest.fixture(scope="module")
+def spec_ref(tiny_lm):
+    """Untraced reference outputs per decode path, computed once — every
+    traced run in this module diffs against these (an engine build + spec
+    workload is the expensive part of this file; don't repeat it)."""
+    cache = {}
+
+    def get(path):
+        if path not in cache:
+            model, params = tiny_lm
+            cache[path] = _spec_run(model, params, trace=False,
+                                    decode_path=path)[0]
+        return cache[path]
+
+    return get
+
+
+@pytest.fixture(scope="module")
+def flight_run(tiny_lm, tmp_path_factory):
+    """One supervised run shared by the flight-recorder and terminal-event
+    tests: crash at step 3 (crash dump + migration of both running rids),
+    run to completion, then a graceful drain (drain dump)."""
+    model, params = tiny_lm
+    flight_dir = str(tmp_path_factory.mktemp("flight"))
+    plan = FaultPlan(step_crash_calls=(3,))
+    eng = InferenceEngine(model, params, faults=plan, **KW)
+    events = []
+    sup = EngineSupervisor(eng, event_sink=events.append,
+                           restart_backoff_s=0.0, max_restarts=2,
+                           flight_dir=flight_dir)
+    rng = np.random.default_rng(4)
+    rids = [sup.submit(rng.integers(0, 128, n).astype(np.int32), 5)
+            for n in (5, 6)]
+    sup.run_sync()
+    sup.request_drain("test")
+    sup.run_sync()
+    return sup, rids, events
+
+
+class TestTracedTokenExact:
+    # the standard path rides slow: paged is the default/production path
+    # and the tier-1 budget is tight; `-m slow` covers the matrix
+    @pytest.mark.parametrize("path", [
+        pytest.param("standard", marks=pytest.mark.slow), "paged"])
+    def test_traced_equals_untraced(self, tiny_lm, spec_ref, path):
+        model, params = tiny_lm
+        ref = spec_ref(path)
+        got, eng = _spec_run(model, params, trace=True, decode_path=path)
+        assert got == ref, f"tracing changed tokens on {path} decode"
+        # and the trace is real: request-scoped events with trace ids
+        names = [ev.name for ev in eng.profiler.events]
+        assert any(n.startswith("serve.submit") for n in names)
+        assert any(n.startswith("serve.finish") for n in names)
+        assert any("trace=t0" in n for n in names)
+
+    def test_traced_clean_under_debug_sync(self, tiny_lm, spec_ref,
+                                           monkeypatch):
+        """Tracing instants/spans are host-side bookkeeping: a traced step
+        under jax.transfer_guard('disallow') neither syncs nor diverges."""
+        model, params = tiny_lm
+        ref = spec_ref("paged")
+        monkeypatch.setenv("TNN_DEBUG_SYNC", "1")
+        got, eng = _spec_run(model, params, trace=True, decode_path="paged")
+        assert eng.debug_sync
+        assert got == ref
+
+    def test_terminal_event_carries_breakdown(self, flight_run):
+        sup, rids, events = flight_run
+        term = [e for e in events if e["event"] == "done"]
+        assert len(term) == len(rids)
+        for ev in term:
+            assert ev["trace_id"] == f"t{ev['id']}"
+            bd = ev["latency_breakdown"]
+            assert set(bd) == {"queued_ms", "prefill_ms", "decode_ms",
+                               "stalled_ms", "preemptions", "migrations"}
+            assert bd["prefill_ms"] > 0 and bd["decode_ms"] > 0
+        # both requests were RUNNING at the crash -> both crash-migrated,
+        # and the breakdown says so
+        assert all(ev["latency_breakdown"]["migrations"] >= 1 for ev in term)
+
+
+class TestFlightRecorder:
+    def test_ring_is_bounded(self):
+        rec = FlightRecorder(capacity=4)
+        for i in range(10):
+            rec.record({"step_seq": i})
+        assert len(rec) == 4
+        assert [r["step_seq"] for r in rec.records()] == [6, 7, 8, 9]
+
+    def test_dump_schema(self, tmp_path):
+        rec = FlightRecorder(capacity=8)
+        rec.record({"step_seq": 1, "queued": 0})
+        path = rec.dump(str(tmp_path / "f.jsonl"), "drain",
+                        extra={"restarts": 0})
+        lines = [json.loads(ln) for ln in open(path) if ln.strip()]
+        meta = lines[0]
+        assert meta["kind"] == "flight_recorder_meta"
+        assert meta["reason"] == "drain"
+        assert meta["capacity"] == 8 and meta["records"] == 1
+        assert meta["total_steps_seen"] == 1 and meta["restarts"] == 0
+        assert lines[1]["step_seq"] == 1
+
+    def test_crash_dump_last_record_is_crashing_step(self, flight_run):
+        """Under faults.step_crash the supervisor writes a crash dump whose
+        final record carries the crashing step's batch (the rids that were
+        RUNNING), the crash marker, and the exception text."""
+        sup, rids, _ = flight_run
+        assert sup.restarts == 1
+        crash_dumps = [p for p in sup.flight_dumps if "crash" in p]
+        assert len(crash_dumps) == 1
+        lines = [json.loads(ln) for ln in open(crash_dumps[0]) if ln.strip()]
+        assert lines[0]["kind"] == "flight_recorder_meta"
+        assert lines[0]["reason"] == "crash"
+        last = lines[-1]
+        assert last["crashed"] is True
+        assert "EngineCrash" in last["error"]
+        assert sorted(last["running_rids"]) == sorted(rids)
+        assert last["step_seq"] == 3
+        # the crashed step ends the dump — nothing recorded after it
+        assert all("crashed" not in ln for ln in lines[1:-1])
+
+    def test_drain_dump_and_step_record_shape(self, flight_run):
+        sup, _, _ = flight_run
+        assert sup.state is SupervisorState.STOPPED
+        drain = [p for p in sup.flight_dumps if "drain" in p]
+        assert len(drain) == 1
+        lines = [json.loads(ln) for ln in open(drain[0]) if ln.strip()]
+        assert len(lines) >= 2
+        rec = lines[1]
+        for key in ("step_seq", "queued", "running_rids", "programs",
+                    "step_latency_s", "pool_allocated", "pool_evictable",
+                    "faults_fired"):
+            assert key in rec, f"step record lacks {key}"
+        prog = rec["programs"][0]
+        assert set(prog) == {"kind", "compile_key", "rids", "fill"}
+
+    def test_no_dir_no_dump(self, tiny_lm):
+        model, params = tiny_lm
+        sup = EngineSupervisor(InferenceEngine(model, params, **KW))
+        sup.flight.record({"step_seq": 1})
+        assert sup._dump_flight("drain") is None    # flight_dir unset
+        assert sup.flight_dumps == []
+
+
+class TestReservoirCap:
+    def test_algorithm_r_bounds_memory(self):
+        r = Reservoir("ttft_s", cap=16)
+        for i in range(10_000):
+            r.append(float(i))
+        assert len(r) == 16
+        assert r.seen == 10_000
+        assert all(0 <= x < 10_000 for x in r)
+
+    def test_deterministic_for_fixed_name(self):
+        a, b = Reservoir("x", cap=8), Reservoir("x", cap=8)
+        for i in range(1000):
+            a.append(float(i)), b.append(float(i))
+        assert list(a) == list(b)
+
+    def test_metrics_series_stay_bounded(self):
+        """The regression this satellite exists for: per-request sample
+        lists must not grow linearly with requests served."""
+        m = ServingMetrics(reservoir_size=32)
+        for i in range(5000):
+            m.observe_ttft(0.001 * i)
+            m.observe_decode(num_tokens=1, seconds=0.002, batch_width=1)
+            m.observe_queue_wait(0.003)
+            m.observe_step_latency(0.004)
+        for series in (m.ttft_s, m.token_latency_s, m.queue_wait_s,
+                       m.step_latency_s):
+            assert len(series) <= 32
+        s = m.summary()
+        assert s["ttft_ms_p50"] > 0     # percentiles still answer
+        # histograms keep EXACT counts even though the reservoir samples
+        assert m.histograms["serve.ttft_s"].count == 5000
+
+
+class TestPrometheusExposition:
+    def _parse(self, text):
+        """Minimal 0.0.4 parser: returns (helps, types, samples)."""
+        helps, types, samples = {}, {}, []
+        for ln in text.splitlines():
+            if ln.startswith("# HELP "):
+                _, _, name, h = ln.split(" ", 3)
+                helps[name] = h
+            elif ln.startswith("# TYPE "):
+                _, _, name, t = ln.split(" ", 3)
+                types[name] = t
+            elif ln:
+                metric, value = ln.rsplit(" ", 1)
+                labels = {}
+                if "{" in metric:
+                    metric, _, rest = metric.partition("{")
+                    for pair in rest.rstrip("}").split(","):
+                        k, _, v = pair.partition("=")
+                        labels[k] = v.strip('"')
+                samples.append((metric, labels, float(value)))
+        return helps, types, samples
+
+    def test_exposition_parses(self):
+        # direct ServingMetrics population: the engine-backed scrape path
+        # is tier-1 in tests/test_server.py; this checks the text contract
+        m = ServingMetrics()
+        for i in range(3):
+            m.observe_ttft(0.01 * (i + 1))
+            m.observe_step_latency(0.002 * (i + 1))
+            m.observe_decode(num_tokens=2, seconds=0.004, batch_width=2)
+        m.observe_gauges(queue_depth=2, pool_occupancy=0.5)
+        m.finished = 3
+        text = render_prometheus(m.prometheus_series())
+        helps, types, samples = self._parse(text)
+        assert types["tnn_serve_ttft_seconds"] == "histogram"
+        assert types["tnn_serve_steps_total"] == "counter"
+        assert types["tnn_serve_queue_depth"] == "gauge"
+        # every sample's family carries HELP and TYPE headers
+        fams = {m.split("{")[0] for m, _, _ in samples}
+        for fam in fams:
+            base = fam
+            for suffix in ("_bucket", "_sum", "_count"):
+                if base.endswith(suffix):
+                    base = base[: -len(suffix)]
+                    break
+            assert base in types and base in helps, f"bare series {fam}"
+        # histogram contract: cumulative buckets, +Inf == count
+        buckets = [(lb, v) for m, lb, v in samples
+                   if m == "tnn_serve_step_latency_seconds_bucket"]
+        counts = [v for _, v in buckets]
+        assert counts == sorted(counts), "buckets must be cumulative"
+        assert buckets[-1][0]["le"] == "+Inf"
+        count = [v for m, lb, v in samples
+                 if m == "tnn_serve_step_latency_seconds_count"][0]
+        assert buckets[-1][1] == count > 0
+
+    def test_every_exposition_key_renders(self, tiny_lm):
+        """The registry IS the exposition: every registered family appears
+        in the rendered text even at zero."""
+        text = render_prometheus(ServingMetrics().prometheus_series())
+        for name, _, _, _ in EXPOSITION.values():
+            assert f"# TYPE {name.removesuffix('_total')}" in text or \
+                f"# TYPE {name}" in text, f"{name} missing from exposition"
+
+    def test_label_and_merge_series(self):
+        fams = ServingMetrics().prometheus_series()
+        a = label_series(fams, {"replica": "0"})
+        b = label_series(fams, {"replica": "1"})
+        merged = merge_series(a, b)
+        names = [f["name"] for f in merged]
+        assert len(names) == len(set(names)), "merge must dedupe families"
+        one = merged[0]
+        replicas = {lbls.get("replica") for _, lbls, _ in one["samples"]}
+        assert replicas == {"0", "1"}
+
+    @pytest.mark.slow   # tier-1 twin: test_server's raw-socket router scrape
+    def test_router_labels_survive_replica_kill(self, tiny_lm):
+        """After a replica dies the exposition still renders, keeps the
+        router's own series, and keeps the survivor's labeled series."""
+        model, params = tiny_lm
+        sups = [EngineSupervisor(InferenceEngine(model, params, **KW))
+                for _ in range(2)]
+        router = Router(sups, seed=0, profiler=Profiler(source="router"))
+        term = []
+        for i in range(4):
+            router.submit(np.arange(1, 6, dtype=np.int32) + i, 4,
+                          listener=lambda ev: (
+                              term.append(ev) if ev["event"] != "token"
+                              else None))
+        router.run_sync(max_rounds=500)
+        assert len(term) == 4
+        router.kill_replica(0)
+        router.pump(5)
+        text = render_prometheus(router.prometheus_series())
+        helps, types, samples = self._parse(text)
+        labels = {lb.get("replica") for _, lb, _ in samples}
+        assert "router" in labels and "1" in labels
+        # supervisor-level families present under the replica label
+        assert any(m == "tnn_serve_supervisor_restarts" for m, _, _ in
+                   samples)

@@ -151,6 +151,54 @@ def _poll_state(eng, rid, timeout_s=60.0):
     raise AssertionError(f"request {rid} never reached a terminal state")
 
 
+async def _http_text(port, method, path):
+    """One-shot request returning (status, content-type, raw text body) —
+    the /metrics scrape is text exposition, not JSON."""
+    reader, writer = await asyncio.open_connection("127.0.0.1", port)
+    writer.write(_request_bytes(method, path))
+    await writer.drain()
+    status = int((await reader.readline()).split()[1])
+    ctype = ""
+    while True:
+        ln = await reader.readline()
+        if ln in (b"\r\n", b""):
+            break
+        if ln.lower().startswith(b"content-type:"):
+            ctype = ln.split(b":", 1)[1].strip().decode()
+    data = await reader.read()
+    writer.close()
+    try:
+        await writer.wait_closed()
+    except (ConnectionError, OSError):
+        pass
+    return status, ctype, data.decode()
+
+
+def _parse_prometheus(text):
+    """Minimal 0.0.4 parser: (types, [(metric, labels, value), ...]).
+    Raises on any line that is neither a comment nor a valid sample."""
+    types, samples = {}, []
+    for ln in text.splitlines():
+        if not ln:
+            continue
+        if ln.startswith("# TYPE "):
+            _, _, name, t = ln.split(" ", 3)
+            types[name] = t
+            continue
+        if ln.startswith("#"):
+            continue
+        metric, value = ln.rsplit(" ", 1)
+        labels = {}
+        if "{" in metric:
+            metric, _, rest = metric.partition("{")
+            for pair in rest.rstrip("}").split(","):
+                k, _, v = pair.partition("=")
+                assert v.startswith('"') and v.endswith('"'), ln
+                labels[k] = v.strip('"')
+        samples.append((metric, labels, float(value)))
+    return types, samples
+
+
 # -- endpoint behavior --------------------------------------------------------
 
 
@@ -232,6 +280,37 @@ def test_cancel_unknown_id_is_benign(stack):
     assert status == 200 and body["cancelled"] is False
 
 
+def test_metrics_scrape_parses(stack):
+    """Raw-socket GET /metrics: text exposition 0.0.4 that a Prometheus
+    scraper would accept — typed families, cumulative histogram buckets,
+    counters that reflect served traffic."""
+    async def go():
+        # put at least one finished request on the books first
+        await _http(stack.port, "POST", "/v1/generate",
+                    {"tokens": [5, 6, 7], "max_new_tokens": 3,
+                     "stream": False})
+        return await _http_text(stack.port, "GET", "/metrics")
+
+    status, ctype, text = asyncio.run(go())
+    assert status == 200
+    assert ctype == "text/plain; version=0.0.4; charset=utf-8"
+    types, samples = _parse_prometheus(text)
+    assert types["tnn_serve_ttft_seconds"] == "histogram"
+    assert types["tnn_serve_requests_finished_total"] == "counter"
+    assert types["tnn_serve_queue_depth"] == "gauge"
+    by_name = {}
+    for m, lb, v in samples:
+        by_name.setdefault(m, []).append((lb, v))
+    assert by_name["tnn_serve_requests_finished_total"][0][1] >= 1
+    assert by_name["tnn_serve_steps_total"][0][1] >= 1
+    # histogram contract: buckets cumulative, +Inf equals _count
+    buckets = by_name["tnn_serve_ttft_seconds_bucket"]
+    counts = [v for _, v in buckets]
+    assert counts == sorted(counts)
+    assert buckets[-1][0]["le"] == "+Inf"
+    assert buckets[-1][1] == by_name["tnn_serve_ttft_seconds_count"][0][1] >= 1
+
+
 def test_client_disconnect_cancels_request(stack):
     before = stack.srv.disconnect_cancels
 
@@ -307,6 +386,63 @@ def test_bad_sampling_param_400(stack):
 
 
 # -- resilience paths (dedicated stacks) --------------------------------------
+
+
+def test_metrics_router_labels_after_replica_kill(tiny_lm):
+    """/metrics behind a Router front: per-replica series carry a
+    ``replica`` label, the router's own series are labeled
+    ``replica="router"``, and a hard replica kill leaves the scrape
+    parseable with the survivor still reporting."""
+    from tnn_tpu.serving import Router
+
+    model, params = tiny_lm
+    ekw = dict(num_blocks=32, block_size=4, max_batch_size=4, max_seq_len=32)
+    sups = [EngineSupervisor(InferenceEngine(model, params, **ekw))
+            for _ in range(2)]
+    router = Router(sups, seed=0).start()
+    loop = asyncio.new_event_loop()
+    thread = threading.Thread(target=loop.run_forever, name="server-loop",
+                              daemon=True)
+    thread.start()
+    srv = ServingServer(router, port=0)
+    asyncio.run_coroutine_threadsafe(srv.start(), loop).result(timeout=30)
+    try:
+        async def go():
+            # traffic through both replicas (JSQ spreads 4 over 2)
+            await asyncio.gather(*[
+                _http(srv.port, "POST", "/v1/generate",
+                      {"tokens": [1 + i, 2, 3], "max_new_tokens": 3,
+                       "stream": False}) for i in range(4)])
+            return await _http_text(srv.port, "GET", "/metrics")
+
+        status, ctype, text = asyncio.run(go())
+        assert status == 200
+        types, samples = _parse_prometheus(text)
+        labels = {lb.get("replica") for _, lb, _ in samples}
+        assert {"router", "0", "1"} <= labels
+        assert types["tnn_serve_supervisor_restarts"] == "counter"
+        done = {lb["replica"]: v for m, lb, v in samples
+                if m == "tnn_serve_requests_finished_total"
+                and lb.get("replica") in ("0", "1")}
+        assert sum(done.values()) >= 4
+
+        router.kill_replica(0)
+        status2, _, text2 = asyncio.run(
+            _http_text(srv.port, "GET", "/metrics"))
+        assert status2 == 200
+        _, samples2 = _parse_prometheus(text2)
+        labels2 = {lb.get("replica") for _, lb, _ in samples2}
+        assert "router" in labels2 and "1" in labels2, \
+            "survivor series vanished after the kill"
+    finally:
+        if not router.finished:
+            router.request_drain("test teardown")
+        router.join(timeout=120)
+        asyncio.run_coroutine_threadsafe(srv.stop(1.0),
+                                         loop).result(timeout=30)
+        loop.call_soon_threadsafe(loop.stop)
+        thread.join(timeout=10)
+        loop.close()
 
 
 def test_read_timeout_408(tiny_lm):

@@ -45,6 +45,7 @@ keeps serving the queue (see docs/serving.md's Operations section).
 """
 import argparse
 import json
+import os
 import queue
 import select
 import signal
@@ -61,6 +62,7 @@ import numpy as np  # noqa: E402
 from tnn_tpu import checkpoint as ckpt_lib  # noqa: E402
 from tnn_tpu import models  # noqa: E402
 from tnn_tpu.data.tokenizer import Tokenizer  # noqa: E402
+from tnn_tpu.profiling.profiler import Profiler  # noqa: E402
 from tnn_tpu.serving import (AdmissionRejected, EngineSupervisor,  # noqa: E402
                              InferenceEngine, Router, ShuttingDown,
                              run_server)
@@ -154,6 +156,15 @@ def main(argv=None):
     ap.add_argument("--spec-k", type=int, default=4,
                     help="max drafted tokens verified per decode row per "
                          "step (the mixed step widens to k+1)")
+    ap.add_argument("--trace", default="",
+                    help="enable request-scoped tracing and write one merged "
+                         "Chrome/Perfetto trace (router + every replica on "
+                         "its own track) to this path on exit")
+    ap.add_argument("--flight-dir", default="",
+                    help="directory for crash flight-recorder JSONL dumps; "
+                         "each supervisor dumps its last-N step records on "
+                         "crash, watchdog trip, restart-budget exhaustion, "
+                         "kill, and drain")
     ap.add_argument("--seed", type=int, default=0)
     args = ap.parse_args(argv)
 
@@ -179,7 +190,13 @@ def main(argv=None):
         print("spec=draft: random-weight gpt2_tiny drafter (wire a trained "
               "draft checkpoint for real acceptance rates)", file=sys.stderr)
 
-    def build_engine():
+    profilers = []
+
+    def build_engine(idx=0):
+        prof = None
+        if args.trace:
+            prof = Profiler(source=f"replica{idx}")
+            profilers.append(prof)
         return InferenceEngine(
             model, params, num_blocks=args.num_blocks,
             block_size=args.block_size,
@@ -197,13 +214,19 @@ def main(argv=None):
             logit_guard=not args.no_logit_guard,
             spec=args.spec, spec_k=args.spec_k,
             draft_model=draft_model, draft_params=draft_params,
+            profiler=prof, trace=bool(args.trace),
             seed=args.seed)
 
-    def build_supervisor(eng):
+    def build_supervisor(eng, idx=0):
+        # each replica dumps into its own subdirectory so the per-reason
+        # sequence numbers of different replicas never collide
+        flight_dir = (os.path.join(args.flight_dir, f"replica{idx}")
+                      if args.flight_dir else None)
         return EngineSupervisor(
             eng, watchdog_step_s=args.watchdog_s or None,
             max_restarts=args.max_restarts,
-            drain_deadline_s=args.drain_deadline_s or None)
+            drain_deadline_s=args.drain_deadline_s or None,
+            flight_dir=flight_dir)
 
     engine = build_engine()
     if not engine._paged and engine.paged_fallback_reason:
@@ -217,17 +240,31 @@ def main(argv=None):
         # replicas share read-only params; each gets its own KV pool,
         # scheduler, and supervised worker thread
         sups = [build_supervisor(engine)] + [
-            build_supervisor(build_engine())
-            for _ in range(args.replicas - 1)]
+            build_supervisor(build_engine(i), i)
+            for i in range(1, args.replicas)]
+        router_prof = Profiler(source="router") if args.trace else None
         supervisor = Router(
             sups,
             migration_budget=(10 ** 9 if args.migration_budget < 0
                               else args.migration_budget),
-            seed=args.seed)
+            seed=args.seed, profiler=router_prof)
         print(f"router: {args.replicas} supervised replicas",
               file=sys.stderr)
     else:
+        router_prof = None
         supervisor = build_supervisor(engine)
+
+    def dump_trace():
+        if not args.trace:
+            return
+        # one merged Perfetto view: router spans plus every replica's
+        # engine spans, each source on its own track
+        merged = router_prof if router_prof is not None else Profiler(
+            source="router")
+        for prof in profilers:
+            merged.merge(prof)
+        merged.to_chrome_trace(args.trace)
+        print(f"trace written to {args.trace}", file=sys.stderr)
 
     if args.http:
         host, _, port = args.http.rpartition(":")
@@ -235,9 +272,12 @@ def main(argv=None):
                           port=int(port), tokenizer=tokenizer,
                           default_max_new=args.max_new_tokens)
         supervisor.join(10.0)  # let worker threads exit before teardown
+        dump_trace()
         _print_summary(supervisor)
         return code
-    return _serve_stdin(supervisor, model, tokenizer, args)
+    code = _serve_stdin(supervisor, model, tokenizer, args)
+    dump_trace()
+    return code
 
 
 def _serve_stdin(supervisor, model, tokenizer, args):

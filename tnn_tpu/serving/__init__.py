@@ -18,7 +18,8 @@ from .engine import InferenceEngine
 from .faults import EngineCrash, FaultInjected, FaultPlan
 from .kv_pool import (PagedKVPool, PoolExhausted, gather_kv, scatter_prefill,
                       scatter_token)
-from .metrics import ServingMetrics
+from .metrics import (ServingMetrics, label_series, merge_series,
+                      render_prometheus)
 from .ownership import worker_only
 from .prefix_cache import PrefixCache
 from .router import BreakerState, CircuitBreaker, NetDrop, Router
@@ -26,6 +27,7 @@ from .scheduler import (TERMINAL_STATES, AdmissionRejected, Request,
                         RequestState, Scheduler, StepPlan)
 from .server import ServingServer, run_server
 from .supervisor import EngineSupervisor, ShuttingDown, SupervisorState
+from .tracing import FlightRecorder, Tracer, span_name
 
 __all__ = [
     "InferenceEngine", "PagedKVPool", "PoolExhausted", "gather_kv",
@@ -35,4 +37,6 @@ __all__ = [
     "EngineSupervisor", "SupervisorState", "ShuttingDown",
     "Router", "CircuitBreaker", "BreakerState", "NetDrop",
     "ServingServer", "run_server", "worker_only",
+    "Tracer", "FlightRecorder", "span_name",
+    "render_prometheus", "label_series", "merge_series",
 ]

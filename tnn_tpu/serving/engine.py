@@ -94,6 +94,7 @@ from __future__ import annotations
 import itertools
 import os
 import time
+from collections import Counter
 from contextlib import nullcontext
 from typing import Any, Dict, List, Optional, Sequence
 
@@ -112,6 +113,7 @@ from .metrics import ServingMetrics
 from .prefix_cache import PrefixCache
 from .scheduler import (TERMINAL_STATES, AdmissionRejected, Request,
                         RequestState, Scheduler)
+from .tracing import Tracer
 
 
 class InferenceEngine:
@@ -162,6 +164,13 @@ class InferenceEngine:
         (growing the evictable set under pressure just churns reclaims;
         matching stays on). Counted in ``stats()["publish_suspended"]``.
     profiler : optional profiling.Profiler for span/counter wiring.
+    trace : request-scoped tracing — every request gets a ``trace_id`` and
+        the engine emits admission/chunk/preemption/publish/finish instants
+        (plus the compute spans ``profiler`` already records) into the
+        profiler timeline, one Perfetto track per profiler ``source``.
+        Auto-creates a ``Profiler(source="engine")`` when none is given.
+        Tracing is host-side only: traced runs are token-exact vs untraced
+        and the TNN_DEBUG_SYNC transfer guard stays clean.
     """
 
     def __init__(self, model, params, *, num_blocks: int = 64,
@@ -178,7 +187,8 @@ class InferenceEngine:
                  prefix_publish_max_occupancy: float = 0.95,
                  spec: Any = "off", spec_k: int = 4,
                  draft_model=None, draft_params=None,
-                 profiler: Optional[Profiler] = None, seed: int = 0):
+                 profiler: Optional[Profiler] = None, trace: bool = False,
+                 seed: int = 0):
         if getattr(model, "kv_cache_dtype", None):
             raise ValueError(
                 "the paged pool stores compute-dtype pages; "
@@ -267,8 +277,13 @@ class InferenceEngine:
         self.scheduler.prefix_cache = self.prefix_cache
         self.prefix_publish_max_occupancy = float(prefix_publish_max_occupancy)
         self._last_decode_emit: Optional[float] = None
+        if trace and profiler is None:
+            profiler = Profiler(source="engine")
         self.profiler = profiler
         self.metrics = ServingMetrics(profiler)
+        self.tracer = Tracer(profiler if trace else None)
+        self.step_seq = 0                   # monotonically counts step() calls
+        self._step_note: Optional[Dict[str, Any]] = None
         self.requests: Dict[int, Request] = {}
         self._rid = itertools.count()
         self._key = jax.random.PRNGKey(seed)
@@ -344,7 +359,8 @@ class InferenceEngine:
                deadline_s: Optional[float] = None,
                max_queue_s: Optional[float] = None,
                priority: int = 0,
-               migration_budget: Optional[int] = None) -> int:
+               migration_budget: Optional[int] = None,
+               trace_id: Optional[str] = None) -> int:
         """Queue a generation request; returns its request id.
 
         ``deadline_s`` bounds the request's total wall time from submit;
@@ -364,6 +380,10 @@ class InferenceEngine:
         ``migration_budget`` caps how many crash/failover re-admissions
         (``migrate_running``) this request may take before it is FAILED as
         poison; None inherits the engine default.
+
+        ``trace_id`` names the request's trace (a router passes its global
+        id so one trace spans every replica the request touched); None
+        derives a deterministic ``t<rid>``.
         """
         prompt = np.asarray(prompt_ids, np.int32).reshape(-1)
         if prompt.size == 0:
@@ -409,8 +429,11 @@ class InferenceEngine:
                       migration_budget=(self.migration_budget
                                         if migration_budget is None
                                         else int(migration_budget)))
+        req.trace_id = trace_id if trace_id else f"t{rid}"
         self.requests[rid] = req
         self.scheduler.submit(req)
+        if self.tracer.enabled:
+            self.tracer.instant("serve.submit", trace=req.trace_id, rid=rid)
         return rid
 
     def cancel(self, rid: int, reason: str = "cancelled by client") -> bool:
@@ -458,6 +481,7 @@ class InferenceEngine:
                             else "fused" if self._fused is not None
                             else "standard"),
             "compiled_step_signatures": len(self._jit),
+            "step_seq": self.step_seq,
             "spec": self.spec_mode,
             "spec_k": self.spec_k if self.drafter is not None else 0,
         })
@@ -478,10 +502,19 @@ class InferenceEngine:
         """Fault-isolation exit: free the request's blocks, move it to a
         terminal failure state, count it, and (when mid-step) report it in
         the step's event bucket."""
+        now = time.perf_counter()
+        if req.state is RequestState.QUEUED:
+            req.queued_s += max(0.0, now - req.queued_time)
+        else:
+            self._note_leave_running(req, now)
         if req.block_table:
             self.pool.free(req.block_table)
             req.block_table = []
         self.scheduler.terminate(req, state, error)
+        if self.tracer.enabled:
+            self.tracer.instant("serve.terminal", trace=req.trace_id,
+                                rid=req.rid, state=state.value,
+                                step=self.step_seq)
         if state is RequestState.FAILED:
             self.metrics.observe_failed()
         elif state is RequestState.CANCELLED:
@@ -490,6 +523,37 @@ class InferenceEngine:
             self.metrics.observe_timeout()
         if events is not None and bucket is not None:
             events[bucket].append((req.rid, error))
+
+    # -- per-request latency breakdown (host-side clocks only) ----------------
+
+    def _note_admit(self, req: Request, now: float) -> None:
+        """Close the request's queued clock at admission and open its
+        prefill phase (the accumulators survive requeues — every QUEUED
+        stretch adds up)."""
+        wait = max(0.0, now - req.queued_time)
+        req.queued_s += wait
+        self.metrics.observe_queue_wait(wait)
+        req.phase = "prefill"
+        req.phase_t0 = now
+        if self.tracer.enabled:
+            self.tracer.instant("serve.admit", trace=req.trace_id,
+                                rid=req.rid, step=self.step_seq)
+
+    def _note_prefill_done(self, req: Request, now: float) -> None:
+        """Prompt fully resident: close the prefill clock, open decode."""
+        if req.phase == "prefill":
+            req.prefill_s += max(0.0, now - req.phase_t0)
+        req.phase = "decode"
+        req.phase_t0 = now
+
+    def _note_leave_running(self, req: Request, now: float) -> None:
+        """Close whichever phase clock is open — preemption, migration, or
+        a terminal exit all end the RUNNING stretch the same way."""
+        if req.phase == "prefill":
+            req.prefill_s += max(0.0, now - req.phase_t0)
+        elif req.phase == "decode":
+            req.decode_s += max(0.0, now - req.phase_t0)
+        req.phase = ""
 
     # -- engine step ----------------------------------------------------------
 
@@ -508,9 +572,70 @@ class InferenceEngine:
         Failures are isolated: a poisoned request (alloc failure, NaN
         logits, oversized resume, exhausted preemption budget) lands in
         ``failed`` and the rest of the batch keeps decoding.
+
+        Every step also finalizes a flight-recorder record
+        (``last_step_record``) — even when the step CRASHES, so a
+        supervisor's post-mortem dump identifies the dying step's batch.
         """
-        with self._sync_guard():
-            return self._step_inner()
+        self.step_seq += 1
+        t0 = time.perf_counter()
+        fired_before = (Counter(self.faults.fired)
+                        if self.faults is not None else None)
+        # built BEFORE the step body runs: a crash fired at the very top of
+        # the step (faults.on_step) must still leave a record naming the
+        # batch it would have stepped
+        note: Dict[str, Any] = {
+            "step_seq": self.step_seq,
+            "queued": self.scheduler.queue_depth,
+            "running_rids": [r.rid for r in self.scheduler.running],
+            "programs": [],
+        }
+        self._step_note = note
+        gen_before = {r.rid: r.num_generated for r in self.scheduler.running
+                      if r.state is RequestState.RUNNING
+                      and r.cache_len >= r.prefill_len}
+        try:
+            with self._sync_guard():
+                events = self._step_inner()
+        finally:
+            dt = time.perf_counter() - t0
+            note["step_latency_s"] = round(dt, 6)
+            note["pool_allocated"] = self.pool.num_allocated
+            note["pool_evictable"] = self.pool.num_evictable
+            if fired_before is None:
+                note["faults_fired"] = {}
+            else:
+                note["faults_fired"] = {
+                    k: int(v - fired_before.get(k, 0))
+                    for k, v in self.faults.fired.items()
+                    if v - fired_before.get(k, 0)}
+        self.metrics.observe_step_latency(dt)
+        # per-request stall attribution: a decode-phase row that survived the
+        # step without committing a token spent the whole step stalled
+        # (behind peer prefills in legacy mode, a retried fault, ...)
+        for r in self.scheduler.running:
+            if r.state is RequestState.RUNNING and \
+                    r.num_generated == gen_before.get(r.rid, -1):
+                r.stall_s += dt
+        return events
+
+    def last_step_record(self) -> Optional[Dict[str, Any]]:
+        """Flight-recorder record of the most recent step: per-program kind
+        + compile key + batch rids + fill, queue depth, pool/evictable
+        occupancy, step latency, faults fired. None before the first step.
+        A crashing step still finalizes its record — the last line of a
+        supervisor crash dump is the step that died."""
+        return dict(self._step_note) if self._step_note is not None else None
+
+    def _note_program(self, kind: str, key, rids: List[int],
+                      fill: float) -> None:
+        """Attach one launched compiled program to the current step's
+        flight record (a legacy step may launch several prefills + a
+        decode; a mixed step launches exactly one)."""
+        if self._step_note is not None:
+            self._step_note["programs"].append(
+                {"kind": kind, "compile_key": list(key), "rids": list(rids),
+                 "fill": round(fill, 4)})
 
     def _sync_guard(self):
         """``jax.transfer_guard("disallow")`` under TNN_DEBUG_SYNC=1: every
@@ -658,6 +783,8 @@ class InferenceEngine:
             self.faults is not None and self.faults.poison_prefill()
         ) else np.float32(0.0)
         key = ("prefill", padded)
+        self._note_program("prefill", key, [req.rid],
+                           fill=len(seq) / padded)
         fn = self._jit.get(key)
         if fn is None:
             fn = self._jit[key] = self._prefill_fn(padded, nb_bucket)
@@ -687,8 +814,12 @@ class InferenceEngine:
                             "non-finite logits in prefill", events, "failed")
             return
         req.cache_len = len(seq)
+        # queue wait closes at t0 (prefill launch), so the whole-prompt
+        # forward lands in prefill_s, not queued_s
+        self._note_admit(req, t0)
         self.scheduler.admit(req)
         now = time.perf_counter()
+        self._note_prefill_done(req, now)
         self.metrics.observe_prefill(len(seq), now - t0)
         if req.out_tokens:
             # preemption recovery: the pending next_token survives; the
@@ -699,6 +830,9 @@ class InferenceEngine:
             req.out_tokens.append(tok)
             req.ttft_s = now - req.submit_time
             self.metrics.observe_ttft(req.ttft_s)
+            if self.tracer.enabled:
+                self.tracer.instant("serve.first_token", trace=req.trace_id,
+                                    rid=req.rid, step=self.step_seq)
             events["tokens"].append((req.rid, tok))
             self._maybe_finish(req, tok, events)
 
@@ -721,6 +855,7 @@ class InferenceEngine:
         req.cache_len = 0
         if self.prefix_cache is not None:
             self._match_prefix(req)
+        self._note_admit(req, time.perf_counter())
         self.scheduler.admit(req)
         return True
 
@@ -977,6 +1112,8 @@ class InferenceEngine:
                 if self.faults.poison_prefill():
                     poison[i] = np.nan
         key = ("mixed", b, qw, nb, "spec") if spec_on else ("mixed", b, qw, nb)
+        self._note_program("spec" if spec_on else "mixed", key,
+                           [r.rid for r in rows], fill=len(rows) / b)
         fn = self._jit.get(key)
         if fn is None:
             if spec_on:
@@ -1076,6 +1213,10 @@ class InferenceEngine:
             take = takes[req.rid]
             req.cache_len += take
             self.metrics.observe_prefill_chunk(take)
+            if self.tracer.enabled:
+                self.tracer.instant("serve.prefill_chunk",
+                                    trace=req.trace_id, rid=req.rid,
+                                    step=self.step_seq, take=take)
             if self.prefix_cache is not None:
                 # every block this chunk just FILLED is immutable now —
                 # index it so the next shared-prefix request forks it.
@@ -1089,8 +1230,13 @@ class InferenceEngine:
                 else:
                     self.prefix_cache.publish(req.resume_tokens,
                                               req.block_table, req.cache_len)
+                    if self.tracer.enabled:
+                        self.tracer.instant("serve.publish",
+                                            trace=req.trace_id, rid=req.rid,
+                                            step=self.step_seq)
             if req.cache_len < req.prefill_len:
                 continue            # more chunks to go; no token yet
+            self._note_prefill_done(req, now)
             if req.out_tokens:
                 # preemption recovery: the pending next_token survives; the
                 # final chunk's own sample is redundant (greedy: identical)
@@ -1100,6 +1246,9 @@ class InferenceEngine:
             req.out_tokens.append(tok)
             req.ttft_s = now - req.submit_time
             self.metrics.observe_ttft(req.ttft_s, under_load=n_dec > 0)
+            if self.tracer.enabled:
+                self.tracer.instant("serve.first_token", trace=req.trace_id,
+                                    rid=req.rid, step=self.step_seq)
             events["tokens"].append((req.rid, tok))
             self._maybe_finish(req, tok, events)
         self.metrics.observe_mixed_step(
@@ -1286,11 +1435,15 @@ class InferenceEngine:
         return jax.jit(fn, donate_argnums=(1, 2))
 
     def _preempt(self, req: Request) -> None:
+        self._note_leave_running(req, time.perf_counter())
         self.pool.free(req.block_table)
         req.block_table = []
         req.cache_len = 0
         self.scheduler.requeue(req)
         self.metrics.observe_preemption(req.rid)
+        if self.tracer.enabled:
+            self.tracer.instant("serve.preempt", trace=req.trace_id,
+                                rid=req.rid, step=self.step_seq)
 
     def _decode_fn(self, batch: int, nb: int):
         model = self.model
@@ -1416,6 +1569,8 @@ class InferenceEngine:
             key, label = ("fdecode", b, nb), "serve.decode_fused"
         else:
             key, label = ("decode", b, nb), "serve.decode"
+        self._note_program(label.split(".", 1)[1], key,
+                           [r.rid for r in live], fill=len(live) / b)
         fn = self._jit.get(key)
         if fn is None:
             fn = self._jit[key] = (
@@ -1552,6 +1707,7 @@ class InferenceEngine:
         continue after the re-prefill."""
         events: Dict[str, List] = {"tokens": [], "finished": [],
                                    "failed": [], "timed_out": []}
+        now = time.perf_counter()
         for req in list(self.scheduler.running):
             budget = req.migration_budget
             if budget is not None and req.migrations >= budget:
@@ -1560,11 +1716,15 @@ class InferenceEngine:
                     f"migration budget exhausted ({budget}) — "
                     f"last failure: {reason}", events, "failed")
                 continue
+            self._note_leave_running(req, now)
             self.pool.free(req.block_table)
             req.block_table = []
             req.cache_len = 0
             self.scheduler.migrate(req)
             self.metrics.observe_migration(len(req.resume_tokens))
+            if self.tracer.enabled:
+                self.tracer.instant("serve.migrate", trace=req.trace_id,
+                                    rid=req.rid, step=self.step_seq)
         self.pool.reset_pages()
         if self.prefix_cache is not None:
             self.pool.purge_evictable()
@@ -1579,8 +1739,13 @@ class InferenceEngine:
             reason = "length"
         else:
             return
+        self._note_leave_running(req, time.perf_counter())
         self.pool.free(req.block_table)
         req.block_table = []
         self.scheduler.finish(req, reason)
         self.metrics.observe_finish(req.ttft_s)
+        if self.tracer.enabled:
+            self.tracer.instant("serve.finish", trace=req.trace_id,
+                                rid=req.rid, reason=reason,
+                                step=self.step_seq)
         events["finished"].append(req.rid)

@@ -1,27 +1,160 @@
 """Serving metrics: TTFT, per-token latency, queue depth, pool occupancy,
-throughput — wired into profiling.profiler.
+throughput — wired into profiling.profiler and a Prometheus exposition.
 
 The engine wraps prefill/decode work in ``profiling.profiled`` spans (visible
 in the Chrome trace alongside training spans) and mirrors the aggregate
 counters into a Profiler via ``tick`` under ``serve.*`` keys, so one merged
 timeline covers both a training job and the serving engine colocated with it.
+
+Three exposition surfaces share one observation path:
+
+- ``summary()`` — the flat dict benchmarks and ``GET /v1/stats`` report.
+- ``prometheus_series()`` — counter/gauge/histogram families rendered by
+  ``render_prometheus`` into text-format 0.0.4 for ``GET /metrics``; the
+  Router merges per-replica families under a ``replica`` label.
+- ``Profiler.tick`` counters (when a profiler is wired) for the merged
+  training+serving timeline.
+
+Every ``_tick`` key MUST be registered in ``EXPOSITION`` (tick key →
+(prometheus name, type, help, summary key)); the ``unregistered-metric-key``
+lint rule fails the build on silent metric drift.
+
+Latency sample series are capped by a fixed-size deterministic reservoir
+(Algorithm R with a per-series seeded RNG) so a days-long serve cannot OOM
+the host; percentiles stay stable within sampling tolerance.
 """
 from __future__ import annotations
 
 import math
+import random
 import time
-from typing import Dict, List, Optional
+from typing import Dict, Iterator, List, Optional, Tuple
 
 from ..profiling.profiler import Profiler
 
+#: default per-series sample cap (reservoir size). Large enough that the
+#: smoke/bench workloads never evict (their aggregates stay exact), small
+#: enough that a sustained run holds a few hundred KB of floats total.
+RESERVOIR_SIZE = 2048
 
-def _finite(xs: List[float]) -> List[float]:
+#: fixed histogram bucket upper bounds (seconds) for the latency families.
+#: Fixed — not adaptive — so scrapes from different replicas/restarts are
+#: always mergeable and dashboards never see bucket churn.
+LATENCY_BUCKETS_S = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+                     0.25, 0.5, 1.0, 2.5, 5.0, 10.0)
+
+#: The exposition registry: every ``_tick`` key maps to
+#: ``(prometheus name, type, help, summary key)`` where ``type`` is
+#: "counter" (cumulative sum of ticked values) or "histogram" (the tick's
+#: value stream also feeds a fixed-bucket histogram), and ``summary key``
+#: names the ``summary()`` entry through which the series is reachable.
+#: The ``unregistered-metric-key`` lint rule cross-checks all three:
+#: ticked keys must appear here, and the named summary keys must appear
+#: as literals in ``summary()``.
+EXPOSITION: Dict[str, Tuple[str, str, str, str]] = {
+    "serve.ttft_s": (
+        "tnn_serve_ttft_seconds", "histogram",
+        "Time to first token per request", "ttft_ms_p50"),
+    "serve.token_latency_s": (
+        "tnn_serve_token_latency_seconds", "histogram",
+        "Per-token decode latency (step wall time per emitted token)",
+        "token_latency_ms_p50"),
+    "serve.step_latency_s": (
+        "tnn_serve_step_latency_seconds", "histogram",
+        "Engine step wall time", "step_latency_ms_p50"),
+    "serve.queue_wait_s": (
+        "tnn_serve_queue_wait_seconds", "histogram",
+        "Time spent QUEUED before (each) admission", "queue_wait_ms_p50"),
+    "serve.prefill_s": (
+        "tnn_serve_prefill_seconds_total", "counter",
+        "Cumulative prefill wall seconds", "prefill_tokens"),
+    "serve.prefill_chunks": (
+        "tnn_serve_prefill_chunks_total", "counter",
+        "Prompt chunks pushed inside mixed steps", "prefill_chunks"),
+    "serve.prefix_tokens_saved": (
+        "tnn_serve_prefix_tokens_saved_total", "counter",
+        "Prompt tokens served from cached KV (prefill skipped)",
+        "prefill_tokens_saved"),
+    "serve.prefix_cows": (
+        "tnn_serve_prefix_cows_total", "counter",
+        "Copy-on-write block copies at full-cover prefix hits",
+        "prefix_cows"),
+    "serve.mixed_step_fill": (
+        "tnn_serve_mixed_step_fill_total", "counter",
+        "Cumulative mixed-step fill ratio (live tokens / compiled capacity)",
+        "mixed_step_fill_mean"),
+    "serve.decode_stall_s": (
+        "tnn_serve_decode_stall_seconds_total", "counter",
+        "Cumulative wall gap between token-emitting steps",
+        "decode_stall_ms_p50"),
+    "serve.decode_s": (
+        "tnn_serve_decode_seconds_total", "counter",
+        "Cumulative decode-step wall seconds", "tok_per_s"),
+    "serve.spec_accepted": (
+        "tnn_serve_spec_accepted_total", "counter",
+        "Drafted tokens accepted by the speculative verifier",
+        "spec_accepted_tokens"),
+    "serve.preemptions": (
+        "tnn_serve_preemptions_total", "counter",
+        "Recompute preemptions (pool pressure victims)", "preemptions"),
+    "serve.shed": (
+        "tnn_serve_shed_total", "counter",
+        "Queued requests displaced by higher-priority arrivals",
+        "shed_requests"),
+    "serve.engine_restarts": (
+        "tnn_serve_engine_restarts_total", "counter",
+        "Supervisor-driven engine recoveries", "engine_restarts"),
+    "serve.migrated_requests": (
+        "tnn_serve_migrated_requests_total", "counter",
+        "Requests re-admitted after an engine restart or replica failover",
+        "migrated_requests"),
+    "serve.router_retries": (
+        "tnn_serve_router_retries_total", "counter",
+        "Router-level dispatch retries", "router_retries"),
+    "serve.drain_duration_s": (
+        "tnn_serve_drain_seconds_total", "counter",
+        "Wall seconds spent in graceful drains", "drain_duration_s"),
+    "serve.publish_suspended": (
+        "tnn_serve_publish_suspended_total", "counter",
+        "Prefix publishes skipped under pool pressure", "publish_suspended"),
+    "serve.rejected": (
+        "tnn_serve_rejected_total", "counter",
+        "Submits rejected by bounded admission", "rejected"),
+    "serve.cancelled": (
+        "tnn_serve_cancelled_total", "counter",
+        "Requests cancelled by clients", "cancelled"),
+    "serve.timed_out": (
+        "tnn_serve_timed_out_total", "counter",
+        "Requests that hit deadline_s / max_queue_s", "timed_out"),
+    "serve.failed": (
+        "tnn_serve_failed_total", "counter",
+        "Requests failed by isolated faults", "failed"),
+    "serve.step_retries": (
+        "tnn_serve_step_retries_total", "counter",
+        "Transient decode faults retried in place", "step_retries"),
+}
+
+#: direct (non-``_tick``) families: attribute/gauge name → (prometheus
+#: name, type, help). Rendered alongside the EXPOSITION families.
+_DIRECT_FAMILIES: Tuple[Tuple[str, str, str, str], ...] = (
+    ("finished", "tnn_serve_requests_finished_total", "counter",
+     "Requests finished normally"),
+    ("decode_tokens", "tnn_serve_decode_tokens_total", "counter",
+     "Tokens emitted by decode steps"),
+    ("prefill_tokens", "tnn_serve_prefill_tokens_total", "counter",
+     "Prompt tokens pushed through prefill"),
+    ("steps", "tnn_serve_steps_total", "counter",
+     "Engine steps executed"),
+)
+
+
+def _finite(xs) -> List[float]:
     """Drop NaN/inf samples — a poisoned or clock-skewed observation must
     degrade one sample, not the whole aggregate."""
     return [x for x in xs if math.isfinite(x)]
 
 
-def _percentile(xs: List[float], q: float) -> float:
+def _percentile(xs, q: float) -> float:
     """Nearest-rank percentile without a numpy dependency on the hot path.
     NaN-safe: non-finite samples are ignored and an empty (or all-NaN)
     series reports 0.0 instead of raising/propagating NaN — a cache-only
@@ -33,15 +166,146 @@ def _percentile(xs: List[float], q: float) -> float:
     return ys[idx]
 
 
-def _mean(xs: List[float]) -> float:
+def _mean(xs) -> float:
     """NaN-safe mean over the finite samples; 0.0 when none exist."""
     ys = _finite(xs)
     return sum(ys) / len(ys) if ys else 0.0
 
 
-def _max(xs: List[float]) -> float:
+def _max(xs) -> float:
     """NaN-safe max over the finite samples; 0.0 when none exist."""
     return max(_finite(xs), default=0.0)
+
+
+class Reservoir:
+    """Fixed-size uniform sample of an unbounded stream (Algorithm R).
+
+    Drop-in for the previous unbounded lists: supports ``append``, ``len``,
+    iteration, and ``max(..., default=)``. The RNG is seeded from the
+    series name, so a given observation sequence always retains the same
+    samples — metric aggregates stay run-to-run deterministic. Below the
+    cap the reservoir IS the full series (aggregates exact); above it,
+    percentiles hold within sampling tolerance while memory stays flat.
+    """
+
+    __slots__ = ("cap", "_items", "_seen", "_rng")
+
+    def __init__(self, name: str = "", cap: int = RESERVOIR_SIZE):
+        if cap < 1:
+            raise ValueError("reservoir cap must be >= 1")
+        self.cap = int(cap)
+        self._items: List[float] = []
+        self._seen = 0
+        self._rng = random.Random(name)
+
+    def append(self, x: float) -> None:
+        self._seen += 1
+        if len(self._items) < self.cap:
+            self._items.append(x)
+            return
+        j = self._rng.randrange(self._seen)
+        if j < self.cap:
+            self._items[j] = x
+
+    def __len__(self) -> int:
+        return len(self._items)
+
+    def __iter__(self) -> Iterator[float]:
+        return iter(self._items)
+
+    @property
+    def seen(self) -> int:
+        """Observations ever appended (>= len once the cap is hit)."""
+        return self._seen
+
+
+class Histogram:
+    """Fixed-bucket cumulative histogram (Prometheus classic shape)."""
+
+    __slots__ = ("buckets", "counts", "total", "count")
+
+    def __init__(self, buckets: Tuple[float, ...] = LATENCY_BUCKETS_S):
+        self.buckets = tuple(buckets)
+        self.counts = [0] * (len(self.buckets) + 1)   # +1 for +Inf
+        self.total = 0.0
+        self.count = 0
+
+    def observe(self, value: float) -> None:
+        if not math.isfinite(value):
+            return
+        self.count += 1
+        self.total += value
+        for i, ub in enumerate(self.buckets):
+            if value <= ub:
+                self.counts[i] += 1
+                return
+        self.counts[-1] += 1
+
+    def samples(self) -> List[Tuple[str, Dict[str, str], float]]:
+        """Prometheus sample tuples: cumulative ``_bucket`` series plus
+        ``_sum`` and ``_count``."""
+        out: List[Tuple[str, Dict[str, str], float]] = []
+        cum = 0
+        for ub, n in zip(self.buckets, self.counts):
+            cum += n
+            out.append(("_bucket", {"le": _format_float(ub)}, float(cum)))
+        out.append(("_bucket", {"le": "+Inf"}, float(self.count)))
+        out.append(("_sum", {}, self.total))
+        out.append(("_count", {}, float(self.count)))
+        return out
+
+
+def _format_float(x: float) -> str:
+    s = repr(float(x))
+    return s[:-2] if s.endswith(".0") else s
+
+
+def _escape_label(v: str) -> str:
+    return v.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def label_series(families: List[Dict], labels: Dict[str, str]) -> List[Dict]:
+    """Return a deep-enough copy of ``families`` with ``labels`` merged
+    into every sample (the Router uses this to add ``replica="N"``)."""
+    out = []
+    for fam in families:
+        samples = [(suffix, {**labels, **lbls}, value)
+                   for suffix, lbls, value in fam["samples"]]
+        out.append({**fam, "samples": samples})
+    return out
+
+
+def merge_series(*family_lists: List[Dict]) -> List[Dict]:
+    """Merge family lists by metric name, concatenating samples — the
+    per-replica series of one family land under one HELP/TYPE header."""
+    by_name: Dict[str, Dict] = {}
+    order: List[str] = []
+    for fams in family_lists:
+        for fam in fams:
+            have = by_name.get(fam["name"])
+            if have is None:
+                by_name[fam["name"]] = {**fam,
+                                        "samples": list(fam["samples"])}
+                order.append(fam["name"])
+            else:
+                have["samples"].extend(fam["samples"])
+    return [by_name[n] for n in order]
+
+
+def render_prometheus(families: List[Dict]) -> str:
+    """Render metric families as Prometheus text exposition format 0.0.4."""
+    lines: List[str] = []
+    for fam in families:
+        lines.append(f"# HELP {fam['name']} {fam['help']}")
+        lines.append(f"# TYPE {fam['name']} {fam['type']}")
+        for suffix, labels, value in fam["samples"]:
+            name = fam["name"] + suffix
+            if labels:
+                lbl = ",".join(f'{k}="{_escape_label(str(v))}"'
+                               for k, v in sorted(labels.items()))
+                name = f"{name}{{{lbl}}}"
+            lines.append(f"{name} {_format_float(float(value))}")
+    return "\n".join(lines) + "\n"
 
 
 class ServingMetrics:
@@ -53,19 +317,35 @@ class ServingMetrics:
 
     def __init__(self, profiler: Optional[Profiler] = None, *,
                  slo_ttft_s: Optional[float] = None,
-                 slo_stall_s: Optional[float] = None):
+                 slo_stall_s: Optional[float] = None,
+                 reservoir_size: int = RESERVOIR_SIZE):
         self.profiler = profiler
         # SLO targets for goodput accounting (None = no SLO configured)
         self.slo_ttft_s = slo_ttft_s
         self.slo_stall_s = slo_stall_s
-        self.ttft_s: List[float] = []
-        self.ttft_under_load_s: List[float] = []
-        self.token_latency_s: List[float] = []
-        self.decode_stall_s: List[float] = []
-        self.queue_depth: List[int] = []
-        self.pool_occupancy: List[float] = []
-        self.batch_fill: List[float] = []
-        self.mixed_step_fill: List[float] = []
+
+        def res(name: str) -> Reservoir:
+            return Reservoir(name, cap=reservoir_size)
+
+        self.ttft_s = res("ttft_s")
+        self.ttft_under_load_s = res("ttft_under_load_s")
+        self.token_latency_s = res("token_latency_s")
+        self.decode_stall_s = res("decode_stall_s")
+        self.step_latency_s = res("step_latency_s")
+        self.queue_wait_s = res("queue_wait_s")
+        self.queue_depth = res("queue_depth")
+        self.pool_occupancy = res("pool_occupancy")
+        self.batch_fill = res("batch_fill")
+        self.mixed_step_fill = res("mixed_step_fill")
+        self.finished_ttft_s = res("finished_ttft_s")  # TTFT of *finished*
+        #: cumulative sum of every value ever ticked, by tick key — the
+        #: counter surface behind the Prometheus exposition (kept even when
+        #: no profiler is wired)
+        self.counters: Dict[str, float] = {}
+        #: fixed-bucket histograms for the EXPOSITION "histogram" families
+        self.histograms: Dict[str, Histogram] = {
+            key: Histogram() for key, (_, mtype, _, _) in EXPOSITION.items()
+            if mtype == "histogram"}
         self.prefill_tokens = 0
         self.prefill_chunks = 0
         # prefix cache: admission-time lookups against the block index
@@ -100,7 +380,6 @@ class ServingMetrics:
         self.migrated_requests = 0       # re-admissions after a crash/failover
         self.migration_resume_tokens = 0  # tokens re-prefilled by migrations
         self.router_retries = 0          # router-level dispatch retries
-        self.finished_ttft_s: List[float] = []  # TTFT of *finished* requests
         self._t_created = time.perf_counter()
         self._t_first: Optional[float] = None
         self._t_last: Optional[float] = None
@@ -114,9 +393,13 @@ class ServingMetrics:
         self._t_last = now
         return now
 
-    def _tick(self, key: str, value: float) -> None:
+    def _tick(self, metric: str, value: float) -> None:
+        self.counters[metric] = self.counters.get(metric, 0.0) + value
+        hist = self.histograms.get(metric)
+        if hist is not None:
+            hist.observe(value)
         if self.profiler is not None:
-            self.profiler.tick(key, value)
+            self.profiler.tick(metric, value)
 
     def observe_ttft(self, seconds: float, under_load: bool = False) -> None:
         """``under_load`` marks a first token produced while OTHER requests
@@ -182,9 +465,22 @@ class ServingMetrics:
             # every live request received exactly one token this step, so the
             # step wall time IS the per-token latency each of them experienced
             self.token_latency_s.append(seconds)
+            self._tick("serve.token_latency_s", seconds)
         if batch_width:
             self.batch_fill.append(num_tokens / batch_width)
         self._tick("serve.decode_s", seconds)
+
+    def observe_step_latency(self, seconds: float) -> None:
+        """Wall time of one whole engine step (any kind) — the flight
+        recorder's and the step-latency histogram's shared source."""
+        self.step_latency_s.append(seconds)
+        self._tick("serve.step_latency_s", seconds)
+
+    def observe_queue_wait(self, seconds: float) -> None:
+        """Continuous QUEUED time ended by one admission (re-admissions
+        after preemption/migration observe their own wait)."""
+        self.queue_wait_s.append(seconds)
+        self._tick("serve.queue_wait_s", seconds)
 
     def observe_spec(self, drafted: int, accepted: int, committed: int,
                      rows: int = 1) -> None:
@@ -204,6 +500,8 @@ class ServingMetrics:
     def observe_gauges(self, queue_depth: int, pool_occupancy: float) -> None:
         self.queue_depth.append(queue_depth)
         self.pool_occupancy.append(pool_occupancy)
+        self._last_queue_depth = queue_depth
+        self._last_pool_occupancy = pool_occupancy
 
     def observe_preemption(self, rid: Optional[int] = None) -> None:
         self.preemptions += 1
@@ -384,9 +682,49 @@ class ServingMetrics:
             "decode_stall_ms_p50": ms(_percentile(self.decode_stall_s, 50)),
             "decode_stall_ms_p99": ms(_percentile(self.decode_stall_s, 99)),
             "decode_stall_ms_max": ms(_max(self.decode_stall_s)),
+            "step_latency_ms_p50": ms(_percentile(self.step_latency_s, 50)),
+            "step_latency_ms_p99": ms(_percentile(self.step_latency_s, 99)),
+            "queue_wait_ms_p50": ms(_percentile(self.queue_wait_s, 50)),
+            "queue_wait_ms_p99": ms(_percentile(self.queue_wait_s, 99)),
             "prefill_chunks": self.prefill_chunks,
             "queue_depth_max": max(self.queue_depth, default=0),
             "pool_occupancy_max": _max(self.pool_occupancy),
             "batch_fill_mean": _mean(self.batch_fill),
             "mixed_step_fill_mean": _mean(self.mixed_step_fill),
         }
+
+    # -- Prometheus exposition ------------------------------------------------
+
+    def prometheus_series(self) -> List[Dict]:
+        """Metric families for ``render_prometheus``: every EXPOSITION
+        entry (counters render the cumulative ticked sum, histograms their
+        fixed buckets), the direct request/token counters, and the live
+        gauges. Families render even before their first observation, so
+        the scrape surface is stable from the first request."""
+        families: List[Dict] = []
+        for key, (name, mtype, help_, _) in EXPOSITION.items():
+            if mtype == "histogram":
+                samples = self.histograms[key].samples()
+            else:
+                samples = [("", {}, self.counters.get(key, 0.0))]
+            families.append({"name": name, "type": mtype, "help": help_,
+                             "samples": samples})
+        for attr, name, mtype, help_ in _DIRECT_FAMILIES:
+            families.append({"name": name, "type": mtype, "help": help_,
+                             "samples": [("", {}, float(getattr(self,
+                                                                attr)))]})
+        families.append({
+            "name": "tnn_serve_queue_depth", "type": "gauge",
+            "help": "Waiting requests at the last engine step",
+            "samples": [("", {}, float(getattr(self, "_last_queue_depth",
+                                               0)))]})
+        families.append({
+            "name": "tnn_serve_pool_occupancy", "type": "gauge",
+            "help": "KV pool block occupancy ratio at the last engine step",
+            "samples": [("", {}, float(getattr(self, "_last_pool_occupancy",
+                                               0.0)))]})
+        families.append({
+            "name": "tnn_serve_uptime_seconds", "type": "gauge",
+            "help": "Seconds since this metrics registry was created",
+            "samples": [("", {}, self.uptime_s)]})
+        return families

@@ -70,10 +70,12 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Set
 
 import numpy as np
 
-from .metrics import ServingMetrics
+from ..profiling.profiler import Profiler
+from .metrics import ServingMetrics, label_series, merge_series
 from .scheduler import AdmissionRejected
 from .supervisor import (EngineSupervisor, EventListener, ShuttingDown,
                          SupervisorState)
+from .tracing import Tracer
 
 
 class NetDrop(ConnectionError):
@@ -204,6 +206,7 @@ class Router:
                  breaker_cooldown_s: float = 0.25,
                  probe_interval_s: float = 0.05,
                  event_sink: Optional[EventListener] = None,
+                 profiler: Optional[Profiler] = None,
                  seed: int = 0):
         if not supervisors:
             raise ValueError("router needs at least one replica")
@@ -224,7 +227,11 @@ class Router:
         self.migration_budget = int(migration_budget)
         self.probe_interval_s = float(probe_interval_s)
         self.event_sink = event_sink
-        self.metrics = ServingMetrics(None)
+        # with a profiler, the router's dispatch/retry/migration instants
+        # land on its own Perfetto track (source = the profiler's source) —
+        # merge the replicas' profilers into it for the one-view trace
+        self.metrics = ServingMetrics(profiler)
+        self.tracer = Tracer(profiler)
         self.drain_duration_s: Optional[float] = None
         self.exit_code: Optional[int] = None
         self._rng = np.random.default_rng(seed)
@@ -361,6 +368,10 @@ class Router:
         rec = _Routed(gid=next(self._gid), prompt=prompt,
                       max_new=int(max_new_tokens), kwargs=dict(kwargs),
                       listener=listener, t_submit=time.perf_counter())
+        # one trace id for the request's whole life — a migration
+        # re-submits with the SAME id, so the Perfetto view shows one
+        # request hopping across replica tracks
+        rec.kwargs.setdefault("trace_id", f"g{rec.gid}")
         with self._lock:
             self._open[rec.gid] = rec
             self._submitted += 1
@@ -438,6 +449,25 @@ class Router:
             for k in agg_keys:
                 s[k] = s.get(k, 0) + rs.get(k, 0)
         return s
+
+    def prometheus_series(self) -> List[Dict]:
+        """Fleet-wide Prometheus families for ``GET /metrics``: the
+        router's own series under ``replica="router"`` plus every live
+        replica's engine series under its replica index — one family per
+        metric name, one labelled sample stream per replica. Dead replicas
+        keep their last-scraped series out rather than blocking the
+        scrape."""
+        parts = [label_series(self.metrics.prometheus_series(),
+                              {"replica": "router"})]
+        for h in list(self._handles):
+            if h.sup.finished and not h.sup.join(0):
+                continue  # worker mid-exit: don't race the closing queue
+            try:
+                fams = h.sup.prometheus_series()
+            except Exception:  # noqa: BLE001 — a dying replica yields none
+                continue
+            parts.append(label_series(fams, {"replica": str(h.idx)}))
+        return merge_series(*parts)
 
     def health_gauges(self) -> Dict[str, Any]:
         """Scalar health gauges for ``GET /v1/health`` — router-side
@@ -527,6 +557,10 @@ class Router:
         while attempt <= self.max_retries:   # explicit retry budget
             if attempt:
                 self.metrics.observe_router_retry()
+                if self.tracer.enabled:
+                    self.tracer.instant(
+                        "router.retry", trace=rec.kwargs.get("trace_id"),
+                        gid=rec.gid, attempt=attempt)
                 delay = min(self.retry_backoff_s * (2 ** (attempt - 1)),
                             self.retry_backoff_max_s)
                 delay += float(self._rng.random()) * self.retry_jitter_s
@@ -573,6 +607,10 @@ class Router:
                 rec.local_rid = lrid
                 h.live.add(rec.gid)
                 h.breaker.record_success()
+            if self.tracer.enabled:
+                self.tracer.instant(
+                    "router.dispatch", trace=rec.kwargs.get("trace_id"),
+                    gid=rec.gid, replica=h.idx, rid=lrid)
             return
         if raising and last is not None:
             raise last
@@ -610,6 +648,7 @@ class Router:
                        "tokens": list(rec.emitted),
                        "finish_reason": ev.get("finish_reason", ""),
                        "ttft_ms": round((rec.ttft_s or 0.0) * 1e3, 3)}
+                self._enrich_terminal(rec, ev, out)
             elif kind == "error" and \
                     self._replica_level(ev.get("reason", "")):
                 migrate_reason = ev.get("reason", "replica failure")
@@ -617,11 +656,26 @@ class Router:
                 self._close(rec, h)
                 out = {"event": kind, "id": rec.gid,
                        "reason": ev.get("reason", "")}
+                self._enrich_terminal(rec, ev, out)
         if migrate_reason is not None:
             self._migrate(rec, epoch, h, migrate_reason)
             return
         if out is not None:
             self._emit(rec, out)
+
+    def _enrich_terminal(self, rec: _Routed, ev: dict, out: dict) -> None:
+        """Carry the replica's observability fields across the gid/rid
+        translation: trace_id (router-assigned, so constant across
+        migrations) and the engine's latency breakdown, with the
+        router-level migration count layered on top."""
+        tid = rec.kwargs.get("trace_id")
+        if tid:
+            out["trace_id"] = tid
+        bd = ev.get("latency_breakdown")
+        if isinstance(bd, dict):
+            bd = dict(bd)
+            bd["migrations"] = bd.get("migrations", 0) + rec.migrations
+            out["latency_breakdown"] = bd
 
     @staticmethod
     def _replica_level(reason: str) -> bool:
@@ -664,12 +718,19 @@ class Router:
                 if rec.done:
                     return
                 self._close(rec, None)
-            self._emit(rec, {"event": "done", "id": rec.gid,
-                             "tokens": list(rec.emitted),
-                             "finish_reason": "length",
-                             "ttft_ms": round((rec.ttft_s or 0.0) * 1e3, 3)})
+            out = {"event": "done", "id": rec.gid,
+                   "tokens": list(rec.emitted),
+                   "finish_reason": "length",
+                   "ttft_ms": round((rec.ttft_s or 0.0) * 1e3, 3)}
+            self._enrich_terminal(rec, {}, out)
+            self._emit(rec, out)
             return
         self.metrics.observe_migration(len(rec.prompt) + len(rec.emitted))
+        if self.tracer.enabled:
+            self.tracer.instant(
+                "router.migrate", trace=rec.kwargs.get("trace_id"),
+                gid=rec.gid, from_replica=h.idx,
+                emitted=len(rec.emitted))
         self._dispatch(rec)   # failure here emits the terminal error event
 
     def _finish_failed(self, rec: _Routed, kind: str, reason: str) -> None:
@@ -677,7 +738,9 @@ class Router:
             if rec.done:
                 return
             self._close(rec, None)
-        self._emit(rec, {"event": kind, "id": rec.gid, "reason": reason})
+        out = {"event": kind, "id": rec.gid, "reason": reason}
+        self._enrich_terminal(rec, {}, out)
+        self._emit(rec, out)
 
     def _close(self, rec: _Routed, h: Optional[_Replica]) -> None:
         """Caller holds the lock."""

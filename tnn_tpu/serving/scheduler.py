@@ -96,10 +96,37 @@ class Request:
     finish_reason: str = ""
     error: str = ""                     # detail for FAILED/CANCELLED/TIMED_OUT
     queued_time: float = 0.0            # last transition into QUEUED
+    trace_id: str = ""                  # request-scoped trace id; assigned at
+    #                                     submit (router- or engine-derived)
+    #                                     and carried across migrations so one
+    #                                     id spans every replica the request
+    #                                     touched
+    # -- latency breakdown (wall seconds accumulated across requeues) --
+    queued_s: float = 0.0               # total time spent QUEUED
+    prefill_s: float = 0.0              # total (re-)prefill wall time
+    decode_s: float = 0.0               # total decode-phase wall time
+    stall_s: float = 0.0                # decode-phase steps that emitted no
+    #                                     token for this row (subset of
+    #                                     decode_s — crash retries, batch
+    #                                     stalls behind peer prefills)
+    phase: str = ""                     # "" | "prefill" | "decode" (engine-
+    phase_t0: float = 0.0               # managed clock for the accumulators)
 
     @property
     def is_terminal(self) -> bool:
         return self.state in TERMINAL_STATES
+
+    def latency_breakdown(self) -> Dict[str, float]:
+        """Per-request wall-time attribution for terminal events (ms):
+        where this request's lifetime actually went."""
+        return {
+            "queued_ms": round(self.queued_s * 1e3, 3),
+            "prefill_ms": round(self.prefill_s * 1e3, 3),
+            "decode_ms": round(self.decode_s * 1e3, 3),
+            "stalled_ms": round(self.stall_s * 1e3, 3),
+            "preemptions": self.preemptions,
+            "migrations": self.migrations,
+        }
 
     @property
     def num_generated(self) -> int:

@@ -22,6 +22,9 @@ Endpoints
   only scalar gauges, so it never blocks behind a slow step.
 - ``GET /v1/stats`` — full ``engine.stats()`` marshalled through the
   worker thread, plus server connection counters.
+- ``GET /metrics`` — Prometheus text exposition (format 0.0.4) of the
+  supervisor's counters/gauges/histograms; behind a ``Router`` front the
+  per-replica series carry a ``replica`` label.
 
 Resilience wiring: the engine runs on the supervisor's worker thread; the
 event loop talks to it only through thread-safe supervisor calls (off-loop
@@ -46,12 +49,16 @@ from typing import Any, Dict, Optional, Tuple
 
 import numpy as np
 
+from .metrics import render_prometheus
 from .scheduler import AdmissionRejected
 from .supervisor import EngineSupervisor, ShuttingDown, SupervisorState
 
 _REASONS = {200: "OK", 400: "Bad Request", 404: "Not Found",
             408: "Request Timeout", 500: "Internal Server Error",
             503: "Service Unavailable"}
+
+# Prometheus text exposition format 0.0.4
+_PROM_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
 
 
 class _BadRequest(ValueError):
@@ -178,6 +185,8 @@ class ServingServer:
             await self._health(writer)
         elif method == "GET" and path == "/v1/stats":
             await self._stats(writer)
+        elif method == "GET" and path == "/metrics":
+            await self._metrics(writer)
         elif method == "POST" and path == "/v1/generate":
             await self._generate(body, reader, writer)
         elif method == "POST" and path == "/v1/cancel":
@@ -221,6 +230,13 @@ class ServingServer:
             "server_stall_cancels": self.stall_cancels,
         })
         await self._respond_json(writer, 200, s)
+
+    async def _metrics(self, writer: asyncio.StreamWriter) -> None:
+        # marshals through the worker thread (or router lock) like /v1/stats;
+        # a Router front aggregates its replicas under a `replica` label
+        loop = asyncio.get_running_loop()
+        fams = await loop.run_in_executor(None, self.sup.prometheus_series)
+        await self._respond_text(writer, 200, render_prometheus(fams))
 
     async def _cancel(self, body: bytes, writer: asyncio.StreamWriter) -> None:
         payload = self._parse_json(body)
@@ -383,9 +399,18 @@ class ServingServer:
 
     async def _respond_json(self, writer: asyncio.StreamWriter, status: int,
                             obj: Dict[str, Any]) -> None:
-        body = json.dumps(obj).encode()
+        await self._respond_bytes(writer, status, json.dumps(obj).encode(),
+                                  "application/json")
+
+    async def _respond_text(self, writer: asyncio.StreamWriter, status: int,
+                            text: str,
+                            content_type: str = _PROM_CONTENT_TYPE) -> None:
+        await self._respond_bytes(writer, status, text.encode(), content_type)
+
+    async def _respond_bytes(self, writer: asyncio.StreamWriter, status: int,
+                             body: bytes, content_type: str) -> None:
         head = (f"HTTP/1.1 {status} {_REASONS.get(status, 'Unknown')}\r\n"
-                f"Content-Type: application/json\r\n"
+                f"Content-Type: {content_type}\r\n"
                 f"Content-Length: {len(body)}\r\n"
                 f"Connection: close\r\n\r\n").encode()
         writer.write(head + body)

@@ -53,9 +53,20 @@ Events are plain dicts::
     {"event": "error",     "id": rid, "reason": "..."}   # FAILED
     {"event": "cancelled", "id": rid, "reason": "..."}   # CANCELLED
     {"event": "timeout",   "id": rid, "reason": "..."}   # TIMED_OUT
+
+Terminal events additionally carry ``trace_id`` and a ``latency_breakdown``
+dict (queued/prefill/decode/stalled ms + preemption/migration counts) —
+see ``scheduler.Request.latency_breakdown`` and docs/observability.md.
+
+The supervisor also owns the crash **flight recorder** (``self.flight``):
+every step's record (``engine.last_step_record``) lands in a bounded ring
+buffer, dumped as JSONL on crash, watchdog trip, restart-budget
+exhaustion, kill, and drain when ``flight_dir`` is set. The last record of
+a crash dump is the step that died, annotated ``crashed=True``.
 """
 from __future__ import annotations
 
+import os
 import queue
 import threading
 import time
@@ -65,6 +76,7 @@ from typing import Any, Callable, Dict, List, Optional
 
 from .ownership import worker_only
 from .scheduler import Request, RequestState
+from .tracing import FlightRecorder, Tracer
 
 
 class ShuttingDown(RuntimeError):
@@ -115,6 +127,11 @@ class EngineSupervisor:
         listeners receive only their own request's events).
     idle_wait_s : worker-thread poll interval while idle (submits wake it
         immediately via the command queue).
+    flight_recorder_capacity : ring-buffer depth of the crash flight
+        recorder (always on — recording a step is a dict append).
+    flight_dir : directory for flight-recorder JSONL dumps; dumps fire on
+        crash, watchdog trip, restart-budget exhaustion, kill, and drain
+        (None = record but never write; ``flight.dump`` still works).
     """
 
     def __init__(self, engine, *, watchdog_step_s: Optional[float] = None,
@@ -123,7 +140,9 @@ class EngineSupervisor:
                  drain_deadline_s: Optional[float] = 30.0,
                  event_sink: Optional[EventListener] = None,
                  idle_wait_s: float = 0.05,
-                 command_timeout_s: float = 600.0):
+                 command_timeout_s: float = 600.0,
+                 flight_recorder_capacity: int = 256,
+                 flight_dir: Optional[str] = None):
         self.engine = engine
         self.watchdog_step_s = watchdog_step_s
         self.max_restarts = int(max_restarts)
@@ -146,6 +165,13 @@ class EngineSupervisor:
         self._open: Dict[int, Request] = {}
         self._drain_reason = ""
         self._drain_started: Optional[float] = None
+        self.flight = FlightRecorder(flight_recorder_capacity)
+        self.flight_dir = flight_dir
+        self.flight_dumps: List[str] = []
+        self._flight_seq = 0
+        # share the engine's tracer so supervisor instants land on the same
+        # profiler timeline (a no-op tracer when the engine is untraced)
+        self.tracer: Tracer = getattr(engine, "tracer", None) or Tracer()
 
     # -- state ----------------------------------------------------------------
 
@@ -206,6 +232,12 @@ class EngineSupervisor:
         """Thread-safe ``engine.stats()`` plus supervisor lifecycle state
         (marshalled through the worker, so the dict is consistent)."""
         return self._execute(self._stats)
+
+    def prometheus_series(self) -> List[Any]:
+        """Thread-safe snapshot of the engine's Prometheus metric families
+        (see ``metrics.ServingMetrics.prometheus_series``) plus supervisor
+        lifecycle gauges — the ``GET /metrics`` backend."""
+        return self._execute(self._prometheus_series)
 
     def request_drain(self, reason: str = "drain requested") -> None:
         """Begin a graceful drain (idempotent; safe from signal handlers):
@@ -342,6 +374,8 @@ class EngineSupervisor:
         self._open[rid] = req
         if listener is not None:
             self._listeners[rid] = listener
+        if self.tracer.enabled:
+            self.tracer.instant("sup.admit", trace=req.trace_id, rid=rid)
         return rid
 
     @worker_only
@@ -351,13 +385,50 @@ class EngineSupervisor:
         return s
 
     @worker_only
+    def _prometheus_series(self) -> List[Any]:
+        fams = list(self.engine.metrics.prometheus_series())
+        fams.append({
+            "name": "tnn_serve_supervisor_restarts", "type": "counter",
+            "help": "Supervisor crash/watchdog restarts",
+            "samples": [("", {}, float(self.restarts))]})
+        fams.append({
+            "name": "tnn_serve_flight_dumps", "type": "counter",
+            "help": "Flight-recorder JSONL dumps written",
+            "samples": [("", {}, float(self.flight.dumps))]})
+        return fams
+
+    @worker_only
     def _do_kill(self, reason: str) -> None:
         if self.finished:
             return
+        self._dump_flight("kill")
         self.engine.abort_all(reason, include_queued=True, reset_pages=True)
         self._sweep_terminals()
         self._set_state(SupervisorState.FAILED)
         self.exit_code = 1
+
+    def _last_step_record(self) -> Optional[Dict[str, Any]]:
+        fn = getattr(self.engine, "last_step_record", None)
+        return fn() if fn is not None else None
+
+    def _dump_flight(self, reason: str) -> Optional[str]:
+        """Write the flight ring as JSONL under ``flight_dir`` (no-op when
+        unset; appends to ``flight_dumps`` on success). Never raises — a
+        failing post-mortem write must not take down recovery itself."""
+        if self.flight_dir is None:
+            return None
+        self._flight_seq += 1
+        path = os.path.join(self.flight_dir,
+                            f"flight_{self._flight_seq:03d}_{reason}.jsonl")
+        try:
+            os.makedirs(self.flight_dir, exist_ok=True)
+            self.flight.dump(path, reason,
+                             extra={"restarts": self.restarts,
+                                    "supervisor_state": self._state.value})
+        except OSError:
+            return None
+        self.flight_dumps.append(path)
+        return path
 
     def _emit(self, rid: int, ev: dict) -> None:
         listener = self._listeners.get(rid)
@@ -390,6 +461,11 @@ class EngineSupervisor:
                 ev["ttft_ms"] = round((req.ttft_s or 0.0) * 1e3, 3)
             else:
                 ev["reason"] = req.error
+            if req.trace_id:
+                ev["trace_id"] = req.trace_id
+            # where this request's lifetime went — the per-request latency
+            # attribution tracing exists to answer
+            ev["latency_breakdown"] = req.latency_breakdown()
             for sink in (listener, self.event_sink):
                 if sink is None:
                     continue
@@ -403,7 +479,10 @@ class EngineSupervisor:
         self.restarts += 1
         self._wake.clear()
         self.engine.metrics.observe_restart()
+        if self.tracer.enabled:
+            self.tracer.instant("sup.restart", n=self.restarts)
         if self.restarts > self.max_restarts:
+            self._dump_flight("restart_budget")
             self.engine.abort_all(
                 f"restart budget exhausted ({self.max_restarts}) — "
                 f"last failure: {reason}",
@@ -432,6 +511,7 @@ class EngineSupervisor:
         self.drain_duration_s = (
             time.perf_counter() - started if started is not None else 0.0)
         self.engine.metrics.observe_drain(self.drain_duration_s)
+        self._dump_flight("drain")
         self._set_state(SupervisorState.STOPPED)
         self.exit_code = 0
 
@@ -465,13 +545,23 @@ class EngineSupervisor:
         try:
             events = self.engine.step()
         except Exception as e:  # noqa: BLE001 — crash recovery is the point
+            # the engine finalizes its step record even on a crash, so the
+            # dump's LAST line is the step that died, annotated with the
+            # exception that killed it
+            rec = self._last_step_record() or {}
+            rec["crashed"] = True
+            rec["error"] = f"{type(e).__name__}: {e}"
+            self.flight.record(rec)
+            self._dump_flight("crash")
             self._sweep_terminals()
             self._restart(f"engine step crashed: {type(e).__name__}: {e}")
             return
         dt = time.perf_counter() - t0
+        self.flight.record(self._last_step_record())
         self._dispatch_tokens(events)
         self._sweep_terminals()
         if self.watchdog_step_s is not None and dt > self.watchdog_step_s:
+            self._dump_flight("watchdog")
             self._restart(
                 f"step-latency watchdog tripped: step took {dt:.3f}s "
                 f"(threshold {self.watchdog_step_s}s)")

@@ -2,6 +2,7 @@
 from . import compile_key    # noqa: F401
 from . import donation       # noqa: F401
 from . import host_sync      # noqa: F401
+from . import metric_registry  # noqa: F401
 from . import pool           # noqa: F401
 from . import prng           # noqa: F401
 from . import retry          # noqa: F401

@@ -1,0 +1,129 @@
+"""unregistered-metric-key — every ticked metric must be reachable.
+
+The serving metrics contract (PR 10): every counter/histogram key ticked
+via ``self._tick("serve.x", v)`` must be registered in the module's
+``EXPOSITION`` dict (key → ``(prometheus name, type, help, summary
+key)``), so the series is rendered by ``/metrics``; and every registered
+entry's summary key must appear as a string literal inside ``summary()``,
+so the series is reachable from the human-facing summary too.  A key that
+is ticked but unregistered silently vanishes from dashboards; a registry
+row whose summary key drifted after a rename lies about reachability.
+
+The rule is scoped to modules that define the registry dict — other
+modules (engines, supervisors) tick through the public ``observe_*``
+surface and are not re-checked here.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List
+
+from ..core import ModuleContext, Rule, Violation, call_name, register
+
+_DEF_REGISTRY = "EXPOSITION"
+_DEF_TICK_METHODS = ["_tick"]
+_DEF_SUMMARY_METHODS = ["summary"]
+
+
+def _registry_dict(tree: ast.Module, name: str):
+    """The module-level ``NAME = {...}`` dict literal, or None."""
+    for node in tree.body:
+        targets = []
+        if isinstance(node, ast.Assign):
+            targets = node.targets
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            targets = [node.target]
+        else:
+            continue
+        for t in targets:
+            if isinstance(t, ast.Name) and t.id == name and \
+                    isinstance(node.value, ast.Dict):
+                return node.value
+        continue
+    return None
+
+
+def _str_const(node) -> str:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return ""
+
+
+@register
+class UnregisteredMetricKey(Rule):
+    name = "unregistered-metric-key"
+    description = ("every _tick key must be registered in the exposition "
+                   "registry, and every registered summary key must appear "
+                   "in summary()")
+
+    def check_module(self, ctx: ModuleContext) -> List[Violation]:
+        opts = ctx.rule_options(self.name)
+        registry_name = opts.get("registry_name", _DEF_REGISTRY)
+        tick_methods = set(opts.get("tick_methods", _DEF_TICK_METHODS))
+        summary_methods = set(opts.get("summary_methods",
+                                       _DEF_SUMMARY_METHODS))
+
+        registry = _registry_dict(ctx.tree, registry_name)
+        if registry is None:
+            return []  # not the metrics module: nothing to cross-check
+
+        keys: Dict[str, ast.AST] = {}
+        for k in registry.keys:
+            key = _str_const(k)
+            if key:
+                keys[key] = k
+
+        out: List[Violation] = []
+        out.extend(self._check_ticks(ctx, keys, tick_methods, registry_name))
+        out.extend(self._check_summary_keys(ctx, registry, keys,
+                                            summary_methods))
+        return out
+
+    def _check_ticks(self, ctx, keys, tick_methods,
+                     registry_name) -> List[Violation]:
+        """Every literal first argument of a tick call is a registry key."""
+        out = []
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call) or not node.args:
+                continue
+            cn = call_name(node) or ""
+            if cn.split(".")[-1] not in tick_methods:
+                continue
+            key = _str_const(node.args[0])
+            if key and key not in keys:
+                out.append(self.violation(
+                    ctx, node,
+                    f"metric key '{key}' is ticked but not registered in "
+                    f"{registry_name} — the series would be invisible to "
+                    f"/metrics; add a registry entry"))
+        return out
+
+    def _check_summary_keys(self, ctx, registry, keys,
+                            summary_methods) -> List[Violation]:
+        """Each registry row's summary key appears in a summary() body."""
+        summary_strings = set()
+        found_summary = False
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and node.name in summary_methods:
+                found_summary = True
+                for sub in ast.walk(node):
+                    s = _str_const(sub)
+                    if s:
+                        summary_strings.add(s)
+        if not found_summary:
+            return []  # registry without a summary surface: ticks-only check
+        out = []
+        for k, v in zip(registry.keys, registry.values):
+            key = _str_const(k)
+            if not key or not isinstance(v, ast.Tuple) or len(v.elts) < 4:
+                continue
+            summary_key = _str_const(v.elts[3])
+            if summary_key and summary_key not in summary_strings:
+                out.append(self.violation(
+                    ctx, k,
+                    f"registry entry '{key}' names summary key "
+                    f"'{summary_key}' which never appears in summary() — "
+                    f"stale registration (renamed or dropped summary "
+                    f"field?)"))
+        return out

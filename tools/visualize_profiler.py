@@ -9,6 +9,14 @@ after ``collect_profiles``) or a Chrome trace from ``to_chrome_trace``.
 
     python -m tools.visualize_profiler profile.json -o timeline.png
 
+Several dumps merge onto one timeline (one row per source — e.g. a router
+dump plus per-replica engine dumps become router/replica0/replica1 rows):
+
+    python -m tools.visualize_profiler router.json r0.json r1.json -o t.png
+
+Sources that collide across files are disambiguated with the file stem, so
+two replicas that both logged as "engine" still get separate rows.
+
 The Chrome-trace export (chrome://tracing / Perfetto) remains the richer
 viewer; this is the quick static picture.
 """
@@ -54,9 +62,30 @@ def load_events(path: str):
     raise SystemExit(f"{path}: not a profiler JSON or chrome trace")
 
 
+def load_merged(paths):
+    """Load every dump onto one timeline. Sources that appear in more than
+    one file get the file stem prefixed (``r0:engine``) so per-replica dumps
+    that share a source name still land on distinct rows."""
+    per_file = [(path, load_events(path)) for path in paths]
+    owners = {}
+    for path, events in per_file:
+        for src in {e[0] for e in events}:
+            owners.setdefault(src, set()).add(path)
+    merged = []
+    for path, events in per_file:
+        stem = os.path.splitext(os.path.basename(path))[0]
+        for src, typ, start, end, name in events:
+            if len(owners[src]) > 1:
+                src = f"{stem}:{src}"
+            merged.append((src, typ, start, end, name))
+    return merged
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__)
-    ap.add_argument("profile", help="profiler JSON or chrome-trace file")
+    ap.add_argument("profiles", nargs="+", metavar="profile",
+                    help="profiler JSON or chrome-trace file(s); several "
+                         "dumps merge onto one timeline, one row per source")
     ap.add_argument("-o", "--out", default="timeline.png")
     ap.add_argument("--max-events", type=int, default=5000)
     args = ap.parse_args(argv)
@@ -67,7 +96,7 @@ def main(argv=None):
     import matplotlib.pyplot as plt
     from matplotlib.patches import Patch
 
-    events = load_events(args.profile)
+    events = load_merged(args.profiles)
     if not events:
         raise SystemExit("no events to plot")
     events.sort(key=lambda e: e[2])
@@ -82,7 +111,7 @@ def main(argv=None):
                 color=COLORS.get(typ, COLORS["OTHER"]), edgecolor="none")
     ax.set_yticks(range(len(sources)), sources)
     ax.set_xlabel("seconds")
-    ax.set_title(os.path.basename(args.profile))
+    ax.set_title(" + ".join(os.path.basename(p) for p in args.profiles))
     ax.legend(handles=[Patch(color=c, label=t) for t, c in COLORS.items()],
               loc="upper right", fontsize=8)
     fig.tight_layout()
