@@ -119,7 +119,7 @@ def test_serve_bench_smoke():
     from benchmarks import serve_bench
 
     results = [r for r in serve_bench.main(["--smoke"]) if r]
-    assert len(results) == 10
+    assert len(results) == 12
     assert [r["bench"] for r in results] == ["serve_smoke_standard",
                                              "serve_smoke_paged",
                                              "serve_smoke_mixed_chunked",
@@ -129,7 +129,9 @@ def test_serve_bench_smoke():
                                              "serve_smoke_spec_off",
                                              "serve_smoke_spec_ngram",
                                              "serve_smoke_spec_draft",
-                                             "serve_smoke_load"]
+                                             "serve_smoke_load",
+                                             "serve_smoke_overlap_off",
+                                             "serve_smoke_overlap_on"]
     for r in results[:6]:                   # the latency/parity A/B rows
         assert r["ms"] > 0
         assert r["tok_per_s"] > 0
@@ -191,6 +193,22 @@ def test_serve_bench_smoke():
     assert nocache["prefill_tokens_saved"] == 0
     assert nocache["prefix_lookups"] == 0
     assert cached["ttft_ms_p50"] <= nocache["ttft_ms_p50"]
+    # the engine-loop A/B: the overlapped row's host gap (fetch->next
+    # dispatch, the window the chip idles on host bookkeeping) must be
+    # strictly below the synchronous row's — that reduction is structural
+    # (speculatively adopted steps contribute zero gap), unlike wall clock.
+    # tok/s gets the documented informational slack for CI CPU noise.
+    ov_off, ov_on = results[10], results[11]
+    for r in (ov_off, ov_on):
+        assert r["ms"] > 0 and r["tok_per_s"] > 0
+        assert r["requests"] == 4 and r["steps"] >= 24
+        assert r["token_latency_ms_p99"] >= r["token_latency_ms_p50"] > 0
+    assert ov_on["host_gap_ms_mean"] < ov_off["host_gap_ms_mean"], \
+        "overlap never closed the fetch->dispatch gap"
+    assert ov_on["host_gap_ms_p50"] <= ov_off["host_gap_ms_p50"]
+    assert ov_off["overlap_rebuilds"] == 0   # sync loop never speculates
+    assert ov_on["tok_per_s"] >= ov_off["tok_per_s"] * 0.85, \
+        "overlap-on decode throughput regressed beyond CI noise"
 
 
 def test_serve_bench_chaos():

@@ -127,6 +127,41 @@ class Offline:
 ''', "host-sync-in-step-path") == []
 
 
+class TestFetchOutsideCommit:
+    def test_fetch_in_step_helper_flags(self):
+        # a second device_get hidden in a build/commit helper: a stealth
+        # pipeline barrier — the exact thing the overlapped loop forbids
+        assert _rules('''
+import jax
+class InferenceEngine:
+    def step(self):
+        self._commit_rec()
+
+    def _commit_rec(self):
+        return int(jax.device_get(self._dev)[0])
+''', "fetch-outside-commit") == ["fetch-outside-commit"]
+
+    def test_fetch_inside_commit_helper_clean(self):
+        assert _rules('''
+import jax
+class InferenceEngine:
+    def step(self):
+        out = self._fetch_bundle([self._dev])
+
+    def _fetch_bundle(self, devs):
+        return jax.device_get(tuple(devs))
+''', "fetch-outside-commit") == []
+
+    def test_fetch_off_step_path_clean(self):
+        # tools/tests off the configured roots may fetch freely
+        assert _rules('''
+import jax
+class Exporter:
+    def snapshot(self):
+        return jax.device_get(self._dev)
+''', "fetch-outside-commit") == []
+
+
 class TestPrngKeyReuse:
     def test_double_consumption_flags(self):
         assert _rules('''
@@ -351,12 +386,13 @@ class TestSuppressions:
 
 
 class TestDriver:
-    def test_all_eight_rules_registered(self):
+    def test_all_nine_rules_registered(self):
         assert set(rule_registry()) == {
             "unbounded-compile-key", "use-after-donate",
-            "host-sync-in-step-path", "prng-key-reuse",
-            "cross-thread-engine-access", "unpaired-pool-mutation",
-            "unbounded-retry", "unregistered-metric-key"}
+            "host-sync-in-step-path", "fetch-outside-commit",
+            "prng-key-reuse", "cross-thread-engine-access",
+            "unpaired-pool-mutation", "unbounded-retry",
+            "unregistered-metric-key"}
 
     def test_unknown_rule_name_rejected(self):
         with pytest.raises(ValueError, match="unknown rule"):

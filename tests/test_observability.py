@@ -170,7 +170,8 @@ class TestTracedTokenExact:
             assert ev["trace_id"] == f"t{ev['id']}"
             bd = ev["latency_breakdown"]
             assert set(bd) == {"queued_ms", "prefill_ms", "decode_ms",
-                               "stalled_ms", "preemptions", "migrations"}
+                               "stalled_ms", "host_gap_ms", "preemptions",
+                               "migrations"}
             assert bd["prefill_ms"] > 0 and bd["decode_ms"] > 0
         # both requests were RUNNING at the crash -> both crash-migrated,
         # and the breakdown says so

@@ -146,6 +146,10 @@ def main(argv=None):
                          "deadline times out (0 = wait forever)")
     ap.add_argument("--no-logit-guard", action="store_true",
                     help="disable per-row non-finite logit detection")
+    ap.add_argument("--no-overlap", action="store_true",
+                    help="escape hatch: run the fully synchronous engine "
+                         "loop (one blocking fetch per step, no step kept "
+                         "in flight during host bookkeeping)")
     ap.add_argument("--spec", default="off",
                     choices=("off", "ngram", "draft"),
                     help="speculative decoding: 'ngram' self-drafts from the "
@@ -215,6 +219,7 @@ def main(argv=None):
             spec=args.spec, spec_k=args.spec_k,
             draft_model=draft_model, draft_params=draft_params,
             profiler=prof, trace=bool(args.trace),
+            overlap=not args.no_overlap,
             seed=args.seed)
 
     def build_supervisor(eng, idx=0):

@@ -96,7 +96,7 @@ import os
 import time
 from collections import Counter
 from contextlib import nullcontext
-from typing import Any, Dict, List, Optional, Sequence
+from typing import Any, Callable, Dict, List, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
@@ -114,6 +114,43 @@ from .prefix_cache import PrefixCache
 from .scheduler import (TERMINAL_STATES, AdmissionRejected, Request,
                         RequestState, Scheduler)
 from .tracing import Tracer
+
+
+@jax.jit
+def _splice_draft_row(toks, draft, row):
+    """Write a device-resident draft into row ``row`` of the step's token
+    matrix at column 1 (after next_token), entirely on-device. Jitted so the
+    column constant never becomes an eager host->device transfer under
+    TNN_DEBUG_SYNC=1."""
+    return jax.lax.dynamic_update_slice(toks, draft, (row, jnp.int32(1)))
+
+
+class StepInFlight:
+    """Handle for one dispatched-but-uncommitted engine step.
+
+    ``begin_step`` fills it with the step's flight-recorder note and one
+    record per launched program (device references only — nothing is
+    fetched at build time); ``finish_step`` fetches the single result
+    bundle and runs the commit phase against it. ``spec`` optionally holds
+    a speculatively dispatched successor step (see
+    ``InferenceEngine.try_speculate``)."""
+
+    __slots__ = ("step_seq", "note", "fired_before", "t0", "gen_before",
+                 "events", "recs", "done", "spec", "latency_s")
+
+    def __init__(self, step_seq: int, note: Dict[str, Any],
+                 fired_before: Optional[Counter], t0: float):
+        self.step_seq = step_seq
+        self.note = note
+        self.fired_before = fired_before
+        self.t0 = t0
+        self.gen_before: Dict[int, int] = {}
+        self.events: Dict[str, List] = {"tokens": [], "finished": [],
+                                        "failed": [], "timed_out": []}
+        self.recs: List[Dict[str, Any]] = []
+        self.done = False
+        self.spec: Optional[Dict[str, Any]] = None
+        self.latency_s = 0.0
 
 
 class InferenceEngine:
@@ -171,6 +208,19 @@ class InferenceEngine:
         Auto-creates a ``Profiler(source="engine")`` when none is given.
         Tracing is host-side only: traced runs are token-exact vs untraced
         and the TNN_DEBUG_SYNC transfer guard stays clean.
+    overlap : double-buffered engine loop. ``begin_step`` builds and
+        DISPATCHES a step without fetching its results; ``finish_step``
+        later fetches the step's one sampled-token/ok/accepts bundle and
+        commits it, and host bookkeeping nothing downstream depends on
+        (prefix publishes + their instants) lands on a deferred queue
+        (``run_deferred``) drained while the next step runs on-device.
+        The drive loops (``run_until_complete``, the supervisor tick) pair
+        begin/finish around the deferred work and may speculatively
+        dispatch step N+1 from predicted row states before step N commits
+        (``try_speculate``; mispredictions roll back and rebuild).
+        Token-exact vs overlap-off on every decode path — a direct
+        ``step()`` call stays fully synchronous either way. Default off;
+        ``tnn-serve`` turns it on (``--no-overlap`` opts out).
     """
 
     def __init__(self, model, params, *, num_blocks: int = 64,
@@ -188,7 +238,7 @@ class InferenceEngine:
                  spec: Any = "off", spec_k: int = 4,
                  draft_model=None, draft_params=None,
                  profiler: Optional[Profiler] = None, trace: bool = False,
-                 seed: int = 0):
+                 overlap: bool = False, seed: int = 0):
         if getattr(model, "kv_cache_dtype", None):
             raise ValueError(
                 "the paged pool stores compute-dtype pages; "
@@ -284,6 +334,16 @@ class InferenceEngine:
         self.tracer = Tracer(profiler if trace else None)
         self.step_seq = 0                   # monotonically counts step() calls
         self._step_note: Optional[Dict[str, Any]] = None
+        self._finished_note: Optional[Dict[str, Any]] = None
+        self.overlap = bool(overlap)
+        self._flight: Optional[StepInFlight] = None
+        self._deferred: List[Callable[[], None]] = []
+        # PRNG key stashed by an abandoned speculative dispatch; the rebuild
+        # reuses it so the key-consumption sequence matches overlap-off
+        self._reuse_key = None
+        self._t_fetch_done: Optional[float] = None
+        self._health_gauges: Dict[str, int] = {"queue_depth": 0,
+                                               "num_running": 0}
         self.requests: Dict[int, Request] = {}
         self._rid = itertools.count()
         self._key = jax.random.PRNGKey(seed)
@@ -432,6 +492,11 @@ class InferenceEngine:
         req.trace_id = trace_id if trace_id else f"t{rid}"
         self.requests[rid] = req
         self.scheduler.submit(req)
+        # keep /healthz honest between steps: an arrival bumps the cached
+        # gauges immediately instead of waiting for the next commit
+        self._health_gauges = {
+            "queue_depth": self.scheduler.queue_depth,
+            "num_running": len(self.scheduler.running)}
         if self.tracer.enabled:
             self.tracer.instant("serve.submit", trace=req.trace_id, rid=rid)
         return rid
@@ -576,9 +641,30 @@ class InferenceEngine:
         Every step also finalizes a flight-recorder record
         (``last_step_record``) — even when the step CRASHES, so a
         supervisor's post-mortem dump identifies the dying step's batch.
+
+        A ``step()`` call is always synchronous: when a step is already in
+        flight (an overlapped drive loop dispatched it) this finishes THAT
+        step; otherwise it runs begin+finish back to back. Either way the
+        deferred queue is drained before returning, so direct callers see
+        the pre-overlap engine exactly.
         """
+        if self._flight is None:
+            self.begin_step()
+        events = self.finish_step()
+        self.run_deferred()
+        return events
+
+    def begin_step(self) -> "StepInFlight":
+        """Build and DISPATCH one step without fetching its results:
+        deadline expiry, scheduling, admissions, input staging (explicit
+        ``device_put``) and the jitted launches all happen here; the one
+        device->host fetch is deferred to ``finish_step``. Raises
+        RuntimeError when a step is already in flight. A crash mid-build
+        still finalizes the dying step's flight-recorder note."""
+        if self._flight is not None:
+            raise RuntimeError(
+                "a step is already in flight — finish_step() first")
         self.step_seq += 1
-        t0 = time.perf_counter()
         fired_before = (Counter(self.faults.fired)
                         if self.faults is not None else None)
         # built BEFORE the step body runs: a crash fired at the very top of
@@ -591,33 +677,81 @@ class InferenceEngine:
             "programs": [],
         }
         self._step_note = note
-        gen_before = {r.rid: r.num_generated for r in self.scheduler.running
-                      if r.state is RequestState.RUNNING
-                      and r.cache_len >= r.prefill_len}
+        flight = StepInFlight(self.step_seq, note, fired_before,
+                              time.perf_counter())
+        flight.gen_before = {
+            r.rid: r.num_generated for r in self.scheduler.running
+            if r.state is RequestState.RUNNING
+            and r.cache_len >= r.prefill_len}
         try:
             with self._sync_guard():
-                events = self._step_inner()
+                self._build_step(flight)
+        except BaseException:
+            self._finalize_note(flight)
+            raise
+        self._flight = flight
+        return flight
+
+    def finish_step(self) -> Dict[str, List]:
+        """Fetch the in-flight step's result bundle — the step's ONE
+        ``jax.device_get`` — and run its commit phase: pool/scheduler
+        state, stop and length checks, event buckets. Finalizes the step's
+        flight-recorder note even when the commit crashes. Ends by
+        resolving a speculatively dispatched successor (adopt or roll
+        back), so afterwards ``in_flight`` is the adopted step or None."""
+        flight = self._flight
+        if flight is None:
+            raise RuntimeError("no step in flight")
+        try:
+            with self._sync_guard():
+                self._commit_step(flight)
         finally:
-            dt = time.perf_counter() - t0
-            note["step_latency_s"] = round(dt, 6)
-            note["pool_allocated"] = self.pool.num_allocated
-            note["pool_evictable"] = self.pool.num_evictable
-            if fired_before is None:
-                note["faults_fired"] = {}
-            else:
-                note["faults_fired"] = {
-                    k: int(v - fired_before.get(k, 0))
-                    for k, v in self.faults.fired.items()
-                    if v - fired_before.get(k, 0)}
-        self.metrics.observe_step_latency(dt)
+            flight.done = True
+            self._flight = None
+            self._finalize_note(flight)
+            self._finished_note = flight.note
+        self.metrics.observe_step_latency(flight.latency_s)
         # per-request stall attribution: a decode-phase row that survived the
         # step without committing a token spent the whole step stalled
         # (behind peer prefills in legacy mode, a retried fault, ...)
         for r in self.scheduler.running:
             if r.state is RequestState.RUNNING and \
-                    r.num_generated == gen_before.get(r.rid, -1):
-                r.stall_s += dt
-        return events
+                    r.num_generated == flight.gen_before.get(r.rid, -1):
+                r.stall_s += flight.latency_s
+        self._resolve_speculation(flight)
+        return flight.events
+
+    def run_deferred(self) -> int:
+        """Drain the deferred host-bookkeeping queue (prefix publishes and
+        their tracing instants — work no commit depends on). The
+        overlapped drive loops run this while the next step executes
+        on-device; the synchronous ``step()`` drains it before returning,
+        so overlap-off behavior is unchanged. Returns the items run."""
+        n = 0
+        while self._deferred:
+            self._deferred.pop(0)()
+            n += 1
+        return n
+
+    @property
+    def in_flight(self) -> Optional["StepInFlight"]:
+        """The dispatched-but-uncommitted step, when one is pending."""
+        return self._flight
+
+    def _finalize_note(self, flight: "StepInFlight") -> None:
+        dt = time.perf_counter() - flight.t0
+        flight.latency_s = dt
+        note = flight.note
+        note["step_latency_s"] = round(dt, 6)
+        note["pool_allocated"] = self.pool.num_allocated
+        note["pool_evictable"] = self.pool.num_evictable
+        if flight.fired_before is None:
+            note["faults_fired"] = {}
+        else:
+            note["faults_fired"] = {
+                k: int(v - flight.fired_before.get(k, 0))
+                for k, v in self.faults.fired.items()
+                if v - flight.fired_before.get(k, 0)}
 
     def last_step_record(self) -> Optional[Dict[str, Any]]:
         """Flight-recorder record of the most recent step: per-program kind
@@ -626,6 +760,14 @@ class InferenceEngine:
         A crashing step still finalizes its record — the last line of a
         supervisor crash dump is the step that died."""
         return dict(self._step_note) if self._step_note is not None else None
+
+    def last_finished_record(self) -> Optional[Dict[str, Any]]:
+        """Flight-recorder record of the most recent FINISHED step. Under
+        overlap the newest note (``last_step_record``) may belong to a
+        still-in-flight — possibly speculative — step that a supervisor
+        must not record yet; a crash dump still wants the newest."""
+        return (dict(self._finished_note)
+                if self._finished_note is not None else None)
 
     def _note_program(self, kind: str, key, rids: List[int],
                       fill: float) -> None:
@@ -650,9 +792,12 @@ class InferenceEngine:
         replacement for the implicit jnp.asarray commit at dispatch)."""
         return jax.device_put(np.asarray(x, dtype))
 
-    def _step_inner(self) -> Dict[str, List]:
-        events: Dict[str, List] = {"tokens": [], "finished": [],
-                                   "failed": [], "timed_out": []}
+    def _build_step(self, flight: "StepInFlight") -> None:
+        """The build/dispatch phase: everything up to and including the
+        jitted launches. Pool pages returned by each launch are adopted at
+        DISPATCH time (``update_pages``) so the donation chain stays valid
+        when another step is dispatched before this one's fetch."""
+        events = flight.events
         if self.faults is not None:
             self.faults.on_step()
         self._enforce_deadlines(events)
@@ -668,15 +813,41 @@ class InferenceEngine:
                     # (a COW alloc fault may have fallen back to uncached)
                     chunks[req.rid] = min(chunks[req.rid],
                                           req.prefill_len - req.cache_len)
-            self._mixed_step(chunks, events)
+            self._mixed_build(chunks, flight)
         else:
+            # legacy whole-prompt mode: prefills dispatch alongside the
+            # decode launch and commit from the same fetch bundle — a row
+            # admitted this step takes its first decode token NEXT step
+            # (final outputs are unchanged; only step attribution moves)
             for req in plan.prefills:
-                self._prefill(req, events)
+                rec = self._prefill_build(req, events)
+                if rec is not None:
+                    flight.recs.append(rec)
             self._ensure_decode_capacity(events)
             live = [r for r in self.scheduler.running
-                    if r.state is RequestState.RUNNING]
+                    if r.state is RequestState.RUNNING
+                    and r.cache_len >= r.prefill_len]
             if live:
-                self._decode(live, events)
+                rec = self._decode_build(live, events)
+                if rec is not None:
+                    flight.recs.append(rec)
+
+    def _commit_step(self, flight: "StepInFlight") -> None:
+        """The commit phase: ONE batched fetch of the step's small
+        sampled-token/ok/accepts bundle (never logits), then the minimal
+        host bookkeeping that must precede building the next step.
+        Deferrable work (prefix publishes) lands on ``self._deferred``."""
+        events = flight.events
+        if flight.recs:
+            try:
+                fetched = self._fetch_bundle(
+                    [rec["dev"] for rec in flight.recs])
+            except Exception as e:  # noqa: BLE001 — isolate, don't crash
+                self._abort_flight(flight, f"step fetch failed: {e}")
+                fetched = None
+            if fetched is not None:
+                for rec, out in zip(flight.recs, fetched):
+                    self._commit_rec(rec, out, events)
         if not any(r.state is RequestState.RUNNING
                    and r.cache_len >= r.prefill_len
                    for r in self.scheduler.running):
@@ -685,7 +856,275 @@ class InferenceEngine:
             self._last_decode_emit = None
         self.metrics.observe_gauges(self.scheduler.queue_depth,
                                     self.pool.occupancy)
-        return events
+        # host-side health gauges, cached at commit: /healthz answers from
+        # the supervisor's copy without ever reaching into the engine
+        self._health_gauges = {
+            "queue_depth": self.scheduler.queue_depth,
+            "num_running": len(self.scheduler.running)}
+
+    def _fetch_bundle(self, devs: List[Any]):
+        """The step's single designated device->host fetch (the
+        ``fetch-outside-commit`` lint rule pins every ``jax.device_get``
+        on the step path to this helper): one batched transfer returns
+        every launched program's sampled-token/ok/accepts bundle."""
+        with profiled("serve.fetch", EventType.COMPUTE, self.profiler):
+            out = jax.device_get(tuple(devs))
+        self._t_fetch_done = time.perf_counter()
+        return out
+
+    def _commit_rec(self, rec: Dict[str, Any], out, events) -> None:
+        kind = rec["kind"]
+        if kind == "prefill":
+            self._prefill_commit(rec, out, events)
+        elif kind == "decode":
+            self._decode_commit(rec, out, events)
+        else:
+            self._mixed_commit(rec, out, events)
+
+    def _abort_flight(self, flight: "StepInFlight", error: str) -> None:
+        """Bundle-fetch failure: unattributable to one row, so every row
+        the flight touched fails (legacy prefill rows not yet admitted
+        included) and the pool pages are recovered."""
+        rows: List[Request] = []
+        for rec in flight.recs:
+            if rec["kind"] == "prefill":
+                req = rec["req"]
+                if req.state not in TERMINAL_STATES:
+                    self._terminate(req, RequestState.FAILED, error,
+                                    flight.events, "failed")
+            else:
+                rows.extend(rec.get("live") or rec.get("rows") or [])
+        self._abort_batch(rows, error, flight.events)
+
+    def _mark_dispatch(self) -> None:
+        """Stamp the step's first jitted launch: the wall gap since the
+        previous bundle fetch is the host gap the overlapped loop exists
+        to close. First launch of a step consumes the stamp; speculative
+        dispatches record a zero gap at adoption instead."""
+        t = self._t_fetch_done
+        if t is None:
+            return
+        self._t_fetch_done = None
+        gap = time.perf_counter() - t
+        self.metrics.observe_host_gap(gap)
+        for r in self.scheduler.running:
+            if r.state is RequestState.RUNNING:
+                r.host_gap_s += gap
+        if self.tracer.enabled:
+            self.tracer.instant("serve.host_gap", step=self.step_seq,
+                                ms=round(gap * 1e3, 3))
+
+    def _step_key(self):
+        """The step's PRNG key: normally the next split, but a rebuild
+        after an abandoned speculative dispatch REUSES the abandoned
+        step's key, so the engine's key-consumption sequence (and thus
+        every stochastic sample) matches the overlap-off engine exactly."""
+        if self._reuse_key is not None:
+            key, self._reuse_key = self._reuse_key, None
+            return key
+        return self._next_key()
+
+    # -- speculative step pipelining ------------------------------------------
+
+    def try_speculate(self) -> bool:
+        """Speculatively build and dispatch step N+1 while step N is still
+        in flight. Legal only when N+1's build is fully determined by
+        committed state plus N's (unfetched) sampled tokens: a pure decode
+        batch whose every row must survive the commit — no stop tokens, no
+        deadlines, headroom for two more tokens — with KV growth that fits
+        the pool without preemption, no drafter, no fault plan, and an
+        empty wait queue. The dispatched program reads step N's sampled
+        tokens DIRECTLY as its device-resident inputs, so nothing syncs;
+        ``finish_step`` validates the prediction and either adopts the
+        dispatch as the next in-flight step or rolls it back
+        (``_resolve_speculation``). Returns True when a step was
+        dispatched. Abandoned KV writes are harmless: they land at
+        positions at or past every surviving row's committed length, or in
+        blocks the rollback frees — always overwritten before attended."""
+        flight = self._flight
+        if (not self.overlap or flight is None or flight.done
+                or flight.spec is not None or self.faults is not None
+                or self.drafter is not None or self.scheduler.waiting
+                or len(flight.recs) != 1
+                or flight.recs[0]["kind"] != "decode"
+                or not (self._paged or self._fused is None)):
+            return False
+        rec = flight.recs[0]
+        live = rec["live"]
+        if live != [r for r in self.scheduler.running
+                    if r.state is RequestState.RUNNING]:
+            return False
+        grows = []
+        for req in live:
+            if (req.state is not RequestState.RUNNING
+                    or req.cache_len < req.prefill_len
+                    or req.stop_token is not None
+                    or req.deadline_s is not None
+                    or req.num_generated + 1 >= req.max_new_tokens
+                    or req.cache_len + 2 > self.max_seq_len):
+                return False
+            grows.append(max(0, self.pool.blocks_for(req.cache_len + 2)
+                             - len(req.block_table)))
+        if sum(grows) and not self.pool.can_alloc(sum(grows)):
+            return False
+        rollback: List[Any] = []
+        try:
+            for req, g in zip(live, grows):
+                if g:
+                    ext = self.pool.alloc(g)
+                    rollback.append((req, len(req.block_table), ext))
+                    req.block_table.extend(ext)
+        except PoolExhausted:
+            for req, orig, ext in rollback:
+                self.pool.free(ext)
+                del req.block_table[orig:]
+            return False
+        b = self.scheduler.max_batch_size
+        nb = self.blocks_per_seq
+        offsets = np.zeros((b,), np.int32)
+        tables = np.full((b, nb), PagedKVPool.SCRATCH, np.int32)
+        temps = np.zeros((b,), np.float32)
+        topks = np.zeros((b,), np.int32)
+        topps = np.zeros((b,), np.float32)
+        poison = np.zeros((b,), np.float32)
+        for i, req in enumerate(live):
+            # the predicted row state: exactly one token committed at N
+            offsets[i] = req.cache_len + 1
+            tables[i, :len(req.block_table)] = req.block_table
+            temps[i] = req.temperature
+            topks[i] = req.top_k
+            topps[i] = req.top_p
+        key = ("pdecode", b, nb) if self._paged else ("decode", b, nb)
+        label = "decode_paged" if self._paged else "decode"
+        fn = self._jit.get(key)
+        if fn is None:
+            fn = self._jit[key] = (self._paged_decode_fn(b, nb)
+                                   if self._paged else self._decode_fn(b, nb))
+        step_key = self._step_key()
+        t0 = time.perf_counter()
+        prev_tok = rec["dev"][0]     # step N's unfetched sampled tokens
+        try:
+            with self._sync_guard(), \
+                    profiled("serve.decode_spec", EventType.COMPUTE,
+                             self.profiler):
+                newtok, ok, pk, pv = fn(
+                    self.params, self.pool.pages_k, self.pool.pages_v,
+                    prev_tok, self._put(offsets), self._put(tables),
+                    self._put(temps), self._put(topks), self._put(topps),
+                    step_key, self._put(poison))
+        except Exception:  # noqa: BLE001 — speculation must never hurt
+            for req, orig, ext in rollback:
+                self.pool.free(ext)
+                del req.block_table[orig:]
+            self._reuse_key = step_key
+            self._recover_pages_if_dead(flight.events)
+            return False
+        self.pool.update_pages(pk, pv)
+        flight.spec = {
+            "rec": {"kind": "decode", "dev": (newtok, ok),
+                    "live": list(live), "t0": t0, "b": b},
+            "rollback": rollback, "key": step_key,
+            "offsets": {r.rid: int(offsets[i])
+                        for i, r in enumerate(live)},
+            "prog": {"kind": label, "compile_key": list(key),
+                     "rids": [r.rid for r in live],
+                     "fill": round(len(live) / b, 4)},
+        }
+        return True
+
+    def _resolve_speculation(self, flight: "StepInFlight") -> None:
+        """After ``flight`` committed: adopt its speculative successor when
+        the prediction held (the same rows, each exactly one token longer,
+        still running, queue still empty), else roll the dispatch back —
+        free the pre-grown blocks, stash the PRNG key for reuse, and let
+        the next ``begin_step`` rebuild from committed state."""
+        spec = flight.spec
+        if spec is None:
+            return
+        flight.spec = None
+        rec = spec["rec"]
+        live = rec["live"]
+        predicted = (
+            not self.scheduler.waiting
+            and live == [r for r in self.scheduler.running
+                         if r.state is RequestState.RUNNING]
+            and all(req.cache_len == spec["offsets"][req.rid]
+                    for req in live))
+        if not predicted:
+            for req, orig, ext in spec["rollback"]:
+                if req.block_table[orig:orig + len(ext)] == ext:
+                    # a terminated/preempted row already freed its whole
+                    # table (extension included); only intact tables still
+                    # own the speculative growth
+                    self.pool.free(ext)
+                    del req.block_table[orig:]
+            self._reuse_key = spec["key"]
+            self.metrics.observe_overlap_rebuild()
+            return
+        # prediction held: the dispatched step IS the next step — give it
+        # its step_seq and flight-recorder note at adoption time
+        self.step_seq += 1
+        note: Dict[str, Any] = {
+            "step_seq": self.step_seq,
+            "queued": self.scheduler.queue_depth,
+            "running_rids": [r.rid for r in live],
+            "programs": [dict(spec["prog"])],
+            "speculative": True,
+        }
+        self._step_note = note
+        nxt = StepInFlight(self.step_seq, note, None, rec["t0"])
+        nxt.gen_before = {r.rid: r.num_generated for r in live}
+        nxt.recs.append(rec)
+        self._flight = nxt
+        # the dispatch preceded the fetch it would have waited for: the
+        # adopted step's host gap is zero by construction
+        self.metrics.observe_host_gap(0.0)
+        self._t_fetch_done = None
+
+    def _defer_publish(self, req: Request) -> None:
+        """Queue a prefix-cache publish for the deferred phase. The
+        snapshot is validated when it runs: the request must still be
+        RUNNING with the snapshotted table prefix intact — a termination,
+        preemption, or pool reset between commit and the deferred run
+        makes the publish a silent no-op (its blocks may already be
+        reused). A request that finishes NORMALLY in the same commit
+        flushes its own queue first (``_flush_deferred_for``), so a
+        short request's prefix is still indexed. Under overlap the index
+        therefore lags the step stream by at most one step; matching is
+        probe-only, so outputs are unaffected."""
+        cache = self.prefix_cache
+        tokens = req.resume_tokens
+        snap = list(req.block_table)
+        clen = req.cache_len
+        step = self.step_seq
+
+        def run() -> None:
+            if (req.state is not RequestState.RUNNING
+                    or req.cache_len < clen
+                    or req.block_table[:len(snap)] != snap):
+                return
+            cache.publish(tokens, snap, clen)
+            if self.tracer.enabled:
+                self.tracer.instant("serve.publish", trace=req.trace_id,
+                                    rid=req.rid, step=step)
+
+        run.rid = req.rid
+        self._deferred.append(run)
+
+    def _flush_deferred_for(self, req: Request) -> None:
+        """Run this request's queued publishes NOW, ahead of the deferred
+        phase. Called on the normal-finish path before the blocks are
+        freed: the snapshot is still valid at this instant, but would be
+        silently dropped by the deferred-phase guard once the pool
+        reclaims the table (a request can fill its last block and finish
+        inside the same commit)."""
+        keep = []
+        for fn in self._deferred:
+            if getattr(fn, "rid", None) == req.rid:
+                fn()
+            else:
+                keep.append(fn)
+        self._deferred = keep
 
     def _enforce_deadlines(self, events: Dict[str, List]) -> None:
         now = time.perf_counter()
@@ -712,13 +1151,23 @@ class InferenceEngine:
 
     def run_until_complete(self, max_steps: int = 100_000) -> Dict[int, List[int]]:
         """Drive steps until every submitted request finished; returns
-        {rid: generated tokens}."""
+        {rid: generated tokens}. With ``overlap`` on this is the
+        overlapped drive loop: dispatch, speculate, deferred bookkeeping,
+        then fetch+commit — a step stays in flight while the host works."""
         steps = 0
-        while self.has_work:
-            self.step()
+        while self.has_work or self._flight is not None:
+            if self.overlap:
+                if self._flight is None:
+                    self.begin_step()
+                self.try_speculate()
+                self.run_deferred()
+                self.finish_step()
+            else:
+                self.step()
             steps += 1
             if steps > max_steps:
                 raise RuntimeError(f"no convergence after {max_steps} steps")
+        self.run_deferred()
         return {rid: list(r.out_tokens) for rid, r in self.requests.items()
                 if r.state is RequestState.FINISHED}
 
@@ -749,7 +1198,11 @@ class InferenceEngine:
         # instead of copying the whole pool per prefill
         return jax.jit(fn, donate_argnums=(1, 2))
 
-    def _prefill(self, req: Request, events) -> None:
+    def _prefill_build(self, req: Request, events) -> Optional[Dict[str, Any]]:
+        """Legacy whole-prompt prefill, build/dispatch half: allocate the
+        prompt's blocks, launch the bucketed prefill program, adopt its
+        pages. Returns the flight record whose device refs
+        ``_prefill_commit`` consumes — or None when the row failed."""
         t0 = time.perf_counter()
         seq = req.resume_tokens
         bs = self.pool.block_size
@@ -761,7 +1214,7 @@ class InferenceEngine:
                 req, RequestState.FAILED,
                 f"oversized resume: {len(seq)} tokens need {nb} blocks > "
                 f"assembly capacity {self.blocks_per_seq}", events, "failed")
-            return
+            return None
         try:
             if self.faults is not None:
                 self.faults.on_prefill()
@@ -769,7 +1222,7 @@ class InferenceEngine:
         except (PoolExhausted, FaultInjected) as e:
             self._terminate(req, RequestState.FAILED,
                             f"prefill failed: {e}", events, "failed")
-            return
+            return None
         # bucket the COMPILED width to the next power of two (capped at the
         # assembly width) so N distinct prompt lengths cost O(log N) compiles,
         # not one each; only the nb real blocks are allocated — the bucket's
@@ -789,6 +1242,7 @@ class InferenceEngine:
         if fn is None:
             fn = self._jit[key] = self._prefill_fn(padded, nb_bucket)
         try:
+            self._mark_dispatch()
             with profiled("serve.prefill", EventType.COMPUTE,
                           self.profiler):
                 tok, ok, pk, pv = fn(
@@ -798,29 +1252,38 @@ class InferenceEngine:
                               jnp.int32),
                     self._put(req.temperature, jnp.float32),
                     self._put(req.top_k, jnp.int32),
-                    self._put(req.top_p, jnp.float32), self._next_key(),
+                    self._put(req.top_p, jnp.float32), self._step_key(),
                     self._put(poison))
-                # one explicit batched fetch instead of two implicit syncs
-                tok, ok = jax.device_get((tok, ok))
-                tok, ok = int(tok), bool(ok)
         except Exception as e:  # noqa: BLE001 — isolate, don't crash serving
             self._terminate(req, RequestState.FAILED,
                             f"prefill step failed: {e}", events, "failed")
             self._recover_pages_if_dead(events)
-            return
+            return None
+        # pages adopted at dispatch: the decode launch sharing this step's
+        # fetch bundle consumes them next in the donation chain
         self.pool.update_pages(pk, pv)
+        return {"kind": "prefill", "dev": (tok, ok), "req": req, "t0": t0,
+                "seq_len": len(seq)}
+
+    def _prefill_commit(self, rec: Dict[str, Any], out, events) -> None:
+        """Legacy prefill commit half: consumes the fetched (token, ok)
+        pair, admits the row, and emits its first token."""
+        req = rec["req"]
+        if req.state in TERMINAL_STATES:
+            return                      # cancelled/expired while in flight
+        tok, ok = int(out[0]), bool(out[1])
         if self.logit_guard and not ok:
             self._terminate(req, RequestState.FAILED,
                             "non-finite logits in prefill", events, "failed")
             return
-        req.cache_len = len(seq)
+        req.cache_len = rec["seq_len"]
         # queue wait closes at t0 (prefill launch), so the whole-prompt
         # forward lands in prefill_s, not queued_s
-        self._note_admit(req, t0)
+        self._note_admit(req, rec["t0"])
         self.scheduler.admit(req)
         now = time.perf_counter()
         self._note_prefill_done(req, now)
-        self.metrics.observe_prefill(len(seq), now - t0)
+        self.metrics.observe_prefill(rec["seq_len"], now - rec["t0"])
         if req.out_tokens:
             # preemption recovery: the pending next_token survives; the
             # prefill's own sample is redundant (greedy: identical) — drop it
@@ -978,8 +1441,14 @@ class InferenceEngine:
         the position cap; empty proposals are dropped (those rows ride the
         same step as plain single-token decode rows). Also routes the
         ``draft.poison`` chaos site — a corrupted draft must cost acceptance
-        rate only, never output exactness."""
-        drafts: Dict[int, List[int]] = {}
+        rate only, never output exactness.
+
+        A drafter may return a host token list OR a
+        ``spec_decode.DeviceDraft`` (device-resident, already
+        vocab-clamped): device drafts never force a sync — their values
+        are spliced into the step's token matrix on-device and come back
+        to the host through the step's single fetch bundle."""
+        drafts: Dict[int, Any] = {}
         vocab = self.model.vocab_size
         for req in self.scheduler.running:
             if req.state is not RequestState.RUNNING or \
@@ -990,27 +1459,36 @@ class InferenceEngine:
                     self.max_seq_len - req.cache_len - 1)
             if k < 1:
                 continue
-            d = [int(t) % vocab for t in self.drafter.draft(req, k)][:k]
-            if not d:
+            d = self.drafter.draft(req, k)
+            if not isinstance(d, spec_decode.DeviceDraft):
+                d = [int(t) % vocab for t in d][:k]
+            if not len(d):
                 continue
             if self.faults is not None and self.faults.poison_draft():
-                d = [(t + 1) % vocab for t in d]
+                if isinstance(d, spec_decode.DeviceDraft):
+                    d = d.shifted(self._put(1, jnp.int32),
+                                  self._put(vocab, jnp.int32))
+                else:
+                    d = [(t + 1) % vocab for t in d]
             drafts[req.rid] = d
         return drafts
 
-    def _mixed_step(self, chunks: Dict[int, int], events) -> None:
-        """One packed step: every decode-phase running row takes 1 token and
-        every mid-prefill row with a chunk grant pushes its next prompt
-        chunk, all inside ONE compiled program keyed on the power-of-two
-        bucket of the widest chunk. Steps with no chunk work delegate to the
-        legacy pure-decode program, so decode streams are bit-identical to
-        the pre-chunking engine.
+    def _mixed_build(self, chunks: Dict[int, int],
+                     flight: "StepInFlight") -> None:
+        """One packed step, build/dispatch half: every decode-phase running
+        row takes 1 token and every mid-prefill row with a chunk grant
+        pushes its next prompt chunk, all inside ONE compiled program keyed
+        on the power-of-two bucket of the widest chunk. Steps with no chunk
+        work delegate to the legacy pure-decode program, so decode streams
+        are bit-identical to the pre-chunking engine. ``_mixed_commit``
+        consumes the launch's fetched bundle.
 
         With a drafter installed, decode rows additionally carry their
         speculative lookahead as extra ragged positions (``q_len = 1 + k``)
         through the SAME launch; verification, accept/rollback, and the
         spec-off paths below stay byte-identical to the non-speculative
         engine for greedy requests."""
+        events = flight.events
         t0 = time.perf_counter()
         spec_on = self.drafter is not None
         has_chunks = any(
@@ -1021,7 +1499,9 @@ class InferenceEngine:
             live = [r for r in self.scheduler.running
                     if r.state is RequestState.RUNNING]
             if live:
-                self._decode(live, events)
+                rec = self._decode_build(live, events)
+                if rec is not None:
+                    flight.recs.append(rec)
             return
         # drafts are proposed BEFORE the capacity pass so decode rows can
         # reserve KV headroom for every drafted position up front
@@ -1062,11 +1542,11 @@ class InferenceEngine:
             # bit-identical and cheaper. Zero-draft rows still count in the
             # spec denominator so acceptance stats stay honest.
             if dec:
-                before = len(events["tokens"])
-                self._decode(dec, events)
-                if spec_on:
-                    self.metrics.observe_spec(
-                        0, 0, len(events["tokens"]) - before, rows=len(dec))
+                rec = self._decode_build(dec, events)
+                if rec is not None:
+                    if spec_on:
+                        rec["spec_rows"] = len(dec)
+                    flight.recs.append(rec)
             return
         rows = dec + [r for r, _ in chk]
         takes = {r.rid: t for r, t in chk}
@@ -1087,6 +1567,7 @@ class InferenceEngine:
         topks = np.zeros((b,), np.int32)
         topps = np.zeros((b,), np.float32)
         poison = np.zeros((b,), np.float32)
+        dev_drafts: List[Any] = []      # (row index, DeviceDraft) splices
         for i, req in enumerate(rows):
             starts[i] = req.cache_len
             tables[i, :len(req.block_table)] = req.block_table
@@ -1096,7 +1577,9 @@ class InferenceEngine:
             if i < len(dec):
                 d = drafts.get(req.rid, []) if spec_on else []
                 toks[i, 0] = req.next_token
-                if d:
+                if isinstance(d, spec_decode.DeviceDraft):
+                    dev_drafts.append((i, d))
+                elif d:
                     toks[i, 1:1 + len(d)] = d
                 q_lens[i] = 1 + len(d)
                 n_draft[i] = len(d)
@@ -1124,9 +1607,18 @@ class InferenceEngine:
                 fn = self._jit[key] = (
                     self._mixed_paged_fn(b, qw, nb) if self._paged
                     else self._mixed_standard_fn(b, qw, nb))
+        toks_in = self._put(toks)
+        for i, dd in dev_drafts:
+            # splice device-resident drafts into the token matrix without
+            # fetching them. The commit reads draft VALUES back from the
+            # fetched token matrix, so host and device drafts commit
+            # identically.
+            toks_in = _splice_draft_row(toks_in, dd.toks[None, :],
+                                        self._put(i, jnp.int32))
         # one key per STEP (held across the retry): a transient fault retried
         # with the same key reproduces the fault-free step bit-for-bit
-        step_key = self._next_key()
+        step_key = self._step_key()
+        self._mark_dispatch()
         for attempt in (0, 1):
             try:
                 if self.faults is not None:
@@ -1136,22 +1628,18 @@ class InferenceEngine:
                     if spec_on:
                         accepts, newtok, ok, pk, pv = fn(
                             self.params, self.pool.pages_k, self.pool.pages_v,
-                            self._put(toks), self._put(starts),
+                            toks_in, self._put(starts),
                             self._put(q_lens), self._put(tables),
                             self._put(n_draft), self._put(temps),
                             self._put(topks), self._put(topps), step_key,
                             self._put(poison))
-                        # one explicit batched fetch instead of three syncs
-                        accepts, newtok, ok = jax.device_get(
-                            (accepts, newtok, ok))
                     else:
                         newtok, ok, pk, pv = fn(
                             self.params, self.pool.pages_k, self.pool.pages_v,
-                            self._put(toks), self._put(starts),
+                            toks_in, self._put(starts),
                             self._put(q_lens), self._put(tables),
                             self._put(temps), self._put(topks),
                             self._put(topps), step_key, self._put(poison))
-                        newtok, ok = jax.device_get((newtok, ok))
                 break
             except FaultInjected as e:
                 # injected pre-call: donated buffers untouched, retryable
@@ -1164,10 +1652,33 @@ class InferenceEngine:
                 self._abort_batch(rows, f"decode step failed: {e}", events)
                 return
         self.pool.update_pages(pk, pv)
+        flight.recs.append({
+            "kind": "spec" if spec_on else "mixed",
+            "dev": ((accepts, newtok, ok, toks_in) if spec_on
+                    else (newtok, ok)),
+            "rows": rows, "n_dec": len(dec), "takes": takes,
+            "n_draft": n_draft, "n_spec": n_spec, "t0": t0, "b": b,
+            "qw": qw})
+
+    def _mixed_commit(self, rec: Dict[str, Any], out, events) -> None:
+        """Mixed/spec step commit half: consumes the fetched bundle —
+        ``(accepts, newtok, ok, token_matrix)`` for spec steps (the token
+        matrix carries the drafted values back, so device drafts never
+        synced), ``(newtok, ok)`` otherwise."""
+        spec_on = rec["kind"] == "spec"
+        if spec_on:
+            accepts, newtok, ok, toks_f = out
+        else:
+            newtok, ok = out
+        rows = rec["rows"]
+        takes = rec["takes"]
+        n_draft = rec["n_draft"]
         now = time.perf_counter()
-        n_dec = len(dec)
+        n_dec = rec["n_dec"]
         n_committed = 0
         for i, req in enumerate(rows):
+            if req.state in TERMINAL_STATES:
+                continue                # cancelled/expired while in flight
             if self.logit_guard and not bool(ok[i]):
                 self._terminate(
                     req, RequestState.FAILED,
@@ -1188,11 +1699,13 @@ class InferenceEngine:
                 # accepted-prefix commit: replay the sequential emit for the
                 # a accepted drafts plus the verifier's bonus/correction
                 # token, stopping at the first finish exactly where
-                # token-by-token decode would have stopped
-                d = drafts.get(req.rid, [])
+                # token-by-token decode would have stopped. Draft values
+                # read back from the fetched token matrix.
+                nd = int(n_draft[i])
                 a = int(accepts[i])
                 emitted = 0
-                for tok in [int(x) for x in d[:a]] + [int(newtok[i])]:
+                for tok in [int(x) for x in toks_f[i, 1:1 + a]] + \
+                        [int(newtok[i])]:
                     req.cache_len += 1
                     req.next_token = tok
                     req.out_tokens.append(tok)
@@ -1201,7 +1714,7 @@ class InferenceEngine:
                     self._maybe_finish(req, tok, events)
                     if req.state is not RequestState.RUNNING:
                         break
-                self.metrics.observe_spec(len(d), a, emitted)
+                self.metrics.observe_spec(nd, a, emitted)
                 n_committed += emitted
                 if req.state is RequestState.RUNNING and req.block_table:
                     # rejected-draft rollback: free the KV blocks past the
@@ -1225,15 +1738,13 @@ class InferenceEngine:
                 # pressure publishing is suspended (degradation mode): a
                 # bigger evictable set would just churn reclaims while live
                 # requests are fighting for blocks. Matching stays on.
+                # The suspension DECISION is taken at commit time; the
+                # publish itself (index walk + hashing) is deferred off the
+                # step critical path and re-validated when it runs.
                 if self.pool.occupancy > self.prefix_publish_max_occupancy:
                     self.metrics.observe_publish_suspended()
                 else:
-                    self.prefix_cache.publish(req.resume_tokens,
-                                              req.block_table, req.cache_len)
-                    if self.tracer.enabled:
-                        self.tracer.instant("serve.publish",
-                                            trace=req.trace_id, rid=req.rid,
-                                            step=self.step_seq)
+                    self._defer_publish(req)
             if req.cache_len < req.prefill_len:
                 continue            # more chunks to go; no token yet
             self._note_prefill_done(req, now)
@@ -1252,12 +1763,13 @@ class InferenceEngine:
             events["tokens"].append((req.rid, tok))
             self._maybe_finish(req, tok, events)
         self.metrics.observe_mixed_step(
-            n_dec + n_spec + sum(takes.values()), b * qw)
+            n_dec + rec["n_spec"] + sum(takes.values()),
+            rec["b"] * rec["qw"])
         if n_dec:
             self._mark_decode_emit()
             self.metrics.observe_decode(
                 n_committed if spec_on else n_dec,
-                time.perf_counter() - t0, b)
+                time.perf_counter() - rec["t0"], rec["b"])
 
     def _mixed_paged_fn(self, b: int, qw: int, nb: int):
         model = self.model
@@ -1537,7 +2049,12 @@ class InferenceEngine:
 
         return jax.jit(fn, donate_argnums=(2, 3))
 
-    def _decode(self, live: Sequence[Request], events) -> None:
+    def _decode_build(self, live: Sequence[Request],
+                      events) -> Optional[Dict[str, Any]]:
+        """Pure-decode build/dispatch half: stage the batch, launch the
+        selected decode program, adopt its pages. Returns the flight
+        record ``_decode_commit`` consumes — or None when the batch
+        aborted."""
         t0 = time.perf_counter()
         b = self.scheduler.max_batch_size
         nb = self.blocks_per_seq
@@ -1579,7 +2096,8 @@ class InferenceEngine:
                 else self._decode_fn(b, nb))
         # one key per STEP (held across the retry): a transient fault retried
         # with the same key reproduces the fault-free step bit-for-bit
-        step_key = self._next_key()
+        step_key = self._step_key()
+        self._mark_dispatch()
         for attempt in (0, 1):
             try:
                 if self.faults is not None:
@@ -1601,8 +2119,6 @@ class InferenceEngine:
                             self._put(tables), self._put(temps),
                             self._put(topks), self._put(topps), step_key,
                             self._put(poison))
-                    # one explicit batched fetch instead of two syncs
-                    newtok, ok = jax.device_get((newtok, ok))
                 break
             except FaultInjected as e:
                 # injected pre-call: donated buffers untouched, retryable
@@ -1610,14 +2126,25 @@ class InferenceEngine:
                     self.metrics.observe_step_retry()
                     continue
                 self._abort_batch(live, f"decode step failed: {e}", events)
-                return
+                return None
             except Exception as e:  # noqa: BLE001 — a real step failure may
                 # have consumed the donated pages: unattributable, so the
                 # live batch aborts but the engine survives for queued work
                 self._abort_batch(live, f"decode step failed: {e}", events)
-                return
+                return None
         self.pool.update_pages(pk, pv)
+        return {"kind": "decode", "dev": (newtok, ok), "live": list(live),
+                "t0": t0, "b": b}
+
+    def _decode_commit(self, rec: Dict[str, Any], out, events) -> None:
+        """Pure-decode commit half: consumes the fetched (tokens, ok)
+        pair and replays the per-row token commit."""
+        newtok, ok = out
+        live = rec["live"]
+        emitted = 0
         for i, req in enumerate(live):
+            if req.state in TERMINAL_STATES:
+                continue                # cancelled/expired while in flight
             if self.logit_guard and not bool(ok[i]):
                 # poisoned row: only this request fails — its sampled token
                 # is garbage and its KV blocks are freed; the other rows'
@@ -1632,8 +2159,16 @@ class InferenceEngine:
             req.out_tokens.append(tok)
             events["tokens"].append((req.rid, tok))
             self._maybe_finish(req, tok, events)
+            emitted += 1
         self._mark_decode_emit()
-        self.metrics.observe_decode(len(live), time.perf_counter() - t0, b)
+        self.metrics.observe_decode(len(live),
+                                    time.perf_counter() - rec["t0"],
+                                    rec["b"])
+        if rec.get("spec_rows"):
+            # a spec-enabled step that proposed zero drafts ran the plain
+            # decode program; its rows still count in the acceptance
+            # denominator so spec stats stay honest
+            self.metrics.observe_spec(0, 0, emitted, rows=rec["spec_rows"])
 
     def _abort_batch(self, live: Sequence[Request], error: str,
                      events) -> None:
@@ -1673,6 +2208,12 @@ class InferenceEngine:
 
         Returns step-shaped event buckets so callers can report the
         terminations the way ``step()`` would have."""
+        # an in-flight or speculative step cannot survive recovery: its
+        # device results are garbage once rows terminate (and pages reset),
+        # and deferred publishes must never index reclaimed blocks
+        self._flight = None
+        self._deferred.clear()
+        self._reuse_key = None
         events: Dict[str, List] = {"tokens": [], "finished": [],
                                    "failed": [], "timed_out": []}
         bucket = "timed_out" if state is RequestState.TIMED_OUT else "failed"
@@ -1705,6 +2246,10 @@ class InferenceEngine:
         Returns step-shaped event buckets holding only the budget-exhausted
         terminations — migrated requests emit nothing; their streams simply
         continue after the re-prefill."""
+        # same in-flight/deferred reset rationale as abort_all
+        self._flight = None
+        self._deferred.clear()
+        self._reuse_key = None
         events: Dict[str, List] = {"tokens": [], "finished": [],
                                    "failed": [], "timed_out": []}
         now = time.perf_counter()
@@ -1740,6 +2285,8 @@ class InferenceEngine:
         else:
             return
         self._note_leave_running(req, time.perf_counter())
+        if self._deferred:
+            self._flush_deferred_for(req)
         self.pool.free(req.block_table)
         req.block_table = []
         self.scheduler.finish(req, reason)

@@ -87,6 +87,14 @@ EXPOSITION: Dict[str, Tuple[str, str, str, str]] = {
         "tnn_serve_decode_stall_seconds_total", "counter",
         "Cumulative wall gap between token-emitting steps",
         "decode_stall_ms_p50"),
+    "serve.host_gap_s": (
+        "tnn_serve_host_gap_seconds_total", "counter",
+        "Cumulative wall gap between a step's result fetch and the next "
+        "dispatch (device idle on host bookkeeping)", "host_gap_ms_p50"),
+    "serve.overlap_rebuild": (
+        "tnn_serve_overlap_rebuilds_total", "counter",
+        "Speculatively dispatched steps rolled back on misprediction",
+        "overlap_rebuilds"),
     "serve.decode_s": (
         "tnn_serve_decode_seconds_total", "counter",
         "Cumulative decode-step wall seconds", "tok_per_s"),
@@ -331,6 +339,7 @@ class ServingMetrics:
         self.ttft_under_load_s = res("ttft_under_load_s")
         self.token_latency_s = res("token_latency_s")
         self.decode_stall_s = res("decode_stall_s")
+        self.host_gap_s = res("host_gap_s")
         self.step_latency_s = res("step_latency_s")
         self.queue_wait_s = res("queue_wait_s")
         self.queue_depth = res("queue_depth")
@@ -371,6 +380,9 @@ class ServingMetrics:
         self.failed = 0
         self.step_retries = 0
         self.steps = 0
+        # overlapped loop: speculatively dispatched steps torn down because
+        # step N's outcome invalidated the predicted row set
+        self.overlap_rebuilds = 0
         # runtime-resilience counters (supervisor / overload degradation)
         self.shed = 0                 # queued requests displaced by priority
         self.engine_restarts = 0      # supervisor-driven engine recoveries
@@ -453,6 +465,19 @@ class ServingMetrics:
         tokens — what a whole-prompt prefill inflates and chunking bounds."""
         self.decode_stall_s.append(seconds)
         self._tick("serve.decode_stall_s", seconds)
+
+    def observe_host_gap(self, seconds: float) -> None:
+        """Wall gap between a step's bundle fetch and the next dispatch — the
+        window where the device sits idle on host bookkeeping. The overlapped
+        loop exists to drive this toward zero."""
+        self.host_gap_s.append(seconds)
+        self._tick("serve.host_gap_s", seconds)
+
+    def observe_overlap_rebuild(self) -> None:
+        """A speculatively dispatched step N+1 was rolled back because step
+        N's committed outcome invalidated its predicted row set."""
+        self.overlap_rebuilds += 1
+        self._tick("serve.overlap_rebuild", 1)
 
     def observe_decode(self, num_tokens: int, seconds: float,
                        batch_width: int) -> None:
@@ -682,6 +707,10 @@ class ServingMetrics:
             "decode_stall_ms_p50": ms(_percentile(self.decode_stall_s, 50)),
             "decode_stall_ms_p99": ms(_percentile(self.decode_stall_s, 99)),
             "decode_stall_ms_max": ms(_max(self.decode_stall_s)),
+            "host_gap_ms_mean": ms(_mean(self.host_gap_s)),
+            "host_gap_ms_p50": ms(_percentile(self.host_gap_s, 50)),
+            "host_gap_ms_p99": ms(_percentile(self.host_gap_s, 99)),
+            "overlap_rebuilds": self.overlap_rebuilds,
             "step_latency_ms_p50": ms(_percentile(self.step_latency_s, 50)),
             "step_latency_ms_p99": ms(_percentile(self.step_latency_s, 99)),
             "queue_wait_ms_p50": ms(_percentile(self.queue_wait_s, 50)),

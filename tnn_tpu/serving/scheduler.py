@@ -109,6 +109,10 @@ class Request:
     #                                     token for this row (subset of
     #                                     decode_s — crash retries, batch
     #                                     stalls behind peer prefills)
+    host_gap_s: float = 0.0             # wall time this row spent waiting on
+    #                                     HOST bookkeeping between a step's
+    #                                     fetch and the next dispatch (the
+    #                                     gap the overlapped loop closes)
     phase: str = ""                     # "" | "prefill" | "decode" (engine-
     phase_t0: float = 0.0               # managed clock for the accumulators)
 
@@ -124,6 +128,7 @@ class Request:
             "prefill_ms": round(self.prefill_s * 1e3, 3),
             "decode_ms": round(self.decode_s * 1e3, 3),
             "stalled_ms": round(self.stall_s * 1e3, 3),
+            "host_gap_ms": round(self.host_gap_s * 1e3, 3),
             "preemptions": self.preemptions,
             "migrations": self.migrations,
         }
@@ -217,7 +222,12 @@ class Scheduler:
         """Plan one engine step: which queued requests to admit, and the
         running set to decode. Admission is strictly FCFS — a blocked
         queue head blocks everyone behind it (no out-of-order admission, so
-        no starvation)."""
+        no starvation).
+
+        Called only from the engine's build phase against COMMITTED state:
+        under the overlapped loop every prior step's commit has already
+        adopted its pool pages and scheduler transitions before the next
+        ``schedule`` runs, so planning never sees a half-applied step."""
         if self.chunk_size:
             return self._schedule_chunked(pool)
         budget = self.token_budget - len(self.running)

@@ -208,17 +208,9 @@ class ServingServer:
             "uptime_s": time.perf_counter() - self._t0,
             "engine_restarts": self.sup.restarts,
         }
-        gauges = getattr(self.sup, "health_gauges", None)
-        if gauges is not None:
-            # router front: scalar router-side gauges, no engine access
-            body.update(gauges())
-        else:
-            body.update({
-                # tnnlint: disable=cross-thread-engine-access -- GIL-atomic scalar reads; health must answer while the worker is mid-step
-                "queue_depth": self.sup.engine.scheduler.queue_depth,
-                # tnnlint: disable=cross-thread-engine-access -- GIL-atomic scalar read, same rationale as queue_depth above
-                "num_running": len(self.sup.engine.scheduler.running),
-            })
+        # host-side gauges cached at commit time (supervisor) or behind the
+        # router lock (router front) — no engine access, no device sync
+        body.update(self.sup.health_gauges())
         await self._respond_json(writer, 200 if serving else 503, body)
 
     async def _stats(self, writer: asyncio.StreamWriter) -> None:

@@ -33,16 +33,60 @@ Both drafters are deterministic given the context, which is what makes
 stochastic verification exact: the proposal distribution is a point mass, so
 accepting draft d with probability p_target(d) and renormalizing the residual
 with d removed is the textbook rejection-sampling recipe.
+
+A drafter may return either a host ``List[int]`` or a ``DeviceDraft`` whose
+tokens are still device-resident (already vocab-clamped inside the drafter's
+own jitted program). Device drafts never force a sync on the step path: the
+engine splices them into the step's token matrix on-device and reads their
+values back through the step's single fetched bundle.
 """
 from __future__ import annotations
 
-from typing import List
+from typing import List, Union
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
 from ..utils.bucketing import pow2_bucket
+
+
+class DeviceDraft:
+    """A proposal whose token ids live on-device: ``toks`` is a ``(k,)``
+    int32 array, already clamped into the target vocabulary by the drafter's
+    jitted program (the engine cannot clamp without syncing). ``len()``
+    reports k from static shape — no transfer."""
+
+    __slots__ = ("toks",)
+
+    def __init__(self, toks):
+        self.toks = toks
+
+    def __len__(self) -> int:
+        return int(self.toks.shape[0])
+
+    def tolist(self) -> List[int]:
+        """Fetch the draft's values. This SYNCS — for tests and tools off
+        the step path; the engine reads draft values from its own fetched
+        step bundle instead."""
+        return [int(t) for t in jax.device_get(self.toks)]
+
+    def __iter__(self):
+        return iter(self.tolist())
+
+    def __eq__(self, other):
+        if isinstance(other, DeviceDraft):
+            other = other.tolist()
+        return self.tolist() == other
+
+    def shifted(self, one, vocab) -> "DeviceDraft":
+        """The draft-poison chaos transform ((t + 1) % vocab), applied
+        on-device; ``one``/``vocab`` arrive pre-``device_put`` so the
+        transfer guard stays clean."""
+        return DeviceDraft(jnp.remainder(self.toks + one, vocab))
+
+
+DraftResult = Union[List[int], DeviceDraft]
 
 
 class Drafter:
@@ -57,7 +101,7 @@ class Drafter:
 
     name = "base"
 
-    def draft(self, req, k: int) -> List[int]:
+    def draft(self, req, k: int) -> DraftResult:
         raise NotImplementedError
 
 
@@ -104,7 +148,7 @@ class DraftModelDrafter(Drafter):
         self.params = params
         self._jit = {}
 
-    def draft(self, req, k: int) -> List[int]:
+    def draft(self, req, k: int) -> DraftResult:
         ctx = _context(req)
         # the draft model's own position cap: it may be shorter than the
         # target's — clamp rather than fail, a shorter draft is still useful
@@ -122,14 +166,17 @@ class DraftModelDrafter(Drafter):
             fn = self._jit[key] = self._draft_fn(width, k)
         ids = np.zeros((1, width), np.int32)
         ids[0, :len(ctx)] = ctx
-        # explicit transfers both ways: draft() runs inside the engine step's
-        # TNN_DEBUG_SYNC transfer guard
+        # device-resident result: draft() runs on the engine's step path, so
+        # the proposal is handed back WITHOUT a device_get — the engine
+        # splices it into the verify step's token matrix on-device and its
+        # values return through the step's single fetched bundle
         toks = fn(self.params, jax.device_put(ids),
                   jax.device_put(np.int32(len(ctx))))
-        return [int(t) for t in jax.device_get(toks)]
+        return DeviceDraft(toks)
 
     def _draft_fn(self, width: int, k: int):
         model = self.model
+        vocab = model.vocab_size
 
         def fn(params, ids, length):
             # prefill the padded context in one pass; positions past
@@ -144,6 +191,9 @@ class DraftModelDrafter(Drafter):
                     params, tok[None, None], caches, length + j)
                 tok = jnp.argmax(logits[0, -1]).astype(jnp.int32)
                 drafts.append(tok)
-            return jnp.stack(drafts)
+            # clamp into the TARGET vocab inside the program: the engine's
+            # host-side ``% vocab`` normalization cannot run on a
+            # device-resident draft without forcing a sync
+            return jnp.remainder(jnp.stack(drafts), vocab)
 
         return jax.jit(fn)

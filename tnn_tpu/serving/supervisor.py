@@ -165,6 +165,11 @@ class EngineSupervisor:
         self._open: Dict[int, Request] = {}
         self._drain_reason = ""
         self._drain_started: Optional[float] = None
+        # host-side health gauges, copied from the engine's commit-time
+        # snapshot at the end of every tick — ``/healthz`` reads these from
+        # the server thread without ever touching the engine (or forcing a
+        # device sync)
+        self._health: Dict[str, int] = {"queue_depth": 0, "num_running": 0}
         self.flight = FlightRecorder(flight_recorder_capacity)
         self.flight_dir = flight_dir
         self.flight_dumps: List[str] = []
@@ -280,7 +285,8 @@ class EngineSupervisor:
             if self.finished:
                 return
             self._tick(block=False)
-            if not self.engine.has_work and not self.draining:
+            if not self.engine.has_work and not self.draining \
+                    and getattr(self.engine, "in_flight", None) is None:
                 return
         raise RuntimeError(f"run_sync exceeded {max_steps} steps")
 
@@ -296,7 +302,8 @@ class EngineSupervisor:
             if self.finished:
                 return
             self._tick(block=False)
-            if not self.engine.has_work and not self.draining:
+            if not self.engine.has_work and not self.draining \
+                    and getattr(self.engine, "in_flight", None) is None:
                 return
 
     # -- command marshalling --------------------------------------------------
@@ -376,7 +383,23 @@ class EngineSupervisor:
             self._listeners[rid] = listener
         if self.tracer.enabled:
             self.tracer.instant("sup.admit", trace=req.trace_id, rid=rid)
+        self._refresh_health()
         return rid
+
+    @worker_only
+    def _refresh_health(self) -> None:
+        """Copy the engine's commit-time gauge snapshot into the
+        supervisor-owned dict that ``health_gauges`` serves cross-thread."""
+        gauges = getattr(self.engine, "_health_gauges", None)
+        if gauges is not None:
+            self._health = dict(gauges)
+
+    def health_gauges(self) -> Dict[str, int]:
+        """Host-side liveness gauges (queue depth, running count) cached at
+        commit time. Safe from any thread WITHOUT marshalling through the
+        worker: the snapshot dict is replaced wholesale each tick, never
+        mutated in place, and reading it cannot force a device sync."""
+        return dict(self._health)
 
     @worker_only
     def _stats(self) -> Dict[str, Any]:
@@ -523,7 +546,18 @@ class EngineSupervisor:
 
     @worker_only
     def _tick(self, *, block: bool) -> None:
-        """One supervision quantum: run queued commands, then one
+        """One supervision quantum. Dispatches on the engine's loop mode:
+        the synchronous tick steps the engine whole (``engine.step``); the
+        overlapped tick splits the quantum into begin/speculate/deferred/
+        finish so host bookkeeping runs while a step is in flight."""
+        if getattr(self.engine, "overlap", False):
+            self._tick_overlap(block=block)
+        else:
+            self._tick_sync(block=block)
+
+    @worker_only
+    def _tick_sync(self, *, block: bool) -> None:
+        """Synchronous quantum: run queued commands, then one
         watchdog-timed, crash-supervised engine step when there is work."""
         self._run_commands(block=block and not self.engine.has_work)
         if self.finished:
@@ -560,6 +594,72 @@ class EngineSupervisor:
         self.flight.record(self._last_step_record())
         self._dispatch_tokens(events)
         self._sweep_terminals()
+        self._refresh_health()
+        if self.watchdog_step_s is not None and dt > self.watchdog_step_s:
+            self._dump_flight("watchdog")
+            self._restart(
+                f"step-latency watchdog tripped: step took {dt:.3f}s "
+                f"(threshold {self.watchdog_step_s}s)")
+
+    @worker_only
+    def _tick_overlap(self, *, block: bool) -> None:
+        """Overlapped quantum: with a step in flight on-device, the host
+        side of this tick (command batch, deferred publishes/instants,
+        speculative build of step N+1) runs INSIDE the device's compute
+        window; only ``finish_step`` blocks, on the one bundle fetch.
+
+        Crash semantics match the sync tick: any exception out of
+        begin/speculate/finish finalizes the dying step's note (the engine
+        guarantees this), so the crash dump's last line is still the step
+        that died. A drain deadline aborts the in-flight step too —
+        ``abort_all`` discards the flight and the fetched-but-uncommitted
+        tokens with it."""
+        eng = self.engine
+        idle = not eng.has_work and getattr(eng, "in_flight", None) is None
+        self._run_commands(block=block and idle)
+        if self.finished:
+            return
+        if not eng.has_work and getattr(eng, "in_flight", None) is None:
+            # nothing on-device: flush any deferred work left by the last
+            # commit before declaring the drain complete
+            eng.run_deferred()
+            self._refresh_health()
+            if self.draining:
+                self._finish_drain()
+            return
+        if self._drain_expired():
+            eng.abort_all(
+                f"drain deadline {self.drain_deadline_s}s exceeded "
+                f"({self._drain_reason})",
+                state=RequestState.TIMED_OUT, include_queued=True,
+                reset_pages=False)
+            self._sweep_terminals()
+            self._finish_drain()
+            return
+        t0 = time.perf_counter()
+        try:
+            if eng.in_flight is None:
+                eng.begin_step()
+            # host work below overlaps the dispatched step's device time
+            eng.try_speculate()
+            eng.run_deferred()
+            events = eng.finish_step()
+        except Exception as e:  # noqa: BLE001 — crash recovery is the point
+            rec = self._last_step_record() or {}
+            rec["crashed"] = True
+            rec["error"] = f"{type(e).__name__}: {e}"
+            self.flight.record(rec)
+            self._dump_flight("crash")
+            self._sweep_terminals()
+            self._restart(f"engine step crashed: {type(e).__name__}: {e}")
+            return
+        dt = time.perf_counter() - t0
+        # the engine's CURRENT note may belong to a speculative step N+1
+        # already in flight — record the step that just committed instead
+        self.flight.record(eng.last_finished_record())
+        self._dispatch_tokens(events)
+        self._sweep_terminals()
+        self._refresh_health()
         if self.watchdog_step_s is not None and dt > self.watchdog_step_s:
             self._dump_flight("watchdog")
             self._restart(

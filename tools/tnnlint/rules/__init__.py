@@ -1,6 +1,7 @@
 """Built-in rules.  Importing this package registers every rule class."""
 from . import compile_key    # noqa: F401
 from . import donation       # noqa: F401
+from . import fetch_commit   # noqa: F401
 from . import host_sync      # noqa: F401
 from . import metric_registry  # noqa: F401
 from . import pool           # noqa: F401

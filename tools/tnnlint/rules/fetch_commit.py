@@ -1,0 +1,101 @@
+"""fetch-outside-commit — the overlapped loop fetches exactly once, inside
+the designated commit helper.
+
+The overlapped engine keeps step N+1 dispatched while step N's results are
+in flight; the entire design collapses if any function on the step path
+calls ``jax.device_get`` itself, because every extra fetch is a hidden
+barrier that re-serializes the pipeline.  The contract: build/dispatch code
+hands device references to the ``StepInFlight`` record, and the ONE batched
+fetch happens inside the designated commit helper
+(``InferenceEngine._fetch_bundle`` by default) — everything downstream
+receives plain host values.
+
+Mechanics: reuse the host-sync rule's intra-file call graph (``self.*`` and
+module-function edges from the configured ``step_roots``), skip defs handed
+to ``jax.jit``, and flag every ``device_get`` call in a reachable function
+whose qualname is not in ``commit_helpers``.  Unlike host-sync-in-step-path
+this needs no taint tracking: ``device_get`` is the explicit fetch, so its
+mere presence outside the commit helper is the violation.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Set
+
+from ..core import (ModuleContext, Rule, Violation, call_name, func_defs,
+                    own_nodes, register)
+from .host_sync import _jitted_inner_defs
+
+_DEF_ROOTS = ["InferenceEngine.step"]
+_DEF_COMMIT_HELPERS = ["InferenceEngine._fetch_bundle"]
+
+
+@register
+class FetchOutsideCommit(Rule):
+    name = "fetch-outside-commit"
+    description = ("jax.device_get on the overlapped step path is legal "
+                   "only inside the designated commit helper — every other "
+                   "fetch is a hidden pipeline barrier")
+
+    def check_module(self, ctx: ModuleContext) -> List[Violation]:
+        opts = ctx.rule_options(self.name)
+        roots = set(opts.get("step_roots", _DEF_ROOTS))
+        helpers = set(opts.get("commit_helpers", _DEF_COMMIT_HELPERS))
+        all_defs = list(func_defs(ctx.tree))
+        by_qual = {q: (fn, cls) for q, fn, cls in all_defs}
+
+        methods_of: Dict[str, Dict[str, str]] = {}
+        module_funcs: Dict[str, str] = {}
+        for q, fn, cls in all_defs:
+            if cls is not None and q.count(".") == 1:
+                methods_of.setdefault(cls, {})[fn.name] = q
+            elif cls is None and "." not in q:
+                module_funcs[fn.name] = q
+
+        exempt = _jitted_inner_defs(ctx.tree)
+
+        def edges(qual: str) -> List[str]:
+            fn, cls = by_qual[qual]
+            targets: List[str] = []
+            for n in own_nodes(fn):
+                if not isinstance(n, ast.Call):
+                    continue
+                cn = call_name(n)
+                if cn is None:
+                    continue
+                if cn.startswith("self.") and cn.count(".") == 1 and cls:
+                    m = methods_of.get(cls, {}).get(cn.split(".")[1])
+                    if m:
+                        targets.append(m)
+                elif "." not in cn and cn in module_funcs:
+                    targets.append(module_funcs[cn])
+            return targets
+
+        reachable: Set[str] = set()
+        frontier = [q for q in by_qual if q in roots]
+        while frontier:
+            q = frontier.pop()
+            if q in reachable:
+                continue
+            reachable.add(q)
+            frontier.extend(edges(q))
+
+        out: List[Violation] = []
+        for q in sorted(reachable):
+            if q in helpers:
+                continue
+            fn, _cls = by_qual[q]
+            if id(fn) in exempt:
+                continue
+            for n in own_nodes(fn):
+                if not isinstance(n, ast.Call):
+                    continue
+                cn = call_name(n) or ""
+                if cn.split(".")[-1] == "device_get":
+                    out.append(self.violation(
+                        ctx, n,
+                        f"device_get outside the commit helper ({q}) — the "
+                        f"overlapped loop fetches once per step, inside "
+                        f"{sorted(helpers)}; route this value through the "
+                        f"step's fetched bundle instead"))
+        return out
