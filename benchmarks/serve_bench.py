@@ -49,6 +49,16 @@ failover contract (exactly one terminal per request, token-exact resumed
 streams, survivor pools zero-leak, clean drain). The full-model mode adds
 the same A/B at 3 replicas.
 
+Both modes also run a quantized-serving A/B (bench_quant): the same
+up-front greedy batch through the f32 engine, the int8 paged-KV engine
+(``kv_dtype="int8"``), and int8 KV + int8 weights (``quant_weights=True``)
+— reporting decode tok/s and TTFT beside the quantization quality columns
+(top-1/top-k agreement with the teacher-forced f32 argmax, teacher-forced
+ppl_delta vs the f32 row) and the capacity headline max_concurrent_at_slo,
+computed hbm_fit-style from the pool's ACTUAL per-token residency (int8
+pages + f32 scale sidecars), not an assumed f32 itemsize. The smoke rows
+persist as benchmarks/results/quant_ab_smoke.json.
+
 Both modes end with a bench_load row: sustained closed-loop users plus
 open-loop background arrivals driven through the supervised runtime
 (``EngineSupervisor``) with one injected engine-loop crash — reporting
@@ -566,6 +576,150 @@ def bench_overlap(model, params, *, num_requests: int, prompt_len: int,
                "requests": s["requests_finished"]})
 
 
+def _teacher_forced_closeness(model, params, prompts, outs, topk):
+    """Teacher-force each prompt + engine output through the plain f32
+    forward: mean NLL of the emitted tokens (ppl = exp), top-1 and top-k
+    agreement. The teacher always runs the ORIGINAL f32 params — it is the
+    quality yardstick every quantized variant is measured against."""
+    import jax.numpy as jnp
+
+    seqs = np.stack([np.concatenate([p, o]).astype(np.int32)
+                     for p, o in zip(prompts, outs)])
+    caches = model.init_cache(len(seqs), seqs.shape[1])
+    logits, _ = model.apply_cached(params, jnp.asarray(seqs), caches, 0)
+    logits = np.asarray(logits, np.float64)
+    plen, n_new = len(prompts[0]), len(outs[0])
+    nll, top1, topk_hit, total = 0.0, 0, 0, 0
+    for i in range(len(seqs)):
+        for j in range(n_new):
+            row = logits[i, plen + j - 1]
+            row = row - row.max()
+            logp = row - np.log(np.exp(row).sum())
+            tok = seqs[i, plen + j]
+            nll -= logp[tok]
+            top1 += int(tok == row.argmax())
+            topk_hit += int(tok in np.argsort(row)[-topk:])
+            total += 1
+    return nll / total, top1 / total, topk_hit / total
+
+
+def _hbm_fit_concurrent(pool, tokens_per_req, budget_bytes):
+    """How many requests' KV fit in a fixed HBM budget — computed from the
+    pool's ACTUAL per-token residency (page itemsize + any scale sidecars),
+    not an assumed 4 bytes/element, so the int8 rows' capacity win is the
+    real one (pages halve, scales claw a little back)."""
+    bytes_per_req = (pool.kv_bytes_per_token
+                     + pool.kv_scale_bytes_per_token) * tokens_per_req
+    return int(budget_bytes // bytes_per_req)
+
+
+def bench_quant(model, params, *, num_requests: int, prompt_len: int,
+                max_new: int, num_blocks: int, block_size: int,
+                max_batch_size: int, label: str, variant: str = "f32",
+                topk: int = 5, seed: int = 0, slo_ttft_s: float = 2.0,
+                kv_budget_mb: int = 1024, shared: dict = None,
+                artifact: str = None):
+    """Quantized-serving A/B row: the same up-front greedy batch through one
+    engine variant — ``f32`` (baseline), ``int8_kv`` (quantized pool), or
+    ``int8_kv_w8`` (quantized pool + int8 weights via quant_matmul).
+
+    Quantization trades exactness for bytes, so the quality columns are
+    CLOSENESS against the f32 teacher: top-1/top-k agreement of the emitted
+    tokens with the teacher-forced f32 argmax, and ppl_delta (teacher-forced
+    perplexity of this variant's stream minus the f32 row's). The capacity
+    headline is max_concurrent_at_slo: how many requests' KV fit in a fixed
+    HBM budget at the pool's actual bytes/token — provided the measured run
+    met the TTFT SLO (else 0; capacity you can't serve at SLO is not
+    capacity). ``shared`` carries the f32 reference NLL between the three
+    rows; ``artifact`` persists all rows as JSON once the last one lands.
+    """
+    from tnn_tpu.serving import InferenceEngine, ServingMetrics
+
+    kv_dtype = "f32" if variant == "f32" else "int8"
+    quant_weights = variant == "int8_kv_w8"
+    print(f"{label}: {num_requests} requests up front, prompt {prompt_len}, "
+          f"max_new {max_new}, kv_dtype={kv_dtype}, "
+          f"quant_weights={quant_weights}")
+    rng = np.random.default_rng(seed)
+    prompts = [rng.integers(0, model.vocab_size, prompt_len).astype(np.int32)
+               for _ in range(num_requests)]
+
+    def run_engine(kvd, qw):
+        engine = InferenceEngine(
+            model, params, num_blocks=num_blocks, block_size=block_size,
+            max_batch_size=max_batch_size, max_seq_len=prompt_len + max_new,
+            seed=seed, decode_path="paged", kv_dtype=kvd, quant_weights=qw)
+        wprompt = np.random.default_rng(seed + 1).integers(
+            0, model.vocab_size, prompt_len).astype(np.int32)
+        wid = engine.submit(wprompt, 1)
+        engine.run_until_complete()
+        del engine.requests[wid]
+        engine.metrics = ServingMetrics(engine.profiler,
+                                        slo_ttft_s=slo_ttft_s)
+        t0 = time.perf_counter()
+        rids = [engine.submit(p, max_new) for p in prompts]
+        out = engine.run_until_complete()
+        wall = time.perf_counter() - t0
+        assert all(engine.requests[r].state.name == "FINISHED" for r in rids)
+        assert engine.pool.num_allocated == 0, "leaked KV blocks"
+        engine.check_invariants()
+        return engine, [out[r] for r in rids], wall
+
+    engine, outs, wall = run_engine(kv_dtype, quant_weights)
+    nll, top1, topk_agree = _teacher_forced_closeness(
+        model, params, prompts, outs, topk)
+
+    shared = shared if shared is not None else {}
+    if variant == "f32":
+        shared["ref_nll"] = nll
+    ref_nll = shared.get("ref_nll")
+    if ref_nll is None:
+        # row isolation: the f32 row failed or was skipped — rebuild the
+        # reference off the clock so ppl_delta stays meaningful
+        _, ref_outs, _ = run_engine("f32", False)
+        ref_nll = _teacher_forced_closeness(
+            model, params, prompts, ref_outs, topk)[0]
+        shared["ref_nll"] = ref_nll
+
+    s = engine.metrics.summary()
+    pool = engine.pool
+    met_slo = s["ttft_ms_p99"] <= slo_ttft_s * 1e3
+    fit = _hbm_fit_concurrent(pool, prompt_len + max_new,
+                              kv_budget_mb * 2**20)
+    row = report(
+        label, wall, items=s["decode_tokens"], item_name="tok",
+        extra={"kv_dtype": kv_dtype,
+               "quant_weights": int(quant_weights),
+               "ttft_ms_p50": s["ttft_ms_p50"],
+               "ttft_ms_p99": s["ttft_ms_p99"],
+               "token_latency_ms_p50": s["token_latency_ms_p50"],
+               "token_latency_ms_p99": s["token_latency_ms_p99"],
+               "kv_bytes_per_token": pool.kv_bytes_per_token,
+               "kv_scale_bytes_per_token": pool.kv_scale_bytes_per_token,
+               "top1_agreement": round(top1, 4),
+               "topk_agreement": round(topk_agree, 4),
+               "ppl": round(float(np.exp(nll)), 4),
+               "ppl_delta": round(float(np.exp(nll) - np.exp(ref_nll)), 4),
+               "max_concurrent_at_slo": fit if met_slo else 0,
+               "goodput_at_slo": round(s["goodput_at_slo"], 4),
+               "requests": s["requests_finished"]})
+    if shared is not None:
+        shared.setdefault("rows", []).append(row)
+        if artifact and variant == "int8_kv_w8":
+            import json
+            import os
+
+            os.makedirs(os.path.dirname(artifact), exist_ok=True)
+            with open(artifact, "w") as f:
+                json.dump({"generated": time.strftime("%Y-%m-%dT%H:%M:%S"),
+                           "platform": jax.devices()[0].platform,
+                           "kv_budget_mb": kv_budget_mb,
+                           "rows": shared["rows"]}, f, indent=2)
+            print(f"  quant A/B artifact -> {artifact}")
+            row["artifact_path"] = artifact
+    return row
+
+
 def bench_availability(model, params, *, replicas: int, num_requests: int,
                        rate_per_s: float, prompt_len: int, max_new: int,
                        num_blocks: int, block_size: int, max_batch_size: int,
@@ -959,6 +1113,22 @@ def main(argv=None):
                 num_blocks=32, block_size=4, max_batch_size=4, overlap=o,
                 label=f"serve_smoke_overlap_{t}"),
                 label=f"bench_overlap_{tag}")
+        # quantized-serving A/B: f32 vs int8-KV vs int8-KV + int8 weights —
+        # decode tok/s and TTFT beside the closeness columns (top-1/top-k
+        # agreement, teacher-forced ppl_delta) and the capacity headline
+        # (max_concurrent_at_slo from the pool's ACTUAL bytes/token); the
+        # three rows persist as one JSON artifact under benchmarks/results/
+        qshared = {}
+        import os
+        art = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "results", "quant_ab_smoke.json")
+        for var in ("f32", "int8_kv", "int8_kv_w8"):
+            rr.add(lambda v=var: bench_quant(
+                model, params, num_requests=4, prompt_len=8, max_new=16,
+                num_blocks=32, block_size=4, max_batch_size=4, variant=v,
+                label=f"serve_smoke_quant_{v}", shared=qshared,
+                artifact=art),
+                label=f"bench_quant_{var}")
         return rr.results
 
     from tnn_tpu import models
@@ -1024,6 +1194,16 @@ def main(argv=None):
             num_blocks=128, block_size=16, max_batch_size=8, kill=k,
             check_exact=False, label=f"serve_{args.model}_avail_{t}"),
             label=f"bench_availability_{tag}")
+    # quantized-serving A/B at model scale: on a chip the int8 rows' decode
+    # tok/s is the HBM-bandwidth headline; everywhere the closeness columns
+    # (top-k agreement, ppl_delta) and max_concurrent_at_slo are the gate
+    qshared = {}
+    for var in ("f32", "int8_kv", "int8_kv_w8"):
+        rr.add(lambda v=var: bench_quant(
+            model, params, num_requests=n, prompt_len=32, max_new=max_new,
+            num_blocks=128, block_size=16, max_batch_size=8, variant=v,
+            label=f"serve_{args.model}_quant_{v}", shared=qshared),
+            label=f"bench_quant_{var}")
     return rr.results
 
 

@@ -86,6 +86,39 @@ class E:
 '''
         assert _rules(src, "use-after-donate") == []
 
+    # quantized pools: int8 pages travel with separate scale sidecars and
+    # BOTH are donated — re-adopting only the pages leaves the scales dead
+    SIDECAR_BUILDER = '''
+import jax
+class E:
+    def _step_fn(self):
+        def fn(pages_k, pages_v, scales_k, scales_v):
+            return pages_k, pages_v, scales_k, scales_v
+        return jax.jit(fn, donate_argnums=(0, 1, 2, 3))
+
+    def step(self):
+        fn = self._jit.get(key)
+        if fn is None:
+            fn = self._jit[key] = self._step_fn()
+        pk, pv, sk, sv = fn(self.pool.pages_k, self.pool.pages_v,
+                            self.pool.scales_k, self.pool.scales_v)
+'''
+
+    def test_dropped_scale_sidecar_flags(self):
+        src = self.SIDECAR_BUILDER + '''
+        self.pool.update_pages(pk, pv)
+'''
+        # one finding per dropped sidecar (scales_k AND scales_v)
+        assert _rules(src, "use-after-donate") == [
+            "use-after-donate", "use-after-donate"]
+
+    def test_full_sidecar_readoption_clean(self):
+        src = self.SIDECAR_BUILDER + '''
+        self.pool.update_pages(pk, pv, sk, sv)
+        shape = self.pool.pages_k.shape
+'''
+        assert _rules(src, "use-after-donate") == []
+
 
 class TestHostSyncInStepPath:
     def test_int_on_device_value_flags(self):

@@ -228,7 +228,10 @@ class TestDeferredPhase:
         assert "tnn_serve_host_gap_seconds_total" in fams
         assert "tnn_serve_overlap_rebuilds_total" in fams
         # commit-time gauges: what /healthz now serves without engine access
-        assert sup.health_gauges() == {"queue_depth": 0, "num_running": 0}
+        assert sup.health_gauges() == {
+            "queue_depth": 0, "num_running": 0, "kv_dtype": "f32",
+            "kv_bytes_per_token": eng.pool.kv_bytes_per_token,
+            "quant_weights": 0}
 
 
 class TestDebugSyncOverlap:

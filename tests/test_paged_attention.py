@@ -325,3 +325,92 @@ def test_scatter_kv_chunk_roundtrip_and_scratch_only():
     # 4-D single-layer form
     pk1 = pa.scatter_kv_chunk(pk[1], tables, starts, rows_k, q_lens)
     np.testing.assert_array_equal(np.asarray(pk1), np.asarray(pk2[1]))
+
+
+# -- int8 quantized pages (QuantPages) ----------------------------------------
+
+
+def _quantize(pages):
+    """Pool-layout quantization: per-(position x head) scale over head_dim."""
+    return pa.QuantPages(*pa.quantize_kv_rows(pages))
+
+
+@pytest.mark.parametrize("block_size", [4, 8])
+@pytest.mark.parametrize("heads", [(4, 4), (4, 2), (4, 1)],
+                         ids=["mha", "gqa2", "mqa"])
+def test_int8_kernel_matches_reference_ragged(block_size, heads):
+    """Decode form on int8 pages: the in-kernel dequant agrees with the XLA
+    reference's gather-dequant to f32 accumulation tolerance, and both stay
+    within quantization error of the unquantized f32 attention."""
+    h, hkv = heads
+    q, pk, pv, tables, lens = _random_case(
+        block_size * 1000 + h, block_size=block_size, num_heads=h,
+        num_kv_heads=hkv)
+    qpk, qpv = _quantize(pk), _quantize(pv)
+    for layer in range(pk.shape[0]):
+        ref = pa.paged_attention_reference(q, qpk, qpv, tables, lens,
+                                           layer=layer)
+        out = pa.paged_attention(q, qpk, qpv, tables, lens, layer=layer,
+                                 backend="pallas")
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-5, rtol=2e-5)
+        f32 = pa.paged_attention_reference(q, pk, pv, tables, lens,
+                                           layer=layer)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(f32),
+                                   atol=5e-2)
+
+
+@pytest.mark.parametrize("qw", [4, 8])
+def test_int8_multitoken_kernel_matches_reference(qw):
+    """Ragged q chunks on int8 pages: chunk KV is quantized at write time by
+    scatter_kv_chunk, then the kernel and reference agree; dead rows stay
+    exactly 0."""
+    q, pk, pv, tables, starts, q_lens, rows_k, rows_v = _random_chunk_case(
+        4100 + qw, qw=qw)
+    kv_lens = starts + q_lens
+    qpk, qpv = _quantize(pk), _quantize(pv)
+    qpk = pa.scatter_kv_chunk(qpk, tables, starts, rows_k, q_lens, layer=1)
+    qpv = pa.scatter_kv_chunk(qpv, tables, starts, rows_v, q_lens, layer=1)
+    assert isinstance(qpk, pa.QuantPages) and qpk.data.dtype == jnp.int8
+    ref = pa.paged_attention_reference(q, qpk, qpv, tables, kv_lens,
+                                       q_lens=q_lens, layer=1)
+    out = pa.paged_attention(q, qpk, qpv, tables, kv_lens, q_lens=q_lens,
+                             layer=1, backend="pallas")
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5,
+                               rtol=2e-5)
+    assert np.all(np.asarray(out[0]) == 0)
+    ql = np.asarray(q_lens)
+    for i in range(q.shape[0]):
+        assert np.all(np.asarray(out[i, ql[i]:]) == 0), i
+
+
+def test_int8_scatter_rows_quantizes_at_write():
+    """scatter_kv_rows on QuantPages stores int8 + per-row scale; the
+    dequantized readback is within quantization error of the f32 rows, and
+    untouched blocks keep both leaves bit-identical."""
+    rng = np.random.default_rng(41)
+    q, pk, pv, tables, lens = _random_case(43)
+    qpk = _quantize(pk)
+    b, h_kv, dh = q.shape[0], pk.shape[2], pk.shape[4]
+    bs = pk.shape[3]
+    rows = jnp.asarray(rng.normal(size=(b, h_kv, dh)), jnp.float32)
+    offsets = lens - 1
+    qpk2 = pa.scatter_kv_rows(qpk, tables, offsets, rows, layer=1)
+    assert qpk2.data.dtype == jnp.int8 and qpk2.scale.dtype == jnp.float32
+    for i in range(b):
+        blk = int(tables[i, int(offsets[i]) // bs])
+        slot = int(offsets[i]) % bs
+        got = (np.asarray(qpk2.data[1, blk, :, slot, :], np.float32) *
+               np.asarray(qpk2.scale[1, blk, :, slot, :]))
+        np.testing.assert_allclose(got, np.asarray(rows[i]), atol=3e-2)
+    # layer 0 untouched on BOTH leaves
+    np.testing.assert_array_equal(np.asarray(qpk2.data[0]),
+                                  np.asarray(qpk.data[0]))
+    np.testing.assert_array_equal(np.asarray(qpk2.scale[0]),
+                                  np.asarray(qpk.scale[0]))
+
+
+def test_int8_mixed_kind_rejected():
+    q, pk, pv, tables, lens = _random_case(47)
+    with pytest.raises(ValueError, match="both"):
+        pa.paged_attention(q, _quantize(pk), pv, tables, lens)

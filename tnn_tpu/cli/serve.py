@@ -113,6 +113,13 @@ def main(argv=None):
                     help="per-request position cap (0 = model/pool limit)")
     ap.add_argument("--decode-path", default="auto",
                     choices=("auto", "standard", "fused", "paged"))
+    ap.add_argument("--kv-dtype", default="f32", choices=("f32", "int8"),
+                    help="KV pool page dtype: int8 halves resident KV and "
+                         "decode page traffic (per-row f32 scale sidecar; "
+                         "output gated by closeness, not exactness)")
+    ap.add_argument("--quant-weights", action="store_true",
+                    help="serve projection/MLP matmuls from int8 weights "
+                         "via the in-VMEM-dequant quant_matmul kernel")
     ap.add_argument("--max-new-tokens", type=int, default=32,
                     help="default for requests that omit it")
     ap.add_argument("--max-queue-depth", type=int, default=0,
@@ -220,6 +227,7 @@ def main(argv=None):
             draft_model=draft_model, draft_params=draft_params,
             profiler=prof, trace=bool(args.trace),
             overlap=not args.no_overlap,
+            kv_dtype=args.kv_dtype, quant_weights=args.quant_weights,
             seed=args.seed)
 
     def build_supervisor(eng, idx=0):

@@ -404,9 +404,12 @@ class MultiHeadAttention(Module):
             # dequant at use. On the XLA backend the int8 read + scale can
             # fuse into the attention contraction (traffic = int8 bytes); on
             # backend="pallas" the dequantized arrays are pallas_call
-            # operands — a fusion boundary — so that path materializes
-            # compute-dtype K/V and only the RESIDENCY win remains. Pair
-            # int8 caches with the XLA decode backend for the traffic win.
+            # operands — a fusion boundary — so THIS contiguous-cache path
+            # materializes compute-dtype K/V and only the residency win
+            # remains. The paged serving path does not share the caveat:
+            # the pool's kv_dtype="int8" QuantPages feed the ragged paged
+            # kernel as int8 operands and dequantize in-VMEM inside its
+            # online-softmax loop, so HBM traffic is int8 bytes there too.
             k = (cache["k"].astype(jnp.float32) * cache["k_scale"]).astype(cd)
             v = (cache["v"].astype(jnp.float32) * cache["v_scale"]).astype(cd)
         else:
@@ -455,15 +458,19 @@ class MultiHeadAttention(Module):
             k_new = apply_rope(k_new, offsets, self.rope_theta)
         from ..ops.pallas import paged_attention as pa
 
+        quant_pool = isinstance(pages_k, pa.QuantPages)
         if q_lens is None and x.shape[1] == 1:
             # decode form, kept verbatim: the pure-decode compiled step must
-            # stay bit-identical to the pre-chunking program
+            # stay bit-identical to the pre-chunking program (QuantPages
+            # skip the dtype cast — scatter quantizes the rows itself)
+            rows_k, rows_v = k_new[:, :, 0], v_new[:, :, 0]
+            if not quant_pool:
+                rows_k = rows_k.astype(pages_k.dtype)
+                rows_v = rows_v.astype(pages_v.dtype)
             pages_k = pa.scatter_kv_rows(pages_k, block_tables, offsets,
-                                         k_new[:, :, 0].astype(pages_k.dtype),
-                                         layer=layer)
+                                         rows_k, layer=layer)
             pages_v = pa.scatter_kv_rows(pages_v, block_tables, offsets,
-                                         v_new[:, :, 0].astype(pages_v.dtype),
-                                         layer=layer)
+                                         rows_v, layer=layer)
             out = pa.paged_attention(q[:, :, 0], pages_k, pages_v,
                                      block_tables, kv_lens=offsets + 1,
                                      layer=layer)
@@ -473,14 +480,15 @@ class MultiHeadAttention(Module):
             raise ValueError("apply_paged with Q > 1 requires q_lens")
         # ragged chunk form: scatter the whole chunk's KV first, then attend
         # each row's live tokens against its own chunk + all prior positions
-        pages_k = pa.scatter_kv_chunk(
-            pages_k, block_tables, offsets,
-            k_new.transpose(0, 2, 1, 3).astype(pages_k.dtype),
-            q_lens, layer=layer)
-        pages_v = pa.scatter_kv_chunk(
-            pages_v, block_tables, offsets,
-            v_new.transpose(0, 2, 1, 3).astype(pages_v.dtype),
-            q_lens, layer=layer)
+        chunk_k = k_new.transpose(0, 2, 1, 3)
+        chunk_v = v_new.transpose(0, 2, 1, 3)
+        if not quant_pool:
+            chunk_k = chunk_k.astype(pages_k.dtype)
+            chunk_v = chunk_v.astype(pages_v.dtype)
+        pages_k = pa.scatter_kv_chunk(pages_k, block_tables, offsets, chunk_k,
+                                      q_lens, layer=layer)
+        pages_v = pa.scatter_kv_chunk(pages_v, block_tables, offsets, chunk_v,
+                                      q_lens, layer=layer)
         out = pa.paged_attention(q.transpose(0, 2, 1, 3), pages_k, pages_v,
                                  block_tables, kv_lens=offsets + q_lens,
                                  q_lens=q_lens, layer=layer)

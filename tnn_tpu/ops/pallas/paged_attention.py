@@ -36,12 +36,23 @@ into a contiguous cache, masked softmax) — the parity oracle for the kernel
 and the CPU/interpret fallback the router picks off-TPU, mirroring how
 ``flash_attention`` routes. ``scatter_kv_rows`` / ``scatter_kv_chunk`` are the
 write half of the page contract: the new KV rows per sequence per step.
+
+INT8 PAGES (``QuantPages``): decode is HBM-bandwidth-bound on KV bytes, so
+the pool may store pages as int8 with a per-(position, head) f32 scale
+sidecar riding alongside (same block ids, same layout, Dh collapsed to 1).
+The scatters quantize rows symmetrically at write time (``quantize_kv_rows``
+— the same scale = amax/127 rule as ``nn.attention``'s per-model int8
+cache), and both consumers dequantize at READ: the kernel inside its
+online-softmax loop (K/V HBM traffic stays int8 bytes + one f32 scale per
+row; compute-dtype K/V never exists in HBM), the XLA reference at its
+gather. Quantized attention is gated by closeness, not bit-exactness — the
+f32 code paths below are byte-untouched.
 """
 from __future__ import annotations
 
 import functools
 import math
-from typing import Optional
+from typing import NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
@@ -57,10 +68,70 @@ _CompilerParams = getattr(pltpu, "CompilerParams", None) \
 _NEG_INF = -1e30
 
 
+class QuantPages(NamedTuple):
+    """Int8 KV pages + per-(position, head) f32 scale sidecar.
+
+    ``data`` is the pool page array quantized to int8, ``scale`` the same
+    layout with the head_dim axis collapsed to 1 — scale[l, n, h, s, 0]
+    dequantizes row data[l, n, h, s, :]. A NamedTuple is a pytree, so the
+    bundle flows through jit (``donate_argnums`` donates BOTH buffers) and
+    through ``pool.update_pages`` unchanged; the two arrays share one
+    block-id space, so alloc/free/fork/evict bookkeeping needs no second
+    ledger.
+    """
+    data: jax.Array    # (L, N, H_kv, bs, Dh) int8
+    scale: jax.Array   # (L, N, H_kv, bs, 1)  float32
+
+
+def quantize_kv_rows(x):
+    """Symmetric per-row (per position, per head) int8 over the last axis:
+    scale = amax/127 — the same quantizer as ``nn.attention``'s per-model
+    int8 cache, so pool-int8 and cache-int8 closeness gates measure the
+    same arithmetic. Returns (int8 values, f32 scales with last axis 1)."""
+    xf = x.astype(jnp.float32)
+    scale = jnp.maximum(jnp.max(jnp.abs(xf), axis=-1, keepdims=True),
+                        1e-8) / 127.0
+    q = jnp.clip(jnp.round(xf / scale), -127, 127).astype(jnp.int8)
+    return q, scale
+
+
 def _attn_kernel(tables_ref, lens_ref, qlens_ref, layer_ref, q_ref, k_ref,
                  v_ref, o_ref, m_scr, l_scr, acc_scr, *, scale: float,
                  bs: int, g: int, qw: int):
     del tables_ref, layer_ref  # consumed by the index maps, not the body
+
+    def load_kv():
+        return k_ref[0, 0, 0], v_ref[0, 0, 0]    # (bs, Dh) — one page
+
+    _attn_step(lens_ref, qlens_ref, q_ref, load_kv, o_ref, m_scr, l_scr,
+               acc_scr, scale=scale, bs=bs, g=g, qw=qw)
+
+
+def _attn_kernel_int8(tables_ref, lens_ref, qlens_ref, layer_ref, q_ref,
+                      k_ref, v_ref, ks_ref, vs_ref, o_ref, m_scr, l_scr,
+                      acc_scr, *, scale: float, bs: int, g: int, qw: int):
+    del tables_ref, layer_ref
+
+    def load_kv():
+        # in-VMEM dequant inside the online-softmax sweep: the page arrives
+        # as int8 + one f32 scale per row, so HBM traffic is int8 bytes on
+        # this backend too (the load runs under the same pl.when as the
+        # block's compute — dead pages fetch nothing extra). NOTE: int8's
+        # minimum TPU tile is (32, 128) sublane x lane; blocks smaller than
+        # that lean on Mosaic's relayout and lose part of the traffic win.
+        k = k_ref[0, 0, 0].astype(jnp.float32) * ks_ref[0, 0, 0]
+        v = v_ref[0, 0, 0].astype(jnp.float32) * vs_ref[0, 0, 0]
+        return k, v
+
+    _attn_step(lens_ref, qlens_ref, q_ref, load_kv, o_ref, m_scr, l_scr,
+               acc_scr, scale=scale, bs=bs, g=g, qw=qw)
+
+
+def _attn_step(lens_ref, qlens_ref, q_ref, load_kv, o_ref, m_scr, l_scr,
+               acc_scr, *, scale: float, bs: int, g: int, qw: int):
+    """Shared online-softmax body: the f32 and int8 kernels differ ONLY in
+    how a page's K/V reaches the MXU (``load_kv``), keeping the two in
+    lockstep by construction."""
     b = pl.program_id(0)
     j = pl.program_id(2)
     nj = pl.num_programs(2)
@@ -78,8 +149,7 @@ def _attn_kernel(tables_ref, lens_ref, qlens_ref, layer_ref, q_ref, k_ref,
     @pl.when(j * bs < kv_len)
     def _block():
         q = q_ref[0, :, 0].reshape(qw * g, dh)   # whole ragged query chunk
-        k = k_ref[0, 0, 0]     # (bs, Dh) — one page
-        v = v_ref[0, 0, 0]
+        k, v = load_kv()
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
         kpos = j * bs + jax.lax.broadcasted_iota(jnp.int32, (qw * g, bs), 1)
@@ -111,8 +181,9 @@ def _attn_kernel(tables_ref, lens_ref, qlens_ref, layer_ref, q_ref, k_ref,
 
 def _paged_attention_pallas(q, pages_k, pages_v, block_tables, kv_lens,
                             q_lens, layer, scale, interpret):
+    quant = isinstance(pages_k, QuantPages)
     b, qw, h, dh = q.shape
-    _, _, hkv, bs, _ = pages_k.shape
+    _, _, hkv, bs, _ = (pages_k.data if quant else pages_k).shape
     g = h // hkv
     nb = block_tables.shape[1]
     qg = q.reshape(b, qw, hkv, g, dh)
@@ -131,14 +202,28 @@ def _paged_attention_pallas(q, pages_k, pages_v, block_tables, kv_lens,
     def q_index(bi, hi, j, tbl, ln, qln, ly):
         return (bi, 0, hi, 0, 0)
 
+    in_specs = [
+        pl.BlockSpec((1, qw, 1, g, dh), q_index),
+        pl.BlockSpec((1, 1, 1, bs, dh), kv_index),
+        pl.BlockSpec((1, 1, 1, bs, dh), kv_index),
+    ]
+    operands = [qg]
+    if quant:
+        # the scale sidecars chase the SAME block-table index maps as their
+        # pages, so a clamped dead-page fetch elides both DMAs together
+        in_specs += [pl.BlockSpec((1, 1, 1, bs, 1), kv_index),
+                     pl.BlockSpec((1, 1, 1, bs, 1), kv_index)]
+        operands += [pages_k.data, pages_v.data, pages_k.scale,
+                     pages_v.scale]
+        kernel = _attn_kernel_int8
+    else:
+        operands += [pages_k, pages_v]
+        kernel = _attn_kernel
+
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=4,
         grid=(b, hkv, nb),
-        in_specs=[
-            pl.BlockSpec((1, qw, 1, g, dh), q_index),
-            pl.BlockSpec((1, 1, 1, bs, dh), kv_index),
-            pl.BlockSpec((1, 1, 1, bs, dh), kv_index),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((1, qw, 1, g, dh), q_index),
         scratch_shapes=[
             pltpu.VMEM((qw * g, 1), jnp.float32),
@@ -147,20 +232,29 @@ def _paged_attention_pallas(q, pages_k, pages_v, block_tables, kv_lens,
         ],
     )
     out = pl.pallas_call(
-        functools.partial(_attn_kernel, scale=scale, bs=bs, g=g, qw=qw),
+        functools.partial(kernel, scale=scale, bs=bs, g=g, qw=qw),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((b, qw, hkv, g, dh), q.dtype),
         # scratch carries only along the innermost (page) sweep
         compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
-    )(tables, lens, qlens, layer_arr, qg, pages_k, pages_v)
+    )(tables, lens, qlens, layer_arr, *operands)
     return out.reshape(b, qw, h, dh)
 
 
 def _gather_pages(pages, block_tables, layer, b, hkv, t, dh):
+    if isinstance(pages, QuantPages):
+        x = pages.data[layer][block_tables]  # (B, nb, Hkv, bs, Dh) int8
+        s = pages.scale[layer][block_tables]
+        x = x.astype(jnp.float32) * s        # dequant AT the gather
+        return x.transpose(0, 2, 1, 3, 4).reshape(b, hkv, t, dh)
     x = pages[layer][block_tables]           # (B, nb, Hkv, bs, Dh)
     return x.transpose(0, 2, 1, 3, 4).reshape(b, hkv, t, dh)
+
+
+def _pages_shape(pages):
+    return pages.data.shape if isinstance(pages, QuantPages) else pages.shape
 
 
 def _paged_attention_xla(q, pages_k, pages_v, block_tables, kv_lens, layer,
@@ -168,7 +262,7 @@ def _paged_attention_xla(q, pages_k, pages_v, block_tables, kv_lens, layer,
     """Single-token (decode) reference — the PR 2 math, kept verbatim so the
     legacy decode traces stay bit-identical."""
     b, h, dh = q.shape
-    _, _, hkv, bs, _ = pages_k.shape
+    _, _, hkv, bs, _ = _pages_shape(pages_k)
     g = h // hkv
     t = block_tables.shape[1] * bs
 
@@ -192,7 +286,7 @@ def _paged_attention_xla_mq(q, pages_k, pages_v, block_tables, kv_lens,
                             q_lens, layer, scale):
     """Multi-token-query reference: same ragged causal mask as the kernel."""
     b, qw, h, dh = q.shape
-    _, _, hkv, bs, _ = pages_k.shape
+    _, _, hkv, bs, _ = _pages_shape(pages_k)
     g = h // hkv
     t = block_tables.shape[1] * bs
 
@@ -235,11 +329,27 @@ def paged_attention_reference(q, pages_k, pages_v, block_tables, kv_lens, *,
 
 
 def _check_args(q, pages_k, pages_v, block_tables, kv_lens, q_lens, scale):
-    if pages_k.ndim == 4:      # single-layer pages: add the unit layer axis
-        pages_k, pages_v = pages_k[None], pages_v[None]
-    if pages_k.shape != pages_v.shape or pages_k.ndim != 5:
+    if isinstance(pages_k, QuantPages) != isinstance(pages_v, QuantPages):
+        raise ValueError("pages_k / pages_v must both be QuantPages or "
+                         "both plain arrays")
+    if isinstance(pages_k, QuantPages):
+        if pages_k.data.ndim == 4:   # single-layer: add the unit layer axis
+            pages_k = QuantPages(pages_k.data[None], pages_k.scale[None])
+            pages_v = QuantPages(pages_v.data[None], pages_v.scale[None])
+        pk, pv = pages_k.data, pages_v.data
+        for p, s in ((pages_k.data, pages_k.scale),
+                     (pages_v.data, pages_v.scale)):
+            if s.shape != p.shape[:-1] + (1,):
+                raise ValueError(f"QuantPages scale {s.shape} must be pages "
+                                 f"{p.shape} with the last axis collapsed "
+                                 "to 1")
+    else:
+        if pages_k.ndim == 4:  # single-layer pages: add the unit layer axis
+            pages_k, pages_v = pages_k[None], pages_v[None]
+        pk, pv = pages_k, pages_v
+    if pk.shape != pv.shape or pk.ndim != 5:
         raise ValueError(f"pages must both be (L, N, H_kv, bs, Dh); got "
-                         f"{pages_k.shape} / {pages_v.shape}")
+                         f"{pk.shape} / {pv.shape}")
     was_3d = q.ndim == 3
     if was_3d:
         if q_lens is not None:
@@ -250,10 +360,10 @@ def _check_args(q, pages_k, pages_v, block_tables, kv_lens, q_lens, scale):
         raise ValueError(f"q must be (B, H, Dh) or (B, Q, H, Dh); "
                          f"got {q.shape}")
     b, qw, h, dh = q.shape
-    hkv = pages_k.shape[2]
-    if h % hkv or pages_k.shape[4] != dh:
+    hkv = pk.shape[2]
+    if h % hkv or pk.shape[4] != dh:
         raise ValueError(f"q has {h} heads / Dh {dh} but pages carry "
-                         f"{hkv} kv heads / Dh {pages_k.shape[4]}; "
+                         f"{hkv} kv heads / Dh {pk.shape[4]}; "
                          "need H % H_kv == 0 and equal head dims")
     if block_tables.shape[0] != b or kv_lens.shape != (b,):
         raise ValueError(f"block_tables {block_tables.shape} / kv_lens "
@@ -325,7 +435,18 @@ def scatter_kv_rows(pages, block_tables, offsets, rows, *, layer=None):
     ``rows`` (B, H, Dh). Rows whose table points at the pool's scratch page
     land there harmlessly. Returns the updated pages — under jit with the
     pool buffers donated this lowers to an in-place dynamic-update-scatter.
+
+    QuantPages: rows are quantized HERE (write time) and the int8 data and
+    f32 scale scatter through the same block-table math, so a row's scale
+    can never drift from its page slot.
     """
+    if isinstance(pages, QuantPages):
+        qrows, srows = quantize_kv_rows(rows)
+        return QuantPages(
+            scatter_kv_rows(pages.data, block_tables, offsets, qrows,
+                            layer=layer),
+            scatter_kv_rows(pages.scale, block_tables, offsets, srows,
+                            layer=layer))
     bs = pages.shape[-2]
     blk = jnp.take_along_axis(block_tables, (offsets // bs)[:, None],
                               axis=1)[:, 0]
@@ -347,8 +468,15 @@ def scatter_kv_chunk(pages, block_tables, starts, rows, q_lens, *,
     ``starts[b] + t`` through its block table; padding tokens (and whole rows
     with q_lens == 0) are redirected to the pool's scratch page 0, which is
     never allocated to a request, so they can't corrupt live KV. Same layer /
-    donation semantics as ``scatter_kv_rows``.
+    donation / write-time-quantization semantics as ``scatter_kv_rows``.
     """
+    if isinstance(pages, QuantPages):
+        qrows, srows = quantize_kv_rows(rows)
+        return QuantPages(
+            scatter_kv_chunk(pages.data, block_tables, starts, qrows, q_lens,
+                             layer=layer),
+            scatter_kv_chunk(pages.scale, block_tables, starts, srows, q_lens,
+                             layer=layer))
     bs = pages.shape[-2]
     qw = rows.shape[1]
     nbt = block_tables.shape[1]

@@ -238,12 +238,16 @@ class InferenceEngine:
                  spec: Any = "off", spec_k: int = 4,
                  draft_model=None, draft_params=None,
                  profiler: Optional[Profiler] = None, trace: bool = False,
-                 overlap: bool = False, seed: int = 0):
+                 overlap: bool = False, kv_dtype: str = "f32",
+                 quant_weights: bool = False, seed: int = 0):
         if getattr(model, "kv_cache_dtype", None):
             raise ValueError(
                 "the paged pool stores compute-dtype pages; "
                 f"kv_cache_dtype={model.kv_cache_dtype!r} models are not "
-                "servable yet — use models.gpt2.generate")
+                "servable — quantize the POOL instead (kv_dtype='int8')")
+        if kv_dtype not in ("f32", "int8"):
+            raise ValueError(f"kv_dtype must be 'f32' or 'int8', "
+                             f"got {kv_dtype!r}")
         if decode_path not in ("auto", "standard", "fused", "paged"):
             raise ValueError(f"unknown decode_path {decode_path!r}")
         if admission_policy not in ("reject", "block"):
@@ -295,13 +299,30 @@ class InferenceEngine:
         self.logit_guard = bool(logit_guard)
         self.faults = faults
         self.model = model
+        self.kv_dtype = kv_dtype
+        # compile-key suffix: int8 pools trace different step programs
+        # (QuantPages operands), so their cache entries must never collide
+        # with f32 ones; f32 appends () — keys stay byte-identical
+        self._kv_key = ("int8",) if kv_dtype == "int8" else ()
+        self.quant_weights = bool(quant_weights)
+        if self.quant_weights:
+            from ..nn import quant as _quant
+            params = _quant.quantize_for_decode(params)
         self.params = params
         self.head_dim = model.d_model // model.num_heads
         self.pool = PagedKVPool(
             num_layers=model.num_layers, num_kv_heads=model.num_kv_heads,
             head_dim=self.head_dim, num_blocks=num_blocks,
-            block_size=block_size, dtype=model.policy.compute_dtype)
+            block_size=block_size, dtype=model.policy.compute_dtype,
+            kv_dtype=kv_dtype)
         self.pool.fault_plan = faults
+        # static gauge extras spliced into every _health_gauges refresh:
+        # lets operators spot a misconfigured replica from /healthz alone
+        self._gauge_extras: Dict[str, Any] = {
+            "kv_dtype": self.kv_dtype,
+            "kv_bytes_per_token": self.pool.kv_bytes_per_token,
+            "quant_weights": int(self.quant_weights),
+        }
         cap = min(model.max_len, self.pool.capacity * block_size)
         self.max_seq_len = min(max_seq_len or cap, cap)
         # fixed assembly width: every decode step gathers this many blocks per
@@ -342,8 +363,8 @@ class InferenceEngine:
         # reuses it so the key-consumption sequence matches overlap-off
         self._reuse_key = None
         self._t_fetch_done: Optional[float] = None
-        self._health_gauges: Dict[str, int] = {"queue_depth": 0,
-                                               "num_running": 0}
+        self._health_gauges: Dict[str, Any] = {
+            "queue_depth": 0, "num_running": 0, **self._gauge_extras}
         self.requests: Dict[int, Request] = {}
         self._rid = itertools.count()
         self._key = jax.random.PRNGKey(seed)
@@ -397,6 +418,11 @@ class InferenceEngine:
     def _probe_fused(self, batch: int) -> Dict[str, Any]:
         """Validate the fused decode kernel against this model/params; raises
         ValueError (with the reason) when the standard path must be used."""
+        if self.kv_dtype == "int8":
+            raise ValueError(
+                "fused decode assembles a contiguous compute-dtype cache — "
+                "int8 pages would dequantize outside the kernel with no "
+                "bandwidth win; int8 pools use the paged or standard path")
         from ..models import fused_decode
 
         chunks = fused_decode.pick_chunks(
@@ -496,7 +522,8 @@ class InferenceEngine:
         # gauges immediately instead of waiting for the next commit
         self._health_gauges = {
             "queue_depth": self.scheduler.queue_depth,
-            "num_running": len(self.scheduler.running)}
+            "num_running": len(self.scheduler.running),
+            **self._gauge_extras}
         if self.tracer.enabled:
             self.tracer.instant("serve.submit", trace=req.trace_id, rid=rid)
         return rid
@@ -549,6 +576,10 @@ class InferenceEngine:
             "step_seq": self.step_seq,
             "spec": self.spec_mode,
             "spec_k": self.spec_k if self.drafter is not None else 0,
+            "kv_dtype": self.kv_dtype,
+            "kv_bytes_per_token": self.pool.kv_bytes_per_token,
+            "kv_scale_bytes_per_token": self.pool.kv_scale_bytes_per_token,
+            "quant_weights": self.quant_weights,
         })
         return s
 
@@ -855,12 +886,14 @@ class InferenceEngine:
             # stream, so the stall clock must not span the idle gap
             self._last_decode_emit = None
         self.metrics.observe_gauges(self.scheduler.queue_depth,
-                                    self.pool.occupancy)
+                                    self.pool.occupancy,
+                                    self.pool.kv_bytes_per_token)
         # host-side health gauges, cached at commit: /healthz answers from
         # the supervisor's copy without ever reaching into the engine
         self._health_gauges = {
             "queue_depth": self.scheduler.queue_depth,
-            "num_running": len(self.scheduler.running)}
+            "num_running": len(self.scheduler.running),
+            **self._gauge_extras}
 
     def _fetch_bundle(self, devs: List[Any]):
         """The step's single designated device->host fetch (the
@@ -994,7 +1027,8 @@ class InferenceEngine:
             temps[i] = req.temperature
             topks[i] = req.top_k
             topps[i] = req.top_p
-        key = ("pdecode", b, nb) if self._paged else ("decode", b, nb)
+        key = (("pdecode", b, nb) if self._paged
+               else ("decode", b, nb)) + self._kv_key
         label = "decode_paged" if self._paged else "decode"
         fn = self._jit.get(key)
         if fn is None:
@@ -1235,7 +1269,7 @@ class InferenceEngine:
         poison = np.float32("nan") if (
             self.faults is not None and self.faults.poison_prefill()
         ) else np.float32(0.0)
-        key = ("prefill", padded)
+        key = ("prefill", padded) + self._kv_key
         self._note_program("prefill", key, [req.rid],
                            fill=len(seq) / padded)
         fn = self._jit.get(key)
@@ -1324,8 +1358,10 @@ class InferenceEngine:
 
     def _cow_copy_fn(self):
         def fn(pages_k, pages_v, src, dst):
-            return (pages_k.at[:, dst].set(pages_k[:, src]),
-                    pages_v.at[:, dst].set(pages_v[:, src]))
+            # kv_pool.copy_blocks: under int8 the scale sidecar clones with
+            # its pages, so the COW block dequantizes identically
+            return (kv_pool_lib.copy_blocks(pages_k, src, dst),
+                    kv_pool_lib.copy_blocks(pages_v, src, dst))
 
         # donated + traced src/dst: one compile, in-place block copy
         return jax.jit(fn, donate_argnums=(0, 1))
@@ -1354,9 +1390,10 @@ class InferenceEngine:
                 if table:
                     self.pool.free(table)
                 return
-            fn = self._jit.get(("cow",))
+            cow_key = ("cow",) + self._kv_key
+            fn = self._jit.get(cow_key)
             if fn is None:
-                fn = self._jit[("cow",)] = self._cow_copy_fn()
+                fn = self._jit[cow_key] = self._cow_copy_fn()
             pk, pv = fn(self.pool.pages_k, self.pool.pages_v,
                         self._put(blocks[-1], jnp.int32),
                         self._put(copy[0], jnp.int32))
@@ -1594,7 +1631,8 @@ class InferenceEngine:
             for i in range(len(dec), len(rows)):
                 if self.faults.poison_prefill():
                     poison[i] = np.nan
-        key = ("mixed", b, qw, nb, "spec") if spec_on else ("mixed", b, qw, nb)
+        key = (("mixed", b, qw, nb, "spec") if spec_on
+               else ("mixed", b, qw, nb)) + self._kv_key
         self._note_program("spec" if spec_on else "mixed", key,
                            [r.rid for r in rows], fill=len(rows) / b)
         fn = self._jit.get(key)
@@ -1796,7 +1834,9 @@ class InferenceEngine:
 
         def fn(params, pages_k, pages_v, toks, starts, q_lens, tables,
                t, k, p, key, poison):
-            kf, vf = kv_pool_lib.gather_kv(pages_k, pages_v, tables)
+            kf, vf = kv_pool_lib.gather_kv(
+                pages_k, pages_v, tables,
+                out_dtype=model.policy.compute_dtype)
             # pad the time axis by qw: apply_cached's per-row cache write
             # CLAMPS its start, so a chunk ending at the assembly edge must
             # have headroom — the padded tail is gathered back below only
@@ -1914,7 +1954,9 @@ class InferenceEngine:
 
         def fn(params, pages_k, pages_v, toks, starts, q_lens, tables,
                n_draft, t, k, p, key, poison):
-            kf, vf = kv_pool_lib.gather_kv(pages_k, pages_v, tables)
+            kf, vf = kv_pool_lib.gather_kv(
+                pages_k, pages_v, tables,
+                out_dtype=model.policy.compute_dtype)
             # same assembly-edge headroom rationale as _mixed_standard_fn
             pad = [(0, 0), (0, 0), (0, 0), (0, qw), (0, 0)]
             kf, vf = jnp.pad(kf, pad), jnp.pad(vf, pad)
@@ -1962,7 +2004,9 @@ class InferenceEngine:
 
         def fn(params, pages_k, pages_v, toks, offsets, tables, t, k, p, key,
                poison):
-            kf, vf = kv_pool_lib.gather_kv(pages_k, pages_v, tables)
+            kf, vf = kv_pool_lib.gather_kv(
+                pages_k, pages_v, tables,
+                out_dtype=model.policy.compute_dtype)
             x, _ = model.wte.apply({"params": params["wte"], "state": {}},
                                    toks[:, None])                 # (B, 1, D)
             x, _ = model.wpe.apply({"params": params["wpe"], "state": {}},
@@ -2016,7 +2060,9 @@ class InferenceEngine:
                t, k, p, key, poison):
             from ..ops.pallas.decode_stack import fused_decode_stack
 
-            kf, vf = kv_pool_lib.gather_kv(pages_k, pages_v, tables)
+            kf, vf = kv_pool_lib.gather_kv(
+                pages_k, pages_v, tables,
+                out_dtype=model.policy.compute_dtype)
             # (L, B, H, T, Dh) -> the kernel's flat (L, B, T, D) layout
             def flat(c):
                 l, b, h, tt, dh = c.shape
@@ -2081,11 +2127,11 @@ class InferenceEngine:
             # stay harmless and the kernel's scalar position is uniform
             offsets[len(live):] = offsets[0]
         if self._paged:
-            key, label = ("pdecode", b, nb), "serve.decode_paged"
+            key, label = ("pdecode", b, nb) + self._kv_key, "serve.decode_paged"
         elif lockstep:
-            key, label = ("fdecode", b, nb), "serve.decode_fused"
+            key, label = ("fdecode", b, nb) + self._kv_key, "serve.decode_fused"
         else:
-            key, label = ("decode", b, nb), "serve.decode"
+            key, label = ("decode", b, nb) + self._kv_key, "serve.decode"
         self._note_program(label.split(".", 1)[1], key,
                            [r.rid for r in live], fill=len(live) / b)
         fn = self._jit.get(key)
@@ -2187,7 +2233,7 @@ class InferenceEngine:
         donated buffers (or unconditionally with ``force``, when no running
         request holds KV anyway). Any request still holding blocks at that
         point has lost its KV and must fail too."""
-        dead = getattr(self.pool.pages_k, "is_deleted", lambda: False)()
+        dead = self.pool.pages_deleted()
         if not (dead or force):
             return
         ev = self.abort_all("KV pages lost to a failed step")

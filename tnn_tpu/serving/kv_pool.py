@@ -39,6 +39,14 @@ contract, not an implementation detail:
 - Pages are stored in the pool dtype (the model's compute dtype); the
   engine donates them through every jitted step, so after a step the
   previously-held arrays are invalid — always re-read ``pool.pages_*``.
+- With ``kv_dtype="int8"`` each ``pages_*`` is a ``QuantPages`` bundle:
+  int8 ``data`` in the layout above plus a per-(position, head) f32
+  ``scale`` sidecar of shape ``(L, N, H_kv, bs, 1)``. The bundle is a
+  pytree, so it rides through every jitted step, donation, and
+  ``update_pages`` as one value — scales can never be re-adopted without
+  their pages or vice versa. Rows are quantized at scatter time and
+  dequantized at the attention read; the block-table math is identical,
+  so fork/COW/truncate/eviction never look inside the bundle.
 """
 from __future__ import annotations
 
@@ -51,6 +59,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..ops.pallas.paged_attention import QuantPages, quantize_kv_rows
+
 
 class PoolExhausted(RuntimeError):
     """No free blocks — the scheduler preempts and retries."""
@@ -60,21 +70,36 @@ class PagedKVPool:
     SCRATCH = 0  # reserved block for padded/inactive batch rows
 
     def __init__(self, num_layers: int, num_kv_heads: int, head_dim: int,
-                 num_blocks: int, block_size: int = 16, dtype=jnp.float32):
+                 num_blocks: int, block_size: int = 16, dtype=jnp.float32,
+                 kv_dtype: str = "f32"):
         if num_blocks < 2:
             raise ValueError("need >= 2 blocks (block 0 is reserved scratch)")
         if block_size < 1:
             raise ValueError(f"block_size must be >= 1, got {block_size}")
+        if kv_dtype not in ("f32", "int8"):
+            raise ValueError(f"kv_dtype must be 'f32' or 'int8', "
+                             f"got {kv_dtype!r}")
         self.num_layers = int(num_layers)
         self.num_kv_heads = int(num_kv_heads)
         self.head_dim = int(head_dim)
         self.num_blocks = int(num_blocks)
         self.block_size = int(block_size)
         self.dtype = dtype
+        self.kv_dtype = kv_dtype
         shape = (self.num_layers, self.num_blocks, self.num_kv_heads,
                  self.block_size, self.head_dim)
-        self.pages_k = jnp.zeros(shape, dtype)
-        self.pages_v = jnp.zeros(shape, dtype)
+        if kv_dtype == "int8":
+            # int8 pages + f32 per-(position, head) scale sidecar, bundled
+            # as one pytree so donation/update_pages move them together
+            self.pages_k = QuantPages(jnp.zeros(shape, jnp.int8),
+                                      jnp.zeros(shape[:-1] + (1,),
+                                                jnp.float32))
+            self.pages_v = QuantPages(jnp.zeros(shape, jnp.int8),
+                                      jnp.zeros(shape[:-1] + (1,),
+                                                jnp.float32))
+        else:
+            self.pages_k = jnp.zeros(shape, dtype)
+            self.pages_v = jnp.zeros(shape, dtype)
         # LIFO free list: freshly freed blocks are reused first (their pages
         # are warmest); block 0 never enters it
         self._free: List[int] = list(range(self.num_blocks - 1, 0, -1))
@@ -128,6 +153,40 @@ class PagedKVPool:
         """Fraction of capacity held by live requests (evictable blocks are
         reclaimable, so they count as available, not occupied)."""
         return self.num_allocated / max(self.capacity, 1)
+
+    @property
+    def page_itemsize(self) -> int:
+        """Bytes per stored KV element in the page arrays (1 under int8)."""
+        if self.kv_dtype == "int8":
+            return 1
+        return int(np.dtype(self.dtype).itemsize)
+
+    @property
+    def kv_bytes_per_token(self) -> int:
+        """Page-array bytes one resident token costs (K + V, all layers).
+
+        Counts the page data only — the int8 scale sidecar is reported
+        separately (``kv_scale_bytes_per_token``) because it is the part
+        that does NOT shrink with the page dtype."""
+        return 2 * self.num_layers * self.num_kv_heads * self.head_dim \
+            * self.page_itemsize
+
+    @property
+    def kv_scale_bytes_per_token(self) -> int:
+        """Sidecar bytes per token: one f32 scale per (position, head) for
+        K and V each under int8; zero otherwise."""
+        if self.kv_dtype != "int8":
+            return 0
+        return 2 * self.num_layers * self.num_kv_heads * 4
+
+    def pages_deleted(self) -> bool:
+        """True when the page buffers were donated into a step that died
+        (the arrays are deleted, so the next step would crash). Looks at
+        the data leaf under int8 — the bundle's leaves live and die
+        together because they are donated together."""
+        leaf = self.pages_k.data if isinstance(self.pages_k, QuantPages) \
+            else self.pages_k
+        return getattr(leaf, "is_deleted", lambda: False)()
 
     def blocks_for(self, num_tokens: int) -> int:
         """Blocks needed to hold ``num_tokens`` cache positions."""
@@ -277,6 +336,27 @@ class PagedKVPool:
         briefly holds that one extra block). A rejected draft suffix whose
         blocks were never truncated shows up here as a longer tail.
         """
+        if self.kv_dtype == "int8":
+            # scale/page agreement: both sides must still be the bundled
+            # pytree with the sidecar shaped to the pages — a step that
+            # re-adopted data without scales (or swapped shapes) fails
+            # HERE, not as silent garbage at the next dequant
+            for name, p in (("pages_k", self.pages_k),
+                            ("pages_v", self.pages_v)):
+                if not isinstance(p, QuantPages):
+                    raise ValueError(
+                        f"{name}: int8 pool holds {type(p).__name__}, not "
+                        "QuantPages — a step re-adopted pages without their "
+                        "scale sidecar")
+                if p.data.dtype != jnp.int8 or p.scale.dtype != jnp.float32:
+                    raise ValueError(
+                        f"{name}: dtype drift — data {p.data.dtype} / "
+                        f"scale {p.scale.dtype}, want int8 / float32")
+                if p.scale.shape != p.data.shape[:-1] + (1,):
+                    raise ValueError(
+                        f"{name}: scale {p.scale.shape} does not match "
+                        f"pages {p.data.shape} (want last axis collapsed "
+                        "to 1)")
         free_set = set(self._free)
         if len(free_set) != len(self._free):
             raise ValueError(f"duplicate blocks in free list: {self._free}")
@@ -361,8 +441,18 @@ class PagedKVPool:
         # explicit puts, not jnp.zeros: recovery runs inside the step's
         # TNN_DEBUG_SYNC transfer guard, where eager jnp ops (which commit
         # their scalar operands implicitly) are disallowed
-        self.pages_k = jax.device_put(np.zeros(shape, np.dtype(self.dtype)))
-        self.pages_v = jax.device_put(np.zeros(shape, np.dtype(self.dtype)))
+        if self.kv_dtype == "int8":
+            def fresh():
+                return QuantPages(
+                    jax.device_put(np.zeros(shape, np.int8)),
+                    jax.device_put(np.zeros(shape[:-1] + (1,), np.float32)))
+            self.pages_k = fresh()
+            self.pages_v = fresh()
+        else:
+            self.pages_k = jax.device_put(
+                np.zeros(shape, np.dtype(self.dtype)))
+            self.pages_v = jax.device_put(
+                np.zeros(shape, np.dtype(self.dtype)))
 
     def padded_table(self, block_table: Sequence[int], width: int):
         """Right-pad a block table with SCRATCH to a fixed ``width``."""
@@ -375,7 +465,7 @@ class PagedKVPool:
 # -- jit-safe assembly (trace into the engine's compiled steps) ---------------
 
 
-def gather_kv(pages_k, pages_v, block_tables):
+def gather_kv(pages_k, pages_v, block_tables, out_dtype=None):
     """Block tables -> contiguous ragged-batch caches.
 
     pages_*: (L, N, H, bs, Dh); block_tables: (B, nb) int32.
@@ -383,8 +473,23 @@ def gather_kv(pages_k, pages_v, block_tables):
     layout ``MultiHeadAttention.apply_cached`` reads. Positions past a row's
     true length hold garbage; the ragged causal mask (per-row kv_offset) keeps
     them out of the softmax.
+
+    ``out_dtype`` applies only to QuantPages: the dequantized cache is cast
+    to it (default f32) so it matches the compute dtype the downstream
+    cached-attention writes its new rows in. Plain pages ignore it — they
+    already ARE the pool dtype.
     """
     def g(pages):
+        if isinstance(pages, QuantPages):
+            # dequant at the gather: the assembled cache is compute-dtype,
+            # so the cached-attention consumers downstream are untouched
+            l, _, h, bs, dh = pages.data.shape
+            b, nb = block_tables.shape
+            x = pages.data[:, block_tables].astype(jnp.float32) \
+                * pages.scale[:, block_tables]
+            x = x.astype(out_dtype or jnp.float32)
+            x = x.transpose(0, 1, 3, 2, 4, 5)
+            return x.reshape(l, b, h, nb * bs, dh)
         l, _, h, bs, dh = pages.shape
         b, nb = block_tables.shape
         x = pages[:, block_tables]               # (L, B, nb, H, bs, Dh)
@@ -397,8 +502,13 @@ def scatter_prefill(pages, blocks, kv):
     """Write one sequence's contiguous prefill cache into its blocks.
 
     pages: (L, N, H, bs, Dh); blocks: (nb,) int32; kv: (L, H, nb*bs, Dh).
-    Returns the updated pages.
+    Returns the updated pages. QuantPages: rows quantize at write time;
+    data and scale scatter through identical index math.
     """
+    if isinstance(pages, QuantPages):
+        qkv, skv = quantize_kv_rows(kv)
+        return QuantPages(scatter_prefill(pages.data, blocks, qkv),
+                          scatter_prefill(pages.scale, blocks, skv))
     l, _, h, bs, dh = pages.shape
     nb = blocks.shape[0]
     x = kv.transpose(0, 2, 1, 3)                 # (L, P, H, Dh)
@@ -413,7 +523,14 @@ def scatter_token(pages, block_tables, offsets, rows):
     pages: (L, N, H, bs, Dh); block_tables: (B, nb); offsets: (B,) the
     position each row just wrote; rows: (L, B, H, Dh). Padded rows point
     their table at SCRATCH, so their writes land in the scratch block.
+    QuantPages: rows quantize at write time.
     """
+    if isinstance(pages, QuantPages):
+        qrows, srows = quantize_kv_rows(rows)
+        return QuantPages(scatter_token(pages.data, block_tables, offsets,
+                                        qrows),
+                          scatter_token(pages.scale, block_tables, offsets,
+                                        srows))
     bs = pages.shape[3]
     blk = jnp.take_along_axis(block_tables, (offsets // bs)[:, None],
                               axis=1)[:, 0]
@@ -432,7 +549,14 @@ def scatter_chunk(pages, block_tables, starts, rows, q_lens):
     padding tokens (t >= q_lens[b], and whole rows with q_lens == 0) are
     redirected to SCRATCH, which is never allocated to a request. The mixed
     prefill+decode step uses this to persist each prefill chunk's KV.
+    QuantPages: rows quantize at write time.
     """
+    if isinstance(pages, QuantPages):
+        qrows, srows = quantize_kv_rows(rows)
+        return QuantPages(scatter_chunk(pages.data, block_tables, starts,
+                                        qrows, q_lens),
+                          scatter_chunk(pages.scale, block_tables, starts,
+                                        srows, q_lens))
     bs = pages.shape[3]
     qw = rows.shape[2]
     nbt = block_tables.shape[1]
@@ -445,3 +569,15 @@ def scatter_chunk(pages, block_tables, starts, rows, q_lens):
     # advanced (blk, slot) indices broadcast to (B, Q) and lead the update
     # operand: (B, Q, L, H, Dh)
     return pages.at[:, blk, :, slot, :].set(rows.transpose(1, 2, 0, 3, 4))
+
+
+def copy_blocks(pages, src, dst):
+    """Copy whole pages ``src -> dst`` across every layer (the COW split's
+    device half). src/dst: (n,) int32 block ids. Under QuantPages the scale
+    sidecar is copied with its pages, so a cloned block dequantizes
+    identically to its source.
+    """
+    if isinstance(pages, QuantPages):
+        return QuantPages(copy_blocks(pages.data, src, dst),
+                          copy_blocks(pages.scale, src, dst))
+    return pages.at[:, dst].set(pages[:, src])

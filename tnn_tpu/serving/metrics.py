@@ -140,6 +140,10 @@ EXPOSITION: Dict[str, Tuple[str, str, str, str]] = {
     "serve.step_retries": (
         "tnn_serve_step_retries_total", "counter",
         "Transient decode faults retried in place", "step_retries"),
+    "serve.kv_bytes_per_token": (
+        "tnn_serve_kv_bytes_per_token", "gauge",
+        "Page-array bytes one resident KV token costs (K+V, all layers; "
+        "int8 scale sidecars excluded)", "kv_bytes_per_token"),
 }
 
 #: direct (non-``_tick``) families: attribute/gauge name → (prometheus
@@ -522,11 +526,13 @@ class ServingMetrics:
         self.spec_row_steps += rows
         self._tick("serve.spec_accepted", accepted)
 
-    def observe_gauges(self, queue_depth: int, pool_occupancy: float) -> None:
+    def observe_gauges(self, queue_depth: int, pool_occupancy: float,
+                       kv_bytes_per_token: float = 0.0) -> None:
         self.queue_depth.append(queue_depth)
         self.pool_occupancy.append(pool_occupancy)
         self._last_queue_depth = queue_depth
         self._last_pool_occupancy = pool_occupancy
+        self._last_kv_bytes_per_token = kv_bytes_per_token
 
     def observe_preemption(self, rid: Optional[int] = None) -> None:
         self.preemptions += 1
@@ -720,6 +726,8 @@ class ServingMetrics:
             "pool_occupancy_max": _max(self.pool_occupancy),
             "batch_fill_mean": _mean(self.batch_fill),
             "mixed_step_fill_mean": _mean(self.mixed_step_fill),
+            "kv_bytes_per_token": getattr(self, "_last_kv_bytes_per_token",
+                                          0.0),
         }
 
     # -- Prometheus exposition ------------------------------------------------
@@ -731,9 +739,14 @@ class ServingMetrics:
         gauges. Families render even before their first observation, so
         the scrape surface is stable from the first request."""
         families: List[Dict] = []
-        for key, (name, mtype, help_, _) in EXPOSITION.items():
+        for key, (name, mtype, help_, summary_key) in EXPOSITION.items():
             if mtype == "histogram":
                 samples = self.histograms[key].samples()
+            elif mtype == "gauge":
+                # gauges render the last observed value (stored by
+                # observe_gauges under the summary key), not a ticked sum
+                samples = [("", {}, float(getattr(
+                    self, "_last_" + summary_key, 0.0)))]
             else:
                 samples = [("", {}, self.counters.get(key, 0.0))]
             families.append({"name": name, "type": mtype, "help": help_,

@@ -12,6 +12,15 @@ reassigner such as ``pool.update_pages``) before its next read.
 The scan is lexical-forward inside one function: reads reached only by
 looping back are out of scope (the engine's retry loop is safe because the
 fault fires before re-entry, not after donation).
+
+Quantized pools add a twist: an int8 page buffer travels with a scale
+sidecar, and BOTH are donated.  When the sidecars are separate arrays
+(names ending in a configured ``scale_suffixes`` entry, default
+``scales_k``/``scales_v``), a reassigner call that re-adopts fewer buffers
+than were donated under its parent re-animates the pages but leaves the
+scales dead — that is a finding, not a kill.  Bundled pytrees (one name
+carrying data + scale, the repo's ``QuantPages``) are immune by
+construction and keep the plain kill behavior.
 """
 from __future__ import annotations
 
@@ -23,6 +32,7 @@ from ..core import (ModuleContext, Rule, Violation, call_name, dotted_name,
 
 _DEF_CACHE_ATTRS = ["_jit"]
 _DEF_REASSIGNERS = ["update_pages"]
+_DEF_SCALE_SUFFIXES = ["scales_k", "scales_v"]
 
 
 def _donate_positions(jit_call: ast.Call) -> Set[int]:
@@ -86,6 +96,7 @@ class UseAfterDonate(Rule):
         opts = ctx.rule_options(self.name)
         cache_attrs = set(opts.get("jit_cache_attrs", _DEF_CACHE_ATTRS))
         reassigners = set(opts.get("reassigners", _DEF_REASSIGNERS))
+        scale_suffixes = set(opts.get("scale_suffixes", _DEF_SCALE_SUFFIXES))
         out: List[Violation] = []
 
         # pass 1: builder methods -> (inner arity, donated positions)
@@ -103,13 +114,13 @@ class UseAfterDonate(Rule):
         # pass 2: call sites
         for _qual, fn, _cls in func_defs(ctx.tree):
             out.extend(self._check_function(ctx, fn, builders, cache_attrs,
-                                            reassigners))
+                                            reassigners, scale_suffixes))
         return out
 
     # -- per-function analysis -------------------------------------------------
 
     def _check_function(self, ctx, fn, builders, cache_attrs,
-                        reassigners) -> List[Violation]:
+                        reassigners, scale_suffixes) -> List[Violation]:
         out: List[Violation] = []
         # name -> donated positions (None = unknown builder: match by arity)
         jit_names: Dict[str, Optional[Set[int]]] = {}
@@ -173,11 +184,12 @@ class UseAfterDonate(Rule):
                         if chain:
                             donated.append(chain)
                 out.extend(self._scan_after(ctx, stmts, i, stmt, call,
-                                            donated, reassigners))
+                                            donated, reassigners,
+                                            scale_suffixes))
         return out
 
     def _scan_after(self, ctx, stmts, i, stmt, call, donated,
-                    reassigners) -> List[Violation]:
+                    reassigners, scale_suffixes) -> List[Violation]:
         out: List[Violation] = []
         live = set(donated)
         # the statement holding the call reassigns its own targets first
@@ -196,8 +208,26 @@ class UseAfterDonate(Rule):
                     if cn:
                         parts = cn.rsplit(".", 1)
                         if len(parts) == 2 and parts[1] in reassigners:
-                            live = {c for c in live
-                                    if not c.startswith(parts[0] + ".")}
+                            parent = parts[0] + "."
+                            under = {c for c in live
+                                     if c.startswith(parent)}
+                            side = {c for c in under
+                                    if c.rsplit(".", 1)[-1]
+                                    in scale_suffixes}
+                            if side and len(node.args) < len(under):
+                                # partial re-adoption: the call names fewer
+                                # buffers than were donated under this
+                                # parent — pages come back, scales stay dead
+                                for c in sorted(side):
+                                    out.append(self.violation(
+                                        ctx, node,
+                                        f"'{cn}' re-adopts donated page "
+                                        f"buffers but drops '{c}' — the "
+                                        f"scale sidecar donated on line "
+                                        f"{call.lineno} stays dead; "
+                                        "re-adopt pages and scales "
+                                        "together"))
+                            live -= under
                             continue
                 chain = dotted_name(node)
                 if chain is None:
