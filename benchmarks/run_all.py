@@ -41,6 +41,9 @@ def main(argv=None):
         # performance regressions
         results.extend(serve_bench.main(["--chaos"]))
         results.extend(serve_bench.main(["--avail"]))
+        # gray-failure gate: one persistently slow replica, mitigation
+        # off-vs-on A/B — hedging + ejection must beat pure JSQ's tail
+        results.extend(serve_bench.main(["--straggler"]))
         # observability gate: traced replicas must keep producing the
         # merged trace / flight-recorder / Prometheus artifacts
         results.extend(serve_bench.main(["--trace"]))

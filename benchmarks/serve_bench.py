@@ -883,6 +883,202 @@ def bench_availability(model, params, *, replicas: int, num_requests: int,
                "terminal": int(sum(terminals.values()))})
 
 
+def bench_straggler(model, params, *, replicas: int, num_requests: int,
+                    rate_per_s: float, prompt_len: int, max_new: int,
+                    num_blocks: int, block_size: int, max_batch_size: int,
+                    label: str, mitigate: bool, slow_idx: int = 0,
+                    slow_step_s: float = 0.4, hedge_ttft_s: float = 0.08,
+                    hedge_budget: float = 0.5, degrade_factor: float = 1.5,
+                    check_exact: bool = True, seed: int = 0,
+                    slo_ttft_s: float = 0.25, shared=None, artifact=None):
+    """Gray-failure A/B row: one Poisson trace through a ``Router`` over
+    ``replicas`` engines where replica ``slow_idx`` is PERSISTENTLY slow
+    (``slow_step_s`` injected per engine step) — alive, token-correct,
+    breaker-invisible. Run once with ``mitigate=False`` (hedging and
+    ejection off: pure JSQ keeps feeding the straggler) and once with
+    ``mitigate=True`` (TTFT hedging + health-scored ejection + proactive
+    migration): the ttft_ms_p99 / goodput_at_slo delta between the twin
+    rows IS the value of gray-failure tolerance.
+
+    The row self-asserts the contract — exactly one terminal per request,
+    every request FINISHED, streams byte-identical to a single-engine
+    greedy reference (hedge winners and proactively migrated streams
+    included), hedges within budget, zero leaked blocks, clean exit-0
+    drain. With ``shared``, the mitigated row additionally asserts its
+    p99 TTFT beats the unmitigated twin's and persists both rows as one
+    JSON artifact."""
+    import threading
+
+    from tnn_tpu.serving import (EngineSupervisor, InferenceEngine, Router,
+                                 ServingMetrics, SupervisorState)
+
+    print(f"{label}: {num_requests} requests @ ~{rate_per_s}/s across "
+          f"{replicas} replicas, replica {slow_idx} slowed by "
+          f"{slow_step_s}s/step, mitigation "
+          + ("ON (hedge+eject)" if mitigate else "OFF (pure JSQ)"))
+    rng = np.random.default_rng(seed)
+    prompts = [rng.integers(0, model.vocab_size, prompt_len).astype(np.int32)
+               for _ in range(num_requests)]
+    gaps = rng.exponential(1.0 / rate_per_s, num_requests)
+
+    ref = None
+    if check_exact:
+        # single-engine greedy reference: outputs are batch-independent,
+        # so a hedged or proactively migrated stream must match it
+        ref_engine = InferenceEngine(
+            model, params, num_blocks=num_blocks, block_size=block_size,
+            max_batch_size=max_batch_size, max_seq_len=prompt_len + max_new,
+            seed=seed)
+        ref = []
+        for p in prompts:
+            rid = ref_engine.submit(p, max_new)
+            ref.append(ref_engine.run_until_complete()[rid])
+
+    wprompt = np.random.default_rng(seed + 1).integers(
+        0, model.vocab_size, prompt_len).astype(np.int32)
+
+    def mk_engine():
+        # max_new=2 warms BOTH the prefill and the decode step: a decode
+        # compile spike during the timed window would poison the health
+        # score's step-latency EWMA and eject a healthy replica
+        eng = InferenceEngine(
+            model, params, num_blocks=num_blocks, block_size=block_size,
+            max_batch_size=max_batch_size, max_seq_len=prompt_len + max_new,
+            seed=seed)
+        wid = eng.submit(wprompt, 2)
+        eng.run_until_complete()
+        del eng.requests[wid]
+        eng.metrics = ServingMetrics(eng.profiler, slo_ttft_s=slo_ttft_s)
+        return eng
+
+    engines = [mk_engine() for _ in range(replicas)]
+    sups = [EngineSupervisor(e, max_restarts=3, restart_backoff_s=0.0,
+                             drain_deadline_s=60.0) for e in engines]
+    router = Router(
+        sups, seed=seed,
+        # fixed hedge threshold (not adaptive): the A/B must not depend
+        # on how many TTFT samples landed before the straggler bites
+        hedge_ttft_s=hedge_ttft_s if mitigate else None,
+        hedge_budget=hedge_budget if mitigate else 0.0,
+        degrade_factor=degrade_factor if mitigate else 0.0,
+        # a window longer than the hedge threshold: overdue first tokens
+        # hedge FIRST (fast rescue), then the sustained-slow replica is
+        # ejected and its remaining streams proactively migrate
+        degrade_window_s=max(0.25, 3 * hedge_ttft_s),
+        # keep the straggler ejected for the whole row: it never speeds
+        # back up, so recovery probes would only re-strand requests
+        degrade_cooldown_s=60.0)
+    # the gray failure itself: alive, correct, just slow — applied before
+    # any submit so both rows see the same degraded fleet from t=0
+    router.slow_replica(slow_idx, slow_step_s)
+
+    lock = threading.Lock()
+    terminals = {}   # gid -> terminal event count (exactly-once gate)
+    done = {}        # gid -> done event (tokens, ttft_ms)
+
+    def mk_listener():
+        def listener(ev):
+            if ev["event"] == "token":
+                return
+            with lock:
+                terminals[ev["id"]] = terminals.get(ev["id"], 0) + 1
+                if ev["event"] == "done":
+                    done[ev["id"]] = ev
+        return listener
+
+    t0 = time.perf_counter()
+    router.start()
+    gids = []
+    for p, gap in zip(prompts, gaps):
+        time.sleep(float(gap))
+        gids.append(router.submit(p, max_new, listener=mk_listener()))
+    deadline = time.monotonic() + 120.0
+    while sum(terminals.values()) < len(gids):
+        if time.monotonic() > deadline:
+            raise RuntimeError(
+                f"straggler bench wedged: "
+                f"{sum(terminals.values())}/{len(gids)} terminal")
+        time.sleep(0.01)
+    st = router.stats()
+    router.request_drain("bench complete")
+    if not router.join(timeout=60):
+        raise RuntimeError("router failed to drain")
+    wall = time.perf_counter() - t0
+
+    # the gray-failure contract IS the gate
+    assert router.state is SupervisorState.STOPPED and router.exit_code == 0
+    assert all(terminals.get(g, 0) == 1 for g in gids), \
+        "duplicated or missing terminal events"
+    assert len(done) == len(gids), \
+        f"only {len(done)}/{len(gids)} requests FINISHED"
+    exact = -1
+    if check_exact:
+        exact = int(all(done[g]["tokens"] == ref[i]
+                        for i, g in enumerate(gids)))
+        assert exact, "a hedged/migrated stream diverged from the reference"
+    hedge_cap = max(1, int(hedge_budget * num_requests))
+    if mitigate:
+        assert (st["hedges_fired"] + st["degraded_ejections"]
+                + st["proactive_migrations"]) >= 1, \
+            "mitigation never engaged — straggler too mild for the knobs"
+        assert st["hedges_fired"] <= hedge_cap, \
+            f"hedge amplification: {st['hedges_fired']} > cap {hedge_cap}"
+        assert st["hedges_won"] <= st["hedges_fired"]
+        assert st["hedges_cancelled"] <= st["hedges_fired"]
+    else:
+        assert st["hedges_fired"] == 0 and st["degraded_ejections"] == 0 \
+            and st["proactive_migrations"] == 0, \
+            "mitigation fired with hedging and ejection disabled"
+    for i, eng in enumerate(engines):
+        assert eng.pool.num_allocated == 0, f"replica {i} leaked KV blocks"
+        eng.check_invariants()
+
+    ttfts = np.array([done[g]["ttft_ms"] for g in gids], dtype=float)
+    within = int(np.sum(ttfts <= slo_ttft_s * 1e3))
+    row = report(
+        label, wall, items=num_requests, item_name="req",
+        extra={"requests": num_requests,
+               "replicas": replicas,
+               "slow_replica": slow_idx,
+               "slow_step_s": slow_step_s,
+               "mitigate": int(mitigate),
+               "finished": len(done),
+               "goodput_at_slo": round(within / wall, 4),
+               "slo_ttft_s": slo_ttft_s,
+               "ttft_ms_p50": round(float(np.percentile(ttfts, 50)), 3),
+               "ttft_ms_p99": round(float(np.percentile(ttfts, 99)), 3),
+               "hedges_fired": st["hedges_fired"],
+               "hedges_won": st["hedges_won"],
+               "hedges_cancelled": st["hedges_cancelled"],
+               "degraded_ejections": st["degraded_ejections"],
+               "proactive_migrations": st["proactive_migrations"],
+               "migrated_requests": st["migrated_requests"],
+               "router_retries": st["router_retries"],
+               "exact_vs_ref": exact,
+               "terminal": int(sum(terminals.values()))})
+    if shared is not None:
+        shared.setdefault("rows", []).append(row)
+        if mitigate:
+            off = [r for r in shared["rows"] if not r.get("mitigate")]
+            if off:
+                assert row["ttft_ms_p99"] < off[0]["ttft_ms_p99"], \
+                    (f"mitigation did not improve tail TTFT: "
+                     f"{row['ttft_ms_p99']} >= {off[0]['ttft_ms_p99']}")
+            if artifact:
+                import json
+                import os
+
+                os.makedirs(os.path.dirname(artifact), exist_ok=True)
+                with open(artifact, "w") as f:
+                    json.dump({"generated":
+                               time.strftime("%Y-%m-%dT%H:%M:%S"),
+                               "platform": jax.devices()[0].platform,
+                               "rows": shared["rows"]}, f, indent=2)
+                print(f"  straggler A/B artifact -> {artifact}")
+                row["artifact_path"] = artifact
+    return row
+
+
 def bench_trace(model, params, *, num_requests: int = 6, prompt_len: int = 6,
                 max_new: int = 8, replicas: int = 2, num_blocks: int = 16,
                 block_size: int = 4, max_batch_size: int = 4,
@@ -1015,6 +1211,13 @@ def main(argv=None):
                          "vs one-replica-killed-mid-run A/B, asserting the "
                          "token-exact failover contract and reporting "
                          "goodput-at-SLO + p99 TTFT for both rows")
+    ap.add_argument("--straggler", action="store_true",
+                    help="tiny model through a 3-replica Router with one "
+                         "persistently slow replica: mitigation-off vs "
+                         "hedging+ejection-on A/B, asserting the token-"
+                         "exact gray-failure contract and that the "
+                         "mitigated row's p99 TTFT beats the unmitigated "
+                         "twin's")
     ap.add_argument("--trace", action="store_true",
                     help="tiny model through a traced 2-replica Router: "
                          "persists the merged Perfetto trace, per-replica "
@@ -1036,6 +1239,27 @@ def main(argv=None):
         rr.add(lambda: bench_chaos(model, params, num_requests=8, max_new=8,
                                    label="serve_chaos"),
                label="bench_chaos")
+        return rr.results
+    if args.straggler:
+        # gray-failure A/B: the same Poisson trace through a 3-replica
+        # Router with replica 0 persistently slow — pure JSQ (mitigation
+        # off) keeps feeding the straggler; the mitigated row hedges late
+        # first tokens, ejects the straggler as DEGRADED, and proactively
+        # migrates its streams. The on-row asserts p99 TTFT strictly
+        # beats the off-row and persists both as one artifact
+        model, params = _smoke_model()
+        sshared = {}
+        import os
+        art = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "results", "straggler_ab_smoke.json")
+        for tag, mit in (("off", False), ("on", True)):
+            rr.add(lambda t=tag, m=mit: bench_straggler(
+                model, params, replicas=3, num_requests=10,
+                rate_per_s=100.0, prompt_len=6, max_new=6, num_blocks=16,
+                block_size=4, max_batch_size=4, mitigate=m,
+                shared=sshared, artifact=art,
+                label=f"serve_straggler_{t}"),
+                label=f"bench_straggler_{tag}")
         return rr.results
     if args.avail:
         # replicated-availability A/B: the same Poisson trace through a

@@ -267,6 +267,51 @@ def test_serve_bench_chaos():
     assert r["survivors_exact"] == 1
 
 
+def test_serve_bench_straggler():
+    """The --straggler A/B is the benchmark-shaped gray-failure gate: the
+    same Poisson trace through a 3-replica Router with one persistently
+    slow replica, mitigation off (pure JSQ keeps feeding the straggler)
+    vs on (TTFT hedging + health-scored ejection + proactive migration).
+    bench_straggler self-asserts the contract (exactly one terminal each,
+    token-exact streams, hedges within budget, zero leaks, exit-0 drain);
+    here we gate the row shapes, that mitigation actually engaged, that
+    the mitigated tail strictly beats the unmitigated one, and that the
+    persisted artifact re-parses. Tier-1 so gray-failure regressions fail
+    fast."""
+    import json
+    import os
+
+    from benchmarks import serve_bench
+
+    results = [r for r in serve_bench.main(["--straggler"]) if r]
+    assert [r["bench"] for r in results] == ["serve_straggler_off",
+                                             "serve_straggler_on"]
+    off, on = results
+    for r in (off, on):
+        assert r["ms"] > 0 and r["req_per_s"] > 0
+        assert r["requests"] == 10
+        assert r["finished"] == 10 and r["terminal"] == 10
+        assert r["replicas"] == 3 and r["slow_replica"] == 0
+        assert r["ttft_ms_p99"] >= r["ttft_ms_p50"] > 0
+        assert r["exact_vs_ref"] == 1  # token-exact even when hedged
+    # the unmitigated row proves the off-switches: nothing fires
+    assert off["hedges_fired"] == 0 and off["degraded_ejections"] == 0
+    assert off["proactive_migrations"] == 0
+    # the mitigated row proves the machinery AND the win
+    assert (on["hedges_fired"] + on["degraded_ejections"]
+            + on["proactive_migrations"]) >= 1
+    assert on["hedges_fired"] <= 5          # budget 0.5 x 10 requests
+    assert on["hedges_won"] <= on["hedges_fired"]
+    assert on["hedges_cancelled"] <= on["hedges_fired"]
+    assert on["ttft_ms_p99"] < off["ttft_ms_p99"]
+    art = on["artifact_path"]
+    assert os.path.exists(art)
+    with open(art) as f:
+        payload = json.load(f)
+    assert [row["bench"] for row in payload["rows"]] == [
+        "serve_straggler_off", "serve_straggler_on"]
+
+
 @pytest.mark.slow
 def test_serve_bench_trace():
     """The --trace row is the benchmark-shaped observability gate: a traced

@@ -330,6 +330,44 @@ def gather(q, want, deadline):
     return got
 ''', "unbounded-retry") == []
 
+    def test_unbudgeted_hedge_loop_flags(self):
+        # hedge amplification bomb: fire duplicates until something lands
+        assert _rules('''
+class Router:
+    def hedge_all(self, rec):
+        while True:
+            try:
+                return self.fire_hedge(rec)
+            except ConnectionError:
+                continue
+''', "unbounded-retry") == ["unbounded-retry"]
+
+    def test_hedge_budget_in_condition_clean(self):
+        assert _rules('''
+class Router:
+    def hedge_all(self, rec, open_):
+        pending = 0
+        while pending < self.hedge_budget * open_:
+            pending += 1
+            try:
+                self.fire_hedge(rec)
+            except ConnectionError:
+                continue
+''', "unbounded-retry") == []
+
+    def test_hedge_deadline_in_condition_clean(self):
+        # a wall deadline bounds the loop as well as a count budget does
+        assert _rules('''
+import time
+class Router:
+    def hedge_until(self, rec, deadline):
+        while time.monotonic() < deadline:
+            try:
+                self.fire_hedge(rec)
+            except ConnectionError:
+                continue
+''', "unbounded-retry") == []
+
 
 class TestUnregisteredMetricKey:
     REGISTRY = '''

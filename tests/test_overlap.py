@@ -228,7 +228,10 @@ class TestDeferredPhase:
         assert "tnn_serve_host_gap_seconds_total" in fams
         assert "tnn_serve_overlap_rebuilds_total" in fams
         # commit-time gauges: what /healthz now serves without engine access
-        assert sup.health_gauges() == {
+        g = sup.health_gauges()
+        assert g.pop("age_s") >= 0.0          # staleness of the snapshot
+        assert g.pop("step_latency_s") > 0.0  # steps ran: last wall time
+        assert g == {
             "queue_depth": 0, "num_running": 0, "kv_dtype": "f32",
             "kv_bytes_per_token": eng.pool.kv_bytes_per_token,
             "quant_weights": 0}

@@ -2076,6 +2076,172 @@ class TestCircuitBreaker:
         b.record_failure()                    # the probe failed
         assert b.state is BreakerState.OPEN
 
+    def test_stale_success_cannot_close_open_breaker(self):
+        """Regression: a success recorded while the breaker is OPEN (a
+        stream that dispatched before the trip landing its terminal after
+        it) must NOT close the breaker — only a HALF_OPEN probe or normal
+        CLOSED traffic counts."""
+        b = CircuitBreaker(threshold=1, cooldown_s=60.0)
+        b.trip()
+        assert b.state is BreakerState.OPEN
+        b.record_success()                    # stale: from before the trip
+        assert b.state is BreakerState.OPEN and not b.allows()
+
+    def test_stale_success_race_after_probe_failure(self):
+        """The precise race: probe admitted, probe fails (re-OPEN), THEN a
+        stale success from an older stream arrives. The breaker must stay
+        OPEN — otherwise one laggard ack reopens the floodgates onto a
+        replica the probe just proved dead."""
+        b = CircuitBreaker(threshold=1, cooldown_s=0.0)
+        b.record_failure()
+        assert b.allows()                     # cooldown 0: probe admitted
+        b.on_dispatch()
+        b.record_failure()                    # probe failed: re-OPEN
+        assert b.state is BreakerState.OPEN
+        b.record_success()                    # stale ack from an old stream
+        assert b.state is BreakerState.OPEN
+
+
+class TestHealthScore:
+    """Unit tests for the EWMA health score: the healthy fixed point is
+    exactly 1.0 (so a fresh fleet places as pure JSQ), and each signal
+    contributes its documented weight."""
+
+    def test_fresh_score_is_exactly_one(self):
+        from tnn_tpu.serving import HealthScore
+
+        hs = HealthScore()
+        assert hs.score() == 1.0
+        assert hs.samples == 0
+
+    def test_dispatch_latency_ewma_blend(self):
+        from tnn_tpu.serving import HealthScore
+
+        hs = HealthScore()
+        hs.observe_dispatch(1.0)
+        assert hs.dispatch_latency_s == pytest.approx(HealthScore.ALPHA)
+        assert hs.score() == pytest.approx(
+            1.0 + HealthScore.W_DISPATCH * HealthScore.ALPHA)
+        hs.observe_dispatch(1.0)
+        a = HealthScore.ALPHA
+        assert hs.dispatch_latency_s == pytest.approx((1 - a) * a + a)
+
+    def test_gauge_sample_contributions(self):
+        from tnn_tpu.serving import HealthScore
+
+        hs = HealthScore()
+        hs.observe_gauges(0.1, 4.0, 0.0)
+        a = HealthScore.ALPHA
+        assert hs.step_latency_s == pytest.approx(a * 0.1)
+        assert hs.queue_depth == pytest.approx(a * 4.0)
+        assert hs.score() == pytest.approx(
+            1.0 + HealthScore.W_STEP * a * 0.1
+            + HealthScore.W_QUEUE * a * 4.0)
+
+    def test_error_rate_folds_and_decays(self):
+        from tnn_tpu.serving import HealthScore
+
+        hs = HealthScore()
+        hs.observe_outcome(False)
+        a = HealthScore.ALPHA
+        assert hs.error_rate == pytest.approx(a)
+        assert hs.score() == pytest.approx(1.0 + HealthScore.W_ERROR * a)
+        hs.observe_outcome(True)              # success decays the EWMA
+        assert hs.error_rate == pytest.approx((1 - a) * a)
+
+    def test_staleness_grace_window(self):
+        from tnn_tpu.serving import HealthScore
+
+        hs = HealthScore()
+        # inside the grace window: free — probe cadence jitter is normal
+        hs.observe_gauges(0.0, 0.0, HealthScore.STALE_GRACE_S * 0.5)
+        assert hs.score() == 1.0
+        # past it: a wedged-but-responsive worker starts paying
+        hs.observe_gauges(0.0, 0.0, HealthScore.STALE_GRACE_S + 2.0)
+        assert hs.score() == pytest.approx(1.0 + HealthScore.W_STALE * 2.0)
+
+
+class TestFaultPlanGraySites:
+    """Seed-determinism and semantics of the gray-failure fault sites:
+    replica.slow, net.partition (windowed), net.flaky (per-replica)."""
+
+    def test_replica_slow_seed_deterministic(self):
+        a = FaultPlan(seed=11, replica_slow_prob=0.3)
+        b = FaultPlan(seed=11, replica_slow_prob=0.3)
+        trace_a = [a.replica_slow() for _ in range(50)]
+        trace_b = [b.replica_slow() for _ in range(50)]
+        assert trace_a == trace_b
+        assert any(trace_a) and not all(trace_a)
+        assert a.fired["replica.slow"] == sum(trace_a)
+        # a different seed yields a different schedule
+        c = FaultPlan(seed=12, replica_slow_prob=0.3)
+        assert [c.replica_slow() for _ in range(50)] != trace_a
+
+    def test_replica_slow_scheduled_calls(self):
+        p = FaultPlan(replica_slow_calls=(3,))
+        assert [p.replica_slow() for _ in range(5)] == \
+            [False, False, True, False, False]
+        assert p.fired["replica.slow"] == 1
+
+    def test_partition_window_semantics(self):
+        """One hit opens a window of net_partition_rounds consults; every
+        consult inside it reports active, then the window closes."""
+        p = FaultPlan(net_partition_calls=(2,), net_partition_rounds=3)
+        got = [p.net_partition() for _ in range(7)]
+        assert got == [False, True, True, True, False, False, False]
+        assert p.fired["net.partition"] == 1   # one HIT, one window
+
+    def test_partition_active_is_a_pure_read(self):
+        """partition_active never advances the rng stream: two identical
+        plans, one read between every consult, fire identically."""
+        a = FaultPlan(seed=7, net_partition_prob=0.2,
+                      net_partition_rounds=2)
+        b = FaultPlan(seed=7, net_partition_prob=0.2,
+                      net_partition_rounds=2)
+        trace_a, trace_b = [], []
+        for _ in range(40):
+            trace_a.append(a.net_partition())
+            trace_b.append(b.net_partition())
+            for _ in range(5):                 # hammer the pure read
+                b.partition_active
+        assert trace_a == trace_b
+        assert a.fired["net.partition"] == b.fired["net.partition"] > 0
+
+    def test_partition_active_tracks_window(self):
+        p = FaultPlan(net_partition_calls=(1,), net_partition_rounds=2)
+        assert not p.partition_active
+        assert p.net_partition()               # hit: window opens
+        assert p.partition_active              # one consult left
+        assert p.net_partition()               # last consult of the window
+        assert not p.partition_active
+        assert not p.net_partition()
+
+    def test_flaky_drop_only_consults_configured_replica(self):
+        """Calls to healthy replicas never perturb the flaky schedule —
+        the rng stream depends only on the flaky replica's own calls."""
+        p = FaultPlan(flaky_replica=1, flaky_drop_calls=(1,))
+        assert not p.flaky_drop(0)             # wrong replica: no consult
+        assert p.calls["net.flaky"] == 0
+        assert p.flaky_drop(1)                 # 1st consult = scheduled hit
+        assert not p.flaky_drop(1)
+        assert p.fired["net.flaky"] == 1
+        # disabled site never consults at all
+        q = FaultPlan(flaky_drop_prob=1.0)     # flaky_replica defaults -1
+        assert not q.flaky_drop(0) and q.calls["net.flaky"] == 0
+
+    def test_flaky_drop_seed_deterministic(self):
+        a = FaultPlan(seed=5, flaky_replica=2, flaky_drop_prob=0.4)
+        b = FaultPlan(seed=5, flaky_replica=2, flaky_drop_prob=0.4)
+        # interleave irrelevant-replica calls on one plan only
+        trace_a = [a.flaky_drop(2) for _ in range(40)]
+        trace_b = []
+        for _ in range(40):
+            b.flaky_drop(0)
+            trace_b.append(b.flaky_drop(2))
+            b.flaky_drop(1)
+        assert trace_a == trace_b
+        assert any(trace_a) and not all(trace_a)
+
 
 class TestRouter:
     """The failover front end over N supervised replicas, driven through
@@ -2338,6 +2504,427 @@ class TestRouter:
         assert router.state is SupervisorState.STOPPED
         assert router.exit_code == 0
         assert gid in {e["id"] for e in events}
+
+    # -- gray-failure tolerance: health-scored placement -----------------------
+
+    def test_uniform_scores_route_byte_identical_to_jsq(self, tiny_lm):
+        """The degenerate case IS the old behaviour: with uniform health
+        scores the weighted placement reduces to pure JSQ, down to the
+        tie-breaks — replicas in index order, strictly-shorter wins."""
+        router, sups, _ = self._router(tiny_lm, n=3)
+        gids = [router.submit(np.arange(5, dtype=np.int32) + i, 4)
+                for i in range(7)]
+        placed = [router._open[g].replica for g in gids]
+        assert placed == [0, 1, 2, 0, 1, 2, 0]
+        assert [len(h.live) for h in router.replicas] == [3, 2, 2]
+        router.run_sync()
+
+    def test_dead_band_snaps_small_score_deltas_to_jsq(self, tiny_lm):
+        """Scores inside the tolerance dead-band don't perturb placement:
+        routing stays byte-identical to JSQ despite the noise."""
+        router, sups, _ = self._router(tiny_lm, n=3)
+        # score 1.x, ratio under 1 + score_tolerance (default 0.5)
+        router.replicas[0].health.step_latency_s = 0.01
+        gids = [router.submit(np.arange(5, dtype=np.int32) + i, 4)
+                for i in range(7)]
+        assert [router._open[g].replica for g in gids] == \
+            [0, 1, 2, 0, 1, 2, 0]
+        router.run_sync()
+
+    def test_large_score_delta_steers_placement_away(self, tiny_lm):
+        """A genuinely worse replica gets proportionally less work: its
+        weighted queue key loses even at equal queue length."""
+        router, sups, _ = self._router(tiny_lm, n=3)
+        router.replicas[0].health.step_latency_s = 1.0   # score ~26
+        gids = [router.submit(np.arange(5, dtype=np.int32) + i, 4)
+                for i in range(6)]
+        placed = [router._open[g].replica for g in gids]
+        assert 0 not in placed
+        assert [len(h.live) for h in router.replicas] == [0, 3, 3]
+        router.run_sync()
+
+    def test_score_tolerance_validated(self, tiny_lm):
+        model, params = tiny_lm
+        sup = EngineSupervisor(InferenceEngine(model, params, **self.KW))
+        with pytest.raises(ValueError, match="score_tolerance"):
+            Router([sup], score_tolerance=-0.1)
+
+    def test_slow_replica_actuator(self, tiny_lm):
+        """The replica.slow chaos actuator installs a per-step delay on a
+        live engine (creating a FaultPlan when none exists) and delay<=0
+        restores full speed."""
+        router, sups, _ = self._router(tiny_lm, n=2)
+        assert sups[0].engine.faults is None
+        router.slow_replica(0, 0.02)
+        assert sups[0].engine.faults.step_delay_s == 0.02
+        assert sups[0].engine.faults.step_delay_calls == ()
+        router.slow_replica(0, -1.0)
+        assert sups[0].engine.faults.step_delay_s == 0.0
+
+    # -- gray-failure tolerance: degraded-replica ejection ---------------------
+
+    GRAY_KW = dict(hedge_budget=0.0, degrade_window_s=0.0,
+                   degrade_cooldown_s=1000.0)
+
+    def test_sustained_bad_score_ejects_replica(self, tiny_lm):
+        """Score past degrade_factor × fleet median, sustained for the
+        window, ejects the replica from placement: DEGRADED, not OPEN —
+        its breaker is untouched because its calls still succeed."""
+        router, sups, _ = self._router(
+            tiny_lm, n=3, router_kw=dict(self.GRAY_KW))
+        router.pump(1)
+        router.replicas[0].health.step_latency_s = 1.0
+        router._probe()                       # crossing: suspect_since set
+        assert not router.replicas[0].degraded
+        router._probe()                       # sustained: ejected
+        assert router.replicas[0].degraded
+        assert not router.replicas[0].available
+        assert router.replicas[0].breaker.state is BreakerState.CLOSED
+        assert router.metrics.degraded_ejections == 1
+        # placement skips it entirely now
+        gids = [router.submit(np.arange(5, dtype=np.int32) + i, 4)
+                for i in range(4)]
+        assert all(router._open[g].replica in (1, 2) for g in gids)
+        router.run_sync()
+
+    def test_ejection_proactively_migrates_live_streams(self, tiny_lm):
+        """Ejecting a replica pulls its in-flight streams off BEFORE they
+        fail: same token-exact recompute-resume as crash migration, old
+        stream cancelled quietly, counted as proactive."""
+        model, params = tiny_lm
+        router, sups, events = self._router(
+            tiny_lm, n=3, router_kw=dict(self.GRAY_KW, migration_budget=3))
+        p = np.arange(6, dtype=np.int32)
+        ref = _greedy_ref(model, params, p, 8, sups[0].engine.assembly_len)
+        gid = router.submit(p, 8)
+        assert router._open[gid].replica == 0
+        router.pump(2)                        # stream genuinely mid-flight
+        router.replicas[0].health.step_latency_s = 1.0
+        router._probe()
+        router._probe()                       # ejects + migrates proactively
+        assert router.metrics.degraded_ejections == 1
+        assert router.metrics.proactive_migrations == 1
+        assert router._open[gid].replica in (1, 2)
+        router.run_sync()
+        term = {e["id"]: e for e in self._terminals(events)}
+        assert term[gid]["event"] == "done" and term[gid]["tokens"] == ref
+        streamed = [e["token"] for e in events if e["event"] == "token"]
+        assert streamed == ref                # nothing duplicated or lost
+        assert router.replicas[0].breaker.state is BreakerState.CLOSED
+
+    def test_never_ejects_last_non_degraded_replica(self, tiny_lm):
+        """The guard that keeps the fleet serving: however bad its score,
+        the last non-degraded replica is never ejected."""
+        router, sups, _ = self._router(
+            tiny_lm, n=3, router_kw=dict(self.GRAY_KW))
+        router.pump(1)
+        router.replicas[1].degraded = True
+        router.replicas[2].degraded = True
+        router.replicas[0].health.step_latency_s = 5.0
+        router._probe()
+        router._probe()
+        assert not router.replicas[0].degraded
+        assert router.metrics.degraded_ejections == 0
+
+    def test_recovered_replica_is_readmitted(self, tiny_lm):
+        """Hysteresis readmission: once the score is back under
+        readmit_factor × median for a sustained window, the replica
+        rejoins placement."""
+        router, sups, _ = self._router(
+            tiny_lm, n=3, router_kw=dict(self.GRAY_KW))
+        router.pump(1)
+        router.replicas[0].health.step_latency_s = 1.0
+        router._probe()
+        router._probe()
+        assert router.replicas[0].degraded
+        router.replicas[0].health.step_latency_s = 0.0   # recovered
+        router._probe()                       # back under: readmit timer
+        router._probe()                       # sustained: readmitted
+        assert not router.replicas[0].degraded
+        assert router.replicas[0].available
+        gids = [router.submit(np.arange(5, dtype=np.int32) + i, 4)
+                for i in range(3)]
+        assert sorted(router._open[g].replica for g in gids) == [0, 1, 2]
+        router.run_sync()
+
+    def test_recovery_probe_after_cooldown(self, tiny_lm):
+        """Past the cooldown a degraded replica is offered ONE probe
+        dispatch at a time so it can prove itself — no thundering herd
+        back onto a replica that may still be sick."""
+        router, sups, _ = self._router(
+            tiny_lm, n=3, router_kw=dict(self.GRAY_KW))
+        router.pump(1)
+        router.replicas[0].health.step_latency_s = 1.0
+        router._probe()
+        router._probe()
+        assert router.replicas[0].degraded
+        g1 = router.submit(np.arange(5, dtype=np.int32), 6)
+        g2 = router.submit(np.arange(6, dtype=np.int32), 6)
+        assert {router._open[g].replica for g in (g1, g2)} == {1, 2}
+        # cooldown elapses; the replica's score has recovered
+        router.replicas[0].health.step_latency_s = 0.0
+        router.degrade_cooldown_s = 0.0
+        g3 = router.submit(np.arange(7, dtype=np.int32), 6)
+        assert router._open[g3].replica == 0   # the probe dispatch
+        assert router.replicas[0].recovery_probing
+        g4 = router.submit(np.arange(8, dtype=np.int32), 6)
+        assert router._open[g4].replica in (1, 2)   # one probe at a time
+        router.run_sync()
+
+    # -- gray-failure tolerance: hedged dispatch -------------------------------
+
+    HEDGE_KW = dict(hedge_ttft_s=0.0, hedge_budget=1.0, degrade_factor=0.0)
+
+    def test_overdue_request_hedges_and_dedupes(self, tiny_lm):
+        """A first token past the threshold races a duplicate on another
+        replica; the primary's first token wins, the duplicate is
+        cancelled quietly, and the client stream carries every token
+        exactly once."""
+        model, params = tiny_lm
+        router, sups, events = self._router(
+            tiny_lm, n=2, router_kw=dict(self.HEDGE_KW))
+        p = np.arange(6, dtype=np.int32)
+        ref = _greedy_ref(model, params, p, 5, sups[0].engine.assembly_len)
+        gid = router.submit(p, 5)
+        router._probe()                       # threshold 0: fires at once
+        assert router.metrics.hedges_fired == 1
+        rec = router._open[gid]
+        assert rec.hedge_replica == 1 and rec.hedge_epoch is not None
+        assert [len(h.live) for h in router.replicas] == [1, 1]
+        router.run_sync()
+        term = {e["id"]: e for e in self._terminals(events)}
+        assert list(term) == [gid]            # exactly one terminal
+        assert term[gid]["event"] == "done" and term[gid]["tokens"] == ref
+        streamed = [e["token"] for e in events if e["event"] == "token"]
+        assert streamed == ref                # epoch guard deduped the race
+        assert router.metrics.hedges_cancelled == 1
+        # the loser never charges a breaker
+        assert all(h.breaker.state is BreakerState.CLOSED
+                   for h in router.replicas)
+        for h in router.replicas:
+            assert not h.live
+            assert h.sup.engine.pool.num_allocated == 0
+            h.sup.engine.check_invariants()
+
+    def test_hedge_budget_bounds_amplification(self, tiny_lm):
+        """The budget is consulted before EVERY fire: with every request
+        overdue at once, only hedge_budget × open duplicates launch."""
+        router, sups, _ = self._router(
+            tiny_lm, n=3,
+            router_kw=dict(self.HEDGE_KW, hedge_budget=0.4))
+        for i in range(5):
+            router.submit(np.arange(5, dtype=np.int32) + i, 4)
+        router._probe()                       # all 5 overdue; cap = 2
+        assert router.metrics.hedges_fired == 2
+        router.run_sync()
+
+    def test_hedge_disabled_when_budget_zero(self, tiny_lm):
+        router, sups, _ = self._router(
+            tiny_lm, n=2,
+            router_kw=dict(self.HEDGE_KW, hedge_budget=0.0))
+        router.submit(np.arange(5, dtype=np.int32), 4)
+        router._probe()
+        assert router.metrics.hedges_fired == 0
+        router.run_sync()
+
+    def test_hedge_fires_at_most_once_per_request(self, tiny_lm):
+        router, sups, _ = self._router(
+            tiny_lm, n=3, router_kw=dict(self.HEDGE_KW))
+        router.submit(np.arange(5, dtype=np.int32), 4)
+        router._probe()
+        assert router.metrics.hedges_fired == 1
+        router._probe()                       # still overdue, already hedged
+        router._probe()
+        assert router.metrics.hedges_fired == 1
+        router.run_sync()
+
+    def test_hedge_promoted_when_primary_dies(self, tiny_lm):
+        """Primary replica hard-killed with a hedge in flight: the
+        duplicate is promoted in place (hedges_won) — no fresh migration
+        dispatch, and the stream finishes token-exact."""
+        model, params = tiny_lm
+        router, sups, events = self._router(
+            tiny_lm, n=2, router_kw=dict(self.HEDGE_KW))
+        p = np.arange(6, dtype=np.int32)
+        ref = _greedy_ref(model, params, p, 5, sups[0].engine.assembly_len)
+        gid = router.submit(p, 5)
+        assert router._open[gid].replica == 0
+        router._probe()                       # hedge racing on replica 1
+        assert router.metrics.hedges_fired == 1
+        router.kill_replica(0)
+        rec = router._open[gid]
+        assert rec.replica == 1               # duplicate promoted to primary
+        assert router.metrics.hedges_won == 1
+        assert router.metrics.migrated_requests == 0
+        router.run_sync()
+        term = {e["id"]: e for e in self._terminals(events)}
+        assert term[gid]["event"] == "done" and term[gid]["tokens"] == ref
+        streamed = [e["token"] for e in events if e["event"] == "token"]
+        assert streamed == ref
+
+    def test_adaptive_threshold_needs_ttft_samples(self, tiny_lm):
+        """hedge_ttft_s=None means adaptive: no hedging until the rolling
+        TTFT window holds enough samples to trust a p95."""
+        router, sups, _ = self._router(
+            tiny_lm, n=2,
+            router_kw=dict(hedge_ttft_s=None, hedge_budget=1.0,
+                           degrade_factor=0.0))
+        assert router._hedge_threshold_locked() is None
+        router.submit(np.arange(5, dtype=np.int32), 4)
+        router._probe()                       # no threshold yet: no hedge
+        assert router.metrics.hedges_fired == 0
+        router._ttft_window.extend([0.01] * 8)
+        thr = router._hedge_threshold_locked()
+        assert thr == pytest.approx(0.01)
+        router.run_sync()
+
+    def test_fixed_threshold_wins_over_adaptive(self, tiny_lm):
+        router, sups, _ = self._router(
+            tiny_lm, n=2, router_kw=dict(hedge_ttft_s=0.123))
+        router._ttft_window.extend([0.01] * 64)
+        assert router._hedge_threshold_locked() == pytest.approx(0.123)
+
+    # -- gray-failure tolerance: observability ---------------------------------
+
+    def test_gray_failure_stats_and_gauges_shape(self, tiny_lm):
+        router, sups, _ = self._router(tiny_lm, n=2)
+        router.submit(np.arange(5, dtype=np.int32), 4)
+        st = router.stats()
+        for k in ("hedges_fired", "hedges_won", "hedges_cancelled",
+                  "degraded_ejections", "proactive_migrations"):
+            assert st[k] == 0
+        for r in st["replicas"]:
+            assert r["degraded"] is False
+            assert r["health_score"] >= 1.0
+        g = router.health_gauges()
+        assert g["replicas_degraded"] == 0
+        for k in ("hedges_fired", "hedges_won", "hedges_cancelled",
+                  "degraded_ejections", "proactive_migrations"):
+            assert g[k] == 0
+        router.run_sync()
+
+    def test_health_score_prometheus_family(self, tiny_lm):
+        """The per-replica health-score gauge survives the router-label
+        merge: one sample per replica, each keeping its own index."""
+        router, sups, _ = self._router(tiny_lm, n=3)
+        fams = {f["name"]: f for f in router.prometheus_series()}
+        fam = fams["tnn_serve_replica_health_score"]
+        assert fam["type"] == "gauge"
+        labels = sorted(lbls["replica"] for _, lbls, _ in fam["samples"])
+        assert labels == ["0", "1", "2"]
+        assert all(v >= 1.0 for _, _, v in fam["samples"])
+        for name in ("tnn_serve_hedges_fired_total",
+                     "tnn_serve_hedges_won_total",
+                     "tnn_serve_hedges_cancelled_total",
+                     "tnn_serve_degraded_ejections_total",
+                     "tnn_serve_proactive_migrations_total"):
+            assert name in fams, name
+
+    def test_gray_failure_metrics_counters(self):
+        from tnn_tpu.serving.metrics import ServingMetrics
+
+        m = ServingMetrics()
+        m.observe_hedge_fired()
+        m.observe_hedge_won()
+        m.observe_hedge_cancelled()
+        m.observe_ejection()
+        m.observe_proactive_migration()
+        m.observe_proactive_migration()
+        s = m.summary()
+        assert s["hedges_fired"] == 1
+        assert s["hedges_won"] == 1
+        assert s["hedges_cancelled"] == 1
+        assert s["degraded_ejections"] == 1
+        assert s["proactive_migrations"] == 2
+
+
+def test_gray_failure_chaos_soak(tiny_lm):
+    """The gray-failure gate: 3 replicas with the full gray fault surface
+    composed — one replica turned persistently slow on a seeded schedule
+    (replica.slow), flaky per-replica call drops (net.flaky), a seeded
+    router↔replica partition window (net.partition), and a mid-run hard
+    kill — with hedging and degraded-ejection live. Asserts the whole
+    contract: exactly one terminal per admitted request, hedged streams'
+    tokens delivered exactly once, every finished stream token-exact
+    against the fault-free reference, zero leaked blocks on survivors."""
+    model, params = tiny_lm
+    rng = np.random.default_rng(33)
+    uniq = [rng.integers(0, 128, int(n)).astype(np.int32)
+            for n in rng.integers(4, 12, 6)]
+    max_new = 5
+    sups = [EngineSupervisor(
+                InferenceEngine(model, params, num_blocks=32, block_size=4,
+                                max_batch_size=4, max_seq_len=32,
+                                max_queue_depth=24),
+                restart_backoff_s=0.0)
+            for _ in range(3)]
+    refs = {i: _greedy_ref(model, params, p, max_new,
+                           sups[0].engine.assembly_len)
+            for i, p in enumerate(uniq)}
+    events = []
+    net = FaultPlan(seed=41, flaky_replica=1, flaky_drop_prob=0.15,
+                    net_partition_calls=(12,), net_partition_rounds=2)
+    router = Router(sups, event_sink=events.append, seed=4, faults=net,
+                    retry_backoff_s=0.0, retry_jitter_s=0.0,
+                    hedge_ttft_s=0.05, hedge_budget=0.3,
+                    degrade_factor=2.0, degrade_window_s=0.05,
+                    degrade_cooldown_s=60.0)
+    chaos = FaultPlan(seed=9, replica_slow_calls=(8,),
+                      replica_kill_calls=(22,))
+    n_requests, rejected, submitted = 40, 0, {}
+    slow_idx, victim = None, None
+    for i in range(n_requests):
+        which = int(rng.integers(0, len(uniq)))
+        try:
+            gid = router.submit(uniq[which], max_new)
+            submitted[gid] = which
+        except (AdmissionRejected, ShuttingDown, ConnectionError):
+            rejected += 1
+        router.pump(1)
+        if slow_idx is None and chaos.replica_slow():
+            # the plan decides WHEN; the harness picks WHICH: the busiest
+            slow_idx = max((h for h in router.replicas if not h.killed),
+                           key=lambda h: len(h.live)).idx
+            router.slow_replica(slow_idx, 0.02)
+        if victim is None and chaos.replica_kill():
+            victim = max((h for h in router.replicas
+                          if not h.killed and h.idx != slow_idx),
+                         key=lambda h: len(h.live)).idx
+            router.kill_replica(victim)
+    router.run_sync()
+    router.request_drain("gray soak complete")
+    router.run_sync()
+
+    # every composed fault actually fired
+    assert chaos.fired["replica.slow"] == 1 and slow_idx is not None
+    assert chaos.fired["replica.kill"] == 1 and victim is not None
+    assert net.fired["net.partition"] == 1
+    assert net.fired["net.flaky"] >= 1
+    assert router.state is SupervisorState.STOPPED
+    assert router.exit_code == 0
+    assert rejected + len(submitted) == n_requests
+    # exactly one terminal event per admitted request
+    terminals = [e for e in events if e["event"] != "token"]
+    per_gid = {}
+    for e in terminals:
+        per_gid[e["id"]] = per_gid.get(e["id"], 0) + 1
+    assert sorted(per_gid) == sorted(submitted)
+    assert all(c == 1 for c in per_gid.values()), per_gid
+    # finished streams token-exact, hedged tokens delivered exactly once
+    finished = [e for e in terminals if e["event"] == "done"]
+    assert finished, "gray soak finished nothing"
+    for e in finished:
+        assert e["tokens"] == refs[submitted[e["id"]]], \
+            f"gid {e['id']} diverged from fault-free reference"
+        streamed = [t["token"] for t in events
+                    if t["event"] == "token" and t["id"] == e["id"]]
+        assert streamed == e["tokens"], \
+            f"gid {e['id']}: hedged stream duplicated or dropped tokens"
+    # zero leaked blocks on the survivors
+    for h in router.replicas:
+        if h.idx != victim:
+            assert h.sup.engine.pool.num_allocated == 0
+            h.sup.engine.check_invariants()
 
 
 @pytest.mark.slow

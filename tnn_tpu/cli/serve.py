@@ -148,6 +148,21 @@ def main(argv=None):
                          "restart re-admissions and router failovers each "
                          "spend from their own budget of this size — before "
                          "it fails as poison (-1 = unlimited)")
+    ap.add_argument("--hedge-ttft-s", type=float, default=-1.0,
+                    help="router hedging: duplicate a request onto the "
+                         "next-best replica when its first token is this "
+                         "late (-1 = adaptive, the fleet's rolling TTFT "
+                         "p95). First token wins; the loser is cancelled "
+                         "and never charges a breaker")
+    ap.add_argument("--hedge-budget", type=float, default=0.1,
+                    help="max concurrent hedges as a fraction of open "
+                         "requests, consulted before every fire "
+                         "(0 = hedging off)")
+    ap.add_argument("--degrade-factor", type=float, default=2.0,
+                    help="eject a replica from placement as DEGRADED when "
+                         "its health score stays worse than this multiple "
+                         "of the fleet median (0 = ejection off); its live "
+                         "streams proactively migrate token-exact")
     ap.add_argument("--drain-deadline-s", type=float, default=30.0,
                     help="graceful-drain budget: in-flight work past this "
                          "deadline times out (0 = wait forever)")
@@ -260,6 +275,10 @@ def main(argv=None):
             sups,
             migration_budget=(10 ** 9 if args.migration_budget < 0
                               else args.migration_budget),
+            hedge_ttft_s=(None if args.hedge_ttft_s < 0
+                          else args.hedge_ttft_s),
+            hedge_budget=args.hedge_budget,
+            degrade_factor=args.degrade_factor,
             seed=args.seed, profiler=router_prof)
         print(f"router: {args.replicas} supervised replicas",
               file=sys.stderr)

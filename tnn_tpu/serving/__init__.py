@@ -22,7 +22,8 @@ from .metrics import (ServingMetrics, label_series, merge_series,
                       render_prometheus)
 from .ownership import worker_only
 from .prefix_cache import PrefixCache
-from .router import BreakerState, CircuitBreaker, NetDrop, Router
+from .router import (BreakerState, CircuitBreaker, HealthScore, NetDrop,
+                     Router)
 from .scheduler import (TERMINAL_STATES, AdmissionRejected, Request,
                         RequestState, Scheduler, StepPlan)
 from .server import ServingServer, run_server
@@ -35,7 +36,7 @@ __all__ = [
     "Request", "RequestState", "Scheduler", "StepPlan", "AdmissionRejected",
     "TERMINAL_STATES", "FaultPlan", "FaultInjected", "EngineCrash",
     "EngineSupervisor", "SupervisorState", "ShuttingDown",
-    "Router", "CircuitBreaker", "BreakerState", "NetDrop",
+    "Router", "CircuitBreaker", "BreakerState", "NetDrop", "HealthScore",
     "ServingServer", "run_server", "worker_only",
     "Tracer", "FlightRecorder", "span_name",
     "render_prometheus", "label_series", "merge_series",

@@ -363,8 +363,13 @@ class InferenceEngine:
         # reuses it so the key-consumption sequence matches overlap-off
         self._reuse_key = None
         self._t_fetch_done: Optional[float] = None
+        # last step's wall time, exposed through the health gauges so the
+        # router's health scoring can see a gray-slow replica without ever
+        # reaching into the engine
+        self._last_step_latency_s = 0.0
         self._health_gauges: Dict[str, Any] = {
-            "queue_depth": 0, "num_running": 0, **self._gauge_extras}
+            "queue_depth": 0, "num_running": 0, "step_latency_s": 0.0,
+            **self._gauge_extras}
         self.requests: Dict[int, Request] = {}
         self._rid = itertools.count()
         self._key = jax.random.PRNGKey(seed)
@@ -523,6 +528,7 @@ class InferenceEngine:
         self._health_gauges = {
             "queue_depth": self.scheduler.queue_depth,
             "num_running": len(self.scheduler.running),
+            "step_latency_s": self._last_step_latency_s,
             **self._gauge_extras}
         if self.tracer.enabled:
             self.tracer.instant("serve.submit", trace=req.trace_id, rid=rid)
@@ -742,6 +748,7 @@ class InferenceEngine:
             self._finalize_note(flight)
             self._finished_note = flight.note
         self.metrics.observe_step_latency(flight.latency_s)
+        self._last_step_latency_s = flight.latency_s
         # per-request stall attribution: a decode-phase row that survived the
         # step without committing a token spent the whole step stalled
         # (behind peer prefills in legacy mode, a retried fault, ...)
@@ -893,6 +900,7 @@ class InferenceEngine:
         self._health_gauges = {
             "queue_depth": self.scheduler.queue_depth,
             "num_running": len(self.scheduler.running),
+            "step_latency_s": self._last_step_latency_s,
             **self._gauge_extras}
 
     def _fetch_bundle(self, devs: List[Any]):

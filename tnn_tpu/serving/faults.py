@@ -34,7 +34,15 @@ indices:
 - **replica-level faults**: ``replica_kill`` (site "replica.kill") is
   consulted by the router's chaos harness to hard-kill a chosen replica
   mid-step; ``net_delay`` / ``net_drop`` (sites "net.delay" / "net.drop")
-  model router↔replica call latency and loss at the router's call seam.
+  model router↔replica call latency and loss at the router's call seam;
+  ``replica_slow`` (site "replica.slow") schedules the *gray* failure — a
+  chosen replica turns persistently slow (``Router.slow_replica`` applies
+  ``replica_slow_s`` of per-step delay) without ever erroring;
+  ``net_partition`` (site "net.partition") opens a window of
+  ``net_partition_rounds`` consults during which EVERY router↔replica
+  call fails (``partition_active`` is the per-call pure read);
+  ``flaky_drop`` (site "net.flaky") drops calls to one configured
+  ``flaky_replica`` only — one bad NIC, not a bad network.
 
 Everything is driven by one ``numpy`` Generator seeded at construction:
 the same plan over the same call sequence fires the same faults, so chaos
@@ -125,12 +133,30 @@ class FaultPlan:
     net_delay_s: float = 0.01                      # injected call latency
     net_drop_prob: float = 0.0
     net_drop_calls: Tuple[int, ...] = ()           # site "net.drop"
+    # gray failure: a chosen replica turns PERSISTENTLY slow (site
+    # "replica.slow"). Like replica.kill, the plan decides WHEN; the
+    # harness picks WHICH replica and applies replica_slow_s per step
+    replica_slow_prob: float = 0.0
+    replica_slow_calls: Tuple[int, ...] = ()       # site "replica.slow"
+    replica_slow_s: float = 0.02                   # injected per-step delay
+    # router↔replica network partition (site "net.partition"): a hit opens
+    # a window of net_partition_rounds consults during which EVERY
+    # router↔replica call must fail — a switch outage, not per-call loss
+    net_partition_prob: float = 0.0
+    net_partition_calls: Tuple[int, ...] = ()
+    net_partition_rounds: int = 3
+    # per-replica flaky drop (site "net.flaky"): only calls to
+    # flaky_replica are consulted/dropped (-1 disables the site)
+    flaky_replica: int = -1
+    flaky_drop_prob: float = 0.0
+    flaky_drop_calls: Tuple[int, ...] = ()
 
     calls: Counter = field(default_factory=Counter, init=False)
     fired: Counter = field(default_factory=Counter, init=False)
 
     def __post_init__(self):
         self._rng = np.random.default_rng(self.seed)
+        self._partition_left = 0   # consults left in the open window
 
     # -- internal -------------------------------------------------------------
 
@@ -250,3 +276,46 @@ class FaultPlan:
         "net.drop")."""
         return self._fires("net.drop", self.net_drop_prob,
                            self.net_drop_calls)
+
+    def replica_slow(self) -> bool:
+        """Consulted once per router pump round (or harness tick): True
+        when the chosen replica should turn persistently slow — the gray
+        failure itself (site "replica.slow"). As with ``replica_kill``,
+        the plan only decides WHEN; the harness picks WHICH replica and
+        actuates via ``Router.slow_replica(idx, replica_slow_s)``."""
+        return self._fires("replica.slow", self.replica_slow_prob,
+                           self.replica_slow_calls)
+
+    def net_partition(self) -> bool:
+        """Consulted once per router pump round (site "net.partition"):
+        True while a partition window is open. A hit opens (or extends) a
+        window of ``net_partition_rounds`` consults; for its duration
+        ``partition_active`` is True and every router↔replica call fails.
+        The rng stream depends only on the consult sequence, so the same
+        seed over the same rounds opens the same windows."""
+        hit = self._fires("net.partition", self.net_partition_prob,
+                          self.net_partition_calls)
+        if hit:
+            self._partition_left = max(self._partition_left,
+                                       int(self.net_partition_rounds))
+        active = self._partition_left > 0
+        if active:
+            self._partition_left -= 1
+        return active
+
+    @property
+    def partition_active(self) -> bool:
+        """Is a net.partition window currently open? Pure read — the
+        router consults this per call WITHOUT advancing the rng stream
+        (window accounting lives in the per-round ``net_partition``)."""
+        return self._partition_left > 0
+
+    def flaky_drop(self, replica: int) -> bool:
+        """True when THIS call to ``replica`` should drop (site
+        "net.flaky"). Only the configured ``flaky_replica`` is consulted,
+        so the rng stream depends only on the flaky replica's own call
+        sequence — calls to healthy replicas never perturb the schedule."""
+        if self.flaky_replica < 0 or replica != self.flaky_replica:
+            return False
+        return self._fires("net.flaky", self.flaky_drop_prob,
+                           self.flaky_drop_calls)

@@ -119,6 +119,26 @@ EXPOSITION: Dict[str, Tuple[str, str, str, str]] = {
     "serve.router_retries": (
         "tnn_serve_router_retries_total", "counter",
         "Router-level dispatch retries", "router_retries"),
+    "serve.hedges_fired": (
+        "tnn_serve_hedges_fired_total", "counter",
+        "Requests duplicated onto a second replica past the TTFT hedge "
+        "threshold", "hedges_fired"),
+    "serve.hedges_won": (
+        "tnn_serve_hedges_won_total", "counter",
+        "Hedge races the duplicate stream won (first token or promotion "
+        "after primary death)", "hedges_won"),
+    "serve.hedges_cancelled": (
+        "tnn_serve_hedges_cancelled_total", "counter",
+        "Hedge losers cancelled/discarded once the race resolved",
+        "hedges_cancelled"),
+    "serve.degraded_ejections": (
+        "tnn_serve_degraded_ejections_total", "counter",
+        "Replicas ejected from placement as DEGRADED (gray failure)",
+        "degraded_ejections"),
+    "serve.proactive_migrations": (
+        "tnn_serve_proactive_migrations_total", "counter",
+        "Live streams proactively migrated off degraded replicas",
+        "proactive_migrations"),
     "serve.drain_duration_s": (
         "tnn_serve_drain_seconds_total", "counter",
         "Wall seconds spent in graceful drains", "drain_duration_s"),
@@ -396,6 +416,12 @@ class ServingMetrics:
         self.migrated_requests = 0       # re-admissions after a crash/failover
         self.migration_resume_tokens = 0  # tokens re-prefilled by migrations
         self.router_retries = 0          # router-level dispatch retries
+        # gray-failure tolerance counters (health-scored routing / hedging)
+        self.hedges_fired = 0         # duplicates dispatched past the threshold
+        self.hedges_won = 0           # races the duplicate stream won
+        self.hedges_cancelled = 0     # losing streams cancelled/discarded
+        self.degraded_ejections = 0   # replicas ejected from placement
+        self.proactive_migrations = 0  # streams pulled off degraded replicas
         self._t_created = time.perf_counter()
         self._t_first: Optional[float] = None
         self._t_last: Optional[float] = None
@@ -570,6 +596,35 @@ class ServingMetrics:
         self.router_retries += 1
         self._tick("serve.router_retries", 1)
 
+    def observe_hedge_fired(self) -> None:
+        """A request idled past the TTFT hedge threshold and was duplicated
+        onto a second replica under a fresh epoch."""
+        self.hedges_fired += 1
+        self._tick("serve.hedges_fired", 1)
+
+    def observe_hedge_won(self) -> None:
+        """The duplicate stream won the hedge race (first token, or
+        promotion after the primary replica died)."""
+        self.hedges_won += 1
+        self._tick("serve.hedges_won", 1)
+
+    def observe_hedge_cancelled(self) -> None:
+        """A hedge loser was cancelled/discarded once the race resolved."""
+        self.hedges_cancelled += 1
+        self._tick("serve.hedges_cancelled", 1)
+
+    def observe_ejection(self) -> None:
+        """A replica's health score stayed above the degrade threshold and
+        it was ejected from placement as DEGRADED (gray failure)."""
+        self.degraded_ejections += 1
+        self._tick("serve.degraded_ejections", 1)
+
+    def observe_proactive_migration(self) -> None:
+        """A live stream was migrated off a degraded replica before the
+        replica failed outright."""
+        self.proactive_migrations += 1
+        self._tick("serve.proactive_migrations", 1)
+
     def observe_drain(self, seconds: float) -> None:
         self.drain_duration_s = seconds
         self._tick("serve.drain_duration_s", seconds)
@@ -681,6 +736,11 @@ class ServingMetrics:
             "migrated_requests": self.migrated_requests,
             "migration_resume_tokens": self.migration_resume_tokens,
             "router_retries": self.router_retries,
+            "hedges_fired": self.hedges_fired,
+            "hedges_won": self.hedges_won,
+            "hedges_cancelled": self.hedges_cancelled,
+            "degraded_ejections": self.degraded_ejections,
+            "proactive_migrations": self.proactive_migrations,
             "goodput_at_slo": self.goodput_at_slo,
             "stall_slo_violations": self.stall_slo_violations,
             "tok_per_s": self.tokens_per_s,

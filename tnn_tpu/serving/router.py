@@ -44,9 +44,42 @@ replica handle for an RPC stub changes no control flow):
   replicas finish and every routed request has reached exactly one
   terminal event.
 
+Gray-failure tolerance (PR: robustness) — a replica that is *slow* but not
+dead defeats both the breaker (calls still succeed) and JSQ (its queue
+drains slowly, so it keeps absorbing traffic). Three cooperating
+mechanisms handle it:
+
+- **Health-scored placement.** Every replica carries a ``HealthScore`` —
+  EWMAs of router-observed dispatch latency, the engine's last step
+  latency and queue depth (sampled from ``health_gauges()`` by the probe
+  loop), and recent dispatch error rate, plus gauge staleness. Placement
+  weighs queue length by the score *ratio* against the healthiest
+  replica, with a dead-band (``score_tolerance``): when scores are within
+  tolerance of uniform, routing is byte-identical to pure JSQ.
+- **Degraded-replica ejection.** A replica whose score stays worse than
+  ``degrade_factor`` × the fleet median for ``degrade_window_s`` enters
+  DEGRADED — distinct from breaker OPEN: the replica is *alive*, so its
+  in-flight streams either finish in place or are proactively migrated
+  through the same token-exact recompute-resume path (the old stream is
+  cancelled quietly; no breaker charge). New admissions route away. After
+  ``degrade_cooldown_s`` a recovery-probe dispatch is admitted; a score
+  back under ``readmit_factor`` × median sustained for the window
+  re-admits it (hysteresis: readmit_factor < degrade_factor, so a
+  replica hovering at the threshold cannot flap).
+- **Hedged dispatch.** A request whose first token hasn't arrived within
+  the hedge threshold (``hedge_ttft_s``, or adaptively the fleet's
+  rolling TTFT p95) is duplicated onto the next-best replica under a
+  fresh epoch; the epoch guard dedupes the two streams to exactly-once.
+  First token wins; the loser is cancelled quietly and never charges a
+  breaker. A hedge budget (``hedge_budget`` × open requests, consulted
+  before every fire) bounds amplification.
+
 Chaos seams: the router's optional ``FaultPlan`` fires ``net.delay`` /
-``net.drop`` inside ``_call`` (injected router↔replica latency and loss)
-and the harness consults ``replica.kill`` to schedule ``kill_replica``.
+``net.drop`` inside ``_call`` (injected router↔replica latency and loss),
+``net.partition`` opens windows during which every router↔replica call
+fails, ``net.flaky`` drops calls to one configured replica only, and the
+harness consults ``replica.kill`` / ``replica.slow`` to schedule
+``kill_replica`` / ``slow_replica``.
 
 Like the supervisor, an unstarted router doubles as a deterministic
 synchronous harness: ``pump`` round-robins one step across live replicas
@@ -62,8 +95,10 @@ from __future__ import annotations
 
 import functools
 import itertools
+import statistics
 import threading
 import time
+from collections import deque
 from dataclasses import dataclass, field
 from enum import Enum
 from typing import Any, Callable, Dict, List, Optional, Sequence, Set
@@ -122,6 +157,15 @@ class CircuitBreaker:
             self._probing = True
 
     def record_success(self) -> None:
+        """Close only from CLOSED (refresh) or HALF_OPEN (probe success).
+
+        A success landing while OPEN is *stale* — a call that started
+        before the trip, finishing after it — and must not short-circuit
+        the cooldown: the replica earned the open state with ``threshold``
+        consecutive failures, and only a deliberate HALF_OPEN probe may
+        re-close it."""
+        if self.state is BreakerState.OPEN:
+            return
         self.state = BreakerState.CLOSED
         self.failures = 0
         self._probing = False
@@ -139,6 +183,66 @@ class CircuitBreaker:
         self._probing = False
 
 
+class HealthScore:
+    """EWMA health of one replica, folded into a scalar placement weight.
+
+    ``score()`` is ``1.0`` for a perfectly healthy replica and grows with
+    smoothed dispatch latency, engine step latency, queue depth, recent
+    dispatch error rate, and gauge staleness (a wedged-but-responsive
+    worker stops refreshing its gauges, so ``age_s`` climbs). All EWMAs
+    start at the healthy fixed point 0.0, so a fresh fleet scores exactly
+    uniform and placement degenerates to pure JSQ."""
+
+    ALPHA = 0.3                # EWMA smoothing: new = (1-a)*old + a*x
+    W_DISPATCH = 25.0          # per second of smoothed dispatch latency
+    W_STEP = 25.0              # per second of smoothed engine step latency
+    W_QUEUE = 0.05             # per smoothed queued/running request
+    W_ERROR = 2.0              # per unit of smoothed error rate (0..1)
+    W_STALE = 0.5              # per second of gauge staleness past grace
+    STALE_GRACE_S = 1.0        # probe cadence slack before staleness counts
+
+    def __init__(self) -> None:
+        self.dispatch_latency_s = 0.0
+        self.step_latency_s = 0.0
+        self.queue_depth = 0.0
+        self.error_rate = 0.0
+        self.staleness_s = 0.0     # instantaneous, not smoothed
+        self.samples = 0
+
+    def _ewma(self, old: float, x: float) -> float:
+        return (1.0 - self.ALPHA) * old + self.ALPHA * float(x)
+
+    def observe_dispatch(self, seconds: float) -> None:
+        """One successful router→replica dispatch took ``seconds``."""
+        self.dispatch_latency_s = self._ewma(self.dispatch_latency_s,
+                                             seconds)
+        self.samples += 1
+
+    def observe_outcome(self, ok: bool) -> None:
+        """One dispatch/stream outcome: folds into the error-rate EWMA."""
+        self.error_rate = self._ewma(self.error_rate, 0.0 if ok else 1.0)
+        self.samples += 1
+
+    def observe_gauges(self, step_latency_s: float, queue_depth: float,
+                       staleness_s: float) -> None:
+        """One probe-loop sample of the replica's ``health_gauges()``."""
+        self.step_latency_s = self._ewma(self.step_latency_s,
+                                         step_latency_s)
+        self.queue_depth = self._ewma(self.queue_depth, queue_depth)
+        self.staleness_s = float(staleness_s)
+        self.samples += 1
+
+    def score(self) -> float:
+        """Scalar placement weight: 1.0 = healthy, larger = worse."""
+        return (1.0
+                + self.W_DISPATCH * self.dispatch_latency_s
+                + self.W_STEP * self.step_latency_s
+                + self.W_QUEUE * self.queue_depth
+                + self.W_ERROR * self.error_rate
+                + self.W_STALE * max(0.0, self.staleness_s
+                                     - self.STALE_GRACE_S))
+
+
 @dataclass
 class _Replica:
     """One supervised replica plus the router's view of it."""
@@ -147,11 +251,19 @@ class _Replica:
     breaker: CircuitBreaker
     live: Set[int] = field(default_factory=set)   # router gids assigned here
     killed: bool = False
+    health: HealthScore = field(default_factory=HealthScore)
+    # DEGRADED state machine (gray failure — alive but ejected from
+    # placement; distinct from breaker OPEN, which means calls FAIL)
+    degraded: bool = False
+    suspect_since: Optional[float] = None   # score first crossed threshold
+    readmit_since: Optional[float] = None   # score first back under readmit
+    degraded_at: Optional[float] = None     # ejection time (cooldown base)
+    recovery_probing: bool = False          # one probe dispatch at a time
 
     @property
     def available(self) -> bool:
-        return (not self.killed and not self.sup.finished
-                and self.breaker.allows())
+        return (not self.killed and not self.degraded
+                and not self.sup.finished and self.breaker.allows())
 
 
 @dataclass
@@ -168,9 +280,22 @@ class _Routed:
     emitted: List[int] = field(default_factory=list)
     replica: Optional[int] = None
     local_rid: Optional[int] = None
-    epoch: int = 0            # bumped on every failover; stale-event guard
+    epoch: int = 0            # current primary stream; stale-event guard
+    epoch_seq: int = 0        # allocator: highest epoch ever issued for
+    #                           this request. Every new stream (failover,
+    #                           proactive migration, hedge) takes the next
+    #                           value, so a hedge epoch can never collide
+    #                           with a later migration epoch
     migrations: int = 0
     ttft_s: Optional[float] = None
+    t_dispatch: float = 0.0   # perf_counter of the last primary dispatch
+    # pending hedge race (duplicate stream on another replica); None/False
+    # when no race is in flight. ``hedged`` stays True after resolution —
+    # at most one hedge per request, ever
+    hedge_epoch: Optional[int] = None
+    hedge_replica: Optional[int] = None
+    hedge_local_rid: Optional[int] = None
+    hedged: bool = False
     done: bool = False
 
 
@@ -205,6 +330,13 @@ class Router:
                  breaker_threshold: int = 3,
                  breaker_cooldown_s: float = 0.25,
                  probe_interval_s: float = 0.05,
+                 hedge_ttft_s: Optional[float] = None,
+                 hedge_budget: float = 0.1,
+                 degrade_factor: float = 2.0,
+                 degrade_window_s: float = 0.25,
+                 degrade_cooldown_s: float = 0.5,
+                 readmit_factor: Optional[float] = None,
+                 score_tolerance: float = 0.5,
                  event_sink: Optional[EventListener] = None,
                  profiler: Optional[Profiler] = None,
                  seed: int = 0):
@@ -214,6 +346,8 @@ class Router:
             raise ValueError("max_retries must be >= 0")
         if migration_budget < 0:
             raise ValueError("migration_budget must be >= 0")
+        if score_tolerance < 0:
+            raise ValueError("score_tolerance must be >= 0")
         self._handles = [
             _Replica(idx=i, sup=s,
                      breaker=CircuitBreaker(breaker_threshold,
@@ -226,6 +360,20 @@ class Router:
         self.retry_jitter_s = float(retry_jitter_s)
         self.migration_budget = int(migration_budget)
         self.probe_interval_s = float(probe_interval_s)
+        # gray-failure knobs (module doc): hedge_budget <= 0 disables
+        # hedging; degrade_factor <= 0 disables ejection; hedge_ttft_s
+        # None means adaptive (rolling fleet TTFT p95)
+        self.hedge_ttft_s = (None if hedge_ttft_s is None
+                             else float(hedge_ttft_s))
+        self.hedge_budget = float(hedge_budget)
+        self.degrade_factor = float(degrade_factor)
+        self.degrade_window_s = float(degrade_window_s)
+        self.degrade_cooldown_s = float(degrade_cooldown_s)
+        self.readmit_factor = (0.7 * self.degrade_factor
+                               if readmit_factor is None
+                               else float(readmit_factor))
+        self.score_tolerance = float(score_tolerance)
+        self._ttft_window: deque = deque(maxlen=64)  # adaptive hedge p95
         self.event_sink = event_sink
         # with a profiler, the router's dispatch/retry/migration instants
         # land on its own Perfetto track (source = the profiler's source) —
@@ -403,7 +551,10 @@ class Router:
         with self._lock:
             if rec.done:
                 return False
+            loser = self._resolve_hedge_locked(rec, hedge_won=False)
             self._close(rec, h)
+        if loser is not None:
+            self._cancel_quiet(*loser)
         self._emit(rec, {"event": "cancelled", "id": gid, "reason": reason})
         return True
 
@@ -418,6 +569,8 @@ class Router:
                 "restarts": h.sup.restarts,
                 "live_requests": len(h.live),
                 "killed": h.killed,
+                "degraded": h.degraded,
+                "health_score": round(h.health.score(), 4),
             } for h in self._handles]
             s: Dict[str, Any] = {
                 "supervisor_state": self._state.value,
@@ -428,6 +581,11 @@ class Router:
                 "migrated_requests": self.metrics.migrated_requests,
                 "migration_resume_tokens":
                     self.metrics.migration_resume_tokens,
+                "hedges_fired": self.metrics.hedges_fired,
+                "hedges_won": self.metrics.hedges_won,
+                "hedges_cancelled": self.metrics.hedges_cancelled,
+                "degraded_ejections": self.metrics.degraded_ejections,
+                "proactive_migrations": self.metrics.proactive_migrations,
                 "replica_restarts": sum(h.sup.restarts
                                         for h in self._handles),
                 "replicas": per_replica,
@@ -457,8 +615,18 @@ class Router:
         metric name, one labelled sample stream per replica. Dead replicas
         keep their last-scraped series out rather than blocking the
         scrape."""
-        parts = [label_series(self.metrics.prometheus_series(),
-                              {"replica": "router"})]
+        fams = self.metrics.prometheus_series()
+        with self._lock:
+            fams.append({
+                "name": "tnn_serve_replica_health_score", "type": "gauge",
+                "help": "Router health score per replica (1.0 = healthy, "
+                        "larger = worse)",
+                # per-sample labels win in label_series' merge, so each
+                # sample keeps its own replica index
+                "samples": [("", {"replica": str(h.idx)},
+                             float(h.health.score()))
+                            for h in self._handles]})
+        parts = [label_series(fams, {"replica": "router"})]
         for h in list(self._handles):
             if h.sup.finished and not h.sup.join(0):
                 continue  # worker mid-exit: don't race the closing queue
@@ -479,6 +647,13 @@ class Router:
                 "num_running": len(self._open),
                 "replicas_total": len(self._handles),
                 "replicas_healthy": healthy,
+                "replicas_degraded": sum(1 for h in self._handles
+                                         if h.degraded),
+                "hedges_fired": self.metrics.hedges_fired,
+                "hedges_won": self.metrics.hedges_won,
+                "hedges_cancelled": self.metrics.hedges_cancelled,
+                "degraded_ejections": self.metrics.degraded_ejections,
+                "proactive_migrations": self.metrics.proactive_migrations,
             }
 
     def kill_replica(self, idx: int,
@@ -499,12 +674,33 @@ class Router:
             pass
         self._probe()
 
+    def slow_replica(self, idx: int, delay_s: float) -> None:
+        """Chaos actuator for the ``replica.slow`` fault site: the replica
+        stays alive and token-correct, but every engine step gains
+        ``delay_s`` of wall time — the gray failure the health score (not
+        the breaker: its calls still succeed) must catch. ``delay_s <= 0``
+        restores full speed (recovery half of the readmit tests)."""
+        from .faults import FaultPlan
+        eng = self._handles[idx].sup.engine
+        if getattr(eng, "faults", None) is None:
+            eng.faults = FaultPlan()
+        eng.faults.step_delay_s = float(max(0.0, delay_s))
+
     # -- internals -------------------------------------------------------------
 
     def _call(self, h: _Replica, fn: Callable[[], Any]) -> Any:
         """Process-shaped seam for every router→replica data-plane call;
-        the chaos plan's ``net.delay`` / ``net.drop`` sites fire here."""
+        the chaos plan's ``net.partition`` (window read — the per-round
+        ``net_partition`` consult does the accounting), ``net.flaky``
+        (per-replica drop), ``net.delay`` and ``net.drop`` sites fire
+        here."""
         if self.faults is not None:
+            if self.faults.partition_active:
+                raise NetDrop(f"injected net partition: call to replica "
+                              f"{h.idx} dropped")
+            if self.faults.flaky_drop(h.idx):
+                raise NetDrop(
+                    f"injected flaky drop on call to replica {h.idx}")
             if self.faults.net_delay():
                 time.sleep(self.faults.net_delay_s)
             if self.faults.net_drop():
@@ -512,18 +708,50 @@ class Router:
                     f"injected net drop on call to replica {h.idx}")
         return fn()
 
-    def _pick(self) -> Optional[_Replica]:
-        """Join-shortest-queue over available replicas (router-assigned
-        live-request counts, so no cross-thread engine reads)."""
+    def _pick(self, exclude: Optional[int] = None) -> Optional[_Replica]:
+        """Health-score-weighted join-shortest-queue over available
+        replicas (router-assigned live counts, so no cross-thread engine
+        reads). The placement key is ``(live + 1) * weight`` where the
+        weight is the replica's score ratio against the healthiest
+        candidate, snapped to 1.0 inside the ``score_tolerance`` dead-band
+        — a fleet with uniform scores routes byte-identical to pure JSQ.
+
+        DEGRADED replicas are excluded, except: past ``degrade_cooldown_s``
+        one recovery-probe dispatch is admitted (so the replica can prove
+        itself), and when *no* non-degraded replica is available the
+        degraded ones are better than failing the request."""
         with self._lock:
+            now = time.monotonic()
+            pool = [h for h in self._handles
+                    if h.available and h.idx != exclude]
+            degraded_alive = [
+                h for h in self._handles
+                if h.degraded and not h.killed and not h.sup.finished
+                and h.breaker.allows() and h.idx != exclude]
+            probes = [h for h in degraded_alive
+                      if not h.recovery_probing
+                      and h.degraded_at is not None
+                      and now - h.degraded_at >= self.degrade_cooldown_s]
+            if pool:
+                pool = pool + probes
+            else:
+                pool = probes or degraded_alive
+            if not pool:
+                return None
+            scores = {h.idx: h.health.score() for h in pool}
+            ref = min(scores.values())
             best: Optional[_Replica] = None
-            for h in self._handles:
-                if not h.available:
-                    continue
-                if best is None or len(h.live) < len(best.live):
-                    best = h
-            if best is not None:
-                best.breaker.on_dispatch()
+            best_key = 0.0
+            for h in pool:
+                ratio = scores[h.idx] / ref if ref > 0 else 1.0
+                weight = (ratio if ratio >= 1.0 + self.score_tolerance
+                          else 1.0)
+                key = (len(h.live) + 1.0) * weight
+                if best is None or key < best_key:
+                    best, best_key = h, key
+            best.breaker.on_dispatch()
+            if best.degraded:
+                best.recovery_probing = True
             return best
 
     def _deadline_left(self, rec: _Routed) -> Optional[float]:
@@ -582,6 +810,7 @@ class Router:
             epoch = rec.epoch
             listener = self._listener_for(rec, epoch, h)
             prompt, max_new, kwargs = self._resume_args(rec)
+            t_call = time.perf_counter()
             try:
                 lrid = self._call(h, functools.partial(
                     h.sup.submit, prompt, max_new,
@@ -593,6 +822,7 @@ class Router:
                 continue
             except (NetDrop, ShuttingDown) as e:
                 h.breaker.record_failure()
+                h.health.observe_outcome(False)
                 last = e
                 continue
             except (ValueError, TypeError) as e:
@@ -605,8 +835,11 @@ class Router:
             with self._lock:
                 rec.replica = h.idx
                 rec.local_rid = lrid
+                rec.t_dispatch = time.perf_counter()
                 h.live.add(rec.gid)
                 h.breaker.record_success()
+                h.health.observe_dispatch(rec.t_dispatch - t_call)
+                h.health.observe_outcome(True)
             if self.tracer.enabled:
                 self.tracer.instant(
                     "router.dispatch", trace=rec.kwargs.get("trace_id"),
@@ -632,13 +865,37 @@ class Router:
         kind = ev.get("event")
         migrate_reason: Optional[str] = None
         out: Optional[dict] = None
+        loser = None           # (handle, lrid) to cancel outside the lock
         with self._lock:
-            if rec.done or rec.epoch != epoch:
+            if rec.done:
+                return
+            if epoch == rec.epoch:
+                # a primary token or terminal (except a replica-level
+                # error, which _migrate resolves by promoting the hedge)
+                # wins any pending race: the duplicate is the loser
+                if rec.hedge_epoch is not None and not (
+                        kind == "error"
+                        and self._replica_level(ev.get("reason", ""))):
+                    loser = self._resolve_hedge_locked(rec, hedge_won=False)
+            elif rec.hedge_epoch is not None and epoch == rec.hedge_epoch:
+                if kind in ("token", "done"):
+                    # the duplicate won the race: promote it to primary,
+                    # cancel the original stream quietly
+                    loser = self._resolve_hedge_locked(rec, hedge_won=True)
+                    h = self._handles[rec.replica]
+                    epoch = rec.epoch
+                else:
+                    # the duplicate failed / was cancelled: a hedge loser
+                    # never charges a breaker — drop it and move on
+                    self._resolve_hedge_locked(rec, hedge_won=False)
+                    return
+            else:
                 return  # stale epoch: a failed-over replica still talking
             if kind == "token":
                 rec.emitted.append(int(ev["token"]))
                 if rec.ttft_s is None:
                     rec.ttft_s = time.perf_counter() - rec.t_submit
+                    self._ttft_window.append(rec.ttft_s)
                 out = {"event": "token", "id": rec.gid,
                        "token": int(ev["token"])}
             elif kind == "done":
@@ -657,11 +914,55 @@ class Router:
                 out = {"event": kind, "id": rec.gid,
                        "reason": ev.get("reason", "")}
                 self._enrich_terminal(rec, ev, out)
+        if loser is not None:
+            self._cancel_quiet(*loser)
         if migrate_reason is not None:
             self._migrate(rec, epoch, h, migrate_reason)
             return
         if out is not None:
             self._emit(rec, out)
+
+    def _resolve_hedge_locked(self, rec: _Routed, *,
+                              hedge_won: bool):
+        """Resolve a pending hedge race (caller holds the lock). With
+        ``hedge_won`` the duplicate stream becomes the primary and the
+        original is the loser; otherwise the duplicate loses. Returns the
+        loser's ``(handle, local_rid)`` for a quiet cancel outside the
+        lock — a hedge loser never charges a breaker — or None."""
+        if rec.hedge_epoch is None:
+            return None
+        if hedge_won:
+            loser = (rec.replica, rec.local_rid)
+            if rec.replica is not None:
+                self._handles[rec.replica].live.discard(rec.gid)
+            rec.epoch = rec.hedge_epoch
+            rec.replica = rec.hedge_replica
+            rec.local_rid = rec.hedge_local_rid
+            self.metrics.observe_hedge_won()
+        else:
+            loser = (rec.hedge_replica, rec.hedge_local_rid)
+            if rec.hedge_replica is not None:
+                self._handles[rec.hedge_replica].live.discard(rec.gid)
+        rec.hedge_epoch = None
+        rec.hedge_replica = None
+        rec.hedge_local_rid = None
+        self.metrics.observe_hedge_cancelled()
+        idx, lrid = loser
+        if idx is None or lrid is None:
+            return None
+        return self._handles[idx], lrid
+
+    def _cancel_quiet(self, h: _Replica, lrid: int) -> None:
+        """Best-effort cancel of a superseded stream (hedge loser or
+        proactively migrated original). Failure is fine: the epoch guard
+        drops whatever the stream still says, and no breaker is charged."""
+        if h.killed or h.sup.finished:
+            return
+        try:
+            self._call(h, functools.partial(
+                h.sup.cancel, lrid, "superseded stream"))
+        except Exception:  # noqa: BLE001 — quiet by design
+            pass
 
     def _enrich_terminal(self, rec: _Routed, ev: dict, out: dict) -> None:
         """Carry the replica's observability fields across the gid/rid
@@ -694,11 +995,30 @@ class Router:
             if rec.done or rec.epoch != epoch:
                 return
             h.breaker.record_failure()
+            h.health.observe_outcome(False)
             h.live.discard(rec.gid)
-            rec.epoch += 1
-            rec.replica = None
-            rec.local_rid = None
-            if rec.migrations >= self.migration_budget:
+            if rec.hedge_epoch is not None:
+                # a duplicate stream is already racing on another replica:
+                # promote it in place of a recompute-resume re-dispatch.
+                # (While a hedge is pending no tokens have streamed, so
+                # the duplicate's full-prompt run is token-exact.)
+                rec.epoch = rec.hedge_epoch
+                rec.replica = rec.hedge_replica
+                rec.local_rid = rec.hedge_local_rid
+                rec.hedge_epoch = None
+                rec.hedge_replica = None
+                rec.hedge_local_rid = None
+                self.metrics.observe_hedge_won()
+                promoted_to = rec.replica
+            else:
+                promoted_to = None
+                rec.epoch_seq += 1
+                rec.epoch = rec.epoch_seq
+                rec.replica = None
+                rec.local_rid = None
+            if promoted_to is not None:
+                out = None
+            elif rec.migrations >= self.migration_budget:
                 self._close(rec, None)
                 out = {"event": "error", "id": rec.gid,
                        "reason": f"router migration budget exhausted "
@@ -708,6 +1028,13 @@ class Router:
                 rec.migrations += 1
                 out = None
             remaining = rec.max_new - len(rec.emitted)
+        if promoted_to is not None:
+            if self.tracer.enabled:
+                self.tracer.instant(
+                    "router.migrate", trace=rec.kwargs.get("trace_id"),
+                    gid=rec.gid, from_replica=h.idx,
+                    promoted_hedge=True, to_replica=promoted_to)
+            return
         if out is not None:
             self._emit(rec, out)
             return
@@ -746,7 +1073,9 @@ class Router:
         """Caller holds the lock."""
         rec.done = True
         self._open.pop(rec.gid, None)
-        if h is not None:
+        if rec.hedge_replica is not None:   # belt and braces: no gid may
+            self._handles[rec.hedge_replica].live.discard(rec.gid)
+        if h is not None:                   # outlive its record anywhere
             h.live.discard(rec.gid)
         elif rec.replica is not None:
             self._handles[rec.replica].live.discard(rec.gid)
@@ -760,12 +1089,225 @@ class Router:
             except Exception:  # noqa: BLE001 — a bad listener can't kill us
                 pass
 
+    # -- gray-failure tolerance: scoring / ejection / hedging ------------------
+
+    def _update_health(self) -> None:
+        """Sample every live replica's ``health_gauges()`` into its EWMA
+        score, then run the degrade/readmit state machine (module doc).
+        Gauges are unreachable during a partition window, so scores keep
+        their last values (staleness keeps climbing on its own)."""
+        proactive = []
+        with self._lock:
+            alive = [h for h in self._handles
+                     if not h.killed and not h.sup.finished]
+            partitioned = (self.faults is not None
+                           and self.faults.partition_active)
+            if not partitioned:
+                for h in alive:
+                    try:
+                        g = h.sup.health_gauges()
+                    except Exception:  # noqa: BLE001 — dying replica
+                        continue
+                    h.health.observe_gauges(
+                        float(g.get("step_latency_s", 0.0)),
+                        float(g.get("queue_depth", 0))
+                        + float(g.get("num_running", 0)),
+                        float(g.get("age_s", 0.0)))
+            if self.degrade_factor <= 0 or len(alive) < 2:
+                return
+            now = time.monotonic()
+            scores = {h.idx: h.health.score() for h in alive}
+            med = statistics.median(scores.values())
+            non_degraded = sum(1 for h in alive if not h.degraded)
+            for h in alive:
+                sc = scores[h.idx]
+                if not h.degraded:
+                    if med > 0 and sc > self.degrade_factor * med:
+                        if h.suspect_since is None:
+                            h.suspect_since = now
+                            if self.tracer.enabled:
+                                self.tracer.instant(
+                                    "router.degrade", replica=h.idx,
+                                    score=round(sc, 4),
+                                    median=round(med, 4))
+                        elif (now - h.suspect_since
+                              >= self.degrade_window_s
+                              and non_degraded > 1):
+                            # never eject the last non-degraded replica
+                            proactive.extend(self._eject_locked(h, sc, med))
+                            non_degraded -= 1
+                    else:
+                        h.suspect_since = None
+                else:
+                    if sc <= self.readmit_factor * med:
+                        if h.readmit_since is None:
+                            h.readmit_since = now
+                        elif (now - h.readmit_since
+                              >= self.degrade_window_s):
+                            h.degraded = False
+                            h.suspect_since = None
+                            h.readmit_since = None
+                            h.degraded_at = None
+                            h.recovery_probing = False
+                            if self.tracer.enabled:
+                                self.tracer.instant(
+                                    "router.readmit", replica=h.idx,
+                                    score=round(sc, 4))
+                    else:
+                        h.readmit_since = None
+                    if not h.live:
+                        # the probe stream finished: allow the next one
+                        h.recovery_probing = False
+        for rec, epoch, h in proactive:
+            self._proactive_migrate(rec, epoch, h)
+
+    def _eject_locked(self, h: _Replica, score: float, median: float):
+        """Eject one replica as DEGRADED (caller holds the lock). Returns
+        the ``(rec, epoch, handle)`` list of its live streams to
+        proactively migrate outside the lock."""
+        h.degraded = True
+        h.degraded_at = time.monotonic()
+        h.suspect_since = None
+        h.readmit_since = None
+        h.recovery_probing = False
+        self.metrics.observe_ejection()
+        if self.tracer.enabled:
+            self.tracer.instant("router.eject", replica=h.idx,
+                                score=round(score, 4),
+                                median=round(median, 4),
+                                live=len(h.live))
+        return [(self._open[gid], self._open[gid].epoch, h)
+                for gid in list(h.live) if gid in self._open
+                and self._open[gid].replica == h.idx]
+
+    def _proactive_migrate(self, rec: _Routed, epoch: int,
+                           h: _Replica) -> None:
+        """Pull one live stream off a degraded replica before it fails
+        outright — the same token-exact recompute-resume path as crash
+        migration, but the old stream is cancelled quietly (the replica
+        is alive, merely slow) and no breaker is charged. Streams that
+        are over budget, already racing a hedge, or effectively done
+        finish in place instead."""
+        with self._lock:
+            if (rec.done or rec.epoch != epoch or rec.replica != h.idx
+                    or rec.hedge_epoch is not None
+                    or rec.migrations >= self.migration_budget
+                    or rec.max_new - len(rec.emitted) <= 0):
+                return
+            old_lrid = rec.local_rid
+            h.live.discard(rec.gid)
+            rec.migrations += 1
+            rec.epoch_seq += 1
+            rec.epoch = rec.epoch_seq
+            rec.replica = None
+            rec.local_rid = None
+        if old_lrid is not None:
+            self._cancel_quiet(h, old_lrid)
+        self.metrics.observe_migration(len(rec.prompt) + len(rec.emitted))
+        self.metrics.observe_proactive_migration()
+        if self.tracer.enabled:
+            self.tracer.instant(
+                "router.migrate", trace=rec.kwargs.get("trace_id"),
+                gid=rec.gid, from_replica=h.idx, proactive=True,
+                emitted=len(rec.emitted))
+        self._dispatch(rec)   # failure here emits the terminal error event
+
+    def _hedge_threshold_locked(self) -> Optional[float]:
+        """The TTFT past which a request gets hedged (caller holds the
+        lock): the fixed ``hedge_ttft_s`` when configured, else adaptive —
+        the rolling fleet TTFT p95, None until enough samples landed to
+        trust a tail estimate."""
+        if self.hedge_ttft_s is not None:
+            return self.hedge_ttft_s
+        if len(self._ttft_window) < 8:
+            return None
+        return float(np.percentile(np.asarray(list(self._ttft_window)),
+                                   95.0))
+
+    def _maybe_hedge(self) -> None:
+        """Duplicate overdue first-token requests onto the next-best
+        replica. The budget (``hedge_budget`` × open requests) is
+        consulted before EVERY fire, so amplification stays bounded even
+        when the whole fleet stalls at once."""
+        if self.hedge_budget <= 0:
+            return
+        now = time.perf_counter()
+        with self._lock:
+            thr = self._hedge_threshold_locked()
+            if thr is None:
+                return
+            pending = sum(1 for r in self._open.values()
+                          if r.hedge_epoch is not None)
+            overdue = [r for r in self._open.values()
+                       if not r.done and r.ttft_s is None and not r.hedged
+                       and r.replica is not None
+                       and r.local_rid is not None
+                       and now - r.t_dispatch > thr]
+        for rec in overdue:
+            with self._lock:
+                cap = max(1, int(self.hedge_budget * len(self._open)))
+                if pending >= cap:
+                    return
+            if self._fire_hedge(rec):
+                pending += 1
+
+    def _fire_hedge(self, rec: _Routed) -> bool:
+        """Race one duplicate of ``rec`` on another replica under a fresh
+        epoch. Returns True when the duplicate is actually in flight."""
+        with self._lock:
+            if (rec.done or rec.ttft_s is not None or rec.hedged
+                    or rec.hedge_epoch is not None or rec.replica is None):
+                return False
+            primary = rec.replica
+            rec.epoch_seq += 1
+            epoch = rec.epoch_seq
+            prompt, max_new, kwargs = self._resume_args(rec)
+        hh = self._pick(exclude=primary)
+        if hh is None:
+            return False   # nowhere to hedge to; the primary keeps running
+        listener = self._listener_for(rec, epoch, hh)
+        try:
+            lrid = self._call(hh, functools.partial(
+                hh.sup.submit, prompt, max_new,
+                listener=listener, **kwargs))
+        except Exception:  # noqa: BLE001 — a failed hedge is a non-event:
+            return False   # the primary is still running; no terminal here
+        with self._lock:
+            if rec.done or rec.ttft_s is not None \
+                    or rec.hedge_epoch is not None:
+                stale = True   # the race resolved while we submitted
+            else:
+                stale = False
+                rec.hedged = True
+                rec.hedge_epoch = epoch
+                rec.hedge_replica = hh.idx
+                rec.hedge_local_rid = lrid
+                hh.live.add(rec.gid)
+                hh.breaker.record_success()
+                self.metrics.observe_hedge_fired()
+        if stale:
+            self._cancel_quiet(hh, lrid)
+            return False
+        if self.tracer.enabled:
+            self.tracer.instant(
+                "router.hedge", trace=rec.kwargs.get("trace_id"),
+                gid=rec.gid, replica=hh.idx, primary=primary)
+        return True
+
     # -- health probe / lifecycle convergence ----------------------------------
 
     def _probe(self) -> None:
-        """Health probe: migrate requests stranded on dead replicas (belt
-        and braces over the event path), then converge the router's
+        """Health probe: advance the partition-window consult, migrate
+        requests stranded on dead replicas (belt and braces over the event
+        path), drop hedges stranded on dead replicas (the primary is still
+        alive), refresh health scores and the degrade/readmit state
+        machine, fire overdue hedges, then converge the router's
         lifecycle state."""
+        if self.faults is not None and (
+                self.faults.net_partition_prob > 0
+                or self.faults.net_partition_calls):
+            # once per probe round: the window accounting consult
+            self.faults.net_partition()
         with self._lock:
             stranded = [
                 (r, r.epoch, self._handles[r.replica])
@@ -776,6 +1318,14 @@ class Router:
         for r, epoch, h in stranded:
             self._migrate(r, epoch, h,
                           f"replica {h.idx} dead ({h.sup.state.value})")
+        with self._lock:
+            for r in list(self._open.values()):
+                if r.hedge_replica is not None and (
+                        self._handles[r.hedge_replica].killed
+                        or self._handles[r.hedge_replica].sup.finished):
+                    self._resolve_hedge_locked(r, hedge_won=False)
+        self._update_health()
+        self._maybe_hedge()
         with self._lock:
             all_dead = all(h.killed or h.sup.finished
                            for h in self._handles)

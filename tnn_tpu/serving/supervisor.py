@@ -170,6 +170,10 @@ class EngineSupervisor:
         # the server thread without ever touching the engine (or forcing a
         # device sync)
         self._health: Dict[str, int] = {"queue_depth": 0, "num_running": 0}
+        # monotonic stamp of the last gauge refresh: ``health_gauges``
+        # serves its age so the router can tell a wedged-but-responsive
+        # worker (stale snapshot, answering thread) from a healthy one
+        self._health_stamp = time.monotonic()
         self.flight = FlightRecorder(flight_recorder_capacity)
         self.flight_dir = flight_dir
         self.flight_dumps: List[str] = []
@@ -393,13 +397,19 @@ class EngineSupervisor:
         gauges = getattr(self.engine, "_health_gauges", None)
         if gauges is not None:
             self._health = dict(gauges)
+        self._health_stamp = time.monotonic()
 
     def health_gauges(self) -> Dict[str, int]:
-        """Host-side liveness gauges (queue depth, running count) cached at
-        commit time. Safe from any thread WITHOUT marshalling through the
-        worker: the snapshot dict is replaced wholesale each tick, never
-        mutated in place, and reading it cannot force a device sync."""
-        return dict(self._health)
+        """Host-side liveness gauges (queue depth, running count, last step
+        latency) cached at commit time, plus ``age_s`` — seconds since the
+        worker last refreshed the snapshot. A wedged-but-responsive worker
+        (alive thread, no ticks) shows up as unbounded age, which the
+        router's health scoring penalizes. Safe from any thread WITHOUT
+        marshalling through the worker: the snapshot dict is replaced
+        wholesale each tick, never mutated in place, and reading it cannot
+        force a device sync."""
+        return {**self._health,
+                "age_s": time.monotonic() - self._health_stamp}
 
     @worker_only
     def _stats(self) -> Dict[str, Any]:

@@ -34,6 +34,14 @@ name matching the budget pattern (default
 one-shot decode retry is ``for attempt in (0, 1)``) and are never flagged.
 Deadline-bounded poll loops that never touch an engine/replica target
 (queue drains, barrier waits) are out of scope by the target filter.
+
+Hedged dispatch (PR: robustness) joins the target list: a ``while`` loop
+that keeps firing hedge duplicates (``hedge`` in its guarded body) is an
+amplification bomb unless a hedge *budget* or *deadline* bounds it, so
+``hedge`` is a default target and ``deadline`` counts as a bounding name
+in the loop condition — ``while pending < self.hedge_budget * open_:`` or
+``while time.monotonic() < deadline:`` both pass; ``while True:`` around
+a hedge submit does not.
 """
 from __future__ import annotations
 
@@ -43,8 +51,8 @@ from typing import Iterable, List
 
 from ..core import ModuleContext, Rule, Violation, dotted_name, register
 
-_DEF_TARGETS = ["submit", "engine", "replica", ".sup.", "dispatch"]
-_DEF_BUDGET_PATTERN = r"max_|budget|retr|attempt|tries"
+_DEF_TARGETS = ["submit", "engine", "replica", ".sup.", "dispatch", "hedge"]
+_DEF_BUDGET_PATTERN = r"max_|budget|retr|attempt|tries|deadline"
 
 
 def _own_nodes(body: Iterable[ast.AST]):
