@@ -47,6 +47,10 @@ def main(argv=None):
         # observability gate: traced replicas must keep producing the
         # merged trace / flight-recorder / Prometheus artifacts
         results.extend(serve_bench.main(["--trace"]))
+        # tensor-parallel gate: tp=1 vs tp=2 A/B with token-exact streams
+        # and the per-chip KV capacity headline (returns no rows — with a
+        # printed note — on a genuinely single-device host)
+        results.extend(serve_bench.main(["--tp"]))
     results = [r for r in results if r]
 
     print("\n== results ==")

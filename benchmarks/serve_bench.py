@@ -59,6 +59,15 @@ computed hbm_fit-style from the pool's ACTUAL per-token residency (int8
 pages + f32 scale sidecars), not an assumed f32 itemsize. The smoke rows
 persist as benchmarks/results/quant_ab_smoke.json.
 
+--tp runs a tensor-parallel A/B (bench_tp): the same up-front greedy batch
+through the paged engine at tp=1 vs tp=2 (attention heads + paged KV pool
+sharded over a TP mesh, one all-reduce per layer). The tp row self-asserts
+token-exact streams vs the tp=1 reference; the headline is per-chip
+capacity — kv_bytes_per_token_per_shard divides exactly by tp and
+max_concurrent_at_slo (requests fitting a fixed PER-CHIP HBM budget) rises
+with it. Needs >=2 JAX devices; rows persist as
+benchmarks/results/tp_ab_smoke.json.
+
 Both modes end with a bench_load row: sustained closed-loop users plus
 open-loop background arrivals driven through the supervised runtime
 (``EngineSupervisor``) with one injected engine-loop crash — reporting
@@ -720,6 +729,114 @@ def bench_quant(model, params, *, num_requests: int, prompt_len: int,
     return row
 
 
+def bench_tp(model, params, *, num_requests: int, prompt_len: int,
+             max_new: int, num_blocks: int, block_size: int,
+             max_batch_size: int, label: str, tp: int = 1,
+             seed: int = 0, slo_ttft_s: float = 2.0,
+             kv_budget_mb: int = 1024, shared: dict = None,
+             artifact: str = None):
+    """Tensor-parallel A/B row: the same up-front greedy batch through the
+    paged engine at ``tp=1`` (baseline) and ``tp>1`` (attention heads and
+    the paged KV pool sharded over a TP mesh, one all-reduce per layer).
+
+    TP is an exactness-preserving transform — the only numeric difference
+    vs tp=1 is the all-reduce summation order — so unlike the quant rows
+    there are no closeness columns: the tp>1 row ASSERTS its streams are
+    token-identical to the tp=1 reference (``exact_vs_tp1``). The capacity
+    headline is per-chip: each shard holds ``1/tp`` of every page, so
+    ``kv_bytes_per_token_per_shard`` divides exactly by tp and
+    ``max_concurrent_at_slo`` — requests whose KV fits a fixed PER-CHIP
+    HBM budget at the shard's actual residency — rises with it, provided
+    the measured run met the TTFT SLO (else 0). ``shared`` carries the
+    tp=1 reference streams between rows; ``artifact`` persists all rows
+    as JSON once the tp>1 row lands.
+    """
+    from tnn_tpu.serving import InferenceEngine, ServingMetrics
+
+    print(f"{label}: {num_requests} requests up front, prompt {prompt_len}, "
+          f"max_new {max_new}, tp={tp} ({jax.device_count()} devices)")
+    rng = np.random.default_rng(seed)
+    prompts = [rng.integers(0, model.vocab_size, prompt_len).astype(np.int32)
+               for _ in range(num_requests)]
+
+    def run_engine(degree):
+        engine = InferenceEngine(
+            model, params, num_blocks=num_blocks, block_size=block_size,
+            max_batch_size=max_batch_size, max_seq_len=prompt_len + max_new,
+            seed=seed, decode_path="paged", tp=degree)
+        wprompt = np.random.default_rng(seed + 1).integers(
+            0, model.vocab_size, prompt_len).astype(np.int32)
+        wid = engine.submit(wprompt, 1)
+        engine.run_until_complete()
+        del engine.requests[wid]
+        engine.metrics = ServingMetrics(engine.profiler,
+                                        slo_ttft_s=slo_ttft_s)
+        t0 = time.perf_counter()
+        rids = [engine.submit(p, max_new) for p in prompts]
+        out = engine.run_until_complete()
+        wall = time.perf_counter() - t0
+        assert all(engine.requests[r].state.name == "FINISHED" for r in rids)
+        assert engine.pool.num_allocated == 0, "leaked KV blocks"
+        engine.check_invariants()
+        return engine, [out[r] for r in rids], wall
+
+    engine, outs, wall = run_engine(tp)
+
+    shared = shared if shared is not None else {}
+    if tp == 1:
+        shared["ref_outs"] = outs
+    ref_outs = shared.get("ref_outs")
+    if ref_outs is None:
+        # row isolation: the tp=1 row failed or was skipped — rebuild the
+        # reference off the clock so the exactness gate stays meaningful
+        _, ref_outs, _ = run_engine(1)
+        shared["ref_outs"] = ref_outs
+    exact = len(outs) == len(ref_outs) and \
+        all(np.array_equal(a, b) for a, b in zip(outs, ref_outs))
+    assert exact, "tensor-parallel decode diverged from the tp=1 streams"
+
+    st = engine.stats()
+    assert st["tp_degree"] == tp
+    pool = engine.pool
+    total_bytes = pool.kv_bytes_per_token + pool.kv_scale_bytes_per_token
+    per_shard = st["kv_bytes_per_token_per_shard"]
+    assert per_shard * tp == total_bytes, \
+        "per-shard KV residency is not an exact 1/tp of the pool"
+
+    s = engine.metrics.summary()
+    met_slo = s["ttft_ms_p99"] <= slo_ttft_s * 1e3
+    fit = int((kv_budget_mb * 2**20) // (per_shard * (prompt_len + max_new)))
+    row = report(
+        label, wall, items=s["decode_tokens"], item_name="tok",
+        extra={"tp": tp,
+               "ttft_ms_p50": s["ttft_ms_p50"],
+               "ttft_ms_p99": s["ttft_ms_p99"],
+               "token_latency_ms_p50": s["token_latency_ms_p50"],
+               "token_latency_ms_p99": s["token_latency_ms_p99"],
+               "kv_bytes_per_token_total": total_bytes,
+               "kv_bytes_per_token_per_shard": per_shard,
+               "exact_vs_tp1": int(exact),
+               "max_concurrent_at_slo": fit if met_slo else 0,
+               "goodput_at_slo": round(s["goodput_at_slo"], 4),
+               "requests": s["requests_finished"]})
+    if shared is not None:
+        shared.setdefault("rows", []).append(row)
+        if artifact and tp > 1:
+            import json
+            import os
+
+            os.makedirs(os.path.dirname(artifact), exist_ok=True)
+            with open(artifact, "w") as f:
+                json.dump({"generated": time.strftime("%Y-%m-%dT%H:%M:%S"),
+                           "platform": jax.devices()[0].platform,
+                           "devices": jax.device_count(),
+                           "kv_budget_mb": kv_budget_mb,
+                           "rows": shared["rows"]}, f, indent=2)
+            print(f"  tp A/B artifact -> {artifact}")
+            row["artifact_path"] = artifact
+    return row
+
+
 def bench_availability(model, params, *, replicas: int, num_requests: int,
                        rate_per_s: float, prompt_len: int, max_new: int,
                        num_blocks: int, block_size: int, max_batch_size: int,
@@ -1218,6 +1335,14 @@ def main(argv=None):
                          "exact gray-failure contract and that the "
                          "mitigated row's p99 TTFT beats the unmitigated "
                          "twin's")
+    ap.add_argument("--tp", action="store_true",
+                    help="tiny model, tp=1 vs tp=2 tensor-parallel A/B on "
+                         "the paged path: asserts the tp row's streams are "
+                         "token-exact vs tp=1 and reports the per-chip "
+                         "capacity headline (KV bytes per shard divided by "
+                         "tp, max_concurrent_at_slo from a per-chip HBM "
+                         "budget); needs >=2 JAX devices (CPU: "
+                         "--xla_force_host_platform_device_count)")
     ap.add_argument("--trace", action="store_true",
                     help="tiny model through a traced 2-replica Router: "
                          "persists the merged Perfetto trace, per-replica "
@@ -1230,6 +1355,29 @@ def main(argv=None):
     args = ap.parse_args(argv)
 
     rr = RowRunner()
+    if args.tp:
+        # tensor-parallel A/B: the same up-front greedy batch at tp=1 vs
+        # tp=2 — the tp row self-asserts token-exact streams; the headline
+        # is per-chip KV residency (bytes/token/shard exactly halved) and
+        # the max_concurrent_at_slo lift that buys under a fixed per-chip
+        # HBM budget. Skips (no rows) on a genuinely single-device host.
+        if jax.device_count() < 2:
+            print("serve_bench --tp: needs >=2 JAX devices (set "
+                  "XLA_FLAGS=--xla_force_host_platform_device_count=8 "
+                  "before jax imports for a virtual CPU mesh); skipping")
+            return rr.results
+        model, params = _smoke_model()
+        tshared = {}
+        import os
+        art = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "results", "tp_ab_smoke.json")
+        for deg in (1, 2):
+            rr.add(lambda d=deg: bench_tp(
+                model, params, num_requests=4, prompt_len=8, max_new=16,
+                num_blocks=32, block_size=4, max_batch_size=4, tp=d,
+                label=f"serve_tp{d}", shared=tshared, artifact=art),
+                label=f"bench_tp_{deg}")
+        return rr.results
     if args.trace:
         model, params = _smoke_model()
         rr.add(lambda: bench_trace(model, params), label="bench_trace")

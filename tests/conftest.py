@@ -49,6 +49,17 @@ def _force_kernel_interpret(request, monkeypatch):
         monkeypatch.setenv("TNN_PALLAS_INTERPRET", "1")
 
 
+@pytest.fixture
+def tp():
+    """Tensor-parallel degree for @pytest.mark.tp tests. The forced 8-device
+    virtual platform above already provides the mesh without perturbing the
+    O0 XLA flags; on an environment that really has fewer than 2 devices
+    (TNN_TEST_PLATFORM=tpu on a single chip) the test skips instead."""
+    if jax.device_count() < 2:
+        pytest.skip("tensor-parallel tests need >=2 devices")
+    return 2
+
+
 # -- test tiers ---------------------------------------------------------------
 # Measured-slow tests (>15s on a 1-CPU host, mostly multi-minute mesh/pipeline
 # XLA compiles) are auto-marked so `pytest -m "not slow"` is a fast dev tier;
@@ -83,6 +94,15 @@ _SLOW_TESTS = {
     "test_gpt2_param_count_small",
     "test_serve_bench_smoke", "test_serve_bench_chaos",
     "test_tp_llama_matches_single_device",
+    # TP-serving composition/failure tests: each builds several tp=2
+    # shard_map engines (multi-second compiles on the 1-CPU host); the
+    # cheap TP gates — tp=2 vs tp=1 parity on both decode paths,
+    # validation, observability, the serve_bench --tp capacity gate —
+    # stay tier-1, these deeper compositions ride the full CI tier to
+    # keep tier-1 inside its 870 s budget
+    "test_full_composition_exact", "test_preemption_parity",
+    "test_sampled_rows_deterministic", "test_debug_sync_clean",
+    "test_supervisor_crash_restart_exact", "test_chaos_gate_per_shard",
 }
 
 

@@ -246,6 +246,49 @@ def test_serve_bench_smoke():
         "serve_smoke_quant_int8_kv_w8"]
 
 
+@pytest.mark.tp
+def test_serve_bench_tp(tp):
+    """The --tp A/B is the benchmark-shaped tensor-parallel gate: the same
+    up-front greedy batch through the paged engine at tp=1 vs tp=2 on the
+    virtual device mesh. bench_tp self-asserts the exactness contract
+    (tp streams token-identical to tp=1, zero leaked blocks); here we gate
+    the capacity arithmetic — per-chip KV bytes divide EXACTLY by tp and
+    the per-chip-budget concurrency headline strictly rises with it — and
+    that the persisted artifact re-parses. Tier-1 so TP serving
+    regressions fail fast."""
+    import json
+    import os
+
+    from benchmarks import serve_bench
+
+    results = [r for r in serve_bench.main(["--tp"]) if r]
+    assert [r["bench"] for r in results] == ["serve_tp1", "serve_tp2"]
+    tp1, tp2 = results
+    for r in results:
+        assert r["ms"] > 0 and r["tok_per_s"] > 0
+        assert r["requests"] == 4
+        assert r["ttft_ms_p99"] >= r["ttft_ms_p50"] > 0
+        assert r["exact_vs_tp1"] == 1
+    assert tp1["tp"] == 1 and tp2["tp"] == tp
+    # the capacity contract is exact arithmetic, not a measurement: each
+    # shard holds 1/tp of every page, so per-chip residency divides by tp
+    # and the requests-per-chip headline rises with it
+    assert tp1["kv_bytes_per_token_per_shard"] == \
+        tp1["kv_bytes_per_token_total"]
+    assert tp2["kv_bytes_per_token_per_shard"] * tp == \
+        tp2["kv_bytes_per_token_total"]
+    assert tp2["kv_bytes_per_token_total"] == tp1["kv_bytes_per_token_total"]
+    assert tp2["max_concurrent_at_slo"] > tp1["max_concurrent_at_slo"] > 0
+    # the smoke artifact persisted and re-parses with both rows
+    art = tp2["artifact_path"]
+    assert os.path.exists(art)
+    with open(art) as f:
+        payload = json.load(f)
+    assert [row["bench"] for row in payload["rows"]] == [
+        "serve_tp1", "serve_tp2"]
+    assert payload["devices"] >= 2
+
+
 def test_serve_bench_chaos():
     """The --chaos row is the benchmark-shaped fault-tolerance gate: seeded
     pool-alloc failures + NaN logits, asserting every request terminal and

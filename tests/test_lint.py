@@ -55,6 +55,29 @@ class E:
         fn = self._jit[key]
 ''', "unbounded-compile-key") == []
 
+    # the step_build split: engines key their jit cache on step.key where
+    # step came from a packer that buckets internally — bounded only when
+    # the packer is a configured bucket_helper
+    PACKED = '''
+from tnn_tpu.serving import step_build
+class E:
+    def step(self, rows):
+        step = step_build.pack_mixed(rows, b=self.b, nb=self.nb)
+        fn = self._jit.get(step.key)
+'''
+
+    def test_packed_step_key_clean_with_helper(self):
+        vios = lint_source(
+            self.PACKED, select=["unbounded-compile-key"],
+            options={"unbounded-compile-key":
+                     {"bucket_helpers": ["pow2_bucket", "pack_mixed"]}})
+        assert vios == []
+
+    def test_attr_of_unbounded_local_still_flags(self):
+        # without the helper blessing, step is opaque and step.key raw
+        assert _rules(self.PACKED, "unbounded-compile-key") == \
+            ["unbounded-compile-key"]
+
 
 class TestUseAfterDonate:
     BUILDER = '''
@@ -115,6 +138,38 @@ class E:
     def test_full_sidecar_readoption_clean(self):
         src = self.SIDECAR_BUILDER + '''
         self.pool.update_pages(pk, pv, sk, sv)
+        shape = self.pool.pages_k.shape
+'''
+        assert _rules(src, "use-after-donate") == []
+
+    # tensor-parallel builders: no direct jax.jit — the builder returns
+    # self._jit_step(fn, donate_argnums=D), which compiles a plain jit at
+    # tp=1 and a per-shard shard_map at tp>1. Donation happens on every
+    # shard; the rule must keep tracking it through the wrapper.
+    WRAPPED_BUILDER = '''
+class E:
+    def _step_fn(self):
+        def fn(params, pages_k, pages_v):
+            return pages_k, pages_v
+        return self._jit_step(fn, donate_argnums=(1, 2))
+
+    def step(self):
+        fn = self._jit.get(key)
+        if fn is None:
+            fn = self._jit[key] = self._step_fn()
+        pk, pv = fn(self.params, self.pool.pages_k, self.pool.pages_v)
+'''
+
+    def test_wrapped_builder_read_after_donation_flags(self):
+        src = self.WRAPPED_BUILDER + '''
+        shape = self.pool.pages_k.shape
+        self.pool.update_pages(pk, pv)
+'''
+        assert _rules(src, "use-after-donate") == ["use-after-donate"]
+
+    def test_wrapped_builder_readoption_clean(self):
+        src = self.WRAPPED_BUILDER + '''
+        self.pool.update_pages(pk, pv)
         shape = self.pool.pages_k.shape
 '''
         assert _rules(src, "use-after-donate") == []
@@ -193,6 +248,37 @@ class Exporter:
     def snapshot(self):
         return jax.device_get(self._dev)
 ''', "fetch-outside-commit") == []
+
+    # the sharded step: TPContext.jit_step returns a dispatch closure that
+    # runs on EVERY engine step — a device_get hidden in it would barrier
+    # all tp shards per step, so closures of reachable functions are on
+    # the step path too
+    TP_OPTS = {"fetch-outside-commit":
+               {"step_roots": ["TPContext.jit_step"],
+                "commit_helpers": ["InferenceEngine._fetch_bundle"]}}
+
+    def test_fetch_in_tp_dispatch_closure_flags(self):
+        vios = lint_source('''
+import jax
+class TPContext:
+    def jit_step(self, fn):
+        jitted = self._compile(fn)
+        def dispatch(*args):
+            return jax.device_get(jitted(*args))
+        return dispatch
+''', select=["fetch-outside-commit"], options=self.TP_OPTS)
+        assert [v.rule for v in vios] == ["fetch-outside-commit"]
+
+    def test_tp_dispatch_returning_device_refs_clean(self):
+        vios = lint_source('''
+class TPContext:
+    def jit_step(self, fn):
+        jitted = self._compile(fn)
+        def dispatch(*args):
+            return jitted(*args)
+        return dispatch
+''', select=["fetch-outside-commit"], options=self.TP_OPTS)
+        assert vios == []
 
 
 class TestPrngKeyReuse:

@@ -120,6 +120,12 @@ def main(argv=None):
     ap.add_argument("--quant-weights", action="store_true",
                     help="serve projection/MLP matmuls from int8 weights "
                          "via the in-VMEM-dequant quant_matmul kernel")
+    ap.add_argument("--tp", type=int, default=1,
+                    help="tensor-parallel degree: shard attention heads and "
+                         "the paged KV pool head-wise over this many chips "
+                         "(one all-reduce per layer for attention out + MLP; "
+                         "requires num_kv_heads %% tp == 0 and tp <= "
+                         "device count; token-exact vs tp=1)")
     ap.add_argument("--max-new-tokens", type=int, default=32,
                     help="default for requests that omit it")
     ap.add_argument("--max-queue-depth", type=int, default=0,
@@ -243,7 +249,7 @@ def main(argv=None):
             profiler=prof, trace=bool(args.trace),
             overlap=not args.no_overlap,
             kv_dtype=args.kv_dtype, quant_weights=args.quant_weights,
-            seed=args.seed)
+            tp=args.tp, seed=args.seed)
 
     def build_supervisor(eng, idx=0):
         # each replica dumps into its own subdirectory so the per-reason
@@ -256,7 +262,34 @@ def main(argv=None):
             drain_deadline_s=args.drain_deadline_s or None,
             flight_dir=flight_dir)
 
+    # fail fast on an impossible TP config BEFORE touching model weights:
+    # the engine would reject it anyway, but a clear one-line error beats
+    # a traceback out of shard placement
+    if args.tp > 1:
+        n_dev = jax.device_count()
+        if args.tp > n_dev:
+            ap.error(f"--tp {args.tp} exceeds the {n_dev} visible "
+                     "device(s); off-TPU, raise the host device count with "
+                     "--xla_force_host_platform_device_count in XLA_FLAGS")
+        h_kv = getattr(model, "num_kv_heads", model.num_heads)
+        if h_kv % args.tp:
+            ap.error(f"--tp {args.tp} does not divide the model's "
+                     f"{h_kv} KV head(s); head-sharded TP needs "
+                     "num_kv_heads % tp == 0")
+        if args.quant_weights:
+            ap.error("--quant-weights is incompatible with --tp > 1 "
+                     "(int8 weight leaves don't column-shard)")
+        if args.decode_path == "fused":
+            ap.error("--decode-path fused is incompatible with --tp > 1 "
+                     "(the fused kernel stacks whole-model weights; use "
+                     "auto, paged, or standard)")
+
     engine = build_engine()
+    if args.tp > 1:
+        print(f"tensor parallel: tp={args.tp}, "
+              f"{model.num_heads // args.tp} head(s)/shard, per-shard KV "
+              f"{engine.stats()['kv_bytes_per_token_per_shard']} B/token",
+              file=sys.stderr)
     if not engine._paged and engine.paged_fallback_reason:
         print(f"paged decode unavailable: {engine.paged_fallback_reason}",
               file=sys.stderr)

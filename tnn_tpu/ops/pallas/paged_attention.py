@@ -24,6 +24,11 @@ decode rows and prefill chunks into ONE compiled mixed step.
 
 Grid: ``(B, H_kv, num_table_entries)`` — the innermost axis sweeps one row's
 block table; the (m, l, acc) scratch carries the online softmax across it.
+Because the grid's head axis never mixes heads, tensor-parallel serving
+(``serving/tp.py``) runs this kernel UNMODIFIED per shard: each shard's pool
+slice holds ``H_kv/tp`` heads of every page, the kernel sweeps it with the
+same block tables (replicated host-side), and the head axis of q/out is just
+locally smaller.
 Grouped-query attention is zero-copy: q is viewed as (B, Q, H_kv, G, Dh) and
 each grid step attends the whole (Q * G)-row query block against one fetched
 kv page. Pages past a row's live length clamp their fetch index to the last

@@ -48,6 +48,28 @@ def shard_map(body, *, mesh, in_specs, out_specs, check_vma: bool = False):
                       out_specs=out_specs, check_rep=True)
 
 
+def shard_map_unchecked(body, *, mesh, in_specs, out_specs):
+    """shard_map with replication tracking OFF — for inference-only bodies.
+
+    The serving hot path never differentiates through the mapped body, so
+    the transpose machinery that forces ``check_rep=True`` above is dead
+    weight here. More importantly, 0.4.x's replication validator has no
+    rules for several primitives that appear in serving step bodies
+    (``pallas_call`` from the paged-attention kernel, threefry sampling),
+    so unregistered ops get pessimistically tagged "unreplicated" and the
+    replicated out-specs the engine relies on (tokens, keys) fail the
+    check even though the values are genuinely device-invariant. With
+    tracking off, replicated out-specs simply take the (identical) value
+    from each shard."""
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(body, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=False)
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    return _shard_map(body, mesh=mesh, in_specs=in_specs,
+                      out_specs=out_specs, check_rep=False)
+
+
 _FIXES_04X_DONE = False
 
 

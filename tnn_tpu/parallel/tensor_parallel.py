@@ -7,6 +7,14 @@ inserts the all-reduces over ICI. No custom kernels or communication code.
 
 Rules are (regex on the param path) -> PartitionSpec, applied to any model's param
 pytree — the same mechanism t5x/maxtext use, fitted to this framework's param naming.
+
+The SERVING side reuses this exact layout (column-parallel qkv/fc, row-parallel
+out/proj, two all-reduces per layer) but not this module: ``serving/tp.py`` builds
+explicit ``shard_map`` step bodies instead of GSPMD annotations, because the engine
+needs donation of the head-sharded paged KV pool and a compile key per geometry —
+see docs/serving.md "Tensor-parallel serving". Training TP rules and serving TP
+shards agree on the "model" axis semantics, so a checkpoint sharded here loads
+there unchanged.
 """
 from __future__ import annotations
 

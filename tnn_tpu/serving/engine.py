@@ -107,6 +107,7 @@ from ..profiling.profiler import EventType, Profiler, profiled
 from ..utils.bucketing import pow2_bucket
 from . import kv_pool as kv_pool_lib
 from . import spec_decode
+from . import step_build
 from .faults import FaultInjected, FaultPlan
 from .kv_pool import PagedKVPool, PoolExhausted
 from .metrics import ServingMetrics
@@ -239,7 +240,7 @@ class InferenceEngine:
                  draft_model=None, draft_params=None,
                  profiler: Optional[Profiler] = None, trace: bool = False,
                  overlap: bool = False, kv_dtype: str = "f32",
-                 quant_weights: bool = False, seed: int = 0):
+                 quant_weights: bool = False, tp: int = 1, seed: int = 0):
         if getattr(model, "kv_cache_dtype", None):
             raise ValueError(
                 "the paged pool stores compute-dtype pages; "
@@ -300,11 +301,38 @@ class InferenceEngine:
         self.faults = faults
         self.model = model
         self.kv_dtype = kv_dtype
+        self.quant_weights = bool(quant_weights)
+        # tensor parallelism: tp > 1 shards attention heads and the paged
+        # pool's head axis over a mesh of tp devices; all host-side
+        # bookkeeping stays replicated (serving/tp.py). _tp is None at
+        # tp=1 and every TP branch below keys off it, so the single-chip
+        # configuration traces byte-identical programs to before.
+        self.tp = int(tp)
+        self._tp = None
+        if self.tp < 1:
+            raise ValueError(f"tp must be >= 1, got {tp}")
+        if self.tp > 1:
+            if self.quant_weights:
+                raise ValueError(
+                    "quant_weights with tp>1 is unsupported — Int8Weight "
+                    "leaves don't column-shard; serve fp weights under TP")
+            if getattr(model, "moe_experts", 0):
+                raise ValueError(
+                    "tensor-parallel serving does not support MoE models "
+                    "(expert dispatch is not head-sharded)")
+            from . import tp as tp_lib
+            self._tp = tp_lib.TPContext(model, params, self.tp)
+            params = self._tp.params
+        # the model the compiled step bodies trace: the head-sharded
+        # adapter under TP (same interface, per-shard math), the model
+        # itself otherwise. Host-side math keeps reading self.model.
+        self._step_model = self._tp.model if self._tp else model
         # compile-key suffix: int8 pools trace different step programs
         # (QuantPages operands), so their cache entries must never collide
-        # with f32 ones; f32 appends () — keys stay byte-identical
-        self._kv_key = ("int8",) if kv_dtype == "int8" else ()
-        self.quant_weights = bool(quant_weights)
+        # with f32 ones; likewise tp>1 (shard_map bodies). f32/tp=1
+        # appends () — keys stay byte-identical
+        self._kv_key = (("int8",) if kv_dtype == "int8" else ()) + \
+            ((f"tp{self.tp}",) if self.tp > 1 else ())
         if self.quant_weights:
             from ..nn import quant as _quant
             params = _quant.quantize_for_decode(params)
@@ -314,7 +342,8 @@ class InferenceEngine:
             num_layers=model.num_layers, num_kv_heads=model.num_kv_heads,
             head_dim=self.head_dim, num_blocks=num_blocks,
             block_size=block_size, dtype=model.policy.compute_dtype,
-            kv_dtype=kv_dtype)
+            kv_dtype=kv_dtype,
+            sharding=self._tp.page_sharding if self._tp else None)
         self.pool.fault_plan = faults
         # static gauge extras spliced into every _health_gauges refresh:
         # lets operators spot a misconfigured replica from /healthz alone
@@ -322,6 +351,11 @@ class InferenceEngine:
             "kv_dtype": self.kv_dtype,
             "kv_bytes_per_token": self.pool.kv_bytes_per_token,
             "quant_weights": int(self.quant_weights),
+            "tp_degree": self.tp,
+            # the TP headline: each chip holds 1/tp of every page's heads
+            "kv_bytes_per_token_per_shard":
+                (self.pool.kv_bytes_per_token +
+                 self.pool.kv_scale_bytes_per_token) // self.tp,
         }
         cap = min(model.max_len, self.pool.capacity * block_size)
         self.max_seq_len = min(max_seq_len or cap, cap)
@@ -353,6 +387,10 @@ class InferenceEngine:
         self.profiler = profiler
         self.metrics = ServingMetrics(profiler)
         self.tracer = Tracer(profiler if trace else None)
+        if self._tp is not None:
+            # every TP step dispatch records a serve.allreduce span (the
+            # 2-psum/layer collective cost is the TP tax worth watching)
+            self._tp.tracer = self.tracer
         self.step_seq = 0                   # monotonically counts step() calls
         self._step_note: Optional[Dict[str, Any]] = None
         self._finished_note: Optional[Dict[str, Any]] = None
@@ -428,6 +466,11 @@ class InferenceEngine:
                 "fused decode assembles a contiguous compute-dtype cache — "
                 "int8 pages would dequantize outside the kernel with no "
                 "bandwidth win; int8 pools use the paged or standard path")
+        if self.tp > 1:
+            raise ValueError(
+                "fused decode stacks whole-model weights into one kernel "
+                "invocation — head-sharded TP params cannot stack; tp>1 "
+                "serves the paged or standard path")
         from ..models import fused_decode
 
         chunks = fused_decode.pick_chunks(
@@ -586,6 +629,9 @@ class InferenceEngine:
             "kv_bytes_per_token": self.pool.kv_bytes_per_token,
             "kv_scale_bytes_per_token": self.pool.kv_scale_bytes_per_token,
             "quant_weights": self.quant_weights,
+            "tp_degree": self.tp,
+            "kv_bytes_per_token_per_shard":
+                self._gauge_extras["kv_bytes_per_token_per_shard"],
         })
         return s
 
@@ -827,8 +873,26 @@ class InferenceEngine:
 
     def _put(self, x, dtype=None):
         """Explicit host->device transfer for step inputs (guard-proof
-        replacement for the implicit jnp.asarray commit at dispatch)."""
+        replacement for the implicit jnp.asarray commit at dispatch).
+        Under TP the put replicates onto the mesh — a committed
+        single-device array cannot feed a jit whose other operands live on
+        the mesh."""
+        if self._tp is not None:
+            return self._tp.put_replicated(np.asarray(x, dtype))
         return jax.device_put(np.asarray(x, dtype))
+
+    def _jit_step(self, fn, *, donate_argnums=(), n_outs: int = 4,
+                  pages_argnums=(1, 2), pages_out=None, params_argnum=0):
+        """Compile a step body: plain jit at tp=1 (byte-identical programs
+        to before TP existed), shard_map over the TP mesh otherwise. The
+        extra keyword arguments describe which operands/outputs are the
+        head-sharded page bundles — plain jit ignores them."""
+        if self._tp is None:
+            return jax.jit(fn, donate_argnums=donate_argnums)
+        return self._tp.jit_step(
+            fn, donate_argnums=donate_argnums, n_outs=n_outs,
+            pages_argnums=pages_argnums, pages_out=pages_out,
+            params_argnum=params_argnum)
 
     def _build_step(self, flight: "StepInFlight") -> None:
         """The build/dispatch phase: everything up to and including the
@@ -894,7 +958,8 @@ class InferenceEngine:
             self._last_decode_emit = None
         self.metrics.observe_gauges(self.scheduler.queue_depth,
                                     self.pool.occupancy,
-                                    self.pool.kv_bytes_per_token)
+                                    self.pool.kv_bytes_per_token,
+                                    tp_degree=self.tp)
         # host-side health gauges, cached at commit: /healthz answers from
         # the supervisor's copy without ever reaching into the engine
         self._health_gauges = {
@@ -1020,23 +1085,13 @@ class InferenceEngine:
                 self.pool.free(ext)
                 del req.block_table[orig:]
             return False
-        b = self.scheduler.max_batch_size
-        nb = self.blocks_per_seq
-        offsets = np.zeros((b,), np.int32)
-        tables = np.full((b, nb), PagedKVPool.SCRATCH, np.int32)
-        temps = np.zeros((b,), np.float32)
-        topks = np.zeros((b,), np.int32)
-        topps = np.zeros((b,), np.float32)
-        poison = np.zeros((b,), np.float32)
-        for i, req in enumerate(live):
-            # the predicted row state: exactly one token committed at N
-            offsets[i] = req.cache_len + 1
-            tables[i, :len(req.block_table)] = req.block_table
-            temps[i] = req.temperature
-            topks[i] = req.top_k
-            topps[i] = req.top_p
-        key = (("pdecode", b, nb) if self._paged
-               else ("decode", b, nb)) + self._kv_key
+        # speculative=True packs the predicted row state: each offset
+        # assumes exactly one token committed at step N
+        step = step_build.pack_decode(
+            live, b=self.scheduler.max_batch_size, nb=self.blocks_per_seq,
+            scratch=PagedKVPool.SCRATCH, kv_key=self._kv_key,
+            paged=self._paged, fused_available=False, speculative=True)
+        b, nb, key, offsets = step.b, step.nb, step.key, step.offsets
         label = "decode_paged" if self._paged else "decode"
         fn = self._jit.get(key)
         if fn is None:
@@ -1051,9 +1106,9 @@ class InferenceEngine:
                              self.profiler):
                 newtok, ok, pk, pv = fn(
                     self.params, self.pool.pages_k, self.pool.pages_v,
-                    prev_tok, self._put(offsets), self._put(tables),
-                    self._put(temps), self._put(topks), self._put(topps),
-                    step_key, self._put(poison))
+                    prev_tok, self._put(offsets), self._put(step.tables),
+                    self._put(step.temps), self._put(step.topks),
+                    self._put(step.topps), step_key, self._put(step.poison))
         except Exception:  # noqa: BLE001 — speculation must never hurt
             for req, orig, ext in rollback:
                 self.pool.free(ext)
@@ -1217,10 +1272,14 @@ class InferenceEngine:
 
     def _next_key(self):
         self._key, sub = jax.random.split(self._key)
+        if self._tp is not None:
+            # jax.random.split runs on the default device; replicate the
+            # subkey onto the mesh before it feeds a sharded step
+            sub = self._tp.put_replicated(sub)
         return sub
 
     def _prefill_fn(self, padded_len: int, nb: int):
-        model = self.model
+        model = self._step_model
 
         def fn(params, pages_k, pages_v, ids, length, blocks, t, k, p, key,
                poison):
@@ -1238,7 +1297,7 @@ class InferenceEngine:
 
         # pool buffers are donated: the scatter updates pages in place
         # instead of copying the whole pool per prefill
-        return jax.jit(fn, donate_argnums=(1, 2))
+        return self._jit_step(fn, donate_argnums=(1, 2), n_outs=4)
 
     def _prefill_build(self, req: Request, events) -> Optional[Dict[str, Any]]:
         """Legacy whole-prompt prefill, build/dispatch half: allocate the
@@ -1372,7 +1431,9 @@ class InferenceEngine:
                     kv_pool_lib.copy_blocks(pages_v, src, dst))
 
         # donated + traced src/dst: one compile, in-place block copy
-        return jax.jit(fn, donate_argnums=(0, 1))
+        return self._jit_step(fn, donate_argnums=(0, 1), n_outs=2,
+                              pages_argnums=(0, 1), pages_out=(0, 1),
+                              params_argnum=None)
 
     def _match_prefix(self, req: Request) -> None:
         """Admission-time cache hit: fork the matched blocks into the
@@ -1507,6 +1568,11 @@ class InferenceEngine:
             d = self.drafter.draft(req, k)
             if not isinstance(d, spec_decode.DeviceDraft):
                 d = [int(t) % vocab for t in d][:k]
+            elif self._tp is not None:
+                # the drafter runs single-device; replicate its tokens onto
+                # the TP mesh so the poison shift and the splice below mix
+                # only mesh-resident arrays
+                d = spec_decode.DeviceDraft(self._tp.put_replicated(d.toks))
             if not len(d):
                 continue
             if self.faults is not None and self.faults.poison_draft():
@@ -1595,71 +1661,45 @@ class InferenceEngine:
             return
         rows = dec + [r for r, _ in chk]
         takes = {r.rid: t for r, t in chk}
-        # compiled chunk width: next power of two over the widest row (chunk
-        # grant or drafted decode row), so N distinct widths cost
-        # O(log chunk_size) compiles
-        widest = max([t for _, t in chk]
-                     + [1 + len(drafts.get(r.rid, ())) for r in dec])
-        qw = pow2_bucket(widest)
-        b = self.scheduler.max_batch_size
-        nb = self.blocks_per_seq
-        toks = np.zeros((b, qw), np.int32)
-        starts = np.zeros((b,), np.int32)
-        q_lens = np.zeros((b,), np.int32)
-        n_draft = np.zeros((b,), np.int32)
-        tables = np.full((b, nb), PagedKVPool.SCRATCH, np.int32)
-        temps = np.zeros((b,), np.float32)
-        topks = np.zeros((b,), np.int32)
-        topps = np.zeros((b,), np.float32)
-        poison = np.zeros((b,), np.float32)
-        dev_drafts: List[Any] = []      # (row index, DeviceDraft) splices
-        for i, req in enumerate(rows):
-            starts[i] = req.cache_len
-            tables[i, :len(req.block_table)] = req.block_table
-            temps[i] = req.temperature
-            topks[i] = req.top_k
-            topps[i] = req.top_p
-            if i < len(dec):
-                d = drafts.get(req.rid, []) if spec_on else []
-                toks[i, 0] = req.next_token
-                if isinstance(d, spec_decode.DeviceDraft):
-                    dev_drafts.append((i, d))
-                elif d:
-                    toks[i, 1:1 + len(d)] = d
-                q_lens[i] = 1 + len(d)
-                n_draft[i] = len(d)
-            else:
-                take = takes[req.rid]
-                seq = req.resume_tokens
-                toks[i, :take] = seq[req.cache_len:req.cache_len + take]
-                q_lens[i] = take
+        # pure host-side packing (compile-width bucketing, row layout,
+        # compile key) lives in step_build; fault poisoning and dispatch
+        # stay here with the rest of the device state
+        step = step_build.pack_mixed(
+            rows, len(dec), drafts, takes,
+            b=self.scheduler.max_batch_size, nb=self.blocks_per_seq,
+            scratch=PagedKVPool.SCRATCH, spec_on=spec_on,
+            kv_key=self._kv_key)
+        b, qw, poison = step.b, step.qw, step.poison
         if self.faults is not None:
             if dec:
                 poison[:len(dec)][self.faults.poison_rows(len(dec))] = np.nan
             for i in range(len(dec), len(rows)):
                 if self.faults.poison_prefill():
                     poison[i] = np.nan
-        key = (("mixed", b, qw, nb, "spec") if spec_on
-               else ("mixed", b, qw, nb)) + self._kv_key
+        key = step.key
         self._note_program("spec" if spec_on else "mixed", key,
                            [r.rid for r in rows], fill=len(rows) / b)
         fn = self._jit.get(key)
         if fn is None:
             if spec_on:
                 fn = self._jit[key] = (
-                    self._spec_paged_fn(b, qw, nb) if self._paged
-                    else self._spec_standard_fn(b, qw, nb))
+                    self._spec_paged_fn(b, qw, step.nb) if self._paged
+                    else self._spec_standard_fn(b, qw, step.nb))
             else:
                 fn = self._jit[key] = (
-                    self._mixed_paged_fn(b, qw, nb) if self._paged
-                    else self._mixed_standard_fn(b, qw, nb))
-        toks_in = self._put(toks)
-        for i, dd in dev_drafts:
+                    self._mixed_paged_fn(b, qw, step.nb) if self._paged
+                    else self._mixed_standard_fn(b, qw, step.nb))
+        toks_in = self._put(step.toks)
+        for i, dd in step.dev_drafts:
             # splice device-resident drafts into the token matrix without
             # fetching them. The commit reads draft VALUES back from the
             # fetched token matrix, so host and device drafts commit
-            # identically.
-            toks_in = _splice_draft_row(toks_in, dd.toks[None, :],
+            # identically. Under TP the draft tensor (produced on the
+            # drafter's single device) replicates onto the mesh first —
+            # a device-to-device transfer, no host sync.
+            draft_toks = dd.toks if self._tp is None \
+                else self._tp.put_replicated(dd.toks)
+            toks_in = _splice_draft_row(toks_in, draft_toks[None, :],
                                         self._put(i, jnp.int32))
         # one key per STEP (held across the retry): a transient fault retried
         # with the same key reproduces the fault-free step bit-for-bit
@@ -1674,18 +1714,19 @@ class InferenceEngine:
                     if spec_on:
                         accepts, newtok, ok, pk, pv = fn(
                             self.params, self.pool.pages_k, self.pool.pages_v,
-                            toks_in, self._put(starts),
-                            self._put(q_lens), self._put(tables),
-                            self._put(n_draft), self._put(temps),
-                            self._put(topks), self._put(topps), step_key,
-                            self._put(poison))
+                            toks_in, self._put(step.starts),
+                            self._put(step.q_lens), self._put(step.tables),
+                            self._put(step.n_draft), self._put(step.temps),
+                            self._put(step.topks), self._put(step.topps),
+                            step_key, self._put(poison))
                     else:
                         newtok, ok, pk, pv = fn(
                             self.params, self.pool.pages_k, self.pool.pages_v,
-                            toks_in, self._put(starts),
-                            self._put(q_lens), self._put(tables),
-                            self._put(temps), self._put(topks),
-                            self._put(topps), step_key, self._put(poison))
+                            toks_in, self._put(step.starts),
+                            self._put(step.q_lens), self._put(step.tables),
+                            self._put(step.temps), self._put(step.topks),
+                            self._put(step.topps), step_key,
+                            self._put(poison))
                 break
             except FaultInjected as e:
                 # injected pre-call: donated buffers untouched, retryable
@@ -1703,7 +1744,7 @@ class InferenceEngine:
             "dev": ((accepts, newtok, ok, toks_in) if spec_on
                     else (newtok, ok)),
             "rows": rows, "n_dec": len(dec), "takes": takes,
-            "n_draft": n_draft, "n_spec": n_spec, "t0": t0, "b": b,
+            "n_draft": step.n_draft, "n_spec": n_spec, "t0": t0, "b": b,
             "qw": qw})
 
     def _mixed_commit(self, rec: Dict[str, Any], out, events) -> None:
@@ -1818,7 +1859,7 @@ class InferenceEngine:
                 time.perf_counter() - rec["t0"], rec["b"])
 
     def _mixed_paged_fn(self, b: int, qw: int, nb: int):
-        model = self.model
+        model = self._step_model
 
         def fn(params, pages_k, pages_v, toks, starts, q_lens, tables,
                t, k, p, key, poison):
@@ -1835,10 +1876,10 @@ class InferenceEngine:
             newtok = sampling.sample_ragged(last, key, t, k, p)
             return newtok, ok, pages_k, pages_v
 
-        return jax.jit(fn, donate_argnums=(1, 2))
+        return self._jit_step(fn, donate_argnums=(1, 2), n_outs=4)
 
     def _mixed_standard_fn(self, b: int, qw: int, nb: int):
-        model = self.model
+        model = self._step_model
 
         def fn(params, pages_k, pages_v, toks, starts, q_lens, tables,
                t, k, p, key, poison):
@@ -1879,7 +1920,7 @@ class InferenceEngine:
                                                 rows_v, q_lens)
             return newtok, ok, pages_k, pages_v
 
-        return jax.jit(fn, donate_argnums=(1, 2))
+        return self._jit_step(fn, donate_argnums=(1, 2), n_outs=4)
 
     # -- speculative verification ----------------------------------------------
 
@@ -1940,7 +1981,7 @@ class InferenceEngine:
         return accepts, newtok, ok
 
     def _spec_paged_fn(self, b: int, qw: int, nb: int):
-        model = self.model
+        model = self._step_model
         verify = self._spec_verify
 
         def fn(params, pages_k, pages_v, toks, starts, q_lens, tables,
@@ -1954,10 +1995,10 @@ class InferenceEngine:
                                          t, k, p, key, poison)
             return accepts, newtok, ok, pages_k, pages_v
 
-        return jax.jit(fn, donate_argnums=(1, 2))
+        return self._jit_step(fn, donate_argnums=(1, 2), n_outs=5)
 
     def _spec_standard_fn(self, b: int, qw: int, nb: int):
-        model = self.model
+        model = self._step_model
         verify = self._spec_verify
 
         def fn(params, pages_k, pages_v, toks, starts, q_lens, tables,
@@ -1994,7 +2035,7 @@ class InferenceEngine:
                                                 rows_v, q_lens)
             return accepts, newtok, ok, pages_k, pages_v
 
-        return jax.jit(fn, donate_argnums=(1, 2))
+        return self._jit_step(fn, donate_argnums=(1, 2), n_outs=5)
 
     def _preempt(self, req: Request) -> None:
         self._note_leave_running(req, time.perf_counter())
@@ -2008,7 +2049,7 @@ class InferenceEngine:
                                 rid=req.rid, step=self.step_seq)
 
     def _decode_fn(self, batch: int, nb: int):
-        model = self.model
+        model = self._step_model
 
         def fn(params, pages_k, pages_v, toks, offsets, tables, t, k, p, key,
                poison):
@@ -2039,10 +2080,10 @@ class InferenceEngine:
                                                 jnp.stack(rows_v))
             return newtok, ok, pages_k, pages_v
 
-        return jax.jit(fn, donate_argnums=(1, 2))
+        return self._jit_step(fn, donate_argnums=(1, 2), n_outs=4)
 
     def _paged_decode_fn(self, batch: int, nb: int):
-        model = self.model
+        model = self._step_model
 
         def fn(params, pages_k, pages_v, toks, offsets, tables, t, k, p, key,
                poison):
@@ -2057,7 +2098,7 @@ class InferenceEngine:
             newtok = sampling.sample_ragged(logits, key, t, k, p)
             return newtok, ok, pages_k, pages_v
 
-        return jax.jit(fn, donate_argnums=(1, 2))
+        return self._jit_step(fn, donate_argnums=(1, 2), n_outs=4)
 
     def _fused_decode_fn(self, batch: int, nb: int):
         model = self.model
@@ -2110,36 +2151,17 @@ class InferenceEngine:
         record ``_decode_commit`` consumes — or None when the batch
         aborted."""
         t0 = time.perf_counter()
-        b = self.scheduler.max_batch_size
-        nb = self.blocks_per_seq
-        toks = np.zeros((b,), np.int32)
-        offsets = np.zeros((b,), np.int32)
-        tables = np.full((b, nb), PagedKVPool.SCRATCH, np.int32)
-        temps = np.zeros((b,), np.float32)
-        topks = np.zeros((b,), np.int32)
-        topps = np.zeros((b,), np.float32)
-        poison = np.zeros((b,), np.float32)
-        for i, req in enumerate(live):
-            toks[i] = req.next_token
-            offsets[i] = req.cache_len
-            tables[i, :len(req.block_table)] = req.block_table
-            temps[i] = req.temperature
-            topks[i] = req.top_k
-            topps[i] = req.top_p
+        step = step_build.pack_decode(
+            live, b=self.scheduler.max_batch_size, nb=self.blocks_per_seq,
+            scratch=PagedKVPool.SCRATCH, kv_key=self._kv_key,
+            paged=self._paged, fused_available=self._fused is not None)
+        b, nb, key, lockstep = step.b, step.nb, step.key, step.lockstep
+        poison = step.poison
         if self.faults is not None:
             poison[:len(live)][self.faults.poison_rows(len(live))] = np.nan
-        lockstep = (not self._paged and self._fused is not None
-                    and len(set(offsets[:len(live)].tolist())) == 1)
-        if lockstep:
-            # padded rows share the live offset: their scratch-block writes
-            # stay harmless and the kernel's scalar position is uniform
-            offsets[len(live):] = offsets[0]
-        if self._paged:
-            key, label = ("pdecode", b, nb) + self._kv_key, "serve.decode_paged"
-        elif lockstep:
-            key, label = ("fdecode", b, nb) + self._kv_key, "serve.decode_fused"
-        else:
-            key, label = ("decode", b, nb) + self._kv_key, "serve.decode"
+        label = {"pdecode": "serve.decode_paged",
+                 "fdecode": "serve.decode_fused",
+                 "decode": "serve.decode"}[key[0]]
         self._note_program(label.split(".", 1)[1], key,
                            [r.rid for r in live], fill=len(live) / b)
         fn = self._jit.get(key)
@@ -2161,18 +2183,18 @@ class InferenceEngine:
                         newtok, ok, pk, pv = fn(
                             self.params, self._fused["stacks"],
                             self.pool.pages_k, self.pool.pages_v,
-                            self._put(toks),
-                            self._put(int(offsets[0]), jnp.int32),
-                            self._put(tables), self._put(temps),
-                            self._put(topks), self._put(topps), step_key,
-                            self._put(poison))
+                            self._put(step.toks),
+                            self._put(int(step.offsets[0]), jnp.int32),
+                            self._put(step.tables), self._put(step.temps),
+                            self._put(step.topks), self._put(step.topps),
+                            step_key, self._put(poison))
                     else:
                         newtok, ok, pk, pv = fn(
                             self.params, self.pool.pages_k, self.pool.pages_v,
-                            self._put(toks), self._put(offsets),
-                            self._put(tables), self._put(temps),
-                            self._put(topks), self._put(topps), step_key,
-                            self._put(poison))
+                            self._put(step.toks), self._put(step.offsets),
+                            self._put(step.tables), self._put(step.temps),
+                            self._put(step.topks), self._put(step.topps),
+                            step_key, self._put(poison))
                 break
             except FaultInjected as e:
                 # injected pre-call: donated buffers untouched, retryable

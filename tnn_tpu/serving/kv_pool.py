@@ -71,7 +71,7 @@ class PagedKVPool:
 
     def __init__(self, num_layers: int, num_kv_heads: int, head_dim: int,
                  num_blocks: int, block_size: int = 16, dtype=jnp.float32,
-                 kv_dtype: str = "f32"):
+                 kv_dtype: str = "f32", sharding=None):
         if num_blocks < 2:
             raise ValueError("need >= 2 blocks (block 0 is reserved scratch)")
         if block_size < 1:
@@ -86,20 +86,13 @@ class PagedKVPool:
         self.block_size = int(block_size)
         self.dtype = dtype
         self.kv_dtype = kv_dtype
-        shape = (self.num_layers, self.num_blocks, self.num_kv_heads,
-                 self.block_size, self.head_dim)
-        if kv_dtype == "int8":
-            # int8 pages + f32 per-(position, head) scale sidecar, bundled
-            # as one pytree so donation/update_pages move them together
-            self.pages_k = QuantPages(jnp.zeros(shape, jnp.int8),
-                                      jnp.zeros(shape[:-1] + (1,),
-                                                jnp.float32))
-            self.pages_v = QuantPages(jnp.zeros(shape, jnp.int8),
-                                      jnp.zeros(shape[:-1] + (1,),
-                                                jnp.float32))
-        else:
-            self.pages_k = jnp.zeros(shape, dtype)
-            self.pages_v = jnp.zeros(shape, dtype)
+        # tensor-parallel serving: a NamedSharding splitting the head axis
+        # over the TP mesh (serving/tp.PAGE_SPEC). Bookkeeping (free list,
+        # refcounts, tables) never looks inside a bundle, so only page
+        # creation here and in reset_pages cares; one sharding covers both
+        # QuantPages leaves (the f32 scale sidecar shards with its heads).
+        self.sharding = sharding
+        self.reset_pages()
         # LIFO free list: freshly freed blocks are reused first (their pages
         # are warmest); block 0 never enters it
         self._free: List[int] = list(range(self.num_blocks - 1, 0, -1))
@@ -435,24 +428,30 @@ class PagedKVPool:
         engine fails every request that held KV first, so only bookkeeping
         (untouched here) and empty pages remain. Callers running a prefix
         cache must also ``purge_evictable()`` and clear the cache index —
-        zeroed pages must never be matchable."""
+        zeroed pages must never be matchable. Under tensor parallelism the
+        puts honor ``self.sharding``, so a crash reset purges EVERY shard's
+        pages, not just the default device's."""
         shape = (self.num_layers, self.num_blocks, self.num_kv_heads,
                  self.block_size, self.head_dim)
+
         # explicit puts, not jnp.zeros: recovery runs inside the step's
         # TNN_DEBUG_SYNC transfer guard, where eager jnp ops (which commit
         # their scalar operands implicitly) are disallowed
+        def put(x):
+            if self.sharding is not None:
+                return jax.device_put(x, self.sharding)
+            return jax.device_put(x)
+
         if self.kv_dtype == "int8":
             def fresh():
                 return QuantPages(
-                    jax.device_put(np.zeros(shape, np.int8)),
-                    jax.device_put(np.zeros(shape[:-1] + (1,), np.float32)))
+                    put(np.zeros(shape, np.int8)),
+                    put(np.zeros(shape[:-1] + (1,), np.float32)))
             self.pages_k = fresh()
             self.pages_v = fresh()
         else:
-            self.pages_k = jax.device_put(
-                np.zeros(shape, np.dtype(self.dtype)))
-            self.pages_v = jax.device_put(
-                np.zeros(shape, np.dtype(self.dtype)))
+            self.pages_k = put(np.zeros(shape, np.dtype(self.dtype)))
+            self.pages_v = put(np.zeros(shape, np.dtype(self.dtype)))
 
     def padded_table(self, block_table: Sequence[int], width: int):
         """Right-pad a block table with SCRATCH to a fixed ``width``."""

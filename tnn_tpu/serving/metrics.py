@@ -164,6 +164,10 @@ EXPOSITION: Dict[str, Tuple[str, str, str, str]] = {
         "tnn_serve_kv_bytes_per_token", "gauge",
         "Page-array bytes one resident KV token costs (K+V, all layers; "
         "int8 scale sidecars excluded)", "kv_bytes_per_token"),
+    "serve.tp_degree": (
+        "tnn_serve_tp_degree", "gauge",
+        "Tensor-parallel degree of this engine (attention heads and KV "
+        "pool head-sharded over tp chips; 1 = single-chip)", "tp_degree"),
 }
 
 #: direct (non-``_tick``) families: attribute/gauge name → (prometheus
@@ -553,12 +557,14 @@ class ServingMetrics:
         self._tick("serve.spec_accepted", accepted)
 
     def observe_gauges(self, queue_depth: int, pool_occupancy: float,
-                       kv_bytes_per_token: float = 0.0) -> None:
+                       kv_bytes_per_token: float = 0.0,
+                       tp_degree: float = 1.0) -> None:
         self.queue_depth.append(queue_depth)
         self.pool_occupancy.append(pool_occupancy)
         self._last_queue_depth = queue_depth
         self._last_pool_occupancy = pool_occupancy
         self._last_kv_bytes_per_token = kv_bytes_per_token
+        self._last_tp_degree = tp_degree
 
     def observe_preemption(self, rid: Optional[int] = None) -> None:
         self.preemptions += 1
@@ -788,6 +794,7 @@ class ServingMetrics:
             "mixed_step_fill_mean": _mean(self.mixed_step_fill),
             "kv_bytes_per_token": getattr(self, "_last_kv_bytes_per_token",
                                           0.0),
+            "tp_degree": getattr(self, "_last_tp_degree", 1.0),
         }
 
     # -- Prometheus exposition ------------------------------------------------

@@ -1,0 +1,158 @@
+"""Host-side step building: batch packing and compile keys, split from
+pool/device state.
+
+The engine's build/dispatch phase has two halves with different natures:
+
+  1. PACKING — pure host math over scheduler grants and request records:
+     lay rows into fixed-width arrays (tokens, offsets, block tables,
+     sampling params), pick the compile-key bucket. No device state, no
+     side effects.
+  2. DISPATCH — device work: fetch-or-build the jitted program, feed it
+     the pool's page buffers, adopt the donated pages it returns.
+
+This module is half 1. Keeping it free of pool/device references is what
+lets one packed step be dispatched unchanged to any device topology: at
+tp=1 the arrays feed a plain ``jax.jit`` program; under tensor parallelism
+the SAME packed step is dispatched per-shard via ``shard_map`` (every
+shard receives the identical replicated batch and sweeps its own head
+shard of the pool — serving/tp.py). The packed batches are also what the
+engine's step-program notes record, so they double as the replay surface.
+
+Row layout contract (mirrored by the commit halves in engine.py):
+``pack_mixed`` puts decode-phase rows first (each carrying 1 committed
+token plus optional speculative draft positions), then mid-prefill chunk
+rows; ``pack_decode`` is the legacy pure-decode batch, one token per row.
+Padding rows point their tables at the pool's scratch block.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Sequence, Tuple
+
+import numpy as np
+
+from ..utils.bucketing import pow2_bucket
+from . import spec_decode
+
+
+@dataclasses.dataclass
+class PackedStep:
+    """One step's host-side arrays + compile key. ``poison`` starts zeroed;
+    the engine's fault plan may NaN rows in place before dispatch (chaos
+    injection is deliberately outside the pure packing math)."""
+    key: Tuple[Any, ...]            # jit-cache compile key
+    tables: np.ndarray              # (B, nb) block tables, scratch-padded
+    temps: np.ndarray               # (B,) sampling temperature per row
+    topks: np.ndarray               # (B,) top-k per row
+    topps: np.ndarray               # (B,) top-p per row
+    poison: np.ndarray              # (B,) f32 additive logit poison (chaos)
+    b: int                          # compiled batch width
+    nb: int                         # compiled table width (blocks per seq)
+
+
+@dataclasses.dataclass
+class MixedStep(PackedStep):
+    """The ragged mixed prefill+decode batch (optionally speculative)."""
+    toks: np.ndarray = None         # (B, qw) token matrix
+    starts: np.ndarray = None       # (B,) first write position per row
+    q_lens: np.ndarray = None       # (B,) live tokens per row
+    n_draft: np.ndarray = None      # (B,) drafted lookahead per decode row
+    qw: int = 0                     # compiled chunk width (pow2 bucket)
+    # (row index, DeviceDraft) pairs whose tokens splice in on-device
+    dev_drafts: List[Tuple[int, Any]] = dataclasses.field(
+        default_factory=list)
+
+
+@dataclasses.dataclass
+class DecodeStep(PackedStep):
+    """The legacy pure-decode batch: one committed token per row."""
+    toks: np.ndarray = None         # (B,) this step's token per row
+    offsets: np.ndarray = None      # (B,) kv length before this token
+    lockstep: bool = False          # uniform offsets (fused-kernel eligible)
+
+
+def _fill_row(step: PackedStep, i: int, req) -> None:
+    step.tables[i, :len(req.block_table)] = req.block_table
+    step.temps[i] = req.temperature
+    step.topks[i] = req.top_k
+    step.topps[i] = req.top_p
+
+
+def _alloc_common(b: int, nb: int, scratch: int):
+    return dict(
+        tables=np.full((b, nb), scratch, np.int32),
+        temps=np.zeros((b,), np.float32),
+        topks=np.zeros((b,), np.int32),
+        topps=np.zeros((b,), np.float32),
+        poison=np.zeros((b,), np.float32))
+
+
+def pack_mixed(rows: Sequence[Any], n_dec: int, drafts: Dict[int, Any],
+               takes: Dict[int, int], *, b: int, nb: int, scratch: int,
+               spec_on: bool, kv_key: Tuple[Any, ...]) -> MixedStep:
+    """Pack decode rows (first ``n_dec`` of ``rows``, each 1 token +
+    optional draft) and prompt-chunk rows (the rest, ``takes[rid]`` tokens
+    each) into one ragged batch. Host drafts land in the token matrix here;
+    ``DeviceDraft`` rows are recorded in ``dev_drafts`` for the engine to
+    splice on-device (their values never touch the host)."""
+    widest = max([takes[r.rid] for r in rows[n_dec:]]
+                 + [1 + len(drafts.get(r.rid, ())) for r in rows[:n_dec]])
+    qw = pow2_bucket(widest)
+    key = (("mixed", b, qw, nb, "spec") if spec_on
+           else ("mixed", b, qw, nb)) + kv_key
+    step = MixedStep(
+        key=key, b=b, nb=nb, qw=qw,
+        toks=np.zeros((b, qw), np.int32),
+        starts=np.zeros((b,), np.int32),
+        q_lens=np.zeros((b,), np.int32),
+        n_draft=np.zeros((b,), np.int32),
+        **_alloc_common(b, nb, scratch))
+    for i, req in enumerate(rows):
+        step.starts[i] = req.cache_len
+        _fill_row(step, i, req)
+        if i < n_dec:
+            d = drafts.get(req.rid, []) if spec_on else []
+            step.toks[i, 0] = req.next_token
+            if isinstance(d, spec_decode.DeviceDraft):
+                step.dev_drafts.append((i, d))
+            elif d:
+                step.toks[i, 1:1 + len(d)] = d
+            step.q_lens[i] = 1 + len(d)
+            step.n_draft[i] = len(d)
+        else:
+            take = takes[req.rid]
+            seq = req.resume_tokens
+            step.toks[i, :take] = seq[req.cache_len:req.cache_len + take]
+            step.q_lens[i] = take
+    return step
+
+
+def pack_decode(live: Sequence[Any], *, b: int, nb: int, scratch: int,
+                kv_key: Tuple[Any, ...], paged: bool,
+                fused_available: bool,
+                speculative: bool = False) -> DecodeStep:
+    """Pack the pure-decode batch. ``speculative=True`` packs the
+    overlapped engine's predicted step N+1: each row's offset assumes
+    exactly one more token committed, and the token column is left zero —
+    the dispatched program reads step N's unfetched sampled tokens
+    directly as its device-resident input."""
+    step = DecodeStep(
+        key=(), b=b, nb=nb,
+        toks=np.zeros((b,), np.int32),
+        offsets=np.zeros((b,), np.int32),
+        **_alloc_common(b, nb, scratch))
+    for i, req in enumerate(live):
+        if not speculative:
+            step.toks[i] = req.next_token
+        step.offsets[i] = req.cache_len + (1 if speculative else 0)
+        _fill_row(step, i, req)
+    step.lockstep = (not paged and fused_available and not speculative
+                     and len(set(step.offsets[:len(live)].tolist())) == 1)
+    if step.lockstep:
+        # padded rows share the live offset: their scratch-block writes
+        # stay harmless and the kernel's scalar position is uniform
+        step.offsets[len(live):] = step.offsets[0]
+    step.key = (("pdecode", b, nb) if paged
+                else ("fdecode", b, nb) if step.lockstep
+                else ("decode", b, nb)) + kv_key
+    return step

@@ -401,7 +401,9 @@ class EngineSupervisor:
 
     def health_gauges(self) -> Dict[str, int]:
         """Host-side liveness gauges (queue depth, running count, last step
-        latency) cached at commit time, plus ``age_s`` — seconds since the
+        latency, and the engine's static extras — ``tp_degree`` and the
+        per-shard KV residency under tensor-parallel serving) cached at
+        commit time, plus ``age_s`` — seconds since the
         worker last refreshed the snapshot. A wedged-but-responsive worker
         (alive thread, no ticks) shows up as unbounded age, which the
         router's health scoring penalizes. Safe from any thread WITHOUT

@@ -11,7 +11,10 @@ O(log N) values, or derive it from fixed engine geometry (``self.*``).
 A key component is *bounded* when it is: a constant; a ``self.*`` attribute
 chain; a call to a configured bucket helper; ``min(...)`` with at least one
 bounded arg (min against fixed geometry has bounded range); ``max``/arith of
-bounded parts; or a local name whose every visible assignment is bounded.
+bounded parts; a local name whose every visible assignment is bounded; or
+an attribute of such a bounded local — the case introduced by the step_build
+split, where ``step = step_build.pack_mixed(...)`` (a configured helper that
+buckets internally) and the engine keys its cache on ``step.key``.
 """
 from __future__ import annotations
 
@@ -88,7 +91,16 @@ class UnboundedCompileKey(Rule):
                     return True
                 if isinstance(expr, ast.Attribute):
                     dn = dotted_name(expr)
-                    return dn is not None and dn.startswith("self.")
+                    if dn is not None and dn.startswith("self."):
+                        return True
+                    # attribute of a bounded local: a packed step returned
+                    # by a configured packer helper carries only bucketed
+                    # or fixed-geometry fields (step.key, step.qw, ...)
+                    base = expr.value
+                    while isinstance(base, ast.Attribute):
+                        base = base.value
+                    return isinstance(base, ast.Name) and \
+                        bounded(base, visiting)
                 if isinstance(expr, (ast.Tuple, ast.List)):
                     return all(bounded(e, visiting) for e in expr.elts)
                 if isinstance(expr, ast.IfExp):

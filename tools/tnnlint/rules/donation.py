@@ -21,6 +21,17 @@ than were donated under its parent re-animates the pages but leaves the
 scales dead — that is a finding, not a kill.  Bundled pytrees (one name
 carrying data + scale, the repo's ``QuantPages``) are immune by
 construction and keep the plain kill behavior.
+
+Tensor parallelism adds one more: the engine's builders no longer call
+``jax.jit`` directly — they return ``self._jit_step(fn, donate_argnums=D)``,
+a dispatcher that compiles either a plain jit (tp=1) or a sharded
+``shard_map`` body (tp>1) with the SAME donated positions.  Donation then
+happens on EVERY shard, so the contract is unchanged but the lexical
+builder pattern is different; calls whose last dotted segment is in the
+configured ``jit_wrappers`` (default ``_jit_step``/``jit_step``) are
+treated exactly like ``jax.jit`` for builder detection, and the donated
+page buffers must still be re-adopted (on all shards at once — the
+reassigner receives the sharded arrays) before their next read.
 """
 from __future__ import annotations
 
@@ -33,6 +44,7 @@ from ..core import (ModuleContext, Rule, Violation, call_name, dotted_name,
 _DEF_CACHE_ATTRS = ["_jit"]
 _DEF_REASSIGNERS = ["update_pages"]
 _DEF_SCALE_SUFFIXES = ["scales_k", "scales_v"]
+_DEF_JIT_WRAPPERS = ["_jit_step", "jit_step"]
 
 
 def _donate_positions(jit_call: ast.Call) -> Set[int]:
@@ -67,8 +79,12 @@ def _inner_arity(jit_call: ast.Call, scope: ast.AST) -> Optional[int]:
     return None
 
 
-def _is_jit_call(call: ast.Call) -> bool:
-    return (call_name(call) or "").split(".")[-1] == "jit"
+def _is_jit_call(call: ast.Call, wrappers: Set[str] = frozenset()) -> bool:
+    """``jax.jit(...)`` or a configured jit-wrapper builder call such as
+    ``self._jit_step(...)`` (plain jit at tp=1, per-shard shard_map at
+    tp>1 — donation semantics identical, so the rule treats them alike)."""
+    last = (call_name(call) or "").split(".")[-1]
+    return last == "jit" or last in wrappers
 
 
 def _stmt_exprs(stmt: ast.stmt):
@@ -97,6 +113,7 @@ class UseAfterDonate(Rule):
         cache_attrs = set(opts.get("jit_cache_attrs", _DEF_CACHE_ATTRS))
         reassigners = set(opts.get("reassigners", _DEF_REASSIGNERS))
         scale_suffixes = set(opts.get("scale_suffixes", _DEF_SCALE_SUFFIXES))
+        wrappers = set(opts.get("jit_wrappers", _DEF_JIT_WRAPPERS))
         out: List[Violation] = []
 
         # pass 1: builder methods -> (inner arity, donated positions)
@@ -105,7 +122,7 @@ class UseAfterDonate(Rule):
             for n in own_nodes(fn):
                 if isinstance(n, ast.Return) and \
                         isinstance(n.value, ast.Call) and \
-                        _is_jit_call(n.value):
+                        _is_jit_call(n.value, wrappers):
                     positions = _donate_positions(n.value)
                     if positions:
                         builders[fn.name] = (_inner_arity(n.value, fn),
@@ -114,13 +131,15 @@ class UseAfterDonate(Rule):
         # pass 2: call sites
         for _qual, fn, _cls in func_defs(ctx.tree):
             out.extend(self._check_function(ctx, fn, builders, cache_attrs,
-                                            reassigners, scale_suffixes))
+                                            reassigners, scale_suffixes,
+                                            wrappers))
         return out
 
     # -- per-function analysis -------------------------------------------------
 
     def _check_function(self, ctx, fn, builders, cache_attrs,
-                        reassigners, scale_suffixes) -> List[Violation]:
+                        reassigners, scale_suffixes, wrappers
+                        ) -> List[Violation]:
         out: List[Violation] = []
         # name -> donated positions (None = unknown builder: match by arity)
         jit_names: Dict[str, Optional[Set[int]]] = {}
@@ -132,7 +151,7 @@ class UseAfterDonate(Rule):
             if isinstance(value, ast.Call):
                 cn = call_name(value) or ""
                 last = cn.split(".")[-1]
-                if _is_jit_call(value):
+                if _is_jit_call(value, wrappers):
                     return _donate_positions(value) or _not
                 if cn.startswith("self.") and last in builders:
                     return builders[last][1]

@@ -16,6 +16,13 @@ to ``jax.jit``, and flag every ``device_get`` call in a reachable function
 whose qualname is not in ``commit_helpers``.  Unlike host-sync-in-step-path
 this needs no taint tracking: ``device_get`` is the explicit fetch, so its
 mere presence outside the commit helper is the violation.
+
+Closures count: a def nested inside a reachable function (the tensor-
+parallel dispatcher returned by ``TPContext.jit_step`` is the motivating
+case — it runs on EVERY sharded step the engine launches) is itself on the
+step path, so the sharded dispatch can't hide a per-shard fetch in a
+wrapper; per-shard results still route through the engine's single batched
+``_fetch_bundle``.
 """
 from __future__ import annotations
 
@@ -79,6 +86,11 @@ class FetchOutsideCommit(Rule):
                 continue
             reachable.add(q)
             frontier.extend(edges(q))
+            # closures defined in a reachable function run on the step path
+            # too (e.g. the per-shard dispatch wrapper TPContext.jit_step
+            # returns) — jitted inner defs are filtered below as always
+            frontier.extend(c for c in by_qual
+                            if c.startswith(q + ".") and c not in reachable)
 
         out: List[Violation] = []
         for q in sorted(reachable):
