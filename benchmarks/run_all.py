@@ -51,6 +51,10 @@ def main(argv=None):
         # and the per-chip KV capacity headline (returns no rows — with a
         # printed note — on a genuinely single-device host)
         results.extend(serve_bench.main(["--tp"]))
+        # elastic-fleet gate: trickle-then-burst A/B, autoscaler off vs on
+        # — the on row must strictly beat the off twin's goodput-at-SLO
+        # and the host-tier probe must beat the no-tier baseline
+        results.extend(serve_bench.main(["--spike"]))
     results = [r for r in results if r]
 
     print("\n== results ==")

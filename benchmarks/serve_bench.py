@@ -68,6 +68,20 @@ max_concurrent_at_slo (requests fitting a fixed PER-CHIP HBM budget) rises
 with it. Needs >=2 JAX devices; rows persist as
 benchmarks/results/tp_ab_smoke.json.
 
+--spike runs an elastic-fleet A/B (bench_spike): the same two-phase
+arrival trace (gentle trickle, then a Poisson burst) through a Router of
+host-tier-enabled replicas, once pinned at 1 replica (autoscaler off) and
+once under a load-driven ``Autoscaler`` (scale up under the burst, graceful
+zero-loss scale-down after it) — reporting goodput-at-SLO, shed/rejected
+counts, a replicas-over-time timeline, and the host-RAM KV tier's hit rate
+on a working set larger than the device pool (probed deterministically
+against a no-tier baseline whose hit rate is zero by construction). The on
+row self-asserts its goodput strictly beats the off twin's and that the
+tier probe readmitted at least one block; both rows assert exactly one
+terminal per request, token-exact survivors, and zero leaked blocks in
+every replica's device pool and host tier. Rows persist as
+benchmarks/results/spike_ab_smoke.json.
+
 Both modes end with a bench_load row: sustained closed-loop users plus
 open-loop background arrivals driven through the supervised runtime
 (``EngineSupervisor``) with one injected engine-loop crash — reporting
@@ -1196,6 +1210,297 @@ def bench_straggler(model, params, *, replicas: int, num_requests: int,
     return row
 
 
+def _tier_probe(model, params, *, num_blocks=10, block_size=4,
+                tier_bytes=1 << 20, seed=0):
+    """Deterministic host-tier hit-rate probe on a working set larger than
+    the device pool: six prompts sharing an 8-token (two-block) prefix run
+    serially TWICE through a pool too small to keep the set resident — the
+    second pass's prefix probes re-admit demoted blocks from the host tier.
+    The no-tier baseline runs the identical trace with the tier disabled
+    (hit rate zero by construction) and must produce identical tokens."""
+    from tnn_tpu.serving import InferenceEngine
+
+    rng = np.random.default_rng(seed)
+    prefix = rng.integers(0, model.vocab_size, 8).astype(np.int32)
+    prompts = [np.concatenate([prefix, rng.integers(
+        0, model.vocab_size, 4).astype(np.int32)]) for _ in range(6)]
+
+    def run(tier_on):
+        eng = InferenceEngine(
+            model, params, num_blocks=num_blocks, block_size=block_size,
+            max_batch_size=2, chunk_size=8, chunked_prefill=True,
+            prefix_cache=True, decode_path="paged", seed=seed,
+            host_tier_bytes=tier_bytes if tier_on else 0)
+        toks = []
+        for _ in range(2):
+            for p in prompts:
+                rid = eng.submit(p, 6)
+                toks.append(eng.run_until_complete()[rid])
+        st = eng.stats()
+        assert eng.pool.num_allocated == 0
+        eng.check_invariants()
+        return toks, st
+
+    on_toks, on_st = run(True)
+    off_toks, off_st = run(False)
+    assert on_toks == off_toks, "tier-on streams diverged from tier-off"
+    assert off_st["tier_readmits"] == 0
+    return {"tier_probe_hits": int(on_st["tier_readmits"]),
+            "tier_probe_demotions": int(on_st["tier_demotions"]),
+            "tier_probe_hit_rate": round(
+                on_st["tier_readmits"] / max(1, on_st["tier_demotions"]), 4),
+            "tier_probe_baseline_hits": int(off_st["tier_readmits"])}
+
+
+def bench_spike(model, params, *, num_requests: int, prompt_len: int,
+                max_new: int, num_blocks: int, block_size: int,
+                max_batch_size: int, autoscale: bool, max_replicas: int = 3,
+                tier_bytes: int = 1 << 20, max_queue_depth: int = 10,
+                burst_rate_per_s: float = 200.0, trickle_rate_per_s: float = 20.0,
+                step_delay_s: float = 0.02, slo_ttft_s: float = 0.25,
+                label: str = "serve_spike",
+                seed: int = 0, shared=None, artifact=None):
+    """Elastic-fleet A/B row: a two-phase arrival trace (gentle trickle,
+    then a Poisson burst) through a ``Router`` whose replicas all carry the
+    host-RAM KV tier, run once pinned at a single replica (``autoscale``
+    False) and once under the load-driven :class:`Autoscaler` (scale up
+    under the burst from a warm-standby pool, hysteresis-guarded zero-loss
+    scale-down after it). The goodput_at_slo / rejected delta between the
+    twin rows is the measured value of elasticity; replicas_timeline
+    records the fleet size the controller actually actuated.
+
+    Standbys are pre-built and warmed (a real fleet joins from warm images,
+    and an in-row cold compile would charge XLA time to the controller), so
+    a join is pure control-plane latency. The row self-asserts the
+    resilience contract — exactly one terminal per accepted request, every
+    accepted request FINISHED token-exact vs a single-engine greedy
+    reference, zero leaked blocks in every replica's device pool AND host
+    tier — plus, with ``shared``, that the on row's goodput strictly beats
+    the off twin's and the deterministic tier probe (see
+    :func:`_tier_probe`) readmitted at least one block where the no-tier
+    baseline by construction readmits none."""
+    import threading
+
+    from tnn_tpu.serving import (AdmissionRejected, Autoscaler,
+                                 EngineSupervisor, FaultPlan,
+                                 InferenceEngine, Router, ServingMetrics,
+                                 ShuttingDown, SupervisorState)
+
+    print(f"{label}: {num_requests} requests (trickle ~{trickle_rate_per_s}"
+          f"/s then burst ~{burst_rate_per_s}/s), autoscaler "
+          + (f"ON (1..{max_replicas} replicas)" if autoscale
+             else "OFF (pinned at 1 replica)"))
+    rng = np.random.default_rng(seed)
+    # grouped prompts: shared two-block prefixes drive the prefix cache /
+    # host tier during the run itself (working set > one replica's pool)
+    n_groups = 4
+    prefixes = [rng.integers(0, model.vocab_size,
+                             2 * block_size).astype(np.int32)
+                for _ in range(n_groups)]
+    prompts = [np.concatenate([prefixes[i % n_groups], rng.integers(
+        0, model.vocab_size,
+        prompt_len - 2 * block_size).astype(np.int32)])
+        for i in range(num_requests)]
+    n_trickle = max(1, num_requests // 4)
+    gaps = np.concatenate([
+        rng.exponential(1.0 / trickle_rate_per_s, n_trickle),
+        rng.exponential(1.0 / burst_rate_per_s, num_requests - n_trickle)])
+
+    ref_engine = InferenceEngine(
+        model, params, num_blocks=num_blocks, block_size=block_size,
+        max_batch_size=max_batch_size, max_seq_len=prompt_len + max_new,
+        seed=seed)
+    ref = []
+    for p in prompts:
+        rid = ref_engine.submit(p, max_new)
+        ref.append(ref_engine.run_until_complete()[rid])
+
+    wprompt = np.random.default_rng(seed + 1).integers(
+        0, model.vocab_size, prompt_len).astype(np.int32)
+
+    def mk_engine():
+        eng = InferenceEngine(
+            model, params, num_blocks=num_blocks, block_size=block_size,
+            max_batch_size=max_batch_size, max_seq_len=prompt_len + max_new,
+            chunk_size=8, chunked_prefill=True, prefix_cache=True,
+            max_queue_depth=max_queue_depth, host_tier_bytes=tier_bytes,
+            seed=seed)
+        wid = eng.submit(wprompt, 2)
+        eng.run_until_complete()
+        del eng.requests[wid]
+        eng.kv_tier.clear()
+        eng.metrics = ServingMetrics(eng.profiler, slo_ttft_s=slo_ttft_s)
+        # uniform injected step latency: a tiny smoke model decodes in
+        # microseconds, which would let ONE replica absorb any burst and
+        # reduce the A/B to wall-clock noise; a realistic per-step cost
+        # makes the single-replica row genuinely saturate so elasticity
+        # (not machine speed) is what the twin rows measure
+        if step_delay_s > 0:
+            eng.faults = FaultPlan()
+            eng.faults.step_delay_s = float(step_delay_s)
+        return eng
+
+    engines = [mk_engine() for _ in range(max_replicas if autoscale else 1)]
+    sups = [EngineSupervisor(e, max_restarts=3, restart_backoff_s=0.0,
+                             drain_deadline_s=60.0) for e in engines]
+    standbys = list(sups[1:])
+
+    def factory():
+        if not standbys:
+            raise ConnectionError("warm-standby pool exhausted")
+        return standbys.pop(0)
+
+    router = Router([sups[0]], seed=seed)
+    scaler = Autoscaler(
+        router, factory, min_replicas=1, max_replicas=max_replicas,
+        up_load=2.0, down_load=0.75, hysteresis_s=0.1, cooldown_s=0.05,
+        interval_s=0.02) if autoscale else None
+
+    lock = threading.Lock()
+    terminals = {}   # gid -> terminal event count (exactly-once gate)
+    done = {}        # gid -> done event (tokens, ttft_ms)
+
+    def mk_listener():
+        def listener(ev):
+            if ev["event"] == "token":
+                return
+            with lock:
+                terminals[ev["id"]] = terminals.get(ev["id"], 0) + 1
+                if ev["event"] == "done":
+                    done[ev["id"]] = ev
+        return listener
+
+    t0 = time.perf_counter()
+    timeline = [(0.0, 1)]   # (elapsed_s, active_replicas) on change
+
+    def sample_replicas():
+        n = router.num_active_replicas()
+        if n != timeline[-1][1]:
+            timeline.append((round(time.perf_counter() - t0, 4), n))
+
+    router.start()
+    if scaler is not None:
+        scaler.start()
+    gids, owner, rejected = [], {}, 0
+    for i, (p, gap) in enumerate(zip(prompts, gaps)):
+        time.sleep(float(gap))
+        try:
+            g = router.submit(p, max_new, listener=mk_listener())
+        except (AdmissionRejected, ShuttingDown):
+            rejected += 1
+        else:
+            gids.append(g)
+            owner[g] = i
+        sample_replicas()
+    deadline = time.monotonic() + 120.0
+    while True:
+        with lock:
+            if sum(terminals.values()) >= len(gids):
+                break
+        if time.monotonic() > deadline:
+            raise RuntimeError(
+                f"spike bench wedged: {sum(terminals.values())}"
+                f"/{len(gids)} terminal")
+        sample_replicas()
+        time.sleep(0.01)
+    # serving wall: last terminal in — goodput must not be diluted by the
+    # post-run scale-down grace or the drain
+    wall = time.perf_counter() - t0
+    if scaler is not None:
+        # quiet grace: give the controller its hysteresis window so the
+        # now-idle fleet shrinks back (a zero-stream retire is the
+        # trivially zero-loss scale-down) and the timeline records it
+        grace = time.monotonic() + 2.0
+        while time.monotonic() < grace:
+            sample_replicas()
+            if (scaler.stats()["scale_downs"] > 0
+                    and router.num_active_replicas() <= 1):
+                break
+            time.sleep(0.02)
+        sample_replicas()
+        scaler.stop()
+    replicas_max = max(n for _, n in timeline)
+    st = router.stats()
+    scaler_st = scaler.stats() if scaler is not None else {}
+    router.request_drain("bench complete")
+    if not router.join(timeout=60):
+        raise RuntimeError("router failed to drain")
+
+    # the elasticity contract IS the gate
+    assert router.state is SupervisorState.STOPPED and router.exit_code == 0
+    assert all(terminals.get(g, 0) == 1 for g in gids), \
+        "duplicated or missing terminal events"
+    assert len(done) == len(gids), \
+        f"only {len(done)}/{len(gids)} accepted requests FINISHED"
+    exact = int(all(done[g]["tokens"] == ref[owner[g]] for g in gids))
+    assert exact, "a migrated/tiered stream diverged from the reference"
+    tier_hits = tier_demotions = 0
+    for i, eng in enumerate(engines):
+        assert eng.pool.num_allocated == 0, f"replica {i} leaked KV blocks"
+        eng.check_invariants()   # device pool AND host tier accounting
+        ts = eng.kv_tier.stats()
+        tier_hits += ts["tier_readmits"]
+        tier_demotions += ts["tier_demotions"]
+
+    probe = None
+    if shared is not None:
+        if "tier_probe" not in shared:
+            shared["tier_probe"] = _tier_probe(model, params, seed=seed)
+        probe = shared["tier_probe"]
+
+    ttfts = np.array([done[g]["ttft_ms"] for g in gids], dtype=float)
+    within = int(np.sum(ttfts <= slo_ttft_s * 1e3))
+    row = report(
+        label, wall, items=len(gids), item_name="req",
+        extra={"requests": num_requests,
+               "accepted": len(gids),
+               "rejected": rejected,
+               "finished": len(done),
+               "autoscale": int(autoscale),
+               "goodput_at_slo": round(within / wall, 4),
+               "slo_ttft_s": slo_ttft_s,
+               "ttft_ms_p50": round(float(np.percentile(ttfts, 50)), 3),
+               "ttft_ms_p99": round(float(np.percentile(ttfts, 99)), 3),
+               "replicas_max": replicas_max,
+               "replicas_timeline": [[t, n] for t, n in timeline],
+               "scale_ups": scaler_st.get("scale_ups", 0),
+               "scale_downs": scaler_st.get("scale_downs", 0),
+               "join_failures": scaler_st.get("join_failures", 0),
+               "tier_hits": tier_hits,
+               "tier_demotions": tier_demotions,
+               "migrated_requests": st["migrated_requests"],
+               "proactive_migrations": st["proactive_migrations"],
+               "exact_vs_ref": exact,
+               "terminal": int(sum(terminals.values()))})
+    if probe is not None:
+        row.update(probe)
+    if shared is not None:
+        shared.setdefault("rows", []).append(row)
+        if autoscale:
+            off = [r for r in shared["rows"] if not r.get("autoscale")]
+            if off:
+                assert row["goodput_at_slo"] > off[0]["goodput_at_slo"], \
+                    (f"autoscaler did not improve goodput-at-SLO: "
+                     f"{row['goodput_at_slo']} <= "
+                     f"{off[0]['goodput_at_slo']}")
+            assert row["replicas_max"] > 1, "autoscaler never scaled up"
+            assert row["tier_probe_hits"] > row["tier_probe_baseline_hits"],\
+                "host tier readmitted nothing on a >HBM working set"
+            if artifact:
+                import json
+                import os
+
+                os.makedirs(os.path.dirname(artifact), exist_ok=True)
+                with open(artifact, "w") as f:
+                    json.dump({"generated":
+                               time.strftime("%Y-%m-%dT%H:%M:%S"),
+                               "platform": jax.devices()[0].platform,
+                               "rows": shared["rows"]}, f, indent=2)
+                print(f"  spike A/B artifact -> {artifact}")
+                row["artifact_path"] = artifact
+    return row
+
+
 def bench_trace(model, params, *, num_requests: int = 6, prompt_len: int = 6,
                 max_new: int = 8, replicas: int = 2, num_blocks: int = 16,
                 block_size: int = 4, max_batch_size: int = 4,
@@ -1335,6 +1640,16 @@ def main(argv=None):
                          "exact gray-failure contract and that the "
                          "mitigated row's p99 TTFT beats the unmitigated "
                          "twin's")
+    ap.add_argument("--spike", action="store_true",
+                    help="tiny model through a Router of host-tier-enabled "
+                         "replicas under a trickle-then-burst arrival "
+                         "trace: autoscaler-off vs autoscaler-on A/B, "
+                         "asserting the on row's goodput-at-SLO strictly "
+                         "beats the off twin's, zero-loss scale-down, "
+                         "token-exact survivors, zero leaked blocks in "
+                         "device pool and host tier, and a deterministic "
+                         "host-tier hit-rate probe beating the no-tier "
+                         "baseline")
     ap.add_argument("--tp", action="store_true",
                     help="tiny model, tp=1 vs tp=2 tensor-parallel A/B on "
                          "the paged path: asserts the tp row's streams are "
@@ -1377,6 +1692,25 @@ def main(argv=None):
                 num_blocks=32, block_size=4, max_batch_size=4, tp=d,
                 label=f"serve_tp{d}", shared=tshared, artifact=art),
                 label=f"bench_tp_{deg}")
+        return rr.results
+    if args.spike:
+        # elastic-fleet A/B: the same trickle-then-burst trace through
+        # host-tier-enabled replicas, pinned at 1 replica vs under the
+        # load-driven autoscaler — the on row asserts goodput strictly
+        # improves, scale-down loses nothing, and the host tier's
+        # deterministic hit-rate probe beats the (zero) no-tier baseline
+        model, params = _smoke_model()
+        spshared = {}
+        import os
+        art = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "results", "spike_ab_smoke.json")
+        for tag, on in (("off", False), ("on", True)):
+            rr.add(lambda t=tag, a=on: bench_spike(
+                model, params, num_requests=24, prompt_len=12, max_new=8,
+                num_blocks=24, block_size=4, max_batch_size=4, autoscale=a,
+                burst_rate_per_s=400.0,
+                shared=spshared, artifact=art, label=f"serve_spike_{t}"),
+                label=f"bench_spike_{tag}")
         return rr.results
     if args.trace:
         model, params = _smoke_model()

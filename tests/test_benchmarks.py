@@ -310,6 +310,7 @@ def test_serve_bench_chaos():
     assert r["survivors_exact"] == 1
 
 
+@pytest.mark.slow
 def test_serve_bench_straggler():
     """The --straggler A/B is the benchmark-shaped gray-failure gate: the
     same Poisson trace through a 3-replica Router with one persistently
@@ -353,6 +354,57 @@ def test_serve_bench_straggler():
         payload = json.load(f)
     assert [row["bench"] for row in payload["rows"]] == [
         "serve_straggler_off", "serve_straggler_on"]
+
+
+@pytest.mark.slow
+def test_serve_bench_spike():
+    """The --spike A/B is the benchmark-shaped elasticity gate: the same
+    trickle-then-burst trace through a Router of host-tier-enabled
+    replicas, pinned at one replica vs under the load-driven autoscaler.
+    bench_spike self-asserts the contract (exactly one terminal per
+    accepted request, token-exact survivors, zero leaked blocks in device
+    pool AND host tier, on-row goodput strictly above the off twin's,
+    tier probe strictly above the no-tier baseline); here we gate the row
+    shapes, the actuation evidence (scale-ups recorded, timeline moved,
+    off row pinned), and that the persisted artifact re-parses. Slow
+    lane: two full router runs with per-replica warmups plus the
+    deterministic tier probe."""
+    import json
+    import os
+
+    from benchmarks import serve_bench
+
+    results = [r for r in serve_bench.main(["--spike"]) if r]
+    assert [r["bench"] for r in results] == ["serve_spike_off",
+                                             "serve_spike_on"]
+    off, on = results
+    for r in (off, on):
+        assert r["ms"] > 0 and r["req_per_s"] > 0
+        assert r["requests"] == 24
+        assert r["accepted"] + r["rejected"] == 24
+        assert r["finished"] == r["accepted"] and r["terminal"] == r["accepted"]
+        assert r["ttft_ms_p99"] >= r["ttft_ms_p50"] > 0
+        assert r["exact_vs_ref"] == 1   # token-exact even when migrated
+        assert r["tier_demotions"] >= 0 and r["tier_hits"] >= 0
+    # the off row proves the pin: one replica, no controller action
+    assert off["autoscale"] == 0 and off["replicas_max"] == 1
+    assert off["scale_ups"] == 0 and off["scale_downs"] == 0
+    assert off["replicas_timeline"] == [[0.0, 1]]
+    # the on row proves the machinery AND the win
+    assert on["autoscale"] == 1 and on["replicas_max"] > 1
+    assert on["scale_ups"] >= 1
+    assert len(on["replicas_timeline"]) >= 2
+    assert on["goodput_at_slo"] > off["goodput_at_slo"]
+    # the deterministic host-tier probe: readmissions on a >pool working
+    # set, strictly above the no-tier baseline's structural zero
+    assert on["tier_probe_hits"] > on["tier_probe_baseline_hits"] == 0
+    assert 0 < on["tier_probe_hit_rate"] <= 1
+    art = on["artifact_path"]
+    assert os.path.exists(art)
+    with open(art) as f:
+        payload = json.load(f)
+    assert [row["bench"] for row in payload["rows"]] == [
+        "serve_spike_off", "serve_spike_on"]
 
 
 @pytest.mark.slow

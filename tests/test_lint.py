@@ -454,6 +454,106 @@ class Router:
                 continue
 ''', "unbounded-retry") == []
 
+    def test_unbudgeted_scale_up_retry_flags(self):
+        # replica-churn bomb: retry a failed join forever against a sick
+        # control plane
+        assert _rules('''
+class Scaler:
+    def grow(self):
+        while True:
+            try:
+                return self.router.add_replica(self.factory)
+            except ConnectionError:
+                continue
+''', "unbounded-retry") == ["unbounded-retry"]
+
+    def test_join_retries_budget_clean(self):
+        assert _rules('''
+class Scaler:
+    def grow(self):
+        attempts = 0
+        while attempts <= self.join_retries:
+            attempts += 1
+            try:
+                return self.router.add_replica(self.factory)
+            except ConnectionError:
+                continue
+''', "unbounded-retry") == []
+
+    def test_hysteresis_bound_counts_as_budget(self):
+        # a scaling control loop is bounded by its stability guards, not
+        # an attempt counter — hysteresis/cooldown names satisfy the rule
+        assert _rules('''
+class Scaler:
+    def wait_low(self, now):
+        while (now - self.low_since) < self.hysteresis_s:
+            try:
+                now = self.scale_probe()
+            except ConnectionError:
+                continue
+''', "unbounded-retry") == []
+
+    def test_cooldown_bound_counts_as_budget(self):
+        assert _rules('''
+class Scaler:
+    def settle(self, t):
+        while (t - self.last_action_t) < self.cooldown_s:
+            try:
+                t = self.scale_probe()
+            except ConnectionError:
+                continue
+''', "unbounded-retry") == []
+
+
+class TestTierAdoptUnverified:
+    def test_raw_tier_readmit_flags(self):
+        assert _rules('''
+class Engine:
+    def readmit(self, key):
+        return self.kv_tier.readmit(key)
+''', "tier-adopt-unverified") == ["tier-adopt-unverified"]
+
+    def test_raw_tier_get_flags(self):
+        # pulling the raw entry skips the digest check just as surely
+        assert _rules('''
+class Engine:
+    def peek(self, key):
+        return self.host_tier.get(key)
+''', "tier-adopt-unverified") == ["tier-adopt-unverified"]
+
+    def test_tier_adopt_flags(self):
+        assert _rules('''
+def splice(tier, key, blk):
+    tier.adopt(key, blk)
+''', "tier-adopt-unverified") == ["tier-adopt-unverified"]
+
+    def test_verify_readmit_clean(self):
+        # the one sanctioned door: digest recomputed, mismatch -> miss
+        assert _rules('''
+class Engine:
+    def readmit(self, key):
+        return self.kv_tier.verify_readmit(key)
+''', "tier-adopt-unverified") == []
+
+    def test_prefix_cache_adopt_clean(self):
+        # device-side index adoption: the receiver is not a tier
+        assert _rules('''
+class Engine:
+    def index(self, key, blk):
+        self.prefix_cache.adopt(key, blk)
+''', "tier-adopt-unverified") == []
+
+    def test_tier_demote_and_maintenance_clean(self):
+        # admission INTO the tier (where the digest is computed) and the
+        # stats/maintenance surface are not adoption
+        assert _rules('''
+class Engine:
+    def housekeeping(self, key, leaves):
+        self.kv_tier.demote(key, leaves)
+        self.kv_tier.clear()
+        return self.kv_tier.stats()
+''', "tier-adopt-unverified") == []
+
 
 class TestUnregisteredMetricKey:
     REGISTRY = '''
@@ -543,13 +643,13 @@ class TestSuppressions:
 
 
 class TestDriver:
-    def test_all_nine_rules_registered(self):
+    def test_all_ten_rules_registered(self):
         assert set(rule_registry()) == {
             "unbounded-compile-key", "use-after-donate",
             "host-sync-in-step-path", "fetch-outside-commit",
             "prng-key-reuse", "cross-thread-engine-access",
             "unpaired-pool-mutation", "unbounded-retry",
-            "unregistered-metric-key"}
+            "unregistered-metric-key", "tier-adopt-unverified"}
 
     def test_unknown_rule_name_rejected(self):
         with pytest.raises(ValueError, match="unknown rule"):

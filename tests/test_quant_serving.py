@@ -180,7 +180,8 @@ class TestInt8EngineCloseness:
         assert eng.stats()["kv_scale_bytes_per_token"] > 0
         _assert_drained(eng)
 
-    @pytest.mark.parametrize("path", ["paged", "standard"])
+    @pytest.mark.parametrize(
+        "path", ["paged", pytest.param("standard", marks=pytest.mark.slow)])
     def test_spec_prefix_overlap_compose(self, tiny_lm, path):
         """spec=ngram + prefix cache + overlapped loop all ride on int8
         blocks; the composed run stays close to its f32 twin and an int8
@@ -242,6 +243,7 @@ class TestInt8EngineCloseness:
         assert eng.metrics.prefix_cows == 2
         _assert_drained(eng)
 
+    @pytest.mark.slow
     def test_chaos_gate_int8(self, tiny_lm):
         """The fault-tolerance gate on int8 blocks: alloc faults + a NaN
         row never leak a page OR its scale sidecar — every request reaches

@@ -15,11 +15,12 @@ import pytest
 import jax
 import jax.numpy as jnp
 
-from tnn_tpu.serving import (TERMINAL_STATES, AdmissionRejected, BreakerState,
-                             CircuitBreaker, EngineCrash, EngineSupervisor,
-                             FaultPlan, InferenceEngine, PagedKVPool,
-                             PoolExhausted, PrefixCache, Request, RequestState,
-                             Router, Scheduler, ShuttingDown, SupervisorState,
+from tnn_tpu.serving import (TERMINAL_STATES, AdmissionRejected, Autoscaler,
+                             BreakerState, CircuitBreaker, EngineCrash,
+                             EngineSupervisor, FaultPlan, HostKVTier,
+                             InferenceEngine, PagedKVPool, PoolExhausted,
+                             PrefixCache, Request, RequestState, Router,
+                             Scheduler, ShuttingDown, SupervisorState,
                              gather_kv, scatter_prefill, scatter_token)
 
 
@@ -2838,6 +2839,7 @@ class TestRouter:
         assert s["proactive_migrations"] == 2
 
 
+@pytest.mark.slow
 def test_gray_failure_chaos_soak(tiny_lm):
     """The gray-failure gate: 3 replicas with the full gray fault surface
     composed — one replica turned persistently slow on a seeded schedule
@@ -3207,7 +3209,8 @@ class TestSpecDecode:
         out = eng.run_until_complete()
         return [out[r] for r in rids]
 
-    @pytest.mark.parametrize("path", ["standard", "paged"])
+    @pytest.mark.parametrize(
+        "path", [pytest.param("standard", marks=pytest.mark.slow), "paged"])
     def test_ngram_staggered_parity(self, tiny_lm, path):
         model, params = tiny_lm
         prompts = _cyclic_prompts(4, seed=0)
@@ -3226,10 +3229,10 @@ class TestSpecDecode:
         assert all(k[2] & (k[2] - 1) == 0 for k in spec_keys)
         _assert_drained(eng)
 
-    # the standard-path variant re-pays the draft-model jit cache from
-    # scratch; the paged path is the production one, so it keeps tier-1 duty
-    @pytest.mark.parametrize(
-        "path", [pytest.param("standard", marks=pytest.mark.slow), "paged"])
+    # both variants re-pay the draft-model jit cache; the draft axis keeps
+    # a tier-1 gate via the spec_draft crash-resume matrix entry
+    @pytest.mark.slow
+    @pytest.mark.parametrize("path", ["standard", "paged"])
     def test_draft_model_staggered_parity(self, tiny_lm, draft_lm, path):
         model, params = tiny_lm
         prompts = _cyclic_prompts(4, seed=1)
@@ -3484,3 +3487,739 @@ def test_gpt2_small_spec_ngram_staggered():
     assert exact >= 0.9 * total, f"only {exact}/{total} tokens were argmax"
     assert all(m < 0.05 for m in ties), f"non-tie divergence: {ties}"
     _assert_drained(eng)
+
+
+# -- host-RAM KV tier + elastic fleet (PR: elastic fleet resilience) ----------
+
+
+class TestFaultPlanFleetSites:
+    """Seed-determinism for the tier/scaling chaos sites, in the same
+    shape as the client/replica site tests above: identical seeds replay
+    identical fire schedules, scheduled calls fire at exact positions."""
+
+    def test_tier_sites_are_deterministic(self):
+        def trace(plan):
+            return [(plan.tier_demote_fail(), plan.tier_corrupt(),
+                     plan.tier_slow_readmit()) for _ in range(48)]
+
+        kw = dict(tier_demote_fail_prob=0.3, tier_corrupt_prob=0.25,
+                  tier_slow_readmit_prob=0.2)
+        a = trace(FaultPlan(seed=5, **kw))
+        b = trace(FaultPlan(seed=5, **kw))
+        c = trace(FaultPlan(seed=6, **kw))
+        assert a == b
+        assert a != c
+        assert any(t[0] for t in a) and any(t[1] for t in a) \
+            and any(t[2] for t in a)
+        plan = FaultPlan(seed=5, **kw)
+        trace(plan)
+        assert plan.calls["tier.demote_fail"] == 48
+        assert plan.fired["tier.demote_fail"] == sum(t[0] for t in a)
+        assert plan.fired["tier.corrupt"] == sum(t[1] for t in a)
+        assert plan.fired["tier.slow_readmit"] == sum(t[2] for t in a)
+
+    def test_scheduled_tier_calls_fire_exactly(self):
+        plan = FaultPlan(tier_demote_fail_calls=(2,),
+                         tier_corrupt_calls=(1, 3),
+                         tier_slow_readmit_calls=(2,))
+        assert [plan.tier_demote_fail() for _ in range(3)] == \
+            [False, True, False]
+        assert [plan.tier_corrupt() for _ in range(3)] == \
+            [True, False, True]
+        assert [plan.tier_slow_readmit() for _ in range(3)] == \
+            [False, True, False]
+        assert plan.fired["tier.demote_fail"] == 1
+        assert plan.fired["tier.corrupt"] == 2
+        assert plan.fired["tier.slow_readmit"] == 1
+
+    def test_scale_join_site_is_deterministic(self):
+        def trace(plan):
+            return [plan.scale_join_fail() for _ in range(48)]
+
+        a = trace(FaultPlan(seed=5, scale_join_fail_prob=0.3))
+        b = trace(FaultPlan(seed=5, scale_join_fail_prob=0.3))
+        c = trace(FaultPlan(seed=6, scale_join_fail_prob=0.3))
+        assert a == b
+        assert a != c
+        assert any(a) and not all(a)
+        plan = FaultPlan(seed=5, scale_join_fail_prob=0.3)
+        trace(plan)
+        assert plan.calls["scale.join_fail"] == 48
+        assert plan.fired["scale.join_fail"] == sum(a)
+
+    def test_scheduled_scale_join_calls_fire_exactly(self):
+        plan = FaultPlan(scale_join_fail_calls=(1, 3))
+        assert [plan.scale_join_fail() for _ in range(4)] == \
+            [True, False, True, False]
+        assert plan.fired["scale.join_fail"] == 2
+
+
+class TestHostKVTier:
+    """Tier unit tests — no engine, no pool: demote/verify roundtrip,
+    digest enforcement, LRU bounds, fault sites, byte accounting."""
+
+    def _leaves(self, seed=0, shape=(2, 4, 2), dtype=np.float32):
+        rng = np.random.default_rng(seed)
+        k = rng.standard_normal(shape).astype(dtype)
+        v = rng.standard_normal(shape).astype(dtype)
+        return (k, v)
+
+    def test_demote_verify_roundtrip(self):
+        tier = HostKVTier(1 << 20)
+        leaves = self._leaves(1)
+        assert tier.demote(b"key-a", leaves)
+        assert b"key-a" in tier and len(tier) == 1
+        assert tier.bytes_used == sum(x.nbytes for x in leaves)
+        out = tier.verify_readmit(b"key-a")
+        assert out is not None
+        np.testing.assert_array_equal(out[0], leaves[0])
+        np.testing.assert_array_equal(out[1], leaves[1])
+        # a successful readmit REMOVES the entry (it is device-resident
+        # again and will re-demote on its next eviction)
+        assert b"key-a" not in tier and tier.bytes_used == 0
+        s = tier.stats()
+        assert s["tier_demotions"] == 1 and s["tier_readmits"] == 1
+        assert s["tier_corrupt_dropped"] == 0
+        tier.check_invariants()
+
+    def test_miss_returns_none(self):
+        tier = HostKVTier(1 << 20)
+        assert tier.verify_readmit(b"never-demoted") is None
+        assert tier.stats()["tier_corrupt_dropped"] == 0
+
+    def test_real_corruption_is_dropped_not_served(self):
+        """Bit rot planted straight into the stored leaf (no fault plan):
+        the digest recomputation catches it, the entry is dropped, the
+        caller sees an uncached miss — never wrong KV."""
+        tier = HostKVTier(1 << 20)
+        tier.demote(b"key-a", self._leaves(2))
+        entry = tier._entries[b"key-a"]
+        entry.leaves[0].reshape(-1).view(np.uint8)[3] ^= 0x40
+        assert tier.verify_readmit(b"key-a") is None
+        assert b"key-a" not in tier
+        assert tier.bytes_used == 0
+        assert tier.stats()["tier_corrupt_dropped"] == 1
+        tier.check_invariants()
+
+    def test_digest_binds_dtype_and_shape(self):
+        """tier_digest covers dtype and shape, not just raw bytes — a
+        reinterpreted payload cannot pass verification."""
+        from tnn_tpu.serving.kv_tier import tier_digest
+
+        arr = np.arange(8, dtype=np.float32)
+        base = tier_digest(b"k", (arr,))
+        assert tier_digest(b"k", (arr.reshape(2, 4),)) != base
+        assert tier_digest(b"k", (arr.view(np.int32),)) != base
+        assert tier_digest(b"other", (arr,)) != base
+        assert tier_digest(b"k", (arr.copy(),)) == base
+
+    def test_lru_bound_displaces_oldest(self):
+        leaves = self._leaves(3)
+        per = sum(x.nbytes for x in leaves)
+        tier = HostKVTier(per * 2)      # room for exactly two entries
+        assert tier.demote(b"a", leaves)
+        assert tier.demote(b"b", leaves)
+        assert tier.demote(b"c", leaves)   # displaces "a" (LRU-oldest)
+        assert tier.keys() == [b"b", b"c"]
+        assert tier.bytes_used == per * 2
+        assert tier.stats()["tier_evictions"] == 1
+        tier.check_invariants()
+
+    def test_oversize_entry_degrades_to_plain_eviction(self):
+        leaves = self._leaves(4)
+        tier = HostKVTier(sum(x.nbytes for x in leaves) - 1)
+        assert not tier.demote(b"big", leaves)
+        assert len(tier) == 0 and tier.bytes_used == 0
+        assert tier.stats()["tier_demote_failures"] == 1
+        tier.check_invariants()
+
+    def test_redemote_same_key_replaces_exactly(self):
+        tier = HostKVTier(1 << 20)
+        old, new = self._leaves(5), self._leaves(6)
+        tier.demote(b"k", old)
+        tier.demote(b"k", new)           # re-published prefix: newest wins
+        assert len(tier) == 1
+        assert tier.bytes_used == sum(x.nbytes for x in new)
+        out = tier.verify_readmit(b"k")
+        np.testing.assert_array_equal(out[0], new[0])
+        tier.check_invariants()
+
+    def test_demote_fail_fault_degrades(self):
+        plan = FaultPlan(tier_demote_fail_calls=(1,))
+        tier = HostKVTier(1 << 20, fault_plan=plan)
+        leaves = self._leaves(7)
+        assert not tier.demote(b"a", leaves)   # injected: plain eviction
+        assert tier.demote(b"b", leaves)       # call 2 passes
+        assert plan.fired["tier.demote_fail"] == 1
+        assert tier.stats()["tier_demote_failures"] == 1
+        assert len(tier) == 1
+
+    def test_corrupt_fault_caught_by_digest(self):
+        """The injected corruption flips a byte of a COPY and keeps the
+        stored digest — so the verifier genuinely detects it, the same
+        code path real bit rot takes."""
+        plan = FaultPlan(tier_corrupt_calls=(1,))
+        tier = HostKVTier(1 << 20, fault_plan=plan)
+        leaves = self._leaves(8)
+        tier.demote(b"k", leaves)
+        assert tier.verify_readmit(b"k") is None
+        assert plan.fired["tier.corrupt"] == 1
+        assert tier.stats()["tier_corrupt_dropped"] == 1
+        assert b"k" not in tier and tier.bytes_used == 0
+        tier.check_invariants()
+
+    def test_slow_readmit_stalls_but_succeeds(self):
+        plan = FaultPlan(tier_slow_readmit_calls=(1,),
+                         tier_slow_readmit_s=0.02)
+        tier = HostKVTier(1 << 20, fault_plan=plan)
+        leaves = self._leaves(9)
+        tier.demote(b"k", leaves)
+        t0 = time.perf_counter()
+        out = tier.verify_readmit(b"k")
+        assert time.perf_counter() - t0 >= 0.02
+        assert out is not None             # late, not wrong
+        np.testing.assert_array_equal(out[0], leaves[0])
+        assert plan.fired["tier.slow_readmit"] == 1
+
+    def test_int8_leaves_halve_footprint(self):
+        shape = (2, 4, 8)
+        f32 = (np.zeros(shape, np.float32), np.zeros(shape, np.float32))
+        q = (np.zeros(shape, np.int8), np.zeros((2, 4, 1), np.float32),
+             np.zeros(shape, np.int8), np.zeros((2, 4, 1), np.float32))
+        tier = HostKVTier(1 << 20)
+        tier.demote(b"f32", f32)
+        f32_bytes = tier.bytes_used
+        tier.clear()
+        tier.demote(b"int8", q)
+        assert tier.bytes_used < f32_bytes * 0.6
+
+    def test_clear_drops_everything(self):
+        tier = HostKVTier(1 << 20)
+        tier.demote(b"a", self._leaves(10))
+        tier.demote(b"b", self._leaves(11))
+        tier.clear()
+        assert len(tier) == 0 and tier.bytes_used == 0
+        assert tier.verify_readmit(b"a") is None
+        tier.check_invariants()
+
+    def test_validation(self):
+        with pytest.raises(ValueError, match="max_bytes"):
+            HostKVTier(0)
+
+
+class TestTierEngine:
+    """Tier <-> engine integration: demotion under pool pressure, verified
+    re-admission through the revive path, token-exactness with the full
+    feature stack composed, corrupt entries degrading to uncached misses."""
+
+    def _prompts(self, n=6, prefix_len=8, tail_len=4, seed=0):
+        """Prompts sharing a cyclic prefix (spec-friendly) + unique tails."""
+        rng = np.random.default_rng(seed)
+        motif = rng.integers(0, 128, 4)
+        prefix = np.tile(motif, prefix_len // 4).astype(np.int32)
+        return [np.concatenate([prefix,
+                                rng.integers(0, 128, tail_len)
+                                   .astype(np.int32)])
+                for _ in range(n)]
+
+    def _engine(self, tiny_lm, *, tier_bytes, **kw):
+        model, params = tiny_lm
+        merged = dict(num_blocks=10, block_size=4, max_batch_size=2,
+                      max_seq_len=32, chunk_size=8,
+                      host_tier_bytes=tier_bytes)
+        merged.update(kw)
+        return InferenceEngine(model, params, **merged)
+
+    def _serve_serially(self, eng, prompts, max_new=6):
+        """One request at a time: each finish releases evictable blocks,
+        each next admission's alloc pressure demotes them — the working
+        set cycles through the tier instead of fitting in the pool."""
+        out = []
+        for p in prompts:
+            rid = eng.submit(p, max_new)
+            res = eng.run_until_complete()
+            out.append(res[rid])
+            del eng.requests[rid]
+        return out
+
+    @pytest.mark.slow
+    @pytest.mark.parametrize("path", ["standard", "paged"])
+    def test_tier_token_exact_composed(self, tiny_lm, path):
+        """The acceptance gate: tier-on output must equal tier-off output
+        token-for-token with prefix cache + ngram speculation + overlap +
+        int8 KV all composed, on both decode paths — and the tier must
+        have genuinely carried traffic (demotions and readmits observed),
+        while the tier-off twin saw none."""
+        prompts = self._prompts()
+        compose = dict(decode_path=path, spec="ngram", spec_k=3,
+                       overlap=True, kv_dtype="int8")
+        on = self._engine(tiny_lm, tier_bytes=1 << 20, **compose)
+        off = self._engine(tiny_lm, tier_bytes=0, **compose)
+        # two passes: the first populates device cache + tier, the second
+        # readmits what pool pressure demoted
+        on_toks = [self._serve_serially(on, prompts) for _ in range(2)][1]
+        off_toks = [self._serve_serially(off, prompts) for _ in range(2)][1]
+        assert on_toks == off_toks
+        s_on, s_off = on.stats(), off.stats()
+        assert s_on["tier_demotions"] > 0, "pool pressure never demoted"
+        assert s_on["tier_readmits"] > 0, "no prefix hit readmitted"
+        assert s_on["tier_corrupt_dropped"] == 0
+        assert s_off["tier_readmits"] == 0
+        _assert_drained(on)
+        _assert_drained(off)
+        on.check_invariants()
+
+    def test_tier_metrics_and_gauges_flow(self, tiny_lm):
+        """stats() and health_gauges() surface the tier counters the
+        dashboards scrape."""
+        eng = self._engine(tiny_lm, tier_bytes=1 << 20)
+        prompts = self._prompts(seed=1)
+        self._serve_serially(eng, prompts)
+        self._serve_serially(eng, prompts)
+        s = eng.stats()
+        assert s["host_tier_enabled"]
+        assert s["tier_demotions"] > 0
+        assert s["tier_bytes"] <= s["tier_max_bytes"] == 1 << 20
+        m = eng.metrics.summary()
+        assert m["tier_hits"] >= s["tier_readmits"] > 0
+        assert m["tier_corrupt"] == 0
+        assert m["tier_blocks"] == s["tier_blocks"]
+        assert m["tier_bytes"] == s["tier_bytes"]
+        # the Prometheus scrape surface carries the tier families
+        from tnn_tpu.serving.metrics import render_prometheus
+
+        text = render_prometheus(eng.metrics.prometheus_series())
+        for name in ("tnn_serve_tier_blocks", "tnn_serve_tier_bytes",
+                     "tnn_serve_tier_hits_total",
+                     "tnn_serve_tier_corrupt_total", "tnn_serve_replicas"):
+            assert name in text, f"{name} missing from exposition"
+
+    @pytest.mark.slow
+    def test_planted_corruption_degrades_to_uncached_miss(self, tiny_lm):
+        """A seeded tier.corrupt on the first readmit: the digest check
+        drops the entry, the request recomputes the prefix (uncached
+        miss), the output stays token-exact, and the corruption counter
+        fires — wrong KV is never adopted."""
+        plan = FaultPlan(tier_corrupt_calls=(1,))
+        eng = self._engine(tiny_lm, tier_bytes=1 << 20, faults=plan)
+        ref = self._engine(tiny_lm, tier_bytes=0)
+        prompts = self._prompts(seed=2)
+        self._serve_serially(eng, prompts)
+        got = self._serve_serially(eng, prompts)
+        self._serve_serially(ref, prompts)
+        want = self._serve_serially(ref, prompts)
+        assert got == want
+        assert plan.fired["tier.corrupt"] == 1
+        s = eng.stats()
+        assert s["tier_corrupt_dropped"] == 1
+        assert eng.metrics.tier_corrupt == 1
+        _assert_drained(eng)
+
+    @pytest.mark.slow
+    def test_tier_cleared_on_crash_recovery(self, tiny_lm):
+        """Crash recovery re-zeroes the pool; everything demoted before
+        the crash is conservatively untrusted and the tier must come back
+        empty — stale KV may never survive a restart."""
+        plan = FaultPlan(step_crash_calls=(6,))
+        eng = self._engine(tiny_lm, tier_bytes=1 << 20, faults=plan)
+        model, params = tiny_lm
+        prompts = self._prompts(seed=3)
+        refs = [_greedy_ref(model, params, p, 4, eng.assembly_len)
+                for p in prompts]
+        events = []
+        sup = EngineSupervisor(eng, event_sink=events.append,
+                               restart_backoff_s=0.0, max_restarts=2)
+        rids = [sup.submit(p, 4) for p in prompts]
+        sup.run_sync()
+        assert sup.restarts == 1
+        assert len(eng.kv_tier) == 0 or eng.stats()["tier_demotions"] > 0
+        term = {e["id"]: e for e in events if e["event"] != "token"}
+        for rid, r in zip(rids, refs):
+            assert term[rid]["event"] == "done"
+            assert term[rid]["tokens"] == r
+        _assert_drained(eng)
+
+
+class TestElasticFleet:
+    """Router join/retire primitives: live scale-up, zero-loss scale-down
+    with proactive token-exact migration, injected join failures."""
+
+    KW = dict(num_blocks=32, block_size=4, max_batch_size=4, max_seq_len=32)
+
+    def _sup(self, tiny_lm, **ekw):
+        model, params = tiny_lm
+        kw = dict(self.KW)
+        kw.update(ekw)
+        return EngineSupervisor(InferenceEngine(model, params, **kw),
+                                restart_backoff_s=0.0)
+
+    def _router(self, tiny_lm, n=2, *, faults=None):
+        sups = [self._sup(tiny_lm) for _ in range(n)]
+        events = []
+        router = Router(sups, event_sink=events.append, seed=0,
+                        faults=faults)
+        return router, sups, events
+
+    @pytest.mark.slow
+    def test_add_replica_joins_and_serves(self, tiny_lm):
+        model, params = tiny_lm
+        router, sups, events = self._router(tiny_lm, n=1)
+        rng = np.random.default_rng(30)
+        prompts = [rng.integers(0, 128, n).astype(np.int32)
+                   for n in (5, 6, 7, 8)]
+        refs = [_greedy_ref(model, params, p, 5,
+                            sups[0].engine.assembly_len) for p in prompts]
+        gids = [router.submit(p, 5) for p in prompts[:2]]
+        router.pump(2)
+        idx = router.add_replica(lambda: self._sup(tiny_lm))
+        assert idx == 1 and router.num_active_replicas() == 2
+        gids += [router.submit(p, 5) for p in prompts[2:]]
+        # join-shortest-queue places new work on the (empty) joiner
+        assert len(router.replicas[1].live) > 0
+        router.run_sync()
+        term = {e["id"]: e for e in events if e["event"] != "token"}
+        for gid, ref in zip(gids, refs):
+            assert term[gid]["event"] == "done"
+            assert term[gid]["tokens"] == ref
+        assert len(router.stats()["replicas"]) == 2
+        for h in router.replicas:
+            assert h.sup.engine.pool.num_allocated == 0
+
+    def test_join_fail_raises_and_leaves_fleet_intact(self, tiny_lm):
+        plan = FaultPlan(scale_join_fail_calls=(1,))
+        router, sups, events = self._router(tiny_lm, n=1, faults=plan)
+        built = []
+        with pytest.raises(ConnectionError):
+            router.add_replica(lambda: built.append(1) or
+                               self._sup(tiny_lm))
+        assert built == [], "join fault fired AFTER the factory ran"
+        assert router.num_active_replicas() == 1
+        assert plan.fired["scale.join_fail"] == 1
+        # the next attempt (site passes) succeeds
+        assert router.add_replica(lambda: self._sup(tiny_lm)) == 1
+        assert router.num_active_replicas() == 2
+
+    @pytest.mark.slow
+    def test_retire_migrates_live_streams_token_exact(self, tiny_lm):
+        """The zero-loss scale-down gate: a replica with streams
+        mid-decode retires; every stream finishes token-exact with
+        exactly one terminal event, nothing is dropped, and the retired
+        replica takes no further placements."""
+        model, params = tiny_lm
+        router, sups, events = self._router(tiny_lm, n=2)
+        rng = np.random.default_rng(31)
+        prompts = [rng.integers(0, 128, n).astype(np.int32)
+                   for n in (5, 6, 7, 8)]
+        refs = [_greedy_ref(model, params, p, 8,
+                            sups[0].engine.assembly_len) for p in prompts]
+        gids = [router.submit(p, 8) for p in prompts]
+        router.pump(3)                   # streams genuinely mid-flight
+        victim = max(router.replicas, key=lambda h: len(h.live)).idx
+        assert len(router.replicas[victim].live) > 0
+        assert router.retire_replica(victim)
+        assert router.num_active_replicas() == 1
+        # retired replicas take no new placements
+        extra = router.submit(prompts[0], 8)
+        assert extra not in router.replicas[victim].live
+        router.run_sync()
+        term = {}
+        for e in events:
+            if e["event"] != "token":
+                term.setdefault(e["id"], []).append(e)
+        assert sorted(term) == sorted(gids + [extra])
+        assert all(len(v) == 1 for v in term.values()), \
+            "a migrated stream double-terminated"
+        for gid, ref in zip(gids, refs):
+            assert term[gid][0]["event"] == "done"
+            assert term[gid][0]["tokens"] == ref
+            streamed = [e["token"] for e in events
+                        if e["event"] == "token" and e["id"] == gid]
+            assert streamed == ref
+        assert router.metrics.proactive_migrations > 0
+        assert router.stats()["replicas"][victim]["retired"]
+        for h in router.replicas:
+            assert h.sup.engine.pool.num_allocated == 0
+            h.sup.engine.check_invariants()
+
+    def test_retire_refuses_last_replica(self, tiny_lm):
+        router, sups, events = self._router(tiny_lm, n=2)
+        assert router.retire_replica(0)
+        assert not router.retire_replica(1), \
+            "retired the last replica standing"
+        assert not router.retire_replica(0)   # already retired: False
+        assert router.num_active_replicas() == 1
+        router.run_sync()
+
+
+class _StubRouter:
+    """Duck-typed router for deterministic Autoscaler control-law tests:
+    load and TTFT are set directly, actions mutate counters."""
+
+    def __init__(self, active=1, open_requests=0):
+        self.active = active
+        self.open_requests = open_requests
+        self.draining = False
+        self.finished = False
+        self.p95 = None
+        self.adds = 0
+        self.retires = 0
+        self.fail_joins = 0
+
+    def num_active_replicas(self):
+        return self.active
+
+    def ttft_quantile(self, q):
+        return self.p95
+
+    def add_replica(self, factory):
+        if self.fail_joins > 0:
+            self.fail_joins -= 1
+            raise ConnectionError("injected join failure")
+        factory()
+        self.active += 1
+        self.adds += 1
+        return self.active - 1
+
+    def retire_replica(self, idx, reason="scale-down"):
+        if self.active <= 1:
+            return False
+        self.active -= 1
+        self.retires += 1
+        return True
+
+    def replica_load(self):
+        return {i: i for i in range(self.active)}
+
+
+class TestAutoscaler:
+    """Control-law unit tests on the stub router with an injected clock:
+    thresholds, hysteresis, cooldown, bounds, bounded join retry."""
+
+    def _scaler(self, router, **kw):
+        merged = dict(min_replicas=1, max_replicas=4, up_load=4.0,
+                      down_load=1.0, hysteresis_s=1.0, cooldown_s=2.0,
+                      join_retries=2)
+        merged.update(kw)
+        return Autoscaler(router, lambda: object(), **merged)
+
+    def test_validation(self):
+        r = _StubRouter()
+        with pytest.raises(ValueError, match="min_replicas"):
+            self._scaler(r, min_replicas=0)
+        with pytest.raises(ValueError, match="max_replicas"):
+            self._scaler(r, min_replicas=3, max_replicas=2)
+        with pytest.raises(ValueError, match="dead band"):
+            self._scaler(r, up_load=1.0, down_load=1.0)
+        with pytest.raises(ValueError, match="slo_ttft_s"):
+            self._scaler(r, slo_ttft_s=0.0)
+        with pytest.raises(ValueError, match="join_retries"):
+            self._scaler(r, join_retries=-1)
+        with pytest.raises(ValueError, match="interval_s"):
+            self._scaler(r, interval_s=0.0)
+
+    def test_scale_up_on_load_and_cooldown_locks(self):
+        r = _StubRouter(active=1, open_requests=10)   # load 10 > 4
+        s = self._scaler(r, cooldown_s=2.0)
+        assert s.tick(now=0.0) == "up" and r.active == 2
+        r.open_requests = 20                          # still way over
+        assert s.tick(now=1.0) is None, "cooldown did not lock scale-up"
+        assert s.tick(now=2.5) == "up" and r.active == 3
+        assert s.stats()["scale_ups"] == 2
+
+    def test_max_replicas_bounds_scale_up(self):
+        r = _StubRouter(active=2, open_requests=100)
+        s = self._scaler(r, max_replicas=2)
+        assert s.tick(now=0.0) is None
+        assert r.adds == 0
+
+    def test_hysteresis_requires_sustained_low(self):
+        r = _StubRouter(active=3, open_requests=0)    # load 0 < 1
+        s = self._scaler(r, hysteresis_s=1.0, cooldown_s=0.0)
+        assert s.tick(now=0.0) is None                # starts the timer
+        assert s.tick(now=0.9) is None                # not sustained yet
+        assert s.tick(now=1.0) == "down" and r.active == 2
+        assert s.stats()["scale_downs"] == 1
+
+    def test_dead_band_resets_hysteresis_timer(self):
+        r = _StubRouter(active=3, open_requests=0)
+        s = self._scaler(r, hysteresis_s=1.0, cooldown_s=0.0)
+        assert s.tick(now=0.0) is None                # low: timer starts
+        r.open_requests = 6                           # load 2: dead band
+        assert s.tick(now=0.5) is None                # timer must reset
+        r.open_requests = 0
+        assert s.tick(now=1.1) is None, \
+            "a dead-band excursion did not reset the hysteresis timer"
+        assert s.tick(now=2.1) == "down"
+
+    def test_high_load_resets_hysteresis_timer(self):
+        r = _StubRouter(active=3, open_requests=0)
+        s = self._scaler(r, hysteresis_s=1.0, cooldown_s=0.0,
+                         max_replicas=3)
+        assert s.tick(now=0.0) is None
+        r.open_requests = 30                          # spike: load 10
+        assert s.tick(now=0.5) is None                # at max: no up
+        r.open_requests = 0
+        assert s.tick(now=1.1) is None, \
+            "a load spike did not reset the hysteresis timer"
+
+    def test_min_replicas_bounds_scale_down(self):
+        r = _StubRouter(active=1, open_requests=0)
+        s = self._scaler(r, hysteresis_s=0.0, cooldown_s=0.0)
+        assert s.tick(now=0.0) is None
+        assert s.tick(now=10.0) is None
+        assert r.retires == 0
+
+    def test_slo_breach_scales_up_at_moderate_load(self):
+        r = _StubRouter(active=1, open_requests=2)    # load 2: dead band
+        r.p95 = 0.5
+        s = self._scaler(r, slo_ttft_s=0.25)
+        assert s.tick(now=0.0) == "up", \
+            "a TTFT SLO breach must scale up even inside the load band"
+        r.p95 = 0.1
+        r.open_requests = 2
+        assert s.tick(now=10.0) is None               # SLO healthy again
+
+    def test_join_retry_is_bounded(self):
+        r = _StubRouter(active=1, open_requests=10)
+        r.fail_joins = 10
+        s = self._scaler(r, join_retries=2, cooldown_s=0.0)
+        assert s.tick(now=0.0) is None
+        assert s.stats()["join_failures"] == 3        # 1 try + 2 retries
+        assert r.fail_joins == 7, "retry loop was not bounded"
+        assert r.adds == 0
+        # a failed scale-up must NOT start the cooldown: the next tick
+        # (faults cleared) succeeds immediately
+        r.fail_joins = 0
+        assert s.tick(now=0.0) == "up"
+
+    def test_draining_router_is_left_alone(self):
+        r = _StubRouter(active=1, open_requests=100)
+        r.draining = True
+        s = self._scaler(r)
+        assert s.tick(now=0.0) is None and r.adds == 0
+
+    def test_collapsed_fleet_is_left_alone(self):
+        r = _StubRouter(active=0, open_requests=5)
+        s = self._scaler(r)
+        assert s.tick(now=0.0) is None
+
+    def test_victim_is_least_loaded(self):
+        seen = []
+        r = _StubRouter(active=3, open_requests=0)
+        r.retire_replica = lambda idx, reason="scale-down": \
+            seen.append(idx) or True
+        s = self._scaler(r, hysteresis_s=0.0, cooldown_s=0.0)
+        assert s.tick(now=0.0) == "down"
+        assert seen == [0], "did not pick the least-loaded replica"
+
+    def test_thread_driver_start_stop(self, tiny_lm):
+        r = _StubRouter(active=1, open_requests=0)
+        s = self._scaler(r, interval_s=0.01)
+        assert s.start() is s
+        with pytest.raises(RuntimeError, match="already started"):
+            s.start()
+        deadline = time.monotonic() + 2.0
+        while s.ticks == 0 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        s.stop()
+        assert s.ticks > 0
+        s.stop()                       # idempotent
+
+
+@pytest.mark.slow
+def test_spike_soak_elastic_fleet(tiny_lm):
+    """The elastic-fleet soak gate: a Poisson burst over a 1-replica
+    fleet with an autoscaler (deterministic injected clock), tier demote
+    faults inside every replica, and one replica hard-killed mid-scale-up.
+    Asserts the full contract: exactly one terminal event per admitted
+    request, finished streams token-exact against the fault-free
+    reference, the scaler actually grew the fleet, and zero leaked blocks
+    in every surviving device pool AND every host tier."""
+    model, params = tiny_lm
+    rng = np.random.default_rng(40)
+    uniq = [rng.integers(0, 128, int(n)).astype(np.int32)
+            for n in rng.integers(4, 14, 8)]
+    max_new = 6
+    built = []
+
+    def make_sup(i):
+        plan = FaultPlan(seed=200 + i, tier_demote_fail_prob=0.1,
+                         tier_corrupt_prob=0.1)
+        eng = InferenceEngine(model, params, num_blocks=16, block_size=4,
+                              max_batch_size=4, max_seq_len=32,
+                              max_queue_depth=24, chunk_size=8,
+                              host_tier_bytes=1 << 20, faults=plan)
+        sup = EngineSupervisor(eng, restart_backoff_s=0.0, max_restarts=5)
+        built.append(sup)
+        return sup
+
+    refs = {}
+    probe = InferenceEngine(model, params, num_blocks=16, block_size=4,
+                            max_batch_size=4, max_seq_len=32)
+    for i, p in enumerate(uniq):
+        refs[i] = _greedy_ref(model, params, p, max_new,
+                              probe.assembly_len)
+    events = []
+    router = Router([make_sup(0)], event_sink=events.append, seed=4)
+    scaler = Autoscaler(router, lambda: make_sup(len(built)),
+                        min_replicas=1, max_replicas=3,
+                        up_load=2.0, down_load=0.5,
+                        hysteresis_s=0.3, cooldown_s=0.1, join_retries=2)
+    n_requests, rejected, submitted = 90, 0, {}
+    victim = None
+    clock = 0.0
+    for i in range(n_requests):
+        # Poisson arrivals: burst in the middle third, trickle elsewhere
+        lam = 3.0 if n_requests // 3 <= i < 2 * n_requests // 3 else 0.5
+        for _ in range(max(1, int(rng.poisson(lam)))):
+            which = int(rng.integers(0, len(uniq)))
+            try:
+                gid = router.submit(uniq[which], max_new, priority=i % 3)
+                submitted[gid] = which
+            except (AdmissionRejected, ShuttingDown, ConnectionError):
+                rejected += 1
+        router.pump(1)
+        clock += 0.05
+        scaler.tick(now=clock)
+        # hard-kill a grown replica mid-run, once, while work is live
+        if victim is None and scaler.ups > 0 and i > n_requests // 2:
+            alive = [h for h in router.replicas
+                     if not h.killed and not h.retired]
+            if len(alive) > 1:
+                victim = max(alive, key=lambda h: len(h.live)).idx
+                router.kill_replica(victim)
+    router.run_sync()
+    router.request_drain("soak complete")
+    router.run_sync()
+
+    assert scaler.ups >= 1, "the burst never scaled the fleet up"
+    assert victim is not None, "no grown replica was ever killed"
+    assert router.state is SupervisorState.STOPPED
+    assert router.exit_code == 0
+    # exactly one terminal event per admitted request
+    terminals = [e for e in events if e["event"] != "token"]
+    per_gid = {}
+    for e in terminals:
+        per_gid[e["id"]] = per_gid.get(e["id"], 0) + 1
+    assert sorted(per_gid) == sorted(submitted)
+    assert all(c == 1 for c in per_gid.values()), per_gid
+    # finished streams token-exact against the fault-free reference
+    finished = [e for e in terminals if e["event"] == "done"]
+    assert finished, "spike soak finished nothing"
+    for e in finished:
+        assert e["tokens"] == refs[submitted[e["id"]]], \
+            f"gid {e['id']} diverged from fault-free reference"
+    # tier demote faults genuinely exercised the degradation paths
+    fired_demote = sum(s.engine.faults.fired["tier.demote_fail"]
+                      for s in built)
+    assert fired_demote > 0 or sum(
+        s.engine.stats()["tier_demotions"] for s in built) > 0
+    # zero leaks: every surviving device pool empty, every tier's byte
+    # accounting exact and within bound (the killed replica's pool was
+    # torn down with it)
+    for h in router.replicas:
+        if h.idx != victim:
+            assert h.sup.engine.pool.num_allocated == 0
+            h.sup.engine.check_invariants()   # includes the tier's
+        if h.sup.engine.kv_tier is not None:
+            h.sup.engine.kv_tier.check_invariants()

@@ -126,6 +126,23 @@ def main(argv=None):
                          "(one all-reduce per layer for attention out + MLP; "
                          "requires num_kv_heads %% tp == 0 and tp <= "
                          "device count; token-exact vs tp=1)")
+    ap.add_argument("--host-tier-bytes", type=int, default=0,
+                    help="host-RAM KV tier capacity in bytes (0 = off): "
+                         "prefix-cache blocks the pool would reclaim are "
+                         "demoted to host memory and re-admitted on a later "
+                         "prefix hit after a rolling-hash digest check (a "
+                         "corrupt or torn block degrades to an uncached "
+                         "miss, never wrong KV); requires the prefix cache "
+                         "and chunked prefill, incompatible with --tp > 1")
+    ap.add_argument("--autoscale", default="",
+                    help="MIN:MAX — run a load-driven autoscaler over the "
+                         "replica fleet: scale up under queue pressure via "
+                         "the router join path, scale down after a "
+                         "hysteresis-guarded quiet period by draining the "
+                         "least-loaded replica with its live streams "
+                         "proactively migrated token-exact (zero dropped "
+                         "requests); --replicas sets the starting size "
+                         "(clamped into [MIN, MAX])")
     ap.add_argument("--max-new-tokens", type=int, default=32,
                     help="default for requests that omit it")
     ap.add_argument("--max-queue-depth", type=int, default=0,
@@ -200,6 +217,37 @@ def main(argv=None):
     ap.add_argument("--seed", type=int, default=0)
     args = ap.parse_args(argv)
 
+    # fail fast on impossible elastic-fleet configs BEFORE touching model
+    # weights: the engine/autoscaler constructors would reject them anyway,
+    # but a clear one-line error beats a traceback out of engine wiring
+    autoscale = None
+    if args.autoscale:
+        lo, sep, hi = args.autoscale.partition(":")
+        try:
+            autoscale = (int(lo), int(hi))
+        except ValueError:
+            autoscale = None
+        if not sep or autoscale is None:
+            ap.error(f"--autoscale {args.autoscale!r} is not MIN:MAX "
+                     "(two integers, e.g. --autoscale 1:4)")
+        if autoscale[0] < 1:
+            ap.error(f"--autoscale MIN must be >= 1, got {autoscale[0]}")
+        if autoscale[1] < autoscale[0]:
+            ap.error(f"--autoscale MAX ({autoscale[1]}) must be >= MIN "
+                     f"({autoscale[0]})")
+    if args.host_tier_bytes < 0:
+        ap.error(f"--host-tier-bytes must be >= 0, got "
+                 f"{args.host_tier_bytes}")
+    if args.host_tier_bytes:
+        if args.no_prefix_cache or args.no_chunked_prefill:
+            ap.error("--host-tier-bytes needs the prefix cache (the tier "
+                     "is keyed by its rolling-hash chain) — drop "
+                     "--no-prefix-cache/--no-chunked-prefill or the tier")
+        if args.tp > 1:
+            ap.error("--host-tier-bytes is incompatible with --tp > 1 "
+                     "(demoted page slices would need a cross-shard "
+                     "gather/scatter)")
+
     tokenizer = None
     if args.vocab:
         tokenizer = Tokenizer().load(args.vocab)
@@ -249,7 +297,8 @@ def main(argv=None):
             profiler=prof, trace=bool(args.trace),
             overlap=not args.no_overlap,
             kv_dtype=args.kv_dtype, quant_weights=args.quant_weights,
-            tp=args.tp, seed=args.seed)
+            tp=args.tp, host_tier_bytes=args.host_tier_bytes,
+            seed=args.seed)
 
     def build_supervisor(eng, idx=0):
         # each replica dumps into its own subdirectory so the per-reason
@@ -285,6 +334,10 @@ def main(argv=None):
                      "auto, paged, or standard)")
 
     engine = build_engine()
+    if args.host_tier_bytes:
+        print(f"host KV tier: {args.host_tier_bytes} bytes, verified "
+              "re-admission (corrupt blocks degrade to uncached misses)",
+              file=sys.stderr)
     if args.tp > 1:
         print(f"tensor parallel: tp={args.tp}, "
               f"{model.num_heads // args.tp} head(s)/shard, per-shard KV "
@@ -297,12 +350,18 @@ def main(argv=None):
         print(f"standard decode path: {engine.fused_fallback_reason}",
               file=sys.stderr)
 
-    if args.replicas > 1:
+    scaler = None
+    if args.replicas > 1 or autoscale is not None:
         # replicas share read-only params; each gets its own KV pool,
-        # scheduler, and supervised worker thread
+        # scheduler, and supervised worker thread. With --autoscale the
+        # router starts at the clamped --replicas size and the controller
+        # grows/shrinks it between MIN and MAX
+        n0 = args.replicas
+        if autoscale is not None:
+            n0 = min(max(args.replicas, autoscale[0]), autoscale[1])
         sups = [build_supervisor(engine)] + [
             build_supervisor(build_engine(i), i)
-            for i in range(1, args.replicas)]
+            for i in range(1, n0)]
         router_prof = Profiler(source="router") if args.trace else None
         supervisor = Router(
             sups,
@@ -313,8 +372,23 @@ def main(argv=None):
             hedge_budget=args.hedge_budget,
             degrade_factor=args.degrade_factor,
             seed=args.seed, profiler=router_prof)
-        print(f"router: {args.replicas} supervised replicas",
-              file=sys.stderr)
+        print(f"router: {n0} supervised replicas", file=sys.stderr)
+        if autoscale is not None:
+            from tnn_tpu.serving import Autoscaler
+
+            next_idx = [n0]
+
+            def scale_factory():
+                idx = next_idx[0]
+                next_idx[0] += 1
+                return build_supervisor(build_engine(idx), idx)
+
+            scaler = Autoscaler(supervisor, scale_factory,
+                                min_replicas=autoscale[0],
+                                max_replicas=autoscale[1]).start()
+            print(f"autoscaler: {autoscale[0]}..{autoscale[1]} replicas, "
+                  "zero-loss scale-down (live streams migrate token-exact "
+                  "before a replica drains)", file=sys.stderr)
     else:
         router_prof = None
         supervisor = build_supervisor(engine)
@@ -331,18 +405,22 @@ def main(argv=None):
         merged.to_chrome_trace(args.trace)
         print(f"trace written to {args.trace}", file=sys.stderr)
 
-    if args.http:
-        host, _, port = args.http.rpartition(":")
-        code = run_server(supervisor, host=host or "127.0.0.1",
-                          port=int(port), tokenizer=tokenizer,
-                          default_max_new=args.max_new_tokens)
-        supervisor.join(10.0)  # let worker threads exit before teardown
+    try:
+        if args.http:
+            host, _, port = args.http.rpartition(":")
+            code = run_server(supervisor, host=host or "127.0.0.1",
+                              port=int(port), tokenizer=tokenizer,
+                              default_max_new=args.max_new_tokens)
+            supervisor.join(10.0)  # let worker threads exit before teardown
+            dump_trace()
+            _print_summary(supervisor)
+            return code
+        code = _serve_stdin(supervisor, model, tokenizer, args)
         dump_trace()
-        _print_summary(supervisor)
         return code
-    code = _serve_stdin(supervisor, model, tokenizer, args)
-    dump_trace()
-    return code
+    finally:
+        if scaler is not None:
+            scaler.stop()
 
 
 def _serve_stdin(supervisor, model, tokenizer, args):

@@ -14,10 +14,12 @@ with crash recovery, a step-latency watchdog, and graceful drain;
 docs/serving.md for the architecture, request lifecycle, failure-mode
 matrix, and operations guide.
 """
+from .autoscaler import Autoscaler
 from .engine import InferenceEngine
 from .faults import EngineCrash, FaultInjected, FaultPlan
 from .kv_pool import (PagedKVPool, PoolExhausted, gather_kv, scatter_prefill,
                       scatter_token)
+from .kv_tier import HostKVTier
 from .metrics import (ServingMetrics, label_series, merge_series,
                       render_prometheus)
 from .ownership import worker_only
@@ -37,6 +39,7 @@ __all__ = [
     "TERMINAL_STATES", "FaultPlan", "FaultInjected", "EngineCrash",
     "EngineSupervisor", "SupervisorState", "ShuttingDown",
     "Router", "CircuitBreaker", "BreakerState", "NetDrop", "HealthScore",
+    "HostKVTier", "Autoscaler",
     "ServingServer", "run_server", "worker_only",
     "Tracer", "FlightRecorder", "span_name",
     "render_prometheus", "label_series", "merge_series",

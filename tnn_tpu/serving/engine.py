@@ -110,6 +110,7 @@ from . import spec_decode
 from . import step_build
 from .faults import FaultInjected, FaultPlan
 from .kv_pool import PagedKVPool, PoolExhausted
+from .kv_tier import HostKVTier
 from .metrics import ServingMetrics
 from .prefix_cache import PrefixCache
 from .scheduler import (TERMINAL_STATES, AdmissionRejected, Request,
@@ -240,7 +241,8 @@ class InferenceEngine:
                  draft_model=None, draft_params=None,
                  profiler: Optional[Profiler] = None, trace: bool = False,
                  overlap: bool = False, kv_dtype: str = "f32",
-                 quant_weights: bool = False, tp: int = 1, seed: int = 0):
+                 quant_weights: bool = False, tp: int = 1,
+                 host_tier_bytes: int = 0, seed: int = 0):
         if getattr(model, "kv_cache_dtype", None):
             raise ValueError(
                 "the paged pool stores compute-dtype pages; "
@@ -264,6 +266,18 @@ class InferenceEngine:
             raise ValueError("chunk_size must be >= 1")
         if prefix_cache_min_hit_blocks < 1:
             raise ValueError("prefix_cache_min_hit_blocks must be >= 1")
+        if host_tier_bytes < 0:
+            raise ValueError("host_tier_bytes must be >= 0 (0 = no tier)")
+        if host_tier_bytes and not (prefix_cache and chunked_prefill):
+            raise ValueError(
+                "host_tier_bytes requires the prefix cache (tier entries "
+                "are addressed by its chain keys) — enable prefix_cache "
+                "and chunked_prefill, or set host_tier_bytes=0")
+        if host_tier_bytes and tp > 1:
+            raise ValueError(
+                "host_tier_bytes with tp>1 is unsupported — demoted page "
+                "slices would need a cross-shard gather/scatter; run the "
+                "host tier on single-chip replicas")
         self.drafter: Optional[spec_decode.Drafter] = None
         self.spec_mode = spec if isinstance(spec, str) else \
             getattr(spec, "name", "custom")
@@ -356,6 +370,7 @@ class InferenceEngine:
             "kv_bytes_per_token_per_shard":
                 (self.pool.kv_bytes_per_token +
                  self.pool.kv_scale_bytes_per_token) // self.tp,
+            "host_tier_max_bytes": int(host_tier_bytes),
         }
         cap = min(model.max_len, self.pool.capacity * block_size)
         self.max_seq_len = min(max_seq_len or cap, cap)
@@ -378,6 +393,16 @@ class InferenceEngine:
             # pool.alloc reports reclaimed ones so the index forgets them
             self.pool.evictable_filter = self.prefix_cache.contains_block
             self.pool.reclaim_hook = self.prefix_cache.drop_blocks
+        # host-RAM KV tier (elastic memory): reclaimed-but-indexed blocks
+        # demote to a bounded host buffer instead of vanishing, and admit
+        # back on a prefix hit through a digest-verified device_put + the
+        # existing evictable-revive path. demote_hook fires BEFORE
+        # reclaim_hook, while the cache still maps block -> chain key.
+        self.kv_tier: Optional[HostKVTier] = None
+        if host_tier_bytes:
+            self.kv_tier = HostKVTier(int(host_tier_bytes),
+                                      fault_plan=faults)
+            self.pool.demote_hook = self._demote_blocks
         # the scheduler PROBES the cache (read-only) to budget admissions
         self.scheduler.prefix_cache = self.prefix_cache
         self.prefix_publish_max_occupancy = float(prefix_publish_max_occupancy)
@@ -407,7 +432,7 @@ class InferenceEngine:
         self._last_step_latency_s = 0.0
         self._health_gauges: Dict[str, Any] = {
             "queue_depth": 0, "num_running": 0, "step_latency_s": 0.0,
-            **self._gauge_extras}
+            "tier_blocks": 0, **self._gauge_extras}
         self.requests: Dict[int, Request] = {}
         self._rid = itertools.count()
         self._key = jax.random.PRNGKey(seed)
@@ -572,6 +597,8 @@ class InferenceEngine:
             "queue_depth": self.scheduler.queue_depth,
             "num_running": len(self.scheduler.running),
             "step_latency_s": self._last_step_latency_s,
+            "tier_blocks": len(self.kv_tier) if self.kv_tier is not None
+            else 0,
             **self._gauge_extras}
         if self.tracer.enabled:
             self.tracer.instant("serve.submit", trace=req.trace_id, rid=rid)
@@ -632,7 +659,15 @@ class InferenceEngine:
             "tp_degree": self.tp,
             "kv_bytes_per_token_per_shard":
                 self._gauge_extras["kv_bytes_per_token_per_shard"],
+            "host_tier_enabled": self.kv_tier is not None,
         })
+        # tier counters: live values when the tier exists, stable zeroed
+        # keys otherwise (dashboards never see a shape change)
+        s.update(self.kv_tier.stats() if self.kv_tier is not None else {
+            "tier_blocks": 0, "tier_bytes": 0, "tier_max_bytes": 0,
+            "tier_demotions": 0, "tier_demote_failures": 0,
+            "tier_readmits": 0, "tier_corrupt_dropped": 0,
+            "tier_evictions": 0})
         return s
 
     def check_invariants(self) -> None:
@@ -643,6 +678,8 @@ class InferenceEngine:
                  for r in self.scheduler.running if r.block_table]
         self.pool.check_invariants([t for t, _ in pairs],
                                    [n for _, n in pairs])
+        if self.kv_tier is not None:
+            self.kv_tier.check_invariants()
 
     def _terminate(self, req: Request, state: RequestState, error: str,
                    events: Optional[Dict[str, List]] = None,
@@ -956,16 +993,22 @@ class InferenceEngine:
             # no decode-phase rows left: the next decode token starts a new
             # stream, so the stall clock must not span the idle gap
             self._last_decode_emit = None
+        tier_blocks = len(self.kv_tier) if self.kv_tier is not None else 0
         self.metrics.observe_gauges(self.scheduler.queue_depth,
                                     self.pool.occupancy,
                                     self.pool.kv_bytes_per_token,
-                                    tp_degree=self.tp)
+                                    tp_degree=self.tp,
+                                    tier_blocks=tier_blocks,
+                                    tier_bytes=(self.kv_tier.bytes_used
+                                                if self.kv_tier is not None
+                                                else 0.0))
         # host-side health gauges, cached at commit: /healthz answers from
         # the supervisor's copy without ever reaching into the engine
         self._health_gauges = {
             "queue_depth": self.scheduler.queue_depth,
             "num_running": len(self.scheduler.running),
             "step_latency_s": self._last_step_latency_s,
+            "tier_blocks": tier_blocks,
             **self._gauge_extras}
 
     def _fetch_bundle(self, devs: List[Any]):
@@ -1435,10 +1478,121 @@ class InferenceEngine:
                               pages_argnums=(0, 1), pages_out=(0, 1),
                               params_argnum=None)
 
+    def _demote_blocks(self, blocks: List[int]) -> None:
+        """``pool.demote_hook``: salvage reclaimed-but-indexed blocks to
+        the host tier before ``reclaim_hook`` unindexes them. ONE batched
+        explicit ``jax.device_get`` fetches every demoted page slice (this
+        runs on the allocation path, outside the step's fetch/commit
+        machinery — the pool hook, not a step-path call). Best-effort
+        throughout: an unindexed block, a tier-full bound, or an injected
+        ``tier.demote_fail`` all degrade to the plain eviction that would
+        have happened without a tier."""
+        if self.kv_tier is None or self.pool.pages_deleted():
+            return
+        pairs = [(b, self.prefix_cache.key_of(b)) for b in blocks]
+        pairs = [(b, k) for b, k in pairs if k is not None]
+        if not pairs:
+            return
+        pk, pv = self.pool.pages_k, self.pool.pages_v
+        quant = isinstance(pk, kv_pool_lib.QuantPages)
+        fetch = []
+        for b, _ in pairs:
+            if quant:
+                fetch.append((pk.data[:, b], pk.scale[:, b],
+                              pv.data[:, b], pv.scale[:, b]))
+            else:
+                fetch.append((pk[:, b], pv[:, b]))
+        host = jax.device_get(tuple(fetch))
+        for (b, key), leaves in zip(pairs, host):
+            if self.kv_tier.demote(key, leaves) and self.tracer.enabled:
+                self.tracer.instant("tier.demote", block=b,
+                                    tier_blocks=len(self.kv_tier),
+                                    tier_bytes=self.kv_tier.bytes_used)
+
+    def _tier_adopt_fn(self):
+        def fn(pages_k, pages_v, blk, payload_k, payload_v):
+            # kv_pool.write_block: under int8 the payload is a QuantPages
+            # of slices, so data and scales re-adopt together
+            return (kv_pool_lib.write_block(pages_k, blk, payload_k),
+                    kv_pool_lib.write_block(pages_v, blk, payload_v))
+
+        # donated pages + traced block id: one compile serves every readmit
+        return self._jit_step(fn, donate_argnums=(0, 1), n_outs=2,
+                              pages_argnums=(0, 1), pages_out=(0, 1),
+                              params_argnum=None)
+
+    def _tier_payload(self, leaves):
+        """Demoted host leaves -> device payloads for the adopt fn:
+        ``(k, v)`` plain arrays, or two QuantPages bundles from
+        ``(k_data, k_scale, v_data, v_scale)`` under int8."""
+        if len(leaves) == 4:
+            return (kv_pool_lib.QuantPages(self._put(leaves[0]),
+                                           self._put(leaves[1])),
+                    kv_pool_lib.QuantPages(self._put(leaves[2]),
+                                           self._put(leaves[3])))
+        return self._put(leaves[0]), self._put(leaves[1])
+
+    def _tier_readmit(self, seq) -> None:
+        """Walk this prompt's chain keys and re-admit every demoted block
+        the device index is missing: allocate a block, digest-verify the
+        tier entry (``HostKVTier.verify_readmit`` — a corrupt entry frees
+        the block again and the walk stops: an uncached miss), device_put
+        the payload through the jitted adopt fn, index it
+        (``prefix_cache.adopt``), and release it into the evictable LRU —
+        from where the ordinary ``probe``/``fork`` revive path picks it up
+        exactly as if it had never left the device. Allocation pressure
+        (or an injected alloc fault) ends the walk early: the tier only
+        ever adds hits."""
+        readmitted = 0
+        for key in self.prefix_cache.chain_keys(seq):
+            if self.prefix_cache.contains_key(key):
+                continue            # device-resident; deeper keys may tier
+            if key not in self.kv_tier:
+                break               # chain broken — nothing deeper can match
+            try:
+                blk = self.pool.alloc(1)
+            except (PoolExhausted, FaultInjected):
+                break
+            if key not in self.kv_tier:
+                # the alloc's own reclaim demoted blocks and LRU-displaced
+                # this entry — an ordinary miss, not corruption
+                self.pool.free(blk)
+                break
+            leaves = self.kv_tier.verify_readmit(key)
+            if leaves is None:
+                # corrupt/torn entry: dropped by the tier; degrade to miss
+                self.metrics.observe_tier_corrupt()
+                self.pool.free(blk)
+                break
+            payload_k, payload_v = self._tier_payload(leaves)
+            adopt_key = ("tier_adopt",) + self._kv_key
+            fn = self._jit.get(adopt_key)
+            if fn is None:
+                fn = self._jit[adopt_key] = self._tier_adopt_fn()
+            pk, pv = fn(self.pool.pages_k, self.pool.pages_v,
+                        self._put(blk[0], jnp.int32), payload_k, payload_v)
+            self.pool.update_pages(pk, pv)
+            self.prefix_cache.adopt(key, blk[0])
+            # release into the evictable LRU (the block is now indexed):
+            # probe() sees it immediately and fork() revives it — COW and
+            # refcounts ride the unchanged device-hit machinery
+            self.pool.free(blk)
+            readmitted += 1
+        if readmitted:
+            self.metrics.observe_tier_hit(readmitted)
+            if self.tracer.enabled:
+                self.tracer.instant("tier.readmit", blocks=readmitted,
+                                    tier_blocks=len(self.kv_tier),
+                                    tier_bytes=self.kv_tier.bytes_used)
+
     def _match_prefix(self, req: Request) -> None:
         """Admission-time cache hit: fork the matched blocks into the
         request's table and mark their positions resident, so the chunked
         prefill pushes only the uncached tail.
+
+        With a host tier, demoted prefix blocks are first re-admitted to
+        the device (``_tier_readmit``) so the probe below sees them as
+        ordinary evictable hits.
 
         A full-cover hit (``cow``) shares all but the last matched block
         and clones that one — the recomputed last prompt token writes its
@@ -1447,6 +1601,8 @@ class InferenceEngine:
         references are released and the request admits uncached — a cache
         miss, never a failure."""
         seq = req.resume_tokens
+        if self.kv_tier is not None and len(self.kv_tier):
+            self._tier_readmit(seq)
         blocks, cached, cow = self.prefix_cache.probe(seq)
         self.metrics.observe_prefix_lookup(cached if blocks else 0, len(seq))
         if not blocks:
@@ -2301,10 +2457,16 @@ class InferenceEngine:
         if reset_pages:
             self.pool.reset_pages()
             if self.prefix_cache is not None:
-                # purge the evictable pool (reclaim_hook unindexes) and drop
-                # any entries still covering live-at-failure blocks
+                # purge the evictable pool (reclaim_hook unindexes; the
+                # demote hook is suppressed — zeroed pages must never be
+                # salvaged) and drop any entries still covering
+                # live-at-failure blocks
                 self.pool.purge_evictable()
                 self.prefix_cache.clear()
+            if self.kv_tier is not None:
+                # conservative: entries demoted before the failure derive
+                # from pages we can no longer cross-check — drop them all
+                self.kv_tier.clear()
             self._last_decode_emit = None
         return events
 
@@ -2350,6 +2512,10 @@ class InferenceEngine:
         if self.prefix_cache is not None:
             self.pool.purge_evictable()
             self.prefix_cache.clear()
+        if self.kv_tier is not None:
+            # same conservative rule as abort_all: a crash mid-demote may
+            # have captured torn pages, so nothing pre-crash may re-admit
+            self.kv_tier.clear()
         self._last_decode_emit = None
         return events
 

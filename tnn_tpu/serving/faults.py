@@ -42,7 +42,18 @@ indices:
   ``net_partition_rounds`` consults during which EVERY router↔replica
   call fails (``partition_active`` is the per-call pure read);
   ``flaky_drop`` (site "net.flaky") drops calls to one configured
-  ``flaky_replica`` only — one bad NIC, not a bad network.
+  ``flaky_replica`` only — one bad NIC, not a bad network;
+- **host-tier faults**: ``tier_demote_fail`` (site "tier.demote_fail")
+  makes a demotion fail — the block falls back to plain eviction;
+  ``tier_corrupt`` (site "tier.corrupt") flips a byte in a demoted
+  payload at readmit time, which the tier's digest check must catch and
+  degrade to an uncached miss; ``tier_slow_readmit`` (site
+  "tier.slow_readmit") stalls a readmit ``tier_slow_readmit_s`` without
+  failing it (a paged-out host buffer, not a corrupt one);
+- **fleet-scaling faults**: ``scale_join_fail`` (site "scale.join_fail")
+  makes a replica join fail mid-scale-up — the router's ``add_replica``
+  raises before the new replica enters placement, and the autoscaler's
+  bounded retry must absorb it.
 
 Everything is driven by one ``numpy`` Generator seeded at construction:
 the same plan over the same call sequence fires the same faults, so chaos
@@ -150,6 +161,17 @@ class FaultPlan:
     flaky_replica: int = -1
     flaky_drop_prob: float = 0.0
     flaky_drop_calls: Tuple[int, ...] = ()
+    # host-KV-tier faults (consulted by serving.kv_tier.HostKVTier)
+    tier_demote_fail_prob: float = 0.0
+    tier_demote_fail_calls: Tuple[int, ...] = ()   # site "tier.demote_fail"
+    tier_corrupt_prob: float = 0.0
+    tier_corrupt_calls: Tuple[int, ...] = ()       # site "tier.corrupt"
+    tier_slow_readmit_prob: float = 0.0
+    tier_slow_readmit_calls: Tuple[int, ...] = ()  # site "tier.slow_readmit"
+    tier_slow_readmit_s: float = 0.01              # injected readmit stall
+    # fleet-scaling faults (consulted by Router.add_replica)
+    scale_join_fail_prob: float = 0.0
+    scale_join_fail_calls: Tuple[int, ...] = ()    # site "scale.join_fail"
 
     calls: Counter = field(default_factory=Counter, init=False)
     fired: Counter = field(default_factory=Counter, init=False)
@@ -309,6 +331,42 @@ class FaultPlan:
         router consults this per call WITHOUT advancing the rng stream
         (window accounting lives in the per-round ``net_partition``)."""
         return self._partition_left > 0
+
+    # -- host-tier sites (called by serving.kv_tier.HostKVTier) ---------------
+
+    def tier_demote_fail(self) -> bool:
+        """Consulted once per demotion attempt: True when this block's
+        demotion should fail (site "tier.demote_fail"). The tier returns
+        False to the pool's demote hook and the block is plainly evicted —
+        a failed demote may cost a future hit, never a request."""
+        return self._fires("tier.demote_fail", self.tier_demote_fail_prob,
+                           self.tier_demote_fail_calls)
+
+    def tier_corrupt(self) -> bool:
+        """Consulted once per readmit attempt: True when the demoted
+        payload should be corrupted before the tier's digest verification
+        (site "tier.corrupt"). The verifier must catch the damage and
+        degrade the lookup to an uncached miss — never wrong KV."""
+        return self._fires("tier.corrupt", self.tier_corrupt_prob,
+                           self.tier_corrupt_calls)
+
+    def tier_slow_readmit(self) -> bool:
+        """Consulted once per readmit attempt: True when the readmit should
+        stall ``tier_slow_readmit_s`` before proceeding (site
+        "tier.slow_readmit") — a paged-out or contended host buffer. The
+        readmit still succeeds; only latency pays."""
+        return self._fires("tier.slow_readmit", self.tier_slow_readmit_prob,
+                           self.tier_slow_readmit_calls)
+
+    # -- fleet-scaling sites (called by Router.add_replica) -------------------
+
+    def scale_join_fail(self) -> bool:
+        """Consulted once per replica-join attempt: True when the join
+        should fail before the new replica enters placement (site
+        "scale.join_fail"). The autoscaler's bounded retry absorbs it; the
+        fleet never sees a half-joined replica."""
+        return self._fires("scale.join_fail", self.scale_join_fail_prob,
+                           self.scale_join_fail_calls)
 
     def flaky_drop(self, replica: int) -> bool:
         """True when THIS call to ``replica`` should drop (site

@@ -109,6 +109,12 @@ class PagedKVPool:
         # recycled so the cache can drop their index entries.
         self.evictable_filter: Optional[Callable[[int], bool]] = None
         self.reclaim_hook: Optional[Callable[[List[int]], None]] = None
+        # host-tier hook: fires on the blocks a reclaim is about to recycle,
+        # BEFORE reclaim_hook unindexes them — the engine fetches their page
+        # content to the host KV tier while the prefix cache can still name
+        # each block's chain key. Never fires from purge_evictable (page
+        # content is untrustworthy there, e.g. after reset_pages).
+        self.demote_hook: Optional[Callable[[List[int]], None]] = None
         # chaos hook: when set (serving.faults.FaultPlan), alloc() consults
         # it and may raise an injected PoolExhausted before mutating state
         self.fault_plan = None
@@ -215,14 +221,23 @@ class PagedKVPool:
         self._debug_check()
         return blocks
 
-    def _reclaim(self, n: int) -> List[int]:
+    def _reclaim(self, n: int, demote: bool = True) -> List[int]:
         """Move ``n`` LRU-oldest evictable blocks to the free list and
-        notify ``reclaim_hook`` (their cached KV is gone for good)."""
+        notify ``reclaim_hook`` (their cached KV leaves the device for
+        good). With a ``demote_hook`` wired (host KV tier) and ``demote``
+        true, the hook sees the blocks FIRST — while the prefix cache still
+        maps block -> chain key and the pages still hold their content — so
+        the engine can salvage each block to host RAM before the index
+        entry dies. The hook is best-effort: whatever it does, reclaim
+        proceeds identically (the tier can only add hits, never block an
+        allocation)."""
         taken = []
         for _ in range(n):
             b, _ = self._evictable.popitem(last=False)
             taken.append(b)
             self._free.append(b)
+        if taken and demote and self.demote_hook is not None:
+            self.demote_hook(taken)
         if taken and self.reclaim_hook is not None:
             self.reclaim_hook(taken)
         self._debug_check()
@@ -296,8 +311,11 @@ class PagedKVPool:
 
     def purge_evictable(self) -> List[int]:
         """Reclaim EVERY evictable block (cache invalidation: page content
-        became untrustworthy, e.g. after ``reset_pages``)."""
-        return self._reclaim(len(self._evictable))
+        became untrustworthy, e.g. after ``reset_pages``). Demotion is
+        suppressed — salvaging zeroed or poisoned pages into the host tier
+        under still-valid chain keys would turn a clean crash recovery into
+        a wrong-KV re-admission later."""
+        return self._reclaim(len(self._evictable), demote=False)
 
     def check_invariants(
             self,
@@ -568,6 +586,21 @@ def scatter_chunk(pages, block_tables, starts, rows, q_lens):
     # advanced (blk, slot) indices broadcast to (B, Q) and lead the update
     # operand: (B, Q, L, H, Dh)
     return pages.at[:, blk, :, slot, :].set(rows.transpose(1, 2, 0, 3, 4))
+
+
+def write_block(pages, block, payload):
+    """Write one whole page at ``block`` across every layer (the host-tier
+    re-admission's device half). pages: (L, N, H, bs, Dh); block: scalar
+    int32 (traced — one compiled fn serves every block id); payload:
+    (L, H, bs, Dh). Under QuantPages the payload is itself a QuantPages of
+    slices, so the int8 data and its f32 scale sidecar are re-adopted
+    together — a readmitted block can never dequantize against stale
+    scales.
+    """
+    if isinstance(pages, QuantPages):
+        return QuantPages(write_block(pages.data, block, payload.data),
+                          write_block(pages.scale, block, payload.scale))
+    return pages.at[:, block].set(payload)
 
 
 def copy_blocks(pages, src, dst):

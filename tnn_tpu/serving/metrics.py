@@ -168,6 +168,25 @@ EXPOSITION: Dict[str, Tuple[str, str, str, str]] = {
         "tnn_serve_tp_degree", "gauge",
         "Tensor-parallel degree of this engine (attention heads and KV "
         "pool head-sharded over tp chips; 1 = single-chip)", "tp_degree"),
+    "serve.tier_hits": (
+        "tnn_serve_tier_hits_total", "counter",
+        "KV blocks re-admitted from the host-RAM tier (digest-verified "
+        "device_put instead of recomputed prefill)", "tier_hits"),
+    "serve.tier_corrupt": (
+        "tnn_serve_tier_corrupt_total", "counter",
+        "Host-tier entries dropped at readmit because their integrity "
+        "digest failed (degraded to an uncached miss)", "tier_corrupt"),
+    "serve.tier_blocks": (
+        "tnn_serve_tier_blocks", "gauge",
+        "KV blocks currently resident in the host-RAM tier", "tier_blocks"),
+    "serve.tier_bytes": (
+        "tnn_serve_tier_bytes", "gauge",
+        "Host-RAM bytes held by demoted KV blocks (int8 blocks cost about "
+        "half their f32 footprint)", "tier_bytes"),
+    "serve.replicas": (
+        "tnn_serve_replicas", "gauge",
+        "Active (non-retired, non-dead) replicas in the fleet — the "
+        "autoscaler's actuated value", "replicas"),
 }
 
 #: direct (non-``_tick``) families: attribute/gauge name → (prometheus
@@ -426,6 +445,9 @@ class ServingMetrics:
         self.hedges_cancelled = 0     # losing streams cancelled/discarded
         self.degraded_ejections = 0   # replicas ejected from placement
         self.proactive_migrations = 0  # streams pulled off degraded replicas
+        # host-KV-tier counters (elastic fleet)
+        self.tier_hits = 0            # blocks re-admitted from the host tier
+        self.tier_corrupt = 0         # entries dropped on digest mismatch
         self._t_created = time.perf_counter()
         self._t_first: Optional[float] = None
         self._t_last: Optional[float] = None
@@ -558,13 +580,34 @@ class ServingMetrics:
 
     def observe_gauges(self, queue_depth: int, pool_occupancy: float,
                        kv_bytes_per_token: float = 0.0,
-                       tp_degree: float = 1.0) -> None:
+                       tp_degree: float = 1.0,
+                       tier_blocks: int = 0,
+                       tier_bytes: float = 0.0) -> None:
         self.queue_depth.append(queue_depth)
         self.pool_occupancy.append(pool_occupancy)
         self._last_queue_depth = queue_depth
         self._last_pool_occupancy = pool_occupancy
         self._last_kv_bytes_per_token = kv_bytes_per_token
         self._last_tp_degree = tp_degree
+        self._last_tier_blocks = tier_blocks
+        self._last_tier_bytes = tier_bytes
+
+    def observe_replicas(self, n: int) -> None:
+        """Active replica count after a fleet change (scale-up/down, death,
+        readmit) — the ``tnn_serve_replicas`` gauge's source."""
+        self._last_replicas = n
+
+    def observe_tier_hit(self, blocks: int = 1) -> None:
+        """``blocks`` KV blocks re-admitted from the host tier (each one a
+        digest-verified device_put instead of a recomputed prefill)."""
+        self.tier_hits += blocks
+        self._tick("serve.tier_hits", blocks)
+
+    def observe_tier_corrupt(self) -> None:
+        """A host-tier entry failed its integrity digest at readmit and was
+        dropped — the lookup degraded to an uncached miss."""
+        self.tier_corrupt += 1
+        self._tick("serve.tier_corrupt", 1)
 
     def observe_preemption(self, rid: Optional[int] = None) -> None:
         self.preemptions += 1
@@ -795,6 +838,11 @@ class ServingMetrics:
             "kv_bytes_per_token": getattr(self, "_last_kv_bytes_per_token",
                                           0.0),
             "tp_degree": getattr(self, "_last_tp_degree", 1.0),
+            "tier_hits": self.tier_hits,
+            "tier_corrupt": self.tier_corrupt,
+            "tier_blocks": getattr(self, "_last_tier_blocks", 0),
+            "tier_bytes": getattr(self, "_last_tier_bytes", 0.0),
+            "replicas": getattr(self, "_last_replicas", 0.0),
         }
 
     # -- Prometheus exposition ------------------------------------------------

@@ -50,7 +50,7 @@ tail-first reclaim avoids creating orphans in the common case.
 from __future__ import annotations
 
 import hashlib
-from typing import Dict, Iterable, List, Sequence, Tuple
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -97,6 +97,17 @@ class PrefixCache:
     def contains_block(self, block: int) -> bool:
         """Is this pool block indexed? (``PagedKVPool.evictable_filter``.)"""
         return block in self._key_of
+
+    def contains_key(self, key: bytes) -> bool:
+        """Is this chain key device-resident? (The host-tier readmit walk
+        skips keys the device index already covers.)"""
+        return key in self._index
+
+    def key_of(self, block: int) -> Optional[bytes]:
+        """Chain key a pool block is indexed under, or None. The pool's
+        ``demote_hook`` fires before ``reclaim_hook``, so at demote time
+        this still names every reclaimed-but-indexed block."""
+        return self._key_of.get(block)
 
     # -- lookup ---------------------------------------------------------------
 
@@ -177,6 +188,20 @@ class PrefixCache:
             self._key_of[blk] = key
             added += 1
         return added
+
+    def adopt(self, key: bytes, block: int) -> bool:
+        """Index one re-admitted block directly under its chain key — the
+        host-tier readmit path, where the key is already known (it addressed
+        the tier entry and survived ``HostKVTier.verify_readmit``'s digest
+        check) so re-deriving it from tokens would be redundant. Same
+        first-publisher-wins rule as :meth:`publish`: an occupied key or an
+        already-indexed block leaves the index untouched and returns False
+        (the caller releases its block back to the pool)."""
+        if key in self._index or block in self._key_of:
+            return False
+        self._index[key] = block
+        self._key_of[block] = key
+        return True
 
     # -- invalidation ---------------------------------------------------------
 

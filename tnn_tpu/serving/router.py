@@ -259,10 +259,14 @@ class _Replica:
     readmit_since: Optional[float] = None   # score first back under readmit
     degraded_at: Optional[float] = None     # ejection time (cooldown base)
     recovery_probing: bool = False          # one probe dispatch at a time
+    # scale-down: a retired replica takes no new placements and drains to
+    # completion (its live streams are proactively migrated first); unlike
+    # killed it stays token-correct while it empties
+    retired: bool = False
 
     @property
     def available(self) -> bool:
-        return (not self.killed and not self.degraded
+        return (not self.killed and not self.degraded and not self.retired
                 and not self.sup.finished and self.breaker.allows())
 
 
@@ -353,6 +357,10 @@ class Router:
                      breaker=CircuitBreaker(breaker_threshold,
                                             breaker_cooldown_s))
             for i, s in enumerate(supervisors)]
+        # kept for add_replica: replicas joining mid-flight get the same
+        # breaker configuration the founding set got
+        self.breaker_threshold = int(breaker_threshold)
+        self.breaker_cooldown_s = float(breaker_cooldown_s)
         self.faults = faults
         self.max_retries = int(max_retries)
         self.retry_backoff_s = float(retry_backoff_s)
@@ -570,6 +578,7 @@ class Router:
                 "live_requests": len(h.live),
                 "killed": h.killed,
                 "degraded": h.degraded,
+                "retired": h.retired,
                 "health_score": round(h.health.score(), 4),
             } for h in self._handles]
             s: Dict[str, Any] = {
@@ -649,6 +658,12 @@ class Router:
                 "replicas_healthy": healthy,
                 "replicas_degraded": sum(1 for h in self._handles
                                          if h.degraded),
+                "replicas_active": sum(
+                    1 for h in self._handles
+                    if not h.killed and not h.retired
+                    and not h.sup.finished),
+                "replicas_retired": sum(1 for h in self._handles
+                                        if h.retired),
                 "hedges_fired": self.metrics.hedges_fired,
                 "hedges_won": self.metrics.hedges_won,
                 "hedges_cancelled": self.metrics.hedges_cancelled,
@@ -685,6 +700,109 @@ class Router:
         if getattr(eng, "faults", None) is None:
             eng.faults = FaultPlan()
         eng.faults.step_delay_s = float(max(0.0, delay_s))
+
+    # -- elastic fleet: join / retire ------------------------------------------
+
+    def num_active_replicas(self) -> int:
+        """Replicas that can still take placements or are serving live
+        streams: not killed, not retired, not finished (degraded counts —
+        it may readmit). The autoscaler's actuated value."""
+        with self._lock:
+            return sum(1 for h in self._handles
+                       if not h.killed and not h.retired
+                       and not h.sup.finished)
+
+    @property
+    def open_requests(self) -> int:
+        """Requests routed but not yet terminal (the autoscaler's load
+        numerator)."""
+        with self._lock:
+            return len(self._open)
+
+    def replica_load(self) -> Dict[int, int]:
+        """Live-stream count per active replica (router-assigned counts,
+        no cross-thread engine reads) — the scale-down victim picker's
+        input."""
+        with self._lock:
+            return {h.idx: len(h.live) for h in self._handles
+                    if not h.killed and not h.retired
+                    and not h.sup.finished}
+
+    def ttft_quantile(self, q: float) -> Optional[float]:
+        """Fleet TTFT quantile (seconds) over the rolling window the
+        adaptive hedge threshold already maintains; None until enough
+        samples landed to trust a tail estimate."""
+        with self._lock:
+            if len(self._ttft_window) < 8:
+                return None
+            return float(np.percentile(
+                np.asarray(list(self._ttft_window)), float(q)))
+
+    def add_replica(self, supervisor_or_factory) -> int:
+        """Scale-up join: append one replica and open it for placement.
+
+        Accepts a ready ``EngineSupervisor`` or a zero-arg factory building
+        one; the ``scale.join_fail`` chaos site fires BEFORE the factory
+        runs, so an injected join failure never leaks a half-built engine.
+        On a started router the new replica's worker thread starts
+        immediately; on a pump-driven router it joins the next pump round.
+        Returns the new replica index."""
+        if self.faults is not None and self.faults.scale_join_fail():
+            raise NetDrop("injected join failure: new replica never "
+                          "came up")
+        sup = (supervisor_or_factory()
+               if not hasattr(supervisor_or_factory, "submit")
+               else supervisor_or_factory)
+        with self._lock:
+            idx = len(self._handles)
+            self._handles.append(_Replica(
+                idx=idx, sup=sup,
+                breaker=CircuitBreaker(self.breaker_threshold,
+                                       self.breaker_cooldown_s)))
+        if self._thread is not None:
+            sup.start()
+        self.metrics.observe_replicas(self.num_active_replicas())
+        if self.tracer.enabled:
+            self.tracer.instant("scale.up", replica=idx,
+                                replicas=self.num_active_replicas())
+        self._wake.set()
+        return idx
+
+    def retire_replica(self, idx: int,
+                       reason: str = "scale-down") -> bool:
+        """Zero-loss scale-down: mark one replica retired (no further
+        placements), proactively migrate its live streams token-exact to
+        the rest of the fleet (the PR 9/15 recompute-resume path), then
+        drain it gracefully. Streams a migration guard keeps in place
+        (over budget, racing a hedge, effectively done) finish on the
+        draining replica — either way no request is dropped. Returns False
+        when the replica is already retired/killed/finished."""
+        with self._lock:
+            h = self._handles[idx]
+            if h.retired or h.killed or h.sup.finished:
+                return False
+            others = sum(1 for o in self._handles
+                         if o.idx != idx and not o.killed
+                         and not o.retired and not o.sup.finished)
+            if others == 0:
+                return False   # never retire the last replica standing
+            h.retired = True
+            victims = [(self._open[gid], self._open[gid].epoch, h)
+                       for gid in list(h.live) if gid in self._open
+                       and self._open[gid].replica == idx]
+        for rec, epoch, hh in victims:
+            self._proactive_migrate(rec, epoch, hh)
+        try:
+            h.sup.request_drain(reason)
+        except Exception:  # noqa: BLE001 — a dying replica drains itself
+            pass
+        self.metrics.observe_replicas(self.num_active_replicas())
+        if self.tracer.enabled:
+            self.tracer.instant("scale.down", replica=idx, reason=reason,
+                                migrated=len(victims),
+                                replicas=self.num_active_replicas())
+        self._wake.set()
+        return True
 
     # -- internals -------------------------------------------------------------
 
@@ -726,7 +844,8 @@ class Router:
                     if h.available and h.idx != exclude]
             degraded_alive = [
                 h for h in self._handles
-                if h.degraded and not h.killed and not h.sup.finished
+                if h.degraded and not h.killed and not h.retired
+                and not h.sup.finished
                 and h.breaker.allows() and h.idx != exclude]
             probes = [h for h in degraded_alive
                       if not h.recovery_probing
@@ -1098,8 +1217,11 @@ class Router:
         their last values (staleness keeps climbing on its own)."""
         proactive = []
         with self._lock:
+            # retired replicas are leaving anyway: sampling them would
+            # skew the fleet median and ejecting them is meaningless
             alive = [h for h in self._handles
-                     if not h.killed and not h.sup.finished]
+                     if not h.killed and not h.retired
+                     and not h.sup.finished]
             partitioned = (self.faults is not None
                            and self.faults.partition_active)
             if not partitioned:
@@ -1326,6 +1448,9 @@ class Router:
                     self._resolve_hedge_locked(r, hedge_won=False)
         self._update_health()
         self._maybe_hedge()
+        # keep the tnn_serve_replicas gauge fresh even when fleet changes
+        # happen through kill/drain rather than an explicit scale event
+        self.metrics.observe_replicas(self.num_active_replicas())
         with self._lock:
             all_dead = all(h.killed or h.sup.finished
                            for h in self._handles)

@@ -8,3 +8,4 @@ from . import pool           # noqa: F401
 from . import prng           # noqa: F401
 from . import retry          # noqa: F401
 from . import thread_owner   # noqa: F401
+from . import tier_adopt     # noqa: F401

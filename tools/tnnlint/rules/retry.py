@@ -42,6 +42,16 @@ amplification bomb unless a hedge *budget* or *deadline* bounds it, so
 in the loop condition — ``while pending < self.hedge_budget * open_:`` or
 ``while time.monotonic() < deadline:`` both pass; ``while True:`` around
 a hedge submit does not.
+
+Fleet scaling (PR: elastic fleet) joins both lists: a retry loop around
+``add_replica``/``scale_up``/autoscaler actuation (``scale`` and
+``autoscal`` targets) is a replica-churn bomb — an injected join failure
+retried forever spins up half-built engines against a sick control plane
+— so it must carry the same budget shape; and a scaling *control loop*
+is legitimately bounded by its stability guards rather than an attempt
+counter, so ``hysteresis`` and ``cooldown`` count as bounding names in
+the condition — ``while (now - low_since) < self.hysteresis_s:`` passes,
+``while True:`` around ``router.add_replica(...)`` does not.
 """
 from __future__ import annotations
 
@@ -51,8 +61,10 @@ from typing import Iterable, List
 
 from ..core import ModuleContext, Rule, Violation, dotted_name, register
 
-_DEF_TARGETS = ["submit", "engine", "replica", ".sup.", "dispatch", "hedge"]
-_DEF_BUDGET_PATTERN = r"max_|budget|retr|attempt|tries|deadline"
+_DEF_TARGETS = ["submit", "engine", "replica", ".sup.", "dispatch", "hedge",
+                "scale", "autoscal"]
+_DEF_BUDGET_PATTERN = (r"max_|budget|retr|attempt|tries|deadline"
+                       r"|hysteresis|cooldown")
 
 
 def _own_nodes(body: Iterable[ast.AST]):

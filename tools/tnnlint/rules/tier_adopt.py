@@ -1,0 +1,75 @@
+"""tier-adopt-unverified — host-tier KV re-admission must be hash-verified.
+
+The host-RAM KV tier (``serving/kv_tier.py``) holds demoted cache blocks
+in ordinary process memory, outside the device pool's invariant-checked
+world: a torn demotion, a buggy resize, or plain bit rot can hand back
+bytes that are no longer the KV the chain key promises. The prefix cache
+then serves those blocks to every future request sharing the prefix —
+silent wrong-KV poisoning, the worst failure mode a cache can have (an
+outage is visible; wrong attention context is not).
+
+The tier's contract is therefore *verify-then-adopt*: the ONLY way to
+take a payload out of a tier is :meth:`HostKVTier.verify_readmit`, which
+recomputes the blake2b digest over the stored leaves (dtype + shape +
+bytes, bound to the chain key) and degrades any mismatch to an uncached
+miss — the tier can add hits, never failures. Code that pulls tier
+payloads through any other door skips that check.
+
+This rule enforces the shape: a call to an adoption-shaped method —
+``adopt``, ``adopt_block``, ``readmit``, ``get``, ``pop`` — on a
+receiver whose dotted path mentions ``tier`` is flagged; the verified
+helper ``verify_readmit`` (and the device-side ``prefix_cache.adopt``,
+whose receiver has no ``tier``) stay clean:
+
+    leaves = self.kv_tier.verify_readmit(key)      # OK: digest-checked
+    self.prefix_cache.adopt(key, blk)              # OK: device-side index
+
+    leaves = self.kv_tier.readmit(key)             # flagged
+    entry = self.host_tier.get(key)                # flagged: raw entry
+    tier.adopt(key, blk)                           # flagged
+
+``demote`` (admission INTO the tier, where the digest is computed) and
+the tier's stats/maintenance surface (``stats``, ``clear``, ``keys``,
+``check_invariants``) are not adoption and are not matched.
+"""
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from ..core import ModuleContext, Rule, Violation, dotted_name, register
+
+#: method names that hand a payload OUT of a tier-shaped receiver
+_ADOPT_ATTRS = ("adopt", "adopt_block", "readmit", "get", "pop")
+
+
+@register
+class TierAdoptUnverified(Rule):
+    name = "tier-adopt-unverified"
+    description = ("host-tier KV adoption must flow through the "
+                   "hash-verifying helper (verify_readmit), never a raw "
+                   "get/adopt on the tier")
+
+    def check_module(self, ctx: ModuleContext) -> List[Violation]:
+        opts = ctx.rule_options(self.name)
+        attrs = tuple(opts.get("adopt_attrs", _ADOPT_ATTRS))
+        out: List[Violation] = []
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            if not isinstance(fn, ast.Attribute) or fn.attr not in attrs:
+                continue
+            receiver = dotted_name(fn.value) if isinstance(
+                fn.value, (ast.Attribute, ast.Name)) else None
+            if receiver is None or "tier" not in receiver.lower():
+                continue
+            out.append(self.violation(
+                ctx, node,
+                f"'{receiver}.{fn.attr}(...)' takes a payload out of a "
+                f"host tier without the digest check — route re-admission "
+                f"through the hash-verifying helper "
+                f"(HostKVTier.verify_readmit), which degrades a corrupt "
+                f"or torn block to an uncached miss instead of adopting "
+                f"wrong KV"))
+        return out
