@@ -154,6 +154,79 @@ def report(name: str, seconds: float, flops: Optional[float] = None,
     return out
 
 
+# -- persisted A/B artifacts -------------------------------------------------
+#
+# Artifacts under benchmarks/results/ serve two audiences: tests gate on the
+# STRUCTURAL outcome of a run (bench names, exactness flags, config echoes,
+# capacity arithmetic, gate_* booleans) while humans read the timing columns.
+# Persisting both in one flat dict meant every re-run rewrote the file even
+# when nothing a test asserts had moved — pure diff churn from wall-clock
+# noise. write_artifact splits each row into a "gated" part (asserted) and an
+# "info" part (informational), and skips the rewrite entirely when the gated
+# section is unchanged.
+
+#: substring markers for row fields that are measurements (rates, latency
+#: quantiles, wall-clock) or scheduling-dependent counters — they land in
+#: the artifact's "info" section and are never asserted by tests
+INFO_FIELD_MARKERS = (
+    "_per_s", "goodput", "_at_slo", "timeline", "duration", "stall",
+    "hedge", "migrat", "eject", "retries", "restart", "rejected",
+    "accepted", "finished", "terminal", "shed", "tier_hits",
+    "tier_demotions", "scale_", "join_failures", "replicas_max",
+    "fallback", "pull", "exported", "adopted",
+)
+
+
+def is_info_field(key: str) -> bool:
+    """True when an artifact row field is timing/scheduling noise rather than
+    a structural outcome tests may gate on. ``gate_*`` fields are always
+    structural — they exist precisely to be asserted."""
+    if key.startswith("gate_"):
+        return False
+    if key == "ms" or "_ms" in key:
+        return True
+    return any(m in key for m in INFO_FIELD_MARKERS)
+
+
+def write_artifact(path: str, rows, meta: Optional[Dict] = None,
+                   label: str = "A/B") -> str:
+    """Persist benchmark rows as ``{"gated": {...}, "info": {...}}``.
+
+    ``gated`` carries ``meta`` (structural run config: devices, budgets) plus
+    the structural fields of every row; ``info`` carries the generation
+    timestamp, platform, and each row's timing fields. When the file already
+    exists with an identical gated section the rewrite is SKIPPED — the old
+    info (and its timestamp) stays put, so re-running a bench only touches
+    the artifact when something a test could assert on actually changed."""
+    import json
+    import os
+
+    gated_rows, info_rows = [], []
+    for r in rows:
+        g = {k: v for k, v in r.items() if not is_info_field(k)}
+        g.pop("artifact_path", None)   # self-reference, not an outcome
+        gated_rows.append(g)
+        info_rows.append({k: v for k, v in r.items() if is_info_field(k)})
+    gated = dict(meta or {})
+    gated["rows"] = gated_rows
+    try:
+        with open(path) as f:
+            if json.load(f).get("gated") == gated:
+                print(f"  {label} artifact unchanged (gated fields) "
+                      f"-> {path}")
+                return path
+    except (OSError, ValueError):
+        pass
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "w") as f:
+        json.dump({"gated": gated,
+                   "info": {"generated": time.strftime("%Y-%m-%dT%H:%M:%S"),
+                            "platform": jax.devices()[0].platform,
+                            "rows": info_rows}}, f, indent=2)
+    print(f"  {label} artifact -> {path}")
+    return path
+
+
 ROW_FAILED = "row_failed"  # label prefix shared with run_all's rc scan
 
 

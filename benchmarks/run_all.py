@@ -55,6 +55,11 @@ def main(argv=None):
         # — the on row must strictly beat the off twin's goodput-at-SLO
         # and the host-tier probe must beat the no-tier baseline
         results.extend(serve_bench.main(["--spike"]))
+        # disaggregation gate: mixed vs prefill/decode roles vs roles +
+        # real KV-block handoff + fleet prefix directory — the kv row
+        # must beat the mixed twin's chat-tail latency and prove handoff
+        # strictly cheaper than recompute via the deterministic probes
+        results.extend(serve_bench.main(["--disagg"]))
     results = [r for r in results if r]
 
     print("\n== results ==")

@@ -82,6 +82,20 @@ terminal per request, token-exact survivors, and zero leaked blocks in
 every replica's device pool and host tier. Rows persist as
 benchmarks/results/spike_ab_smoke.json.
 
+--disagg runs a disaggregated-serving A/B (bench_disagg): the same
+long-prompt + short-chat mix through a 3-replica Router, all-mixed vs
+prefill/decode roles with recompute-resume handoff vs roles with real
+KV-block handoff + the fleet-wide prefix directory. Prefill is charged a
+per-token cost (FaultPlan.prefill_delay_per_token_s) so long chunks
+genuinely stall co-scheduled decodes; the kv row asserts chat TTFT p99
+and decode-stall p99 strictly improve vs the mixed twin, that every long
+prompt crossed the boundary with zero fault-free fallbacks, token-exact
+streams, and zero leaked blocks — plus two deterministic probes: KV
+handoff strictly cheaper than recompute on the receiver (counted in
+prefill chunks, not wall-clock) and the fleet prefix directory strictly
+beating the per-replica baseline on an identical trace. Rows persist as
+benchmarks/results/disagg_ab_smoke.json.
+
 Both modes end with a bench_load row: sustained closed-loop users plus
 open-loop background arrivals driven through the supervised runtime
 (``EngineSupervisor``) with one injected engine-loop crash — reporting
@@ -97,7 +111,7 @@ import time
 import jax
 import numpy as np
 
-from benchmarks.common import RowRunner, report
+from benchmarks.common import RowRunner, report, write_artifact
 
 
 def bench_serving(model, params, *, num_requests: int, rate_per_s: float,
@@ -729,16 +743,9 @@ def bench_quant(model, params, *, num_requests: int, prompt_len: int,
     if shared is not None:
         shared.setdefault("rows", []).append(row)
         if artifact and variant == "int8_kv_w8":
-            import json
-            import os
-
-            os.makedirs(os.path.dirname(artifact), exist_ok=True)
-            with open(artifact, "w") as f:
-                json.dump({"generated": time.strftime("%Y-%m-%dT%H:%M:%S"),
-                           "platform": jax.devices()[0].platform,
-                           "kv_budget_mb": kv_budget_mb,
-                           "rows": shared["rows"]}, f, indent=2)
-            print(f"  quant A/B artifact -> {artifact}")
+            write_artifact(artifact, shared["rows"],
+                           meta={"kv_budget_mb": kv_budget_mb},
+                           label="quant A/B")
             row["artifact_path"] = artifact
     return row
 
@@ -836,17 +843,10 @@ def bench_tp(model, params, *, num_requests: int, prompt_len: int,
     if shared is not None:
         shared.setdefault("rows", []).append(row)
         if artifact and tp > 1:
-            import json
-            import os
-
-            os.makedirs(os.path.dirname(artifact), exist_ok=True)
-            with open(artifact, "w") as f:
-                json.dump({"generated": time.strftime("%Y-%m-%dT%H:%M:%S"),
-                           "platform": jax.devices()[0].platform,
-                           "devices": jax.device_count(),
-                           "kv_budget_mb": kv_budget_mb,
-                           "rows": shared["rows"]}, f, indent=2)
-            print(f"  tp A/B artifact -> {artifact}")
+            write_artifact(artifact, shared["rows"],
+                           meta={"devices": jax.device_count(),
+                                 "kv_budget_mb": kv_budget_mb},
+                           label="tp A/B")
             row["artifact_path"] = artifact
     return row
 
@@ -1196,16 +1196,8 @@ def bench_straggler(model, params, *, replicas: int, num_requests: int,
                     (f"mitigation did not improve tail TTFT: "
                      f"{row['ttft_ms_p99']} >= {off[0]['ttft_ms_p99']}")
             if artifact:
-                import json
-                import os
-
-                os.makedirs(os.path.dirname(artifact), exist_ok=True)
-                with open(artifact, "w") as f:
-                    json.dump({"generated":
-                               time.strftime("%Y-%m-%dT%H:%M:%S"),
-                               "platform": jax.devices()[0].platform,
-                               "rows": shared["rows"]}, f, indent=2)
-                print(f"  straggler A/B artifact -> {artifact}")
+                write_artifact(artifact, shared["rows"],
+                               label="straggler A/B")
                 row["artifact_path"] = artifact
     return row
 
@@ -1487,16 +1479,445 @@ def bench_spike(model, params, *, num_requests: int, prompt_len: int,
             assert row["tier_probe_hits"] > row["tier_probe_baseline_hits"],\
                 "host tier readmitted nothing on a >HBM working set"
             if artifact:
-                import json
-                import os
+                write_artifact(artifact, shared["rows"], label="spike A/B")
+                row["artifact_path"] = artifact
+    return row
 
-                os.makedirs(os.path.dirname(artifact), exist_ok=True)
-                with open(artifact, "w") as f:
-                    json.dump({"generated":
-                               time.strftime("%Y-%m-%dT%H:%M:%S"),
-                               "platform": jax.devices()[0].platform,
-                               "rows": shared["rows"]}, f, indent=2)
-                print(f"  spike A/B artifact -> {artifact}")
+
+def _handoff_probe(model, params, *, seed=0):
+    """Deterministic KV-handoff cost probe: ONE long prompt through a
+    synchronous 2-replica prefill/decode fleet, once with real KV-block
+    handoff and once degraded to recompute-resume (``handoff_kv=False``).
+    Both runs hand off at the same first-token boundary and must produce
+    tokens identical to a single-engine reference; the receiver-side
+    prefill work is counted exactly (chunks processed, prompt positions
+    admitted straight from adopted KV), so "handoff strictly cheaper than
+    recompute" is a deterministic counter comparison, not a timing race."""
+    from tnn_tpu.serving import EngineSupervisor, InferenceEngine, Router
+
+    rng = np.random.default_rng(seed)
+    prompt = rng.integers(0, model.vocab_size, 40).astype(np.int32)
+
+    ref_eng = InferenceEngine(model, params, num_blocks=64, block_size=4,
+                              max_batch_size=4, max_seq_len=64, seed=seed)
+    ref_rid = ref_eng.submit(prompt, 8)
+    ref = ref_eng.run_until_complete()[ref_rid]
+
+    def run(kv):
+        engines = [InferenceEngine(
+            model, params, num_blocks=64, block_size=4, max_batch_size=4,
+            max_seq_len=64, chunk_size=8, chunked_prefill=True,
+            prefix_cache=True, decode_path="paged", seed=seed)
+            for _ in range(2)]
+        sups = [EngineSupervisor(e, restart_backoff_s=0.0) for e in engines]
+        router = Router(sups, seed=seed, roles=["prefill", "decode"],
+                        disagg_prompt_threshold=16, handoff_kv=kv)
+        out = {}
+
+        def listener(ev):
+            if ev["event"] == "done":
+                out["tokens"] = ev["tokens"]
+
+        router.submit(prompt, 8, listener=listener)
+        router.run_sync()
+        assert router.stats()["boundary_handoffs"] == 1, \
+            "probe request never crossed the prefill->decode boundary"
+        recv = engines[1].metrics.summary()
+        for i, e in enumerate(engines):
+            assert e.pool.num_allocated == 0, f"probe replica {i} leaked"
+            e.check_invariants()
+        return out["tokens"], recv
+
+    kv_toks, kv_recv = run(True)
+    rc_toks, rc_recv = run(False)
+    assert kv_toks == ref and rc_toks == ref, \
+        "handoff probe streams diverged from the single-engine reference"
+    cheaper = (kv_recv["prefill_chunks"] < rc_recv["prefill_chunks"]
+               and kv_recv["prefill_tokens_saved"]
+               > rc_recv["prefill_tokens_saved"])
+    assert cheaper, (
+        f"KV handoff not strictly cheaper than recompute-resume: receiver "
+        f"chunks {kv_recv['prefill_chunks']} vs {rc_recv['prefill_chunks']}, "
+        f"tokens from adopted KV {kv_recv['prefill_tokens_saved']} vs "
+        f"{rc_recv['prefill_tokens_saved']}")
+    return {"handoff_probe_recv_chunks_kv": int(kv_recv["prefill_chunks"]),
+            "handoff_probe_recv_chunks_recompute":
+                int(rc_recv["prefill_chunks"]),
+            "handoff_probe_tokens_from_kv":
+                int(kv_recv["prefill_tokens_saved"]),
+            "gate_handoff_cheaper": int(cheaper)}
+
+
+def _fleet_prefix_probe(model, params, *, seed=0):
+    """Deterministic fleet-prefix-cache probe. A 12-token "system prompt"
+    request runs wholly on the prefill replica (max_new=1, so it never
+    crosses the boundary) and publishes the shared two-block prefix there;
+    three 11-token requests sharing the same prefix then land on the decode
+    replica (below the disagg threshold). Directory off, the decode
+    replica's first request cold-misses and recomputes the prefix;
+    directory on, the router pulls the publisher's blocks across, so the
+    aggregate fleet hit count is strictly higher on an otherwise identical,
+    token-exact trace."""
+    from tnn_tpu.serving import EngineSupervisor, InferenceEngine, Router
+
+    rng = np.random.default_rng(seed)
+    prefix = rng.integers(0, model.vocab_size, 8).astype(np.int32)
+    sys_prompt = np.concatenate(
+        [prefix, rng.integers(0, model.vocab_size, 4).astype(np.int32)])
+    shorts = [np.concatenate([prefix, rng.integers(
+        0, model.vocab_size, 3).astype(np.int32)]) for _ in range(3)]
+
+    ref_eng = InferenceEngine(model, params, num_blocks=64, block_size=4,
+                              max_batch_size=4, max_seq_len=32, seed=seed)
+    refs = []
+    for p, n in [(sys_prompt, 1)] + [(p, 4) for p in shorts]:
+        rid = ref_eng.submit(p, n)
+        refs.append(ref_eng.run_until_complete()[rid])
+
+    def run(fleet):
+        engines = [InferenceEngine(
+            model, params, num_blocks=64, block_size=4, max_batch_size=4,
+            max_seq_len=32, chunk_size=8, chunked_prefill=True,
+            prefix_cache=True, decode_path="paged", seed=seed)
+            for _ in range(2)]
+        sups = [EngineSupervisor(e, restart_backoff_s=0.0) for e in engines]
+        router = Router(sups, seed=seed, roles=["prefill", "decode"],
+                        disagg_prompt_threshold=12, fleet_prefix=fleet)
+        toks = []
+        for p, n in [(sys_prompt, 1)] + [(p, 4) for p in shorts]:
+            out = {}
+
+            def listener(ev, out=out):
+                if ev["event"] == "done":
+                    out["tokens"] = ev["tokens"]
+
+            router.submit(p, n, listener=listener)
+            router.run_sync()
+            toks.append(out["tokens"])
+            # the monitor thread owns directory refreshes in a live fleet;
+            # the sync probe drives them by hand between requests
+            router._refresh_prefix_dir()
+        hits = sum(e.metrics.summary()["prefix_hits"] for e in engines)
+        pulls = router.stats()["fleet_prefix_pulls"]
+        for i, e in enumerate(engines):
+            assert e.pool.num_allocated == 0, f"probe replica {i} leaked"
+            e.check_invariants()
+        return toks, hits, pulls
+
+    on_toks, on_hits, on_pulls = run(True)
+    off_toks, off_hits, off_pulls = run(False)
+    assert on_toks == refs and off_toks == refs, \
+        "fleet prefix probe streams diverged from the reference"
+    assert off_pulls == 0
+    assert on_pulls >= 1, "fleet prefix directory never pulled a block"
+    assert on_hits > off_hits, (
+        f"fleet prefix cache did not beat the per-replica baseline: "
+        f"{on_hits} hits vs {off_hits}")
+    return {"fleet_probe_hits": int(on_hits),
+            "fleet_probe_baseline_hits": int(off_hits),
+            "fleet_probe_pulls": int(on_pulls),
+            "gate_fleet_hit_rate": int(on_hits > off_hits)}
+
+
+def bench_disagg(model, params, *, variant: str, n_long: int = 6,
+                 n_chat: int = 12, long_len: int = 40, max_new_long: int = 6,
+                 max_new_chat: int = 8, num_blocks: int = 64,
+                 block_size: int = 4, max_batch_size: int = 6,
+                 chunk_size: int = 32, step_delay_s: float = 0.004,
+                 prefill_delay_per_token_s: float = 0.02,
+                 gap_s: float = 0.012, slo_ttft_s: float = 0.5,
+                 label: str = "serve_disagg", seed: int = 0,
+                 shared=None, artifact=None):
+    """Disaggregated-serving A/B row: a long-prompt + short-chat mix through
+    a 3-replica ``Router``, once all-mixed (``variant="mixed"``), once with
+    static prefill/decode roles but handoff degraded to recompute-resume
+    (``"recompute"``), and once with real KV-block handoff plus the
+    fleet-wide prefix directory (``"kv"``).
+
+    Engines charge prefill a per-token cost (``prefill_delay_per_token_s``,
+    the same realistic-cost trick as bench_spike's ``step_delay_s``), so a
+    long prefill chunk genuinely stalls whatever decodes share its step. In
+    the mixed fleet every replica interleaves long prefills with chat
+    decodes; with roles, chat requests land on decode replicas and long
+    prompts hand off at the first-token boundary, so chat TTFT p99 and
+    decode-stall p99 improve — the "kv" row asserts both against the mixed
+    twin. Every row asserts the correctness contract: exactly one terminal
+    per request, all requests FINISHED token-exact vs a single-engine
+    reference, boundary handoffs fired for every long prompt in the disagg
+    rows, and zero leaked blocks in every replica's pool. The "kv" row adds
+    the two deterministic probes (:func:`_handoff_probe` — handoff strictly
+    cheaper than recompute on the receiver; :func:`_fleet_prefix_probe` —
+    fleet directory beats the per-replica baseline) and persists all rows
+    via :func:`benchmarks.common.write_artifact`."""
+    import threading
+
+    from tnn_tpu.serving import (EngineSupervisor, FaultPlan,
+                                 InferenceEngine, Router, ServingMetrics)
+
+    roles = (None if variant == "mixed"
+         else ["prefill", "decode", "decode", "decode"])
+    print(f"{label}: {n_long} long ({long_len} tok) + {n_chat} chat prompts, "
+          f"variant={variant}" + ("" if roles is None else f", roles={roles}"))
+    rng = np.random.default_rng(seed)
+    # chat prompts share four 8-token (two-block) "system prompt" prefixes;
+    # long prompts are distinct — their win is the boundary handoff
+    n_groups = 4
+    prefixes = [rng.integers(0, model.vocab_size,
+                             2 * block_size).astype(np.int32)
+                for _ in range(n_groups)]
+    longs = [rng.integers(0, model.vocab_size, long_len).astype(np.int32)
+             for _ in range(n_long)]
+    chats = [np.concatenate([prefixes[i % n_groups], rng.integers(
+        0, model.vocab_size, block_size).astype(np.int32)])
+        for i in range(n_chat)]
+    # interleaved arrival order: one long, then two chats, repeating
+    prompts, kinds = [], []
+    li, ci = 0, 0
+    while li < n_long or ci < n_chat:
+        if li < n_long:
+            prompts.append((longs[li], max_new_long))
+            kinds.append("long")
+            li += 1
+        for _ in range(2):
+            if ci < n_chat:
+                prompts.append((chats[ci], max_new_chat))
+                kinds.append("chat")
+                ci += 1
+    max_seq = long_len + max_new_long + block_size
+
+    ref_engine = InferenceEngine(
+        model, params, num_blocks=num_blocks, block_size=block_size,
+        max_batch_size=max_batch_size, max_seq_len=max_seq, seed=seed)
+    ref = []
+    for p, mn in prompts:
+        rid = ref_engine.submit(p, mn)
+        ref.append(ref_engine.run_until_complete()[rid])
+
+    wprompt = np.random.default_rng(seed + 1).integers(
+        0, model.vocab_size, long_len).astype(np.int32)
+
+    def mk_engine():
+        return InferenceEngine(
+            model, params, num_blocks=num_blocks, block_size=block_size,
+            max_batch_size=max_batch_size, max_seq_len=max_seq,
+            chunk_size=chunk_size, chunked_prefill=True, prefix_cache=True,
+            decode_path="paged", seed=seed)
+
+    engines = [mk_engine() for _ in range(4)]
+    # Warm EVERY step shape the measured mix will execute, per replica.
+    # On this eager CPU host each first-seen step signature — a prefill
+    # chunk length, a decode batch row count, the adopt/export block
+    # moves, the kv variant's resume-with-prefix-hit tail chunk —
+    # compiles for multiple SECONDS, and a compile landing
+    # mid-measurement pauses the engine loop and is charged as a
+    # decode stall to whatever chat streams are co-resident. A steady-
+    # state fleet never sees those one-time costs, so the A/B must not
+    # either. The warmup prompts come from a different rng stream than
+    # the workload, so no seeded block can serve a measured request.
+    wrng = np.random.default_rng(seed + 1)
+    wchats = [wrng.integers(0, model.vocab_size,
+                            3 * block_size).astype(np.int32)
+              for _ in range(3)]
+    # wprompt + one token is exactly the post-handoff resume shape (a
+    # full-chain prefix hit with a 1-token uncovered tail); the fresh
+    # 41-token prompt is the recompute-resume shape (prompt + first
+    # token re-prefilled from scratch)
+    wresume = np.concatenate(
+        [wprompt, wrng.integers(0, model.vocab_size, 1).astype(np.int32)])
+    wrecompute = wrng.integers(0, model.vocab_size,
+                               long_len + 1).astype(np.int32)
+    wdonor = wrng.integers(0, model.vocab_size, long_len).astype(np.int32)
+    # chats re-hitting a resident system prompt prefill only their tail
+    # (a one-block pow2 bucket no full prompt ever compiles)
+    whits = [np.concatenate(
+        [wchats[0][:2 * block_size],
+         wrng.integers(0, model.vocab_size, block_size).astype(np.int32)])
+        for _ in range(2)]
+    # a second 1-token-tail resume (distinct last token, same warmed
+    # chain) plus a chat to hold in decode while it admits — see below
+    wtail = np.concatenate(
+        [wprompt, wrng.integers(0, model.vocab_size, 1).astype(np.int32)])
+    wtail_chat = wrng.integers(0, model.vocab_size,
+                               3 * block_size).astype(np.int32)
+    for i, eng in enumerate(engines):
+        wids = [eng.submit(wprompt, 2)]
+        eng.run_until_complete()
+        if i == 0:
+            # the donor chain exists ONLY on engine 0, so the other
+            # replicas' adopts below do real verified writes
+            wids.append(eng.submit(wdonor, 2))
+            eng.run_until_complete()
+            wire = eng.export_prefix(wdonor)
+        # concurrent mix: resume shapes + chats drive every decode
+        # batch row count up to max_batch_size and every chunk-width
+        # bucket, both solo and co-scheduled with decodes
+        wids.append(eng.submit(wresume, 2))
+        wids.append(eng.submit(wrecompute, 2))
+        wids += [eng.submit(c, 2) for c in wchats]
+        wids.append(eng.submit(whits[0], 2))
+        eng.run_until_complete()
+        wids.append(eng.submit(whits[1], 2))
+        eng.run_until_complete()
+        # a handed-off resume admits as a ONE-token chunk (its whole
+        # prompt is a prefix hit) while chat decodes are already live —
+        # a ('mixed', b, qw=1, nb) signature none of the packs above
+        # trace, because wresume always co-admits with a wider chunk.
+        # Park a chat in steady-state decode first, then admit the
+        # 1-token tail against it.
+        wids.append(eng.submit(wtail_chat, 6))
+        for _ in range(3):
+            eng.step()
+        wids.append(eng.submit(wtail, 2))
+        eng.run_until_complete()
+        for w in wids:
+            del eng.requests[w]
+    for eng in engines[1:]:
+        eng.adopt_prefix(wire)
+        eng.export_prefix(wprompt)   # decode replicas export fleet pulls
+    for eng in engines:
+        eng.metrics = ServingMetrics(eng.profiler, slo_ttft_s=slo_ttft_s)
+        # realistic cost model (applied AFTER warmup): decode steps cost
+        # step_delay_s; prefill chunks additionally cost
+        # prefill_delay_per_token_s per prompt token, so a monolithic
+        # long chunk visibly stalls co-scheduled decodes the way a real
+        # forward pass would
+        eng.faults = FaultPlan()
+        eng.faults.step_delay_s = float(step_delay_s)
+        eng.faults.prefill_delay_per_token_s = \
+            float(prefill_delay_per_token_s)
+    sups = [EngineSupervisor(e, max_restarts=3, restart_backoff_s=0.0,
+                             drain_deadline_s=60.0) for e in engines]
+    # gray-failure mitigation (hedging/ejection) off for EVERY variant:
+    # the A/B isolates the placement policy, and on an oversubscribed CPU
+    # host the adaptive hedge threshold fires on ordinary queueing noise,
+    # migrating streams mid-flight and swamping the stall/TTFT tails with
+    # multi-second recompute gaps unrelated to disaggregation
+    rkw = dict(hedge_budget=0.0, degrade_factor=0.0)
+    if roles is not None:
+        rkw.update(roles=roles, disagg_prompt_threshold=long_len // 2,
+                   handoff_kv=(variant == "kv"),
+                   fleet_prefix=(variant == "kv"))
+    router = Router(sups, seed=seed, **rkw)
+
+    lock = threading.Lock()
+    terminals, done, times = {}, {}, {}
+
+    def mk_listener():
+        def listener(ev):
+            with lock:
+                if ev["event"] == "token":
+                    times.setdefault(ev["id"], []).append(
+                        time.perf_counter())
+                    return
+                terminals[ev["id"]] = terminals.get(ev["id"], 0) + 1
+                if ev["event"] == "done":
+                    done[ev["id"]] = ev
+        return listener
+
+    router.start()
+    t0 = time.perf_counter()
+    gids, owner = [], {}
+    for i, (p, mn) in enumerate(prompts):
+        time.sleep(gap_s)
+        g = router.submit(p, mn, listener=mk_listener())
+        gids.append(g)
+        owner[g] = i
+    deadline = time.monotonic() + 120.0
+    while True:
+        with lock:
+            if sum(terminals.values()) >= len(gids):
+                break
+        if time.monotonic() > deadline:
+            raise RuntimeError(
+                f"disagg bench wedged: {sum(terminals.values())}"
+                f"/{len(gids)} terminal")
+        time.sleep(0.01)
+    wall = time.perf_counter() - t0
+    st = router.stats()
+    router.request_drain("bench complete")
+    if not router.join(timeout=60):
+        raise RuntimeError("router failed to drain")
+
+    # the disaggregation contract IS the gate
+    assert all(terminals.get(g, 0) == 1 for g in gids), \
+        "duplicated or missing terminal events"
+    assert len(done) == len(gids), \
+        f"only {len(done)}/{len(gids)} requests FINISHED"
+    exact = int(all(done[g]["tokens"] == ref[owner[g]] for g in gids))
+    assert exact, "a disaggregated stream diverged from the reference"
+    for i, eng in enumerate(engines):
+        assert eng.pool.num_allocated == 0, f"replica {i} leaked KV blocks"
+        eng.check_invariants()
+    if roles is not None:
+        assert st["boundary_handoffs"] == n_long, \
+            (f"expected every long prompt to cross the prefill->decode "
+             f"boundary: {st['boundary_handoffs']} != {n_long}")
+        if variant == "kv":
+            assert st["handoff_fallbacks"] == 0, \
+                "a fault-free KV handoff degraded to recompute-resume"
+    adopted = sum(e.metrics.summary()["handoff_adopted_blocks"]
+                  for e in engines)
+    if variant == "kv":
+        assert adopted > 0, "KV handoff never moved a block"
+
+    chat_gids = [g for g in gids if kinds[owner[g]] == "chat"]
+    chat_ttfts = np.array([done[g]["ttft_ms"] for g in chat_gids], float)
+    ttfts = np.array([done[g]["ttft_ms"] for g in gids], float)
+    stalls = []   # inter-token gaps of chat decode streams, ms
+    for g in chat_gids:
+        ts = times.get(g, [])
+        stalls.extend(
+            [(b - a) * 1e3 for a, b in zip(ts, ts[1:])])
+    stalls = np.array(stalls or [0.0], float)
+    row = report(
+        label, wall, items=len(gids), item_name="req",
+        extra={"requests": len(gids),
+               "n_long": n_long,
+               "n_chat": n_chat,
+               "disagg": int(roles is not None),
+               "kv_handoff": int(variant == "kv"),
+               "fleet_prefix": int(variant == "kv"),
+               "ttft_ms_p50": round(float(np.percentile(ttfts, 50)), 3),
+               "ttft_ms_p99": round(float(np.percentile(ttfts, 99)), 3),
+               "chat_ttft_ms_p99":
+                   round(float(np.percentile(chat_ttfts, 99)), 3),
+               "decode_stall_ms_p50":
+                   round(float(np.percentile(stalls, 50)), 3),
+               "decode_stall_ms_p99":
+                   round(float(np.percentile(stalls, 99)), 3),
+               "boundary_handoffs": st["boundary_handoffs"],
+               "handoff_fallbacks": st["handoff_fallbacks"],
+               "fleet_prefix_pulls": st["fleet_prefix_pulls"],
+               "handoff_adopted_blocks": adopted,
+               "exact_vs_ref": exact,
+               "terminal": int(sum(terminals.values()))})
+    if shared is not None:
+        shared.setdefault("rows", []).append(row)
+        if variant == "kv":
+            mixed = [r for r in shared["rows"] if not r.get("disagg")]
+            if mixed:
+                assert (row["chat_ttft_ms_p99"]
+                        < mixed[0]["chat_ttft_ms_p99"]), \
+                    (f"disaggregation did not improve chat tail TTFT: "
+                     f"{row['chat_ttft_ms_p99']} >= "
+                     f"{mixed[0]['chat_ttft_ms_p99']}")
+                assert (row["decode_stall_ms_p99"]
+                        < mixed[0]["decode_stall_ms_p99"]), \
+                    (f"disaggregation did not improve decode-stall p99: "
+                     f"{row['decode_stall_ms_p99']} >= "
+                     f"{mixed[0]['decode_stall_ms_p99']}")
+                row["gate_chat_ttft_p99_improved"] = 1
+                row["gate_decode_stall_p99_improved"] = 1
+            if "handoff_probe" not in shared:
+                shared["handoff_probe"] = _handoff_probe(
+                    model, params, seed=seed)
+            if "fleet_probe" not in shared:
+                shared["fleet_probe"] = _fleet_prefix_probe(
+                    model, params, seed=seed)
+            row.update(shared["handoff_probe"])
+            row.update(shared["fleet_probe"])
+            if artifact:
+                write_artifact(artifact, shared["rows"], label="disagg A/B")
                 row["artifact_path"] = artifact
     return row
 
@@ -1650,6 +2071,15 @@ def main(argv=None):
                          "device pool and host tier, and a deterministic "
                          "host-tier hit-rate probe beating the no-tier "
                          "baseline")
+    ap.add_argument("--disagg", action="store_true",
+                    help="tiny model through a 3-replica Router: all-mixed "
+                         "vs prefill/decode roles (recompute-resume) vs "
+                         "roles + real KV-block handoff + fleet prefix "
+                         "directory, asserting the kv row's chat TTFT p99 "
+                         "and decode-stall p99 beat the mixed twin, "
+                         "token-exact streams, zero leaked blocks, and the "
+                         "deterministic handoff-cheaper / fleet-hit-rate "
+                         "probes")
     ap.add_argument("--tp", action="store_true",
                     help="tiny model, tp=1 vs tp=2 tensor-parallel A/B on "
                          "the paged path: asserts the tp row's streams are "
@@ -1692,6 +2122,24 @@ def main(argv=None):
                 num_blocks=32, block_size=4, max_batch_size=4, tp=d,
                 label=f"serve_tp{d}", shared=tshared, artifact=art),
                 label=f"bench_tp_{deg}")
+        return rr.results
+    if args.disagg:
+        # disaggregated-serving A/B: the same long+chat mix all-mixed, with
+        # prefill/decode roles but recompute-resume handoff, and with real
+        # KV-block handoff + the fleet prefix directory — the kv row gates
+        # the tail-latency wins vs the mixed twin and both deterministic
+        # probes (handoff cheaper than recompute; fleet cache beats the
+        # per-replica baseline), then persists all three rows
+        model, params = _smoke_model()
+        dshared = {}
+        import os
+        art = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "results", "disagg_ab_smoke.json")
+        for var in ("mixed", "recompute", "kv"):
+            rr.add(lambda v=var: bench_disagg(
+                model, params, variant=v, shared=dshared, artifact=art,
+                label=f"serve_disagg_{v}"),
+                label=f"bench_disagg_{var}")
         return rr.results
     if args.spike:
         # elastic-fleet A/B: the same trickle-then-burst trace through

@@ -103,6 +103,11 @@ _SLOW_TESTS = {
     "test_full_composition_exact", "test_preemption_parity",
     "test_sampled_rows_deterministic", "test_debug_sync_clean",
     "test_supervisor_crash_restart_exact", "test_chaos_gate_per_shard",
+    # disaggregation: the composed-chaos PR gate runs two full 3-replica
+    # fleets per decode path (~25 s each); the per-mechanism handoff
+    # tests (boundary exactness, corrupt/slow/pressure degradation,
+    # receiver death, fleet pulls) stay tier-1
+    "test_disagg_composed_chaos_token_exact", "test_serve_bench_disagg",
 }
 
 

@@ -237,13 +237,18 @@ def test_serve_bench_smoke():
         assert abs(r["ppl_delta"]) <= 0.1 * qf32["ppl"]
         assert r["tok_per_s"] >= qf32["tok_per_s"] * 0.7
     assert qf32["ppl_delta"] == 0.0
-    # the smoke artifact persisted and re-parses with all three rows
+    # the smoke artifact persisted with the gated/info split: structural
+    # fields (bench names, config echoes) under "gated", timing noise under
+    # "info" — tests assert only the former, so re-runs don't churn diffs
     import json
     with open(qw8["artifact_path"]) as f:
         art = json.load(f)
-    assert [r["bench"] for r in art["rows"]] == [
+    assert [r["bench"] for r in art["gated"]["rows"]] == [
         "serve_smoke_quant_f32", "serve_smoke_quant_int8_kv",
         "serve_smoke_quant_int8_kv_w8"]
+    assert art["gated"]["kv_budget_mb"] > 0
+    assert "generated" in art["info"] and "platform" in art["info"]
+    assert not any("_ms" in k for row in art["gated"]["rows"] for k in row)
 
 
 @pytest.mark.tp
@@ -284,9 +289,13 @@ def test_serve_bench_tp(tp):
     assert os.path.exists(art)
     with open(art) as f:
         payload = json.load(f)
-    assert [row["bench"] for row in payload["rows"]] == [
+    assert [row["bench"] for row in payload["gated"]["rows"]] == [
         "serve_tp1", "serve_tp2"]
-    assert payload["devices"] >= 2
+    assert payload["gated"]["devices"] >= 2
+    # timing lives in the informational section so re-runs don't churn
+    assert "generated" in payload["info"]
+    assert not any(k.endswith("_ms") or k == "ms"
+                   for row in payload["gated"]["rows"] for k in row)
 
 
 def test_serve_bench_chaos():
@@ -352,7 +361,7 @@ def test_serve_bench_straggler():
     assert os.path.exists(art)
     with open(art) as f:
         payload = json.load(f)
-    assert [row["bench"] for row in payload["rows"]] == [
+    assert [row["bench"] for row in payload["gated"]["rows"]] == [
         "serve_straggler_off", "serve_straggler_on"]
 
 
@@ -403,8 +412,103 @@ def test_serve_bench_spike():
     assert os.path.exists(art)
     with open(art) as f:
         payload = json.load(f)
-    assert [row["bench"] for row in payload["rows"]] == [
+    assert [row["bench"] for row in payload["gated"]["rows"]] == [
         "serve_spike_off", "serve_spike_on"]
+
+
+@pytest.mark.slow
+def test_serve_bench_disagg():
+    """The --disagg A/B is the benchmark-shaped disaggregation gate: the
+    same long+chat mix all-mixed, with prefill/decode roles but
+    recompute-resume handoff, and with real KV-block handoff + the fleet
+    prefix directory. bench_disagg self-asserts the timing wins (chat
+    TTFT p99 and decode-stall p99 improve vs the mixed twin) and both
+    deterministic probes (handoff strictly cheaper than recompute on the
+    receiver; fleet prefix cache strictly beats the per-replica
+    baseline); here we gate the row shapes, the handoff/probe evidence,
+    token-exactness, and that the persisted artifact re-parses with
+    timing confined to its info section. Slow lane: three full router
+    runs plus two probe fleets."""
+    import json
+    import os
+
+    from benchmarks import serve_bench
+
+    results = [r for r in serve_bench.main(["--disagg"]) if r]
+    assert [r["bench"] for r in results] == [
+        "serve_disagg_mixed", "serve_disagg_recompute", "serve_disagg_kv"]
+    mixed, rc, kv = results
+    for r in results:
+        assert r["ms"] > 0
+        assert r["requests"] == 18 and r["terminal"] == 18
+        assert r["n_long"] == 6 and r["n_chat"] == 12
+        assert r["exact_vs_ref"] == 1   # token-exact even across handoffs
+        assert r["ttft_ms_p99"] >= r["ttft_ms_p50"] > 0
+    # the mixed row proves the off-switch: no roles, nothing crosses
+    assert mixed["disagg"] == 0 and mixed["boundary_handoffs"] == 0
+    assert mixed["handoff_adopted_blocks"] == 0
+    # both disaggregated rows actually hand every long over
+    for r in (rc, kv):
+        assert r["disagg"] == 1 and r["boundary_handoffs"] >= 1
+    assert rc["kv_handoff"] == 0 and rc["handoff_adopted_blocks"] == 0
+    # the kv row proves the wire path AND the wins (self-asserted gates)
+    assert kv["kv_handoff"] == 1 and kv["fleet_prefix"] == 1
+    assert kv["handoff_fallbacks"] == 0    # fault-free run never degrades
+    assert kv["handoff_adopted_blocks"] > 0
+    assert kv["gate_chat_ttft_p99_improved"] == 1
+    assert kv["gate_decode_stall_p99_improved"] == 1
+    # deterministic handoff probe: adopting beats recomputing
+    assert kv["gate_handoff_cheaper"] == 1
+    assert (kv["handoff_probe_recv_chunks_kv"]
+            < kv["handoff_probe_recv_chunks_recompute"])
+    assert kv["handoff_probe_tokens_from_kv"] > 0
+    # deterministic fleet-prefix probe: directory pulls raise hits
+    assert kv["gate_fleet_hit_rate"] == 1
+    assert kv["fleet_probe_hits"] > kv["fleet_probe_baseline_hits"]
+    assert kv["fleet_probe_pulls"] >= 1
+    art = kv["artifact_path"]
+    assert os.path.exists(art)
+    with open(art) as f:
+        payload = json.load(f)
+    assert [row["bench"] for row in payload["gated"]["rows"]] == [
+        "serve_disagg_mixed", "serve_disagg_recompute", "serve_disagg_kv"]
+    # timing stays in info: a re-run must not churn the gated section
+    assert not any(k == "ms" or "_ms" in k
+                   for row in payload["gated"]["rows"] for k in row)
+    assert "generated" in payload["info"]
+
+
+def test_write_artifact_gated_info_split(tmp_path):
+    """write_artifact splits rows into asserted structure vs timing noise
+    and skips the rewrite when nothing structural moved — the contract
+    every serve_bench artifact test leans on."""
+    import json
+
+    from benchmarks.common import write_artifact
+
+    path = str(tmp_path / "ab.json")
+    row = {"bench": "x", "ms": 12.5, "ttft_ms_p99": 3.0, "req_per_s": 8.0,
+           "exact_vs_ref": 1, "gate_win": 1, "artifact_path": "self"}
+    write_artifact(path, [row], meta={"devices": 1}, label="t")
+    with open(path) as f:
+        p1 = json.load(f)
+    assert p1["gated"]["devices"] == 1
+    assert p1["gated"]["rows"] == [
+        {"bench": "x", "exact_vs_ref": 1, "gate_win": 1}]
+    assert p1["info"]["rows"] == [
+        {"ms": 12.5, "ttft_ms_p99": 3.0, "req_per_s": 8.0}]
+    # a timing-only change must not rewrite the file (no diff churn)
+    write_artifact(path, [dict(row, ms=99.0, ttft_ms_p99=7.0)],
+                   meta={"devices": 1}, label="t")
+    with open(path) as f:
+        assert json.load(f) == p1
+    # a structural change does rewrite
+    write_artifact(path, [dict(row, exact_vs_ref=0)],
+                   meta={"devices": 1}, label="t")
+    with open(path) as f:
+        p3 = json.load(f)
+    assert p3["gated"]["rows"][0]["exact_vs_ref"] == 0
+    assert p3["info"]["rows"][0]["ms"] == 12.5  # rewritten wholesale
 
 
 @pytest.mark.slow

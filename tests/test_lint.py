@@ -554,6 +554,57 @@ class Engine:
         return self.kv_tier.stats()
 ''', "tier-adopt-unverified") == []
 
+    # -- cross-replica wire adoption: adopt_blocks on ANY receiver ------------
+
+    def test_wire_adopt_without_verification_flags(self):
+        # writing wire bytes into device pages with no digest check in
+        # the enclosing function — the disaggregation handoff hole
+        assert _rules('''
+class Engine:
+    def adopt_prefix(self, exports):
+        for key, leaves, digest in exports:
+            blk = self.pool.alloc(1)
+            self.pool.adopt_blocks([(blk[0], leaves[0], leaves[1])],
+                                   fn, put)
+''', "tier-adopt-unverified") == ["tier-adopt-unverified"]
+
+    def test_wire_adopt_with_tier_digest_clean(self):
+        assert _rules('''
+class Engine:
+    def adopt_prefix(self, exports):
+        for key, leaves, digest in exports:
+            if tier_digest(key, leaves) != digest:
+                break
+            blk = self.pool.alloc(1)
+            self.pool.adopt_blocks([(blk[0], leaves[0], leaves[1])],
+                                   fn, put)
+''', "tier-adopt-unverified") == []
+
+    def test_wire_adopt_with_verify_readmit_clean(self):
+        # tier re-admission path: verify_readmit IS the digest check
+        assert _rules('''
+class Engine:
+    def readmit(self, key):
+        leaves = self.kv_tier.verify_readmit(key)
+        if leaves is not None:
+            self.pool.adopt_blocks([(3, leaves[0], leaves[1])], fn, put)
+''', "tier-adopt-unverified") == []
+
+    def test_wire_adopt_helper_indirection_still_flags(self):
+        # the check must be visible AT the adoption site: a verification
+        # call in a DIFFERENT function does not sanctify this one
+        assert _rules('''
+def checked(key, leaves, digest):
+    return tier_digest(key, leaves) == digest
+
+class Engine:
+    def adopt_prefix(self, exports):
+        for key, leaves, digest in exports:
+            if not checked(key, leaves, digest):
+                break
+            self.pool.adopt_blocks([(3, leaves[0], leaves[1])], fn, put)
+''', "tier-adopt-unverified") == ["tier-adopt-unverified"]
+
 
 class TestUnregisteredMetricKey:
     REGISTRY = '''

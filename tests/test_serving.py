@@ -4223,3 +4223,443 @@ def test_spike_soak_elastic_fleet(tiny_lm):
             h.sup.engine.check_invariants()   # includes the tier's
         if h.sup.engine.kv_tier is not None:
             h.sup.engine.kv_tier.check_invariants()
+
+
+# -- disaggregated prefill/decode serving -------------------------------------
+
+
+class TestDisagg:
+    """Prefill/decode disaggregation through the deterministic sync
+    harness: role placement, the first-token boundary handoff (real KV
+    wire transfer and the recompute-resume baseline), chaos degradation
+    (corrupt/slow wire blocks, a receiver dying mid-adopt), and the
+    fleet-wide shared prefix cache."""
+
+    KW = dict(num_blocks=64, block_size=4, max_batch_size=4, max_seq_len=64,
+              chunk_size=8, chunked_prefill=True, prefix_cache=True,
+              decode_path="paged")
+    THRESH = 16
+
+    def _fleet(self, tiny_lm, n=2, *, plans=None, router_kw=None,
+               engine_kw=None, sup_kw=None):
+        model, params = tiny_lm
+        ekw = dict(self.KW)
+        ekw.update(engine_kw or {})
+        skw = dict(restart_backoff_s=0.0)
+        skw.update(sup_kw or {})
+        plans = plans or [None] * n
+        sups = [EngineSupervisor(
+                    InferenceEngine(model, params, faults=plans[i], **ekw),
+                    **skw)
+                for i in range(n)]
+        rkw = dict(roles=["prefill"] + ["decode"] * (n - 1),
+                   disagg_prompt_threshold=self.THRESH)
+        rkw.update(router_kw or {})
+        events = []
+        router = Router(sups, event_sink=events.append, seed=0, **rkw)
+        return router, sups, events
+
+    def _long(self, rng, max_new=6):
+        return rng.integers(0, 128, self.THRESH + 8).astype(np.int32), max_new
+
+    @staticmethod
+    def _terminals(events):
+        return [e for e in events if e["event"] != "token"]
+
+    def _no_leaks(self, router, skip=()):
+        for h in router.replicas:
+            if h.idx in skip:
+                continue
+            assert h.sup.engine.pool.num_allocated == 0
+            h.sup.engine.check_invariants()
+
+    def test_roles_validation(self, tiny_lm):
+        model, params = tiny_lm
+        sups = [EngineSupervisor(InferenceEngine(model, params, **self.KW),
+                                 restart_backoff_s=0.0) for _ in range(2)]
+        with pytest.raises(ValueError, match="every replica"):
+            Router(sups, roles=["prefill"])
+        with pytest.raises(ValueError, match="unknown replica role"):
+            Router(sups, roles=["prefill", "gpu"])
+        with pytest.raises(ValueError, match="at least one decode"):
+            Router(sups, roles=["prefill", "prefill"])
+
+    @pytest.mark.parametrize("path", ["standard", "paged"])
+    @pytest.mark.parametrize("kv", [True, False])
+    def test_boundary_handoff_token_exact(self, tiny_lm, path, kv):
+        """The tentpole, both decode paths: a long prompt lands on the
+        prefill replica, crosses to the decode replica at the first-token
+        boundary (KV wire transfer or recompute-resume), and the client
+        sees one uninterrupted token-exact stream."""
+        model, params = tiny_lm
+        router, sups, events = self._fleet(
+            tiny_lm, router_kw=dict(handoff_kv=kv),
+            engine_kw=dict(decode_path=path))
+        rng = np.random.default_rng(11)
+        lp, ln = self._long(rng)
+        sp = rng.integers(0, 128, 6).astype(np.int32)
+        alen = sups[0].engine.assembly_len
+        refs = [_greedy_ref(model, params, lp, ln, alen),
+                _greedy_ref(model, params, sp, 5, alen)]
+        glong = router.submit(lp, ln)
+        gshort = router.submit(sp, 5)
+        # role placement: the long prompt prefers the prefill replica,
+        # the short one the decode replica
+        assert glong in router.replicas[0].live
+        assert gshort in router.replicas[1].live
+        router.run_sync()
+        term = {e["id"]: e for e in self._terminals(events)}
+        assert term[glong]["event"] == "done"
+        assert term[glong]["tokens"] == refs[0]
+        assert term[gshort]["tokens"] == refs[1]
+        streamed = [e["token"] for e in events
+                    if e["event"] == "token" and e["id"] == glong]
+        assert streamed == refs[0]     # no token duplicated or dropped
+        st = router.stats()
+        assert st["boundary_handoffs"] == 1
+        recv = sups[1].engine.metrics.summary()
+        if kv:
+            assert st["handoff_fallbacks"] == 0
+            assert recv["handoff_adopted_blocks"] > 0
+            # the resume prefill hit the adopted blocks instead of
+            # recomputing them
+            assert recv["prefill_tokens_saved"] > 0
+        else:
+            assert recv["handoff_adopted_blocks"] == 0
+        self._no_leaks(router)
+
+    def test_boundary_handoff_overlap_single_chunk_ships_kv(self, tiny_lm):
+        """Overlap defers prefix publishes to idle time; a single-chunk
+        long prompt commits its whole chain AND its first token in the
+        same tick, so the boundary export races the deferred publish and
+        (before the fix) found nothing resident — every handoff silently
+        degraded to recompute-resume. export_prefix now drains the
+        deferred queue first; the wire must actually ship."""
+        model, params = tiny_lm
+        router, sups, events = self._fleet(
+            tiny_lm, router_kw=dict(handoff_kv=True),
+            engine_kw=dict(overlap=True, chunk_size=64))
+        rng = np.random.default_rng(23)
+        lp, ln = self._long(rng)
+        alen = sups[0].engine.assembly_len
+        ref = _greedy_ref(model, params, lp, ln, alen)
+        g = router.submit(lp, ln)
+        router.run_sync()
+        term = {e["id"]: e for e in self._terminals(events)}
+        assert term[g]["tokens"] == ref
+        st = router.stats()
+        assert st["boundary_handoffs"] == 1
+        assert st["handoff_fallbacks"] == 0, \
+            "single-chunk overlap handoff degraded: export raced the " \
+            "deferred publish"
+        recv = sups[1].engine.metrics.summary()
+        # the FULL chain crossed: every complete prompt block adopted
+        assert recv["handoff_adopted_blocks"] == len(lp) // 4
+        assert recv["prefill_tokens_saved"] > 0
+        self._no_leaks(router)
+
+    def test_corrupt_wire_block_degrades_to_recompute(self, tiny_lm):
+        """handoff.corrupt chaos: the receiver's digest check catches the
+        damage, adopts nothing, and the handoff falls back to token-exact
+        recompute-resume — never a wrong token."""
+        model, params = tiny_lm
+        plans = [None, FaultPlan(handoff_corrupt_calls=(1,))]
+        router, sups, events = self._fleet(tiny_lm, plans=plans)
+        rng = np.random.default_rng(12)
+        lp, ln = self._long(rng)
+        ref = _greedy_ref(model, params, lp, ln, sups[0].engine.assembly_len)
+        gid = router.submit(lp, ln)
+        router.run_sync()
+        term = {e["id"]: e for e in self._terminals(events)}
+        assert term[gid]["event"] == "done" and term[gid]["tokens"] == ref
+        st = router.stats()
+        assert st["boundary_handoffs"] == 1
+        assert st["handoff_fallbacks"] == 1
+        recv = sups[1].engine.metrics.summary()
+        assert recv["handoff_corrupt"] == 1
+        assert recv["handoff_adopted_blocks"] == 0
+        self._no_leaks(router)
+
+    def test_slow_wire_adopt_is_late_not_wrong(self, tiny_lm):
+        """handoff.slow chaos: a congested transfer stalls the adopt but
+        does not fail it — the blocks still land, verified."""
+        model, params = tiny_lm
+        plans = [None, FaultPlan(handoff_slow_calls=(1,),
+                                 handoff_slow_s=0.005)]
+        router, sups, events = self._fleet(tiny_lm, plans=plans)
+        rng = np.random.default_rng(13)
+        lp, ln = self._long(rng)
+        ref = _greedy_ref(model, params, lp, ln, sups[0].engine.assembly_len)
+        gid = router.submit(lp, ln)
+        router.run_sync()
+        term = {e["id"]: e for e in self._terminals(events)}
+        assert term[gid]["event"] == "done" and term[gid]["tokens"] == ref
+        st = router.stats()
+        assert st["boundary_handoffs"] == 1
+        assert st["handoff_fallbacks"] == 0
+        assert sups[1].engine.faults.fired["handoff.slow"] == 1
+        assert sups[1].engine.metrics.summary()["handoff_adopted_blocks"] > 0
+        self._no_leaks(router)
+
+    def test_receiver_pool_pressure_degrades(self, tiny_lm):
+        """A full receiver pool ends the adopt walk early (here: at zero
+        blocks, via an injected alloc failure) — handoff still happens,
+        as recompute-resume."""
+        model, params = tiny_lm
+        plans = [None, FaultPlan(alloc_fail_calls=(1,))]
+        router, sups, events = self._fleet(tiny_lm, plans=plans)
+        rng = np.random.default_rng(14)
+        lp, ln = self._long(rng)
+        ref = _greedy_ref(model, params, lp, ln, sups[0].engine.assembly_len)
+        gid = router.submit(lp, ln)
+        router.run_sync()
+        term = {e["id"]: e for e in self._terminals(events)}
+        assert term[gid]["event"] == "done" and term[gid]["tokens"] == ref
+        st = router.stats()
+        assert st["boundary_handoffs"] == 1
+        assert st["handoff_fallbacks"] == 1
+        assert sups[1].engine.metrics.summary()[
+            "handoff_adopted_blocks"] == 0
+        self._no_leaks(router)
+
+    def test_no_decode_target_finishes_in_place(self, tiny_lm):
+        """Roles are preferences, never admission gates: with every decode
+        replica dead, the long prompt finishes on the prefill replica."""
+        model, params = tiny_lm
+        router, sups, events = self._fleet(tiny_lm)
+        router.kill_replica(1)
+        rng = np.random.default_rng(15)
+        lp, ln = self._long(rng)
+        ref = _greedy_ref(model, params, lp, ln, sups[0].engine.assembly_len)
+        gid = router.submit(lp, ln)
+        router.run_sync()
+        term = {e["id"]: e for e in self._terminals(events)}
+        assert term[gid]["event"] == "done" and term[gid]["tokens"] == ref
+        st = router.stats()
+        assert st["boundary_handoffs"] == 0
+        assert st["handoff_fallbacks"] == 0
+        self._no_leaks(router, skip=(1,))
+
+    def test_receiver_killed_mid_adopt_degrades(self, tiny_lm, monkeypatch):
+        """The receiver dies DURING the adopt call: the handoff degrades
+        to recompute-resume on a surviving replica — never a dropped
+        request."""
+        model, params = tiny_lm
+        router, sups, events = self._fleet(tiny_lm)
+        rng = np.random.default_rng(16)
+        lp, ln = self._long(rng)
+        ref = _greedy_ref(model, params, lp, ln, sups[0].engine.assembly_len)
+
+        def dying_adopt(exports):
+            router.kill_replica(1)
+            raise EngineCrash("receiver died mid-adopt")
+
+        monkeypatch.setattr(sups[1], "adopt_prefix", dying_adopt)
+        gid = router.submit(lp, ln)
+        router.run_sync()
+        term = {e["id"]: e for e in self._terminals(events)}
+        assert term[gid]["event"] == "done" and term[gid]["tokens"] == ref
+        st = router.stats()
+        assert st["boundary_handoffs"] == 1
+        assert st["handoff_fallbacks"] == 1
+        self._no_leaks(router, skip=(1,))
+
+    def test_fleet_prefix_pull_then_local_hit(self, tiny_lm):
+        """Fleet-wide shared prefix cache: a prefix published on the
+        prefill replica is pulled over on a miss from the decode replica
+        (verified wire path), after which the same prefix hits locally —
+        no second pull."""
+        model, params = tiny_lm
+        router, sups, events = self._fleet(
+            tiny_lm, router_kw=dict(disagg_prompt_threshold=12,
+                                    fleet_prefix=True))
+        rng = np.random.default_rng(17)
+        prefix = rng.integers(0, 128, 8).astype(np.int32)
+        seeder = np.concatenate(
+            [prefix, rng.integers(0, 128, 4).astype(np.int32)])
+        shorts = [np.concatenate(
+            [prefix, rng.integers(0, 128, 3).astype(np.int32)])
+            for _ in range(2)]
+        alen = sups[0].engine.assembly_len
+        g1 = router.submit(seeder, 1)   # 12 tokens -> the prefill replica
+        assert g1 in router.replicas[0].live
+        router.run_sync()
+        router._refresh_prefix_dir()
+        g2 = router.submit(shorts[0], 4)  # 11 tokens -> the decode replica
+        assert g2 in router.replicas[1].live
+        router.run_sync()
+        st = router.stats()
+        assert st["fleet_prefix_pulls"] == 1
+        recv = sups[1].engine.metrics.summary()
+        assert recv["prefill_tokens_saved"] >= 8   # two pulled blocks
+        # the adopted keys are now local: same prefix, no second pull
+        router._refresh_prefix_dir()
+        g3 = router.submit(shorts[1], 4)
+        router.run_sync()
+        assert router.stats()["fleet_prefix_pulls"] == 1
+        term = {e["id"]: e for e in self._terminals(events)}
+        assert term[g1]["tokens"] == _greedy_ref(model, params, seeder,
+                                                 1, alen)
+        for g, p in ((g2, shorts[0]), (g3, shorts[1])):
+            assert term[g]["event"] == "done"
+            assert term[g]["tokens"] == _greedy_ref(model, params, p,
+                                                    4, alen)
+        self._no_leaks(router)
+
+    def test_auto_roles_assignment(self, tiny_lm):
+        """roles="auto": the probe loop dedicates the healthiest half to
+        decode and the rest to prefill; a fleet shrunk to one alive
+        replica reverts to mixed."""
+        router, sups, _ = self._fleet(tiny_lm, n=3,
+                                      router_kw=dict(roles="auto"))
+        assert [h.role for h in router.replicas] == ["mixed"] * 3
+        router._probe()
+        roles = [h.role for h in router.replicas]
+        assert roles.count("decode") == 2 and roles.count("prefill") == 1
+        router.kill_replica(1)
+        router.kill_replica(2)
+        router._probe()
+        assert router.replicas[0].role == "mixed"
+
+    def test_role_singleton_never_ejected(self, tiny_lm):
+        """Role-aware ejection: the lone prefill replica is structurally
+        slower than its decode peers (it eats every long prompt) — judged
+        only against same-role peers, a singleton is never ejected for
+        doing its job."""
+        router, sups, _ = self._fleet(tiny_lm, n=3)
+        # plant a fleet-median-breaking score on the prefill replica: under
+        # the old fleet-wide median this ejects; role-aware it must not
+        router.replicas[0].health.dispatch_latency_s = 10.0
+        for _ in range(3):
+            router._update_health()
+            time.sleep(0.01)
+        assert not router.replicas[0].degraded
+        assert router.stats()["degraded_ejections"] == 0
+        # ... and an ejection stranded in a group of one heals: plant the
+        # degraded state a pre-role-aware run could have left behind
+        router.replicas[0].degraded = True
+        router._update_health()
+        assert not router.replicas[0].degraded
+
+    def test_handoff_pending_requests_are_never_hedged(self, tiny_lm):
+        """Handoff-aware hedging: a long prompt mid-prefill on the prefill
+        tier is slow BY SELECTION — the boundary handoff is already its
+        migration, so the hedge scan must skip it."""
+        router, sups, _ = self._fleet(
+            tiny_lm, router_kw=dict(hedge_ttft_s=0.0, hedge_budget=1.0))
+        rng = np.random.default_rng(18)
+        lp, ln = self._long(rng)
+        gid = router.submit(lp, ln)
+        rec = router._open[gid]
+        assert rec.prefer_role == "prefill"
+        # every request is overdue at threshold 0.0 — yet the pending
+        # handoff must be exempt
+        router._maybe_hedge()
+        assert router.stats()["hedges_fired"] == 0
+        assert rec.hedge_epoch is None
+        router.run_sync()
+        assert router.stats()["boundary_handoffs"] == 1
+        self._no_leaks(router)
+
+    @pytest.mark.parametrize("path", ["standard", "paged"])
+    def test_disagg_composed_chaos_token_exact(self, tiny_lm, path):
+        """The PR gate, both decode paths: disagg-on vs disagg-off with
+        prefix cache + ngram spec + overlap + int8 KV composed, under
+        handoff chaos (seeded corrupt + slow wire blocks, one decode
+        replica killed mid-run) — every stream token-exact against the
+        greedy reference, zero leaked blocks on the survivors."""
+        model, params = tiny_lm
+        ekw = dict(decode_path=path, kv_dtype="int8", spec="ngram",
+                   spec_k=3, overlap=True)
+        rng = np.random.default_rng(19)
+        prefix = rng.integers(0, 128, 8).astype(np.int32)
+        prompts = [rng.integers(0, 128, self.THRESH + 4 + i).astype(np.int32)
+                   for i in range(4)]
+        prompts += [np.concatenate(
+            [prefix, rng.integers(0, 128, 3 + i).astype(np.int32)])
+            for i in range(4)]
+        max_new = 6
+
+        def run(disagg):
+            plans = [None,
+                     FaultPlan(seed=3, handoff_corrupt_prob=0.4,
+                               handoff_slow_prob=0.4, handoff_slow_s=0.001),
+                     FaultPlan(seed=4, handoff_corrupt_prob=0.4,
+                               handoff_slow_prob=0.4, handoff_slow_s=0.001)]
+            rkw = (dict(roles=["prefill", "decode", "decode"],
+                        disagg_prompt_threshold=self.THRESH,
+                        handoff_kv=True, fleet_prefix=True)
+                   if disagg else dict(roles=None,
+                                       disagg_prompt_threshold=0))
+            router, sups, events = self._fleet(
+                tiny_lm, n=3, plans=plans, router_kw=rkw, engine_kw=ekw)
+            gids = [router.submit(p, max_new) for p in prompts]
+            router.pump(2)
+            router.kill_replica(2)       # a receiver dies mid-fleet
+            router.run_sync()
+            term = {e["id"]: e for e in self._terminals(events)}
+            toks = []
+            for g in gids:
+                assert term[g]["event"] == "done"
+                toks.append(term[g]["tokens"])
+            self._no_leaks(router, skip=(2,))
+            return toks, router.stats(), sups[0].engine.assembly_len
+
+        on_toks, on_st, _ = run(True)
+        off_toks, off_st, _ = run(False)
+        # the disagg contract is on == off: crossing the prefill/decode
+        # boundary (with chaos-degraded KV handoffs in the mix) must not
+        # change a single token relative to the same engines un-split.
+        # The f32 greedy reference is NOT the baseline here — int8 KV is
+        # argmax-sensitive on some prompts, identically on both sides,
+        # and that quantization contract is tested elsewhere.
+        assert on_toks == off_toks, \
+            "disagg-on diverged from the disagg-off twin"
+        assert on_st["boundary_handoffs"] >= 1
+        assert off_st["boundary_handoffs"] == 0
+
+
+class TestPrefixCacheAdoptEdges:
+    """PrefixCache.adopt (the wire/tier re-admission entry) against the
+    races the engine sees in production: duplicate adoption of one chain
+    key, and a block reclaimed out from under a just-adopted entry."""
+
+    def test_duplicate_adopt_same_key_loses(self):
+        pc = PrefixCache(block_size=4)
+        assert pc.adopt(b"k1", 3)
+        assert not pc.adopt(b"k1", 9)      # occupied key: first wins
+        assert not pc.adopt(b"k2", 3)      # block already serves a chain
+        assert pc.block_of(b"k1") == 3 and pc.block_of(b"k2") is None
+        assert len(pc) == 1
+
+    def test_adopt_after_concurrent_reclaim(self):
+        pc = PrefixCache(block_size=4)
+        assert pc.adopt(b"k1", 3)
+        pc.drop_blocks([3])                # the pool reclaimed it mid-race
+        assert pc.block_of(b"k1") is None and len(pc) == 0
+        assert not pc.contains_block(3)
+        # the key is free again: a later adopt re-admits under a new block
+        assert pc.adopt(b"k1", 7)
+        assert pc.block_of(b"k1") == 7
+
+
+class TestHostTierTPExclusion:
+    """The host-RAM KV tier is incompatible with tensor-parallel pool
+    sharding (demoted page slices would need a cross-shard gather/
+    scatter): both the engine constructor and the CLI must fail fast with
+    a clear one-line error, not crash somewhere in kernel wiring."""
+
+    def test_engine_rejects_tier_with_tp(self, tiny_lm):
+        model, params = tiny_lm
+        with pytest.raises(ValueError, match="tp>1 is unsupported"):
+            InferenceEngine(model, params, num_blocks=8, block_size=4,
+                            max_batch_size=2, max_seq_len=16,
+                            chunked_prefill=True, prefix_cache=True,
+                            host_tier_bytes=1 << 20, tp=2)
+
+    def test_cli_rejects_tier_with_tp(self, capsys):
+        from tnn_tpu.cli import serve as serve_cli
+        with pytest.raises(SystemExit):
+            serve_cli.main(["--host-tier-bytes", "1048576", "--tp", "2"])
+        err = capsys.readouterr().err
+        assert "--host-tier-bytes is incompatible with --tp" in err

@@ -186,6 +186,29 @@ def main(argv=None):
                          "its health score stays worse than this multiple "
                          "of the fleet median (0 = ejection off); its live "
                          "streams proactively migrate token-exact")
+    ap.add_argument("--roles", default="",
+                    help="disaggregated prefill/decode serving: comma-"
+                         "separated per-replica roles (prefill|decode|mixed) "
+                         "matching the fleet size, or 'auto' to let the "
+                         "router rank replicas by health score and dedicate "
+                         "the healthiest half to decode. Roles are placement "
+                         "preferences — no request ever fails for lack of a "
+                         "matching role")
+    ap.add_argument("--disagg-prompt-threshold", type=int, default=128,
+                    help="with --roles: prompts at least this many tokens "
+                         "long prefer a prefill replica; the router hands "
+                         "the stream to a decode replica at the first-token "
+                         "boundary (token-exact)")
+    ap.add_argument("--no-handoff-kv", action="store_true",
+                    help="disable the KV-block handoff at the prefill/"
+                         "decode boundary — the stream still moves, via "
+                         "token-exact recompute-resume (A/B baseline)")
+    ap.add_argument("--fleet-prefix", action="store_true",
+                    help="fleet-wide shared prefix cache: the router tracks "
+                         "which replica holds which prefix chain keys and "
+                         "pulls blocks from a peer on a local miss through "
+                         "the digest-verified export/adopt path (a failed "
+                         "pull is just a cache miss, never wrong KV)")
     ap.add_argument("--drain-deadline-s", type=float, default=30.0,
                     help="graceful-drain budget: in-flight work past this "
                          "deadline times out (0 = wait forever)")
@@ -247,6 +270,32 @@ def main(argv=None):
             ap.error("--host-tier-bytes is incompatible with --tp > 1 "
                      "(demoted page slices would need a cross-shard "
                      "gather/scatter)")
+    roles = None
+    if args.roles:
+        if args.replicas <= 1 and not args.autoscale:
+            ap.error("--roles needs a replica fleet "
+                     "(--replicas N > 1 or --autoscale MIN:MAX)")
+        if args.roles == "auto":
+            roles = "auto"
+        else:
+            roles = [r.strip() for r in args.roles.split(",")]
+            bad = sorted(set(r for r in roles
+                             if r not in ("prefill", "decode", "mixed")))
+            if bad:
+                ap.error(f"--roles: unknown role(s) {', '.join(bad)} "
+                         "(choose prefill, decode, or mixed)")
+            if "prefill" in roles and not any(
+                    r in ("decode", "mixed") for r in roles):
+                ap.error("--roles: a disaggregated fleet needs at least "
+                         "one decode or mixed replica")
+    if args.disagg_prompt_threshold < 1:
+        ap.error(f"--disagg-prompt-threshold must be >= 1, got "
+                 f"{args.disagg_prompt_threshold}")
+    if args.fleet_prefix and (args.no_prefix_cache
+                              or args.no_chunked_prefill):
+        ap.error("--fleet-prefix needs the prefix cache (pulled blocks "
+                 "are keyed by its rolling-hash chain) — drop "
+                 "--no-prefix-cache/--no-chunked-prefill")
 
     tokenizer = None
     if args.vocab:
@@ -359,6 +408,10 @@ def main(argv=None):
         n0 = args.replicas
         if autoscale is not None:
             n0 = min(max(args.replicas, autoscale[0]), autoscale[1])
+        if isinstance(roles, list) and len(roles) != n0:
+            ap.error(f"--roles names {len(roles)} replica(s) but the "
+                     f"fleet starts at {n0} — give one role per replica "
+                     "or use --roles auto")
         sups = [build_supervisor(engine)] + [
             build_supervisor(build_engine(i), i)
             for i in range(1, n0)]
@@ -371,8 +424,22 @@ def main(argv=None):
                           else args.hedge_ttft_s),
             hedge_budget=args.hedge_budget,
             degrade_factor=args.degrade_factor,
+            roles=roles,
+            disagg_prompt_threshold=args.disagg_prompt_threshold,
+            handoff_kv=not args.no_handoff_kv,
+            fleet_prefix=args.fleet_prefix,
             seed=args.seed, profiler=router_prof)
         print(f"router: {n0} supervised replicas", file=sys.stderr)
+        if roles is not None:
+            kv = "recompute-resume only" if args.no_handoff_kv \
+                else "verified KV-block handoff"
+            print(f"disaggregated serving: roles="
+                  f"{roles if roles == 'auto' else ','.join(roles)}, "
+                  f"prompt threshold {args.disagg_prompt_threshold}, {kv}",
+                  file=sys.stderr)
+        if args.fleet_prefix:
+            print("fleet prefix cache: content-addressed directory + "
+                  "peer block pulls (verified)", file=sys.stderr)
         if autoscale is not None:
             from tnn_tpu.serving import Autoscaler
 

@@ -110,7 +110,7 @@ from . import spec_decode
 from . import step_build
 from .faults import FaultInjected, FaultPlan
 from .kv_pool import PagedKVPool, PoolExhausted
-from .kv_tier import HostKVTier
+from .kv_tier import HostKVTier, tier_digest
 from .metrics import ServingMetrics
 from .prefix_cache import PrefixCache
 from .scheduler import (TERMINAL_STATES, AdmissionRejected, Request,
@@ -1493,16 +1493,7 @@ class InferenceEngine:
         pairs = [(b, k) for b, k in pairs if k is not None]
         if not pairs:
             return
-        pk, pv = self.pool.pages_k, self.pool.pages_v
-        quant = isinstance(pk, kv_pool_lib.QuantPages)
-        fetch = []
-        for b, _ in pairs:
-            if quant:
-                fetch.append((pk.data[:, b], pk.scale[:, b],
-                              pv.data[:, b], pv.scale[:, b]))
-            else:
-                fetch.append((pk[:, b], pv[:, b]))
-        host = jax.device_get(tuple(fetch))
+        host = self.pool.export_blocks([b for b, _ in pairs])
         for (b, key), leaves in zip(pairs, host):
             if self.kv_tier.demote(key, leaves) and self.tracer.enabled:
                 self.tracer.instant("tier.demote", block=b,
@@ -1531,6 +1522,15 @@ class InferenceEngine:
                     kv_pool_lib.QuantPages(self._put(leaves[2]),
                                            self._put(leaves[3])))
         return self._put(leaves[0]), self._put(leaves[1])
+
+    def _get_adopt_fn(self):
+        """The compiled whole-block write step (one compile per pool
+        dtype/TP signature serves every readmit and handoff adopt)."""
+        adopt_key = ("tier_adopt",) + self._kv_key
+        fn = self._jit.get(adopt_key)
+        if fn is None:
+            fn = self._jit[adopt_key] = self._tier_adopt_fn()
+        return fn
 
     def _tier_readmit(self, seq) -> None:
         """Walk this prompt's chain keys and re-admit every demoted block
@@ -1565,13 +1565,8 @@ class InferenceEngine:
                 self.pool.free(blk)
                 break
             payload_k, payload_v = self._tier_payload(leaves)
-            adopt_key = ("tier_adopt",) + self._kv_key
-            fn = self._jit.get(adopt_key)
-            if fn is None:
-                fn = self._jit[adopt_key] = self._tier_adopt_fn()
-            pk, pv = fn(self.pool.pages_k, self.pool.pages_v,
-                        self._put(blk[0], jnp.int32), payload_k, payload_v)
-            self.pool.update_pages(pk, pv)
+            self.pool.adopt_blocks([(blk[0], payload_k, payload_v)],
+                                   self._get_adopt_fn(), self._put)
             self.prefix_cache.adopt(key, blk[0])
             # release into the evictable LRU (the block is now indexed):
             # probe() sees it immediately and fork() revives it — COW and
@@ -1584,6 +1579,161 @@ class InferenceEngine:
                 self.tracer.instant("tier.readmit", blocks=readmitted,
                                     tier_blocks=len(self.kv_tier),
                                     tier_bytes=self.kv_tier.bytes_used)
+
+    # -- cross-replica KV handoff (disaggregated serving) ---------------------
+
+    def export_prefix(self, tokens: Sequence[int],
+                      max_blocks: Optional[int] = None) -> List[tuple]:
+        """Serialize the longest exportable chain prefix of ``tokens`` for
+        cross-replica shipment: a list of ``(chain_key, leaves, digest)``
+        wire blocks in chain order, where ``leaves`` is the host payload
+        ``pool.export_blocks`` produces (int8 pools ship data + scale at
+        ~half the f32 wire bytes) and ``digest = tier_digest(key, leaves)``
+        — the receiver re-derives it from the wire bytes, so any in-flight
+        damage is caught before a single page is written.
+
+        Each key is sourced from the device index (``prefix_cache``) or
+        from the host tier's staging buffer (``HostKVTier.peek`` —
+        verified, non-destructive); the walk stops at the first key neither
+        holds, since a chain with a hole cannot adopt past it. Best-effort
+        and read-only: no refcounts move, nothing is consumed, an empty
+        result just means the receiver recomputes."""
+        if self.prefix_cache is None or self.pool.pages_deleted():
+            return []
+        # with the overlapped loop, publishes land on the deferred queue
+        # and drain on idle time — a boundary export arriving right after
+        # the first-token commit would find the chain this request JUST
+        # prefilled still unpublished and degrade to recompute-resume.
+        # Export runs on the engine's worker thread between ticks, which
+        # is exactly where the deferred phase normally runs.
+        self.run_deferred()
+        keys = self.prefix_cache.chain_keys(tokens)
+        if max_blocks is not None:
+            keys = keys[:max_blocks]
+        sources: List[tuple] = []      # (key, device block | None, leaves)
+        for key in keys:
+            blk = self.prefix_cache.block_of(key)
+            if blk is not None:
+                sources.append((key, blk, None))
+                continue
+            leaves = (self.kv_tier.peek(key)
+                      if self.kv_tier is not None else None)
+            if leaves is None:
+                break
+            sources.append((key, None, leaves))
+        fetched = iter(self.pool.export_blocks(
+            [b for _, b, _ in sources if b is not None]))
+        exports = []
+        for key, blk, leaves in sources:
+            if blk is not None:
+                leaves = tuple(np.asarray(x) for x in next(fetched))
+            exports.append((key, leaves, tier_digest(key, leaves)))
+        if exports:
+            self.metrics.observe_handoff_export(len(exports))
+            if self.tracer.enabled:
+                self.tracer.instant("handoff.export", blocks=len(exports),
+                                    wire_bytes=sum(
+                                        sum(x.nbytes for x in lv)
+                                        for _, lv, _ in exports))
+        return exports
+
+    def _wire_leaves_ok(self, leaves) -> bool:
+        """Geometry guard for wire payloads: a digest only proves the bytes
+        match what the SENDER exported — a sender with a different pool
+        geometry/dtype would still verify, then crash the adopt write. A
+        mismatch degrades to recompute-resume, never an error."""
+        shape = (self.pool.num_layers, self.pool.num_kv_heads,
+                 self.pool.block_size, self.pool.head_dim)
+        if self.pool.kv_dtype == "int8":
+            return (len(leaves) == 4
+                    and leaves[0].shape == shape
+                    and leaves[2].shape == shape
+                    and leaves[0].dtype == np.int8
+                    and leaves[2].dtype == np.int8
+                    and leaves[1].shape == shape[:-1] + (1,)
+                    and leaves[3].shape == shape[:-1] + (1,))
+        return (len(leaves) == 2
+                and leaves[0].shape == shape and leaves[1].shape == shape)
+
+    def adopt_prefix(self, exports: Sequence[tuple]) -> int:
+        """Adopt cross-replica wire blocks into this replica's prefix
+        index; returns how many of the wire chain are RESIDENT afterwards
+        (fresh adopts plus already-present dedupes — the caller's question
+        is "will the resume prefix-hit here?", and a block this replica
+        already holds answers it as well as a freshly written one; the
+        ``handoff_adopted_blocks`` metric counts only real writes). Per
+        block, in chain order: skip
+        keys already resident; recompute ``tier_digest`` over the WIRE
+        bytes and compare to the shipped digest (a mismatch — real damage
+        or the seeded ``handoff.corrupt`` fault — drops the block and
+        stops: the rest of the chain is unadoptable past a hole anyway);
+        allocate a block (pool pressure ends the walk — handoff only ever
+        adds hits); write the payload through the same compiled
+        ``write_block`` step the host tier uses; index it
+        (``prefix_cache.adopt``) and park it in the evictable LRU, from
+        where the ordinary probe/fork machinery serves it exactly like
+        locally-computed KV. Every degradation path returns a smaller
+        count — the caller (router) falls back to token-exact
+        recompute-resume, never a wrong token or a dropped request."""
+        if self.prefix_cache is None or self.pool.pages_deleted():
+            return 0
+        adopted = resident = 0
+        for key, leaves, digest in exports:
+            if self.prefix_cache.contains_key(key):
+                resident += 1       # dedupe — served here, keep walking
+                continue
+            if self.faults is not None:
+                if self.faults.handoff_slow():
+                    # a congested transfer: the adopt succeeds, late
+                    time.sleep(self.faults.handoff_slow_s)
+                if self.faults.handoff_corrupt():
+                    # flip one byte of a COPY so the digest check below
+                    # catches planted damage exactly like real wire rot
+                    leaves = tuple(np.array(x, copy=True) for x in leaves)
+                    flat = leaves[0].reshape(-1).view(np.uint8)
+                    flat[0] ^= 0xFF
+            leaves = tuple(np.asarray(x) for x in leaves)
+            if tier_digest(key, leaves) != digest:
+                self.metrics.observe_handoff_corrupt()
+                break
+            if not self._wire_leaves_ok(leaves):
+                break               # geometry mismatch — recompute instead
+            try:
+                blk = self.pool.alloc(1)
+            except (PoolExhausted, FaultInjected):
+                break
+            payload_k, payload_v = self._tier_payload(leaves)
+            self.pool.adopt_blocks([(blk[0], payload_k, payload_v)],
+                                   self._get_adopt_fn(), self._put)
+            if not self.prefix_cache.adopt(key, blk[0]):
+                # raced a local publish of the same chain: the key is
+                # served either way; the private copy drains to free
+                self.pool.free(blk)
+                resident += 1
+                continue
+            # release into the evictable LRU (the block is now indexed):
+            # probe() sees it immediately and fork() revives it
+            self.pool.free(blk)
+            adopted += 1
+            resident += 1
+        if adopted:
+            self.metrics.observe_handoff_adopt(adopted)
+            if self.tracer.enabled:
+                self.tracer.instant("handoff.adopt", blocks=adopted)
+        return resident
+
+    def prefix_keys(self) -> List[bytes]:
+        """Chain keys this replica can currently export: the device index
+        plus host-tier staged entries. The router's fleet-wide directory
+        refreshes from this (content-addressed, so keys mean the same
+        thing on every replica)."""
+        if self.prefix_cache is None:
+            return []
+        keys = self.prefix_cache.keys()
+        if self.kv_tier is not None:
+            have = set(keys)
+            keys.extend(k for k in self.kv_tier.keys() if k not in have)
+        return keys
 
     def _match_prefix(self, req: Request) -> None:
         """Admission-time cache hit: fork the matched blocks into the
@@ -1969,6 +2119,8 @@ class InferenceEngine:
             take = takes[req.rid]
             req.cache_len += take
             self.metrics.observe_prefill_chunk(take)
+            if self.faults is not None:
+                self.faults.prefill_delay(take)
             if self.tracer.enabled:
                 self.tracer.instant("serve.prefill_chunk",
                                     trace=req.trace_id, rid=req.rid,

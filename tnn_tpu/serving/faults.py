@@ -50,6 +50,11 @@ indices:
   degrade to an uncached miss; ``tier_slow_readmit`` (site
   "tier.slow_readmit") stalls a readmit ``tier_slow_readmit_s`` without
   failing it (a paged-out host buffer, not a corrupt one);
+- **KV-handoff faults**: ``handoff_corrupt`` (site "handoff.corrupt")
+  flips a byte in a cross-replica wire payload before the receiver's
+  digest verification — the verifier must catch it and the router
+  degrades to recompute-resume; ``handoff_slow`` (site "handoff.slow")
+  stalls an adopt ``handoff_slow_s`` without failing it;
 - **fleet-scaling faults**: ``scale_join_fail`` (site "scale.join_fail")
   makes a replica join fail mid-scale-up — the router's ``add_replica``
   raises before the new replica enters placement, and the autoscaler's
@@ -126,6 +131,11 @@ class FaultPlan:
     # delays every step, otherwise only the listed 1-based step indices
     step_delay_s: float = 0.0
     step_delay_calls: Tuple[int, ...] = ()
+    # artificial latency proportional to prompt tokens committed by a
+    # prefill chunk (site "prefill.delay"): models compute cost that scales
+    # with chunk size, so benches can surface prefill/decode interference
+    # on hosts where the real forward pass is too cheap to measure
+    prefill_delay_per_token_s: float = 0.0
     # engine-loop crash escaping engine.step (site "engine.step")
     step_crash_calls: Tuple[int, ...] = ()
     # connection-level faults, consulted by front ends / chaos clients
@@ -169,6 +179,17 @@ class FaultPlan:
     tier_slow_readmit_prob: float = 0.0
     tier_slow_readmit_calls: Tuple[int, ...] = ()  # site "tier.slow_readmit"
     tier_slow_readmit_s: float = 0.01              # injected readmit stall
+    # cross-replica KV-handoff faults (consulted by engine.adopt_prefix on
+    # the RECEIVING replica): handoff_corrupt flips a byte of a wire payload
+    # before digest verification — the verifier must catch it and the router
+    # degrades to recompute-resume; handoff_slow stalls the adopt
+    # handoff_slow_s without failing it (a congested transfer, not a lost
+    # one)
+    handoff_corrupt_prob: float = 0.0
+    handoff_corrupt_calls: Tuple[int, ...] = ()    # site "handoff.corrupt"
+    handoff_slow_prob: float = 0.0
+    handoff_slow_calls: Tuple[int, ...] = ()       # site "handoff.slow"
+    handoff_slow_s: float = 0.01                   # injected adopt stall
     # fleet-scaling faults (consulted by Router.add_replica)
     scale_join_fail_prob: float = 0.0
     scale_join_fail_calls: Tuple[int, ...] = ()    # site "scale.join_fail"
@@ -255,6 +276,14 @@ class FaultPlan:
             time.sleep(self.step_delay_s)
         if crash:
             raise EngineCrash(f"injected engine-loop crash (step #{n})")
+
+    def prefill_delay(self, tokens: int) -> None:
+        """Per-token artificial prefill latency (site "prefill.delay"):
+        called once per committed prompt chunk with the number of tokens
+        it advanced. Lets a bench charge prefill work a realistic cost so
+        prefill/decode interference shows up in step cadence."""
+        if self.prefill_delay_per_token_s > 0.0 and tokens > 0:
+            time.sleep(self.prefill_delay_per_token_s * tokens)
 
     # -- connection-level sites (called by front ends, not the engine) --------
 
@@ -357,6 +386,25 @@ class FaultPlan:
         readmit still succeeds; only latency pays."""
         return self._fires("tier.slow_readmit", self.tier_slow_readmit_prob,
                            self.tier_slow_readmit_calls)
+
+    # -- KV-handoff sites (called by engine.adopt_prefix on the receiver) -----
+
+    def handoff_corrupt(self) -> bool:
+        """Consulted once per adopted wire block: True when the payload
+        should be corrupted before digest verification (site
+        "handoff.corrupt"). The verifier must catch the damage and the
+        handoff degrades to recompute-resume — never wrong KV, never a
+        dropped request."""
+        return self._fires("handoff.corrupt", self.handoff_corrupt_prob,
+                           self.handoff_corrupt_calls)
+
+    def handoff_slow(self) -> bool:
+        """Consulted once per adopted wire block: True when the adopt
+        should stall ``handoff_slow_s`` before proceeding (site
+        "handoff.slow") — a congested inter-replica transfer. The adopt
+        still succeeds; only latency pays."""
+        return self._fires("handoff.slow", self.handoff_slow_prob,
+                           self.handoff_slow_calls)
 
     # -- fleet-scaling sites (called by Router.add_replica) -------------------
 

@@ -471,6 +471,47 @@ class PagedKVPool:
             self.pages_k = put(np.zeros(shape, np.dtype(self.dtype)))
             self.pages_v = put(np.zeros(shape, np.dtype(self.dtype)))
 
+    def export_blocks(self, blocks: Sequence[int]) \
+            -> List[tuple]:
+        """Fetch whole pages to the host, one leaf tuple per block: ONE
+        batched explicit ``jax.device_get`` covering every requested block
+        (this runs outside the step's fetch/commit machinery — the demote
+        hook and the cross-replica export path, never a step-path call).
+        f32 pools yield ``(k_slice, v_slice)``; int8 pools yield
+        ``(k_data, k_scale, v_data, v_scale)`` — the int8 payload ships
+        both leaves at ~half the f32 wire bytes, scale sidecar included.
+        The leaf order is exactly what ``write_block`` payloads (and the
+        host tier's ``demote``) consume, so an exported block re-adopts
+        byte-identically anywhere with the same pool geometry."""
+        pk, pv = self.pages_k, self.pages_v
+        fetch = []
+        for b in blocks:
+            if isinstance(pk, QuantPages):
+                fetch.append((pk.data[:, b], pk.scale[:, b],
+                              pv.data[:, b], pv.scale[:, b]))
+            else:
+                fetch.append((pk[:, b], pv[:, b]))
+        return list(jax.device_get(tuple(fetch))) if fetch else []
+
+    def adopt_blocks(self, items: Sequence[tuple], write_fn,
+                     put: Callable) -> None:
+        """Write exported payloads into already-allocated blocks — the
+        device half of re-admission/handoff. ``items`` is a sequence of
+        ``(block_id, payload_k, payload_v)`` where the payloads are
+        device-resident values shaped for ``write_block`` (QuantPages
+        bundles under int8); ``write_fn`` is the caller's compiled
+        ``(pages_k, pages_v, blk, payload_k, payload_v) -> (pages_k',
+        pages_v')`` adopt step (donation/compile-key discipline stays with
+        the engine) and ``put`` the caller's explicit host->device
+        transfer for the traced block id. Callers MUST digest-verify wire
+        payloads (``kv_tier.tier_digest``) before handing them here — the
+        ``tier-adopt-unverified`` lint rule enforces it at every call
+        site."""
+        for blk, payload_k, payload_v in items:
+            pk, pv = write_fn(self.pages_k, self.pages_v,
+                              put(blk, jnp.int32), payload_k, payload_v)
+            self.update_pages(pk, pv)
+
     def padded_table(self, block_table: Sequence[int], width: int):
         """Right-pad a block table with SCRATCH to a fixed ``width``."""
         if len(block_table) > width:

@@ -174,6 +174,26 @@ class HostKVTier:
         self.readmits += 1
         return entry.leaves
 
+    def peek(self, chain: bytes) -> Optional[Tuple[np.ndarray, ...]]:
+        """Non-destructively read one entry's payload, integrity-checked —
+        the cross-replica EXPORT path, where the tier doubles as a staging
+        buffer: a demoted block can ship to another replica without being
+        consumed locally (the entry stays resident for future local
+        readmits). A digest mismatch drops the entry and returns None,
+        exactly like :meth:`verify_readmit`, so a corrupt staged block can
+        never leave this host. No chaos consult: the wire faults
+        (``handoff.corrupt`` / ``handoff.slow``) fire on the RECEIVER,
+        where degradation to recompute-resume is decided."""
+        entry = self._entries.get(chain)
+        if entry is None:
+            return None
+        if tier_digest(chain, entry.leaves) != entry.digest:
+            self._entries.pop(chain, None)
+            self.bytes_used -= entry.nbytes
+            self.corrupt_dropped += 1
+            return None
+        return entry.leaves
+
     # -- invalidation ---------------------------------------------------------
 
     def clear(self) -> None:

@@ -187,6 +187,32 @@ EXPOSITION: Dict[str, Tuple[str, str, str, str]] = {
         "tnn_serve_replicas", "gauge",
         "Active (non-retired, non-dead) replicas in the fleet — the "
         "autoscaler's actuated value", "replicas"),
+    "serve.handoff_exported": (
+        "tnn_serve_handoff_exported_blocks_total", "counter",
+        "KV blocks serialized for cross-replica handoff (device or host-"
+        "tier staged, digest attached)", "handoff_exported_blocks"),
+    "serve.handoff_adopted": (
+        "tnn_serve_handoff_adopted_blocks_total", "counter",
+        "Wire KV blocks adopted after digest verification (prefill those "
+        "positions never recompute)", "handoff_adopted_blocks"),
+    "serve.handoff_corrupt": (
+        "tnn_serve_handoff_corrupt_total", "counter",
+        "Wire KV blocks dropped at adopt because their integrity digest "
+        "failed (handoff degraded to recompute-resume)", "handoff_corrupt"),
+    "serve.boundary_handoffs": (
+        "tnn_serve_boundary_handoffs_total", "counter",
+        "Requests handed prefill->decode across replicas at the first-"
+        "token boundary", "boundary_handoffs"),
+    "serve.handoff_fallbacks": (
+        "tnn_serve_handoff_fallbacks_total", "counter",
+        "Boundary handoffs whose KV shipment failed or fell short — the "
+        "stream continued via token-exact recompute-resume",
+        "handoff_fallbacks"),
+    "serve.fleet_prefix_pulls": (
+        "tnn_serve_fleet_prefix_pulls_total", "counter",
+        "Admissions whose prefix KV was pulled from a peer replica via "
+        "the fleet chain-key directory instead of recomputed",
+        "fleet_prefix_pulls"),
 }
 
 #: direct (non-``_tick``) families: attribute/gauge name → (prometheus
@@ -448,6 +474,13 @@ class ServingMetrics:
         # host-KV-tier counters (elastic fleet)
         self.tier_hits = 0            # blocks re-admitted from the host tier
         self.tier_corrupt = 0         # entries dropped on digest mismatch
+        # disaggregated-serving counters (cross-replica KV handoff)
+        self.handoff_exported_blocks = 0  # blocks serialized for shipment
+        self.handoff_adopted_blocks = 0   # wire blocks digest-verified in
+        self.handoff_corrupt = 0          # wire blocks failing their digest
+        self.boundary_handoffs = 0    # prefill->decode replica handoffs
+        self.handoff_fallbacks = 0    # handoffs degraded to recompute-resume
+        self.fleet_prefix_pulls = 0   # peer-sourced prefix admissions
         self._t_created = time.perf_counter()
         self._t_first: Optional[float] = None
         self._t_last: Optional[float] = None
@@ -608,6 +641,42 @@ class ServingMetrics:
         dropped — the lookup degraded to an uncached miss."""
         self.tier_corrupt += 1
         self._tick("serve.tier_corrupt", 1)
+
+    def observe_handoff_export(self, blocks: int) -> None:
+        """``blocks`` KV blocks serialized (with chain key + digest) for
+        cross-replica shipment — from device pages or host-tier staging."""
+        self.handoff_exported_blocks += blocks
+        self._tick("serve.handoff_exported", blocks)
+
+    def observe_handoff_adopt(self, blocks: int) -> None:
+        """``blocks`` wire KV blocks adopted after digest verification —
+        prefill work the receiving replica never re-ran."""
+        self.handoff_adopted_blocks += blocks
+        self._tick("serve.handoff_adopted", blocks)
+
+    def observe_handoff_corrupt(self) -> None:
+        """A wire KV block failed its integrity digest at adopt and was
+        dropped — the handoff degrades to recompute-resume."""
+        self.handoff_corrupt += 1
+        self._tick("serve.handoff_corrupt", 1)
+
+    def observe_boundary_handoff(self) -> None:
+        """One request handed prefill->decode across replicas at its
+        first-token boundary."""
+        self.boundary_handoffs += 1
+        self._tick("serve.boundary_handoffs", 1)
+
+    def observe_handoff_fallback(self) -> None:
+        """A boundary handoff's KV shipment failed or fell short; the
+        stream continued token-exact via recompute-resume."""
+        self.handoff_fallbacks += 1
+        self._tick("serve.handoff_fallbacks", 1)
+
+    def observe_fleet_prefix_pull(self) -> None:
+        """An admission's prefix KV was pulled from a peer replica via the
+        fleet chain-key directory instead of recomputed locally."""
+        self.fleet_prefix_pulls += 1
+        self._tick("serve.fleet_prefix_pulls", 1)
 
     def observe_preemption(self, rid: Optional[int] = None) -> None:
         self.preemptions += 1
@@ -840,6 +909,12 @@ class ServingMetrics:
             "tp_degree": getattr(self, "_last_tp_degree", 1.0),
             "tier_hits": self.tier_hits,
             "tier_corrupt": self.tier_corrupt,
+            "handoff_exported_blocks": self.handoff_exported_blocks,
+            "handoff_adopted_blocks": self.handoff_adopted_blocks,
+            "handoff_corrupt": self.handoff_corrupt,
+            "boundary_handoffs": self.boundary_handoffs,
+            "handoff_fallbacks": self.handoff_fallbacks,
+            "fleet_prefix_pulls": self.fleet_prefix_pulls,
             "tier_blocks": getattr(self, "_last_tier_blocks", 0),
             "tier_bytes": getattr(self, "_last_tier_bytes", 0.0),
             "replicas": getattr(self, "_last_replicas", 0.0),

@@ -67,6 +67,19 @@ def block_key(prev_key: bytes, tokens: np.ndarray) -> bytes:
     return h.digest()
 
 
+def chain_keys(tokens: Sequence[int], block_size: int) -> List[bytes]:
+    """Chain keys for every FULL block of ``tokens`` (partial tail
+    excluded — it has no stable key until filled). Module-level so callers
+    WITHOUT a cache instance — the router's fleet-wide chain-key directory
+    — can address content by prefix identically to every replica's index."""
+    toks = np.asarray(tokens, np.int32).reshape(-1)
+    keys, key = [], ROOT_KEY
+    for i in range(len(toks) // block_size):
+        key = block_key(key, toks[i * block_size:(i + 1) * block_size])
+        keys.append(key)
+    return keys
+
+
 class PrefixCache:
     """Content-addressed full-block index over one ``PagedKVPool``.
 
@@ -109,18 +122,22 @@ class PrefixCache:
         this still names every reclaimed-but-indexed block."""
         return self._key_of.get(block)
 
+    def block_of(self, key: bytes) -> Optional[int]:
+        """Pool block a chain key is indexed at, or None — the export
+        half of cross-replica handoff reads page content by key."""
+        return self._index.get(key)
+
+    def keys(self) -> List[bytes]:
+        """Every device-resident chain key (the fleet directory's view of
+        what this replica can export)."""
+        return list(self._index.keys())
+
     # -- lookup ---------------------------------------------------------------
 
     def chain_keys(self, tokens: Sequence[int]) -> List[bytes]:
         """Chain keys for every FULL block of ``tokens`` (partial tail
         excluded — it has no stable key until filled)."""
-        toks = np.asarray(tokens, np.int32).reshape(-1)
-        bs = self.block_size
-        keys, key = [], ROOT_KEY
-        for i in range(len(toks) // bs):
-            key = block_key(key, toks[i * bs:(i + 1) * bs])
-            keys.append(key)
-        return keys
+        return chain_keys(tokens, self.block_size)
 
     def probe(self, tokens: Sequence[int]) -> Tuple[List[int], int, bool]:
         """Longest cached prefix of ``tokens`` at full-block granularity.

@@ -74,6 +74,36 @@ mechanisms handle it:
   breaker. A hedge budget (``hedge_budget`` × open requests, consulted
   before every fire) bounds amplification.
 
+Disaggregated prefill/decode serving (PR: disagg) — prefill is
+compute-bound and bursty, decode is latency-bound and steady; co-locating
+them makes every long prompt stall every decode stream sharing the batch.
+Replicas therefore carry a **role** (``prefill`` / ``decode`` / ``mixed``,
+the default), assigned statically per replica or dynamically (``roles=
+"auto"``: the probe loop ranks replicas by health score and dedicates the
+healthiest half to decode). Roles are placement *preferences*, never
+admission gates — a fleet with no matching role falls back to any
+available replica, so no request can fail because of a role:
+
+- **Long-prompt admission** (``len(prompt) >= disagg_prompt_threshold``)
+  prefers prefill replicas; everything else prefers decode/mixed, keeping
+  prefill bursts off the decode batch.
+- **Boundary handoff.** When a prefill replica streams a request's FIRST
+  token, the router moves the stream to a decode replica through the same
+  epoch-guarded migration path as crash failover — token-exact by
+  recompute-resume. With ``handoff_kv`` the move is cheap: the source
+  exports its prefix KV blocks (``export_prefix``, content-addressed by
+  chain key + blake2b digest) and the target adopts them through the
+  digest-verified path (``adopt_prefix``) before re-dispatch, so the
+  "recompute" prefill hits the adopted prefix instead of re-running it.
+  A failed export/adopt (corrupt wire bytes, pool full, receiver killed
+  mid-adopt) degrades to plain recompute-resume — never a wrong token,
+  never a dropped request.
+- **Fleet-wide shared prefix cache** (``fleet_prefix``). The probe loop
+  maintains a content-addressed directory of which replica holds which
+  chain keys (``prefix_keys()``); at dispatch, a prompt whose prefix
+  misses on the chosen replica but hits on a peer pulls the blocks over
+  through the same export/adopt path instead of recomputing them.
+
 Chaos seams: the router's optional ``FaultPlan`` fires ``net.delay`` /
 ``net.drop`` inside ``_call`` (injected router↔replica latency and loss),
 ``net.partition`` opens windows during which every router↔replica call
@@ -107,6 +137,7 @@ import numpy as np
 
 from ..profiling.profiler import Profiler
 from .metrics import ServingMetrics, label_series, merge_series
+from .prefix_cache import chain_keys
 from .scheduler import AdmissionRejected
 from .supervisor import (EngineSupervisor, EventListener, ShuttingDown,
                          SupervisorState)
@@ -263,6 +294,9 @@ class _Replica:
     # completion (its live streams are proactively migrated first); unlike
     # killed it stays token-correct while it empties
     retired: bool = False
+    # disaggregation role (module doc): a placement PREFERENCE, never an
+    # admission gate — "mixed" serves anything
+    role: str = "mixed"
 
     @property
     def available(self) -> bool:
@@ -301,6 +335,11 @@ class _Routed:
     hedge_local_rid: Optional[int] = None
     hedged: bool = False
     done: bool = False
+    # disaggregation: role preference for the NEXT dispatch ("prefill"
+    # until the boundary handoff flips it to "decode"), plus a one-replica
+    # affinity hint so a re-dispatch lands where the KV was just adopted
+    prefer_role: Optional[str] = None
+    prefer_replica: Optional[int] = None
 
 
 #: substrings identifying a terminal error as the REPLICA dying (migrate)
@@ -341,6 +380,10 @@ class Router:
                  degrade_cooldown_s: float = 0.5,
                  readmit_factor: Optional[float] = None,
                  score_tolerance: float = 0.5,
+                 roles: Optional[Sequence[str]] = None,
+                 disagg_prompt_threshold: int = 0,
+                 handoff_kv: bool = True,
+                 fleet_prefix: bool = False,
                  event_sink: Optional[EventListener] = None,
                  profiler: Optional[Profiler] = None,
                  seed: int = 0):
@@ -357,6 +400,39 @@ class Router:
                      breaker=CircuitBreaker(breaker_threshold,
                                             breaker_cooldown_s))
             for i, s in enumerate(supervisors)]
+        # disaggregation (module doc): roles is a per-replica sequence, the
+        # string "auto" (health-ranked assignment by the probe loop), or
+        # None (all mixed — disaggregation off)
+        self._auto_roles = roles == "auto"
+        if roles is not None and not self._auto_roles:
+            rl = list(roles)
+            if len(rl) != len(self._handles):
+                raise ValueError(
+                    f"roles must name every replica: got {len(rl)} roles "
+                    f"for {len(self._handles)} replicas")
+            bad = sorted(set(r for r in rl
+                             if r not in ("prefill", "decode", "mixed")))
+            if bad:
+                raise ValueError(f"unknown replica role(s): {bad}")
+            if "prefill" in rl and not any(r in ("decode", "mixed")
+                                           for r in rl):
+                raise ValueError(
+                    "a disaggregated fleet needs at least one decode or "
+                    "mixed replica to stream completions")
+            for h, r in zip(self._handles, rl):
+                h.role = r
+        self.disagg_prompt_threshold = int(disagg_prompt_threshold)
+        self.handoff_kv = bool(handoff_kv)
+        self.fleet_prefix = bool(fleet_prefix)
+        # fleet prefix directory: replica idx -> chain keys it can export
+        # (refreshed by the probe loop at a slower cadence)
+        self._replica_keys: Dict[int, Set[bytes]] = {}
+        self._probe_count = 0
+        # block size for chain-key computation at the router (immutable
+        # engine config; None when the handle is not a real supervisor)
+        eng = getattr(supervisors[0], "engine", None)
+        self._block_size = getattr(getattr(eng, "pool", None),
+                                   "block_size", None)
         # kept for add_replica: replicas joining mid-flight get the same
         # breaker configuration the founding set got
         self.breaker_threshold = int(breaker_threshold)
@@ -528,6 +604,12 @@ class Router:
         # re-submits with the SAME id, so the Perfetto view shows one
         # request hopping across replica tracks
         rec.kwargs.setdefault("trace_id", f"g{rec.gid}")
+        # disaggregation: a long prompt is prefill-bound — prefer a
+        # prefill replica; the boundary handoff moves it to decode after
+        # the first token (module doc)
+        if (self._disagg_on() and self.disagg_prompt_threshold > 0
+                and len(prompt) >= self.disagg_prompt_threshold):
+            rec.prefer_role = "prefill"
         with self._lock:
             self._open[rec.gid] = rec
             self._submitted += 1
@@ -579,6 +661,7 @@ class Router:
                 "killed": h.killed,
                 "degraded": h.degraded,
                 "retired": h.retired,
+                "role": h.role,
                 "health_score": round(h.health.score(), 4),
             } for h in self._handles]
             s: Dict[str, Any] = {
@@ -595,6 +678,9 @@ class Router:
                 "hedges_cancelled": self.metrics.hedges_cancelled,
                 "degraded_ejections": self.metrics.degraded_ejections,
                 "proactive_migrations": self.metrics.proactive_migrations,
+                "boundary_handoffs": self.metrics.boundary_handoffs,
+                "handoff_fallbacks": self.metrics.handoff_fallbacks,
+                "fleet_prefix_pulls": self.metrics.fleet_prefix_pulls,
                 "replica_restarts": sum(h.sup.restarts
                                         for h in self._handles),
                 "replicas": per_replica,
@@ -669,6 +755,9 @@ class Router:
                 "hedges_cancelled": self.metrics.hedges_cancelled,
                 "degraded_ejections": self.metrics.degraded_ejections,
                 "proactive_migrations": self.metrics.proactive_migrations,
+                "boundary_handoffs": self.metrics.boundary_handoffs,
+                "handoff_fallbacks": self.metrics.handoff_fallbacks,
+                "fleet_prefix_pulls": self.metrics.fleet_prefix_pulls,
             }
 
     def kill_replica(self, idx: int,
@@ -826,13 +915,36 @@ class Router:
                     f"injected net drop on call to replica {h.idx}")
         return fn()
 
-    def _pick(self, exclude: Optional[int] = None) -> Optional[_Replica]:
+    def _disagg_on(self) -> bool:
+        """Any non-mixed role assigned? (Reads are GIL-atomic; callers
+        that must not race hold the lock anyway.)"""
+        return any(h.role != "mixed" for h in self._handles)
+
+    @staticmethod
+    def _role_ok(h: _Replica, want: str) -> bool:
+        """Does replica ``h`` match the role preference ``want``? Mixed
+        replicas match everything; a decode-phase request also matches
+        decode-only replicas, never prefill-only ones (and vice versa)."""
+        if want == "prefill":
+            return h.role in ("prefill", "mixed")
+        return h.role in ("decode", "mixed")
+
+    def _pick(self, exclude: Optional[int] = None,
+              prefer_role: Optional[str] = None,
+              prefer: Optional[int] = None) -> Optional[_Replica]:
         """Health-score-weighted join-shortest-queue over available
         replicas (router-assigned live counts, so no cross-thread engine
         reads). The placement key is ``(live + 1) * weight`` where the
         weight is the replica's score ratio against the healthiest
         candidate, snapped to 1.0 inside the ``score_tolerance`` dead-band
         — a fleet with uniform scores routes byte-identical to pure JSQ.
+
+        Disaggregation narrows the pool by role preference first: an
+        explicit ``prefer_role``, else (when any role is assigned)
+        "decode" — short requests belong on the decode side. An empty
+        role-matched pool falls back to the full pool: roles are
+        preferences, not admission gates. ``prefer`` is a single-replica
+        affinity hint (the KV-handoff target) honored when available.
 
         DEGRADED replicas are excluded, except: past ``degrade_cooldown_s``
         one recovery-probe dispatch is admitted (so the replica can prove
@@ -857,6 +969,18 @@ class Router:
                 pool = probes or degraded_alive
             if not pool:
                 return None
+            if prefer_role is not None or self._disagg_on():
+                want = prefer_role or "decode"
+                matched = [h for h in pool if self._role_ok(h, want)]
+                if matched:
+                    pool = matched
+            if prefer is not None:
+                for h in pool:
+                    if h.idx == prefer:
+                        h.breaker.on_dispatch()
+                        if h.degraded:
+                            h.recovery_probing = True
+                        return h
             scores = {h.idx: h.health.score() for h in pool}
             ref = min(scores.values())
             best: Optional[_Replica] = None
@@ -921,11 +1045,19 @@ class Router:
                 if delay > 0:
                     time.sleep(delay)
             attempt += 1
-            h = self._pick()
+            h = self._pick(prefer_role=rec.prefer_role,
+                           prefer=(rec.prefer_replica
+                                   if attempt == 1 else None))
             if h is None:
                 last = ShuttingDown("no healthy replica "
                                     "(all dead or breakers open)")
                 continue
+            if (self.fleet_prefix and attempt == 1
+                    and not rec.emitted and rec.migrations == 0):
+                # shared prefix cache: before the first prefill, pull any
+                # peer-resident prefix blocks over (best-effort; a failed
+                # pull just means the prefill recomputes them)
+                self._fleet_prefix_pull(rec, h)
             epoch = rec.epoch
             listener = self._listener_for(rec, epoch, h)
             prompt, max_new, kwargs = self._resume_args(rec)
@@ -984,6 +1116,7 @@ class Router:
         kind = ev.get("event")
         migrate_reason: Optional[str] = None
         out: Optional[dict] = None
+        boundary = False       # prefill→decode handoff due after the emit
         loser = None           # (handle, lrid) to cancel outside the lock
         with self._lock:
             if rec.done:
@@ -1015,6 +1148,14 @@ class Router:
                 if rec.ttft_s is None:
                     rec.ttft_s = time.perf_counter() - rec.t_submit
                     self._ttft_window.append(rec.ttft_s)
+                    # prefill→decode boundary: the FIRST token from a
+                    # prefill replica triggers the handoff (after the
+                    # token is emitted — TTFT comes from the prefill side)
+                    if (h.role == "prefill"
+                            and rec.hedge_epoch is None
+                            and rec.migrations < self.migration_budget
+                            and rec.max_new - len(rec.emitted) > 0):
+                        boundary = True
                 out = {"event": "token", "id": rec.gid,
                        "token": int(ev["token"])}
             elif kind == "done":
@@ -1040,6 +1181,8 @@ class Router:
             return
         if out is not None:
             self._emit(rec, out)
+        if boundary:
+            self._boundary_handoff(rec, epoch, h)
 
     def _resolve_hedge_locked(self, rec: _Routed, *,
                               hedge_won: bool):
@@ -1239,10 +1382,34 @@ class Router:
                 return
             now = time.monotonic()
             scores = {h.idx: h.health.score() for h in alive}
-            med = statistics.median(scores.values())
+            # role-aware baseline: a disaggregated fleet is heterogeneous
+            # BY DESIGN — the prefill replica eats every long prompt, so
+            # its step latency and queue depth are structurally inflated
+            # relative to decode peers. Judged against the fleet-wide
+            # median it would be ejected for doing exactly its job; judged
+            # against same-role peers only genuine gray failure stands
+            # out. With roles off every replica is "mixed" and this
+            # degenerates to the fleet-wide median unchanged.
+            med_by_role = {}
+            for role in set(a.role for a in alive):
+                grp = [scores[a.idx] for a in alive if a.role == role]
+                med_by_role[role] = (statistics.median(grp), len(grp))
             non_degraded = sum(1 for h in alive if not h.degraded)
             for h in alive:
                 sc = scores[h.idx]
+                med, n_peers = med_by_role[h.role]
+                if n_peers < 2:
+                    # a role singleton has no like-for-like baseline:
+                    # never eject it (the breaker + restart path still
+                    # covers hard failure), and readmit it if a past
+                    # ejection stranded it in a group of one
+                    h.suspect_since = None
+                    if h.degraded:
+                        h.degraded = False
+                        h.readmit_since = None
+                        h.degraded_at = None
+                        h.recovery_probing = False
+                    continue
                 if not h.degraded:
                     if med > 0 and sc > self.degrade_factor * med:
                         if h.suspect_since is None:
@@ -1334,6 +1501,169 @@ class Router:
                 emitted=len(rec.emitted))
         self._dispatch(rec)   # failure here emits the terminal error event
 
+    # -- disaggregated serving: boundary handoff / fleet prefix cache ----------
+
+    def _boundary_handoff(self, rec: _Routed, epoch: int,
+                          h: _Replica) -> None:
+        """Move one stream from its prefill replica to a decode replica at
+        the first-token boundary — the same epoch-guarded, token-exact
+        migration path as crash failover, but the old stream is cancelled
+        quietly (the prefill replica is healthy) and no breaker is
+        charged. With ``handoff_kv`` the prefix KV ships ahead of the
+        re-dispatch through the digest-verified export/adopt path, so the
+        resume prefill on the decode side hits the adopted blocks instead
+        of recomputing them; ANY failure along that path (corrupt wire
+        bytes, pool full, the target dying mid-adopt) degrades to plain
+        recompute-resume. Streams that resolved, hedged, or ran out of
+        migration budget while we worked finish in place."""
+        with self._lock:
+            if self._state is not SupervisorState.RUNNING:
+                # a draining fleet refuses new engine-level submits, so
+                # cancelling the healthy source stream would strand the
+                # resume in rejected re-dispatches — finish where we are
+                return
+        target = self._pick(exclude=h.idx, prefer_role="decode")
+        if target is None:
+            return   # no decode-side capacity: finish where we are
+        handed = 0
+        if self.handoff_kv:
+            try:
+                toks = (np.concatenate(
+                    [rec.prompt, np.asarray(rec.emitted, np.int32)])
+                    if rec.emitted else rec.prompt)
+                exports = self._call(h, functools.partial(
+                    h.sup.export_prefix, toks))
+                if exports:
+                    handed = int(self._call(target, functools.partial(
+                        target.sup.adopt_prefix, exports)))
+            except Exception:  # noqa: BLE001 — degrade to recompute-resume
+                handed = 0
+        with self._lock:
+            if (rec.done or rec.epoch != epoch or rec.replica != h.idx
+                    or rec.hedge_epoch is not None
+                    or rec.migrations >= self.migration_budget
+                    or rec.max_new - len(rec.emitted) <= 0):
+                return
+            old_lrid = rec.local_rid
+            h.live.discard(rec.gid)
+            rec.migrations += 1
+            rec.epoch_seq += 1
+            rec.epoch = rec.epoch_seq
+            rec.replica = None
+            rec.local_rid = None
+            rec.prefer_role = "decode"
+            rec.prefer_replica = target.idx
+        if old_lrid is not None:
+            self._cancel_quiet(h, old_lrid)
+        self.metrics.observe_boundary_handoff()
+        if self.handoff_kv and handed == 0:
+            self.metrics.observe_handoff_fallback()
+        self.metrics.observe_migration(len(rec.prompt) + len(rec.emitted))
+        if self.tracer.enabled:
+            self.tracer.instant(
+                "router.handoff", trace=rec.kwargs.get("trace_id"),
+                gid=rec.gid, from_replica=h.idx, to_replica=target.idx,
+                adopted_blocks=handed, kv=self.handoff_kv)
+        self._dispatch(rec)   # failure here emits the terminal error event
+
+    def _fleet_prefix_pull(self, rec: _Routed, h: _Replica) -> None:
+        """Shared prefix cache: before ``rec``'s first prefill on replica
+        ``h``, find the peer whose directory entry covers the longest
+        leading chain of the prompt — strictly longer than what ``h``
+        already holds — and pull those blocks over through the verified
+        export/adopt path. Entirely best-effort: any miss, stale directory
+        entry, or wire failure leaves the prefill to recompute."""
+        if self._block_size is None or len(rec.prompt) < self._block_size:
+            return
+        keys = chain_keys(rec.prompt, self._block_size)
+        if not keys:
+            return
+        with self._lock:
+            directory = dict(self._replica_keys)
+        have = directory.get(h.idx, set())
+        lead = 0
+        while lead < len(keys) and keys[lead] in have:
+            lead += 1
+        if lead >= len(keys):
+            return   # the chosen replica already holds the whole chain
+        best: Optional[_Replica] = None
+        best_run = lead
+        for idx, ks in directory.items():
+            if idx == h.idx:
+                continue
+            hh = self._handles[idx]
+            if hh.killed or hh.sup.finished:
+                continue
+            run = 0
+            while run < len(keys) and keys[run] in ks:
+                run += 1
+            if run > best_run:
+                best, best_run = hh, run
+        if best is None:
+            return
+        try:
+            exports = self._call(best, functools.partial(
+                best.sup.export_prefix, rec.prompt, best_run))
+            if not exports:
+                return
+            adopted = int(self._call(h, functools.partial(
+                h.sup.adopt_prefix, exports)))
+        except Exception:  # noqa: BLE001 — a failed pull is a cache miss
+            self.metrics.observe_handoff_fallback()
+            return
+        if adopted:
+            self.metrics.observe_fleet_prefix_pull()
+            with self._lock:
+                self._replica_keys.setdefault(h.idx, set()).update(
+                    k for k, _, _ in exports)
+            if self.tracer.enabled:
+                self.tracer.instant(
+                    "router.prefix_pull", gid=rec.gid, source=best.idx,
+                    target=h.idx, blocks=adopted)
+
+    def _refresh_prefix_dir(self) -> None:
+        """Probe-loop refresh of the fleet prefix directory: which replica
+        can export which chain keys. Dead/retired replicas drop out; a
+        replica that cannot answer keeps its last entry (content
+        addressing makes staleness safe — a stale key at worst yields an
+        empty export, never wrong bytes)."""
+        for h in list(self._handles):
+            if h.killed or h.retired or h.sup.finished:
+                with self._lock:
+                    self._replica_keys.pop(h.idx, None)
+                continue
+            try:
+                ks = self._call(h, h.sup.prefix_keys)
+            except Exception:  # noqa: BLE001 — keep the last snapshot
+                continue
+            with self._lock:
+                self._replica_keys[h.idx] = set(ks)
+
+    def _auto_assign_roles(self) -> None:
+        """Dynamic role assignment (``roles="auto"``): rank live replicas
+        by health score and dedicate the healthiest half to decode (the
+        latency-bound side), the rest to prefill. A one-replica fleet
+        stays mixed. Roles are preferences, so reassignment never strands
+        a stream — at worst the next dispatch prefers a different
+        replica."""
+        with self._lock:
+            alive = [h for h in self._handles
+                     if not h.killed and not h.retired
+                     and not h.sup.finished]
+            if len(alive) < 2:
+                for h in alive:
+                    h.role = "mixed"
+                return
+            ranked = sorted(alive, key=lambda h: (h.health.score(), h.idx))
+            n_decode = (len(ranked) + 1) // 2
+            for i, h in enumerate(ranked):
+                want = "decode" if i < n_decode else "prefill"
+                if h.role != want:
+                    h.role = want
+                    if self.tracer.enabled:
+                        self.tracer.instant("router.role", replica=h.idx,
+                                            role=want)
+
     def _hedge_threshold_locked(self) -> Optional[float]:
         """The TTFT past which a request gets hedged (caller holds the
         lock): the fixed ``hedge_ttft_s`` when configured, else adaptive —
@@ -1360,10 +1690,17 @@ class Router:
                 return
             pending = sum(1 for r in self._open.values()
                           if r.hedge_epoch is not None)
+            # a request still awaiting its prefill→decode boundary
+            # (prefer_role == "prefill") is slow BY SELECTION — it is a
+            # long prompt on the prefill tier, and the boundary handoff
+            # is already the migration that will move it. Hedging it
+            # would duplicate the most expensive prefill in the fleet
+            # onto a decode replica, defeating the disaggregation.
             overdue = [r for r in self._open.values()
                        if not r.done and r.ttft_s is None and not r.hedged
                        and r.replica is not None
                        and r.local_rid is not None
+                       and r.prefer_role != "prefill"
                        and now - r.t_dispatch > thr]
         for rec in overdue:
             with self._lock:
@@ -1447,6 +1784,14 @@ class Router:
                         or self._handles[r.hedge_replica].sup.finished):
                     self._resolve_hedge_locked(r, hedge_won=False)
         self._update_health()
+        if self._auto_roles:
+            self._auto_assign_roles()
+        if self.fleet_prefix:
+            # directory refresh at a slower cadence than the health probe:
+            # prefix publication changes far slower than health does
+            self._probe_count += 1
+            if self._probe_count % 4 == 1:
+                self._refresh_prefix_dir()
         self._maybe_hedge()
         # keep the tnn_serve_replicas gauge fresh even when fleet changes
         # happen through kill/drain rather than an explicit scale event

@@ -248,6 +248,26 @@ class EngineSupervisor:
         lifecycle gauges — the ``GET /metrics`` backend."""
         return self._execute(self._prometheus_series)
 
+    def export_prefix(self, tokens, max_blocks: Optional[int] = None) \
+            -> List[Any]:
+        """Thread-safe ``engine.export_prefix``: serialize the longest
+        exportable chain prefix of ``tokens`` as digest-carrying wire
+        blocks for a cross-replica handoff (marshalled through the worker
+        so the page fetch never races a step's donation)."""
+        return self._execute(
+            lambda: self.engine.export_prefix(tokens, max_blocks))
+
+    def adopt_prefix(self, exports) -> int:
+        """Thread-safe ``engine.adopt_prefix``: digest-verify and adopt
+        wire blocks into this replica's prefix index; returns how many
+        landed (short counts degrade to recompute-resume at the router)."""
+        return self._execute(lambda: self.engine.adopt_prefix(exports))
+
+    def prefix_keys(self) -> List[bytes]:
+        """Thread-safe ``engine.prefix_keys``: the chain keys this replica
+        can export — the router's fleet-directory refresh source."""
+        return self._execute(lambda: self.engine.prefix_keys())
+
     def request_drain(self, reason: str = "drain requested") -> None:
         """Begin a graceful drain (idempotent; safe from signal handlers):
         close admissions now, let in-flight work finish or deadline out,

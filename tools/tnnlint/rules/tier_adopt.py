@@ -31,6 +31,25 @@ whose receiver has no ``tier``) stay clean:
 ``demote`` (admission INTO the tier, where the digest is computed) and
 the tier's stats/maintenance surface (``stats``, ``clear``, ``keys``,
 ``check_invariants``) are not adoption and are not matched.
+
+Cross-replica wire adoption is held to the same contract. Disaggregated
+serving ships KV blocks between replicas as ``(chain_key, leaves,
+digest)`` wire tuples, and ``pool.adopt_blocks`` writes whatever payload
+it is handed straight into device pages — so EVERY ``adopt_blocks`` call
+site (any receiver, not just tier-shaped ones) must recompute the digest
+in the same enclosing function, via ``tier_digest`` (wire blocks) or
+``verify_readmit`` (tier entries). A call site that adopts without a
+local verification call is flagged:
+
+    if tier_digest(key, leaves) != digest:      # OK: verified here
+        break
+    self.pool.adopt_blocks([(blk, k, v)], fn, put)
+
+    self.pool.adopt_blocks([(blk, k, v)], fn, put)   # flagged: no check
+
+Helper indirection does not satisfy the rule on purpose: the check must
+be visible AT the adoption site, so a refactor cannot silently detach
+verification from the write.
 """
 from __future__ import annotations
 
@@ -41,6 +60,12 @@ from ..core import ModuleContext, Rule, Violation, dotted_name, register
 
 #: method names that hand a payload OUT of a tier-shaped receiver
 _ADOPT_ATTRS = ("adopt", "adopt_block", "readmit", "get", "pop")
+
+#: method names that write a wire payload into device pages on ANY receiver
+_WIRE_ADOPT_ATTRS = ("adopt_blocks",)
+
+#: calls that count as digest verification in the enclosing function
+_VERIFY_CALLS = ("tier_digest", "verify_readmit")
 
 
 @register
@@ -53,15 +78,42 @@ class TierAdoptUnverified(Rule):
     def check_module(self, ctx: ModuleContext) -> List[Violation]:
         opts = ctx.rule_options(self.name)
         attrs = tuple(opts.get("adopt_attrs", _ADOPT_ATTRS))
+        wire_attrs = tuple(opts.get("wire_adopt_attrs", _WIRE_ADOPT_ATTRS))
+        verify_calls = tuple(opts.get("verify_calls", _VERIFY_CALLS))
+        # nearest-enclosing-function map: a wire adopt is judged against the
+        # verification calls of ITS OWN scope, not a parent's or sibling's
+        parents = {}
+        for node in ast.walk(ctx.tree):
+            for child in ast.iter_child_nodes(node):
+                parents[child] = node
+
+        def scope_of(node):
+            while node in parents:
+                node = parents[node]
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    return node
+            return ctx.tree   # module level is its own scope
+
+        verified_scopes = set()
+        wire_sites = []
         out: List[Violation] = []
         for node in ast.walk(ctx.tree):
             if not isinstance(node, ast.Call):
                 continue
             fn = node.func
-            if not isinstance(fn, ast.Attribute) or fn.attr not in attrs:
+            if not isinstance(fn, ast.Attribute):
+                if isinstance(fn, ast.Name) and fn.id in verify_calls:
+                    verified_scopes.add(id(scope_of(node)))
                 continue
+            if fn.attr in verify_calls:
+                verified_scopes.add(id(scope_of(node)))
             receiver = dotted_name(fn.value) if isinstance(
                 fn.value, (ast.Attribute, ast.Name)) else None
+            if fn.attr in wire_attrs:
+                wire_sites.append((node, receiver or "?", fn.attr))
+                continue
+            if fn.attr not in attrs:
+                continue
             if receiver is None or "tier" not in receiver.lower():
                 continue
             out.append(self.violation(
@@ -72,4 +124,15 @@ class TierAdoptUnverified(Rule):
                 f"(HostKVTier.verify_readmit), which degrades a corrupt "
                 f"or torn block to an uncached miss instead of adopting "
                 f"wrong KV"))
+        for node, receiver, attr in wire_sites:
+            if id(scope_of(node)) in verified_scopes:
+                continue
+            out.append(self.violation(
+                ctx, node,
+                f"'{receiver}.{attr}(...)' writes a wire payload into "
+                f"device pages with no digest verification in the "
+                f"enclosing function — recompute the blake2b digest at the "
+                f"adoption site (tier_digest over the wire bytes, or "
+                f"verify_readmit for tier entries) so corrupt or torn "
+                f"blocks degrade to recompute, never to wrong KV"))
         return out
