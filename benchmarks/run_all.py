@@ -51,6 +51,12 @@ def main(argv=None):
         # and the per-chip KV capacity headline (returns no rows — with a
         # printed note — on a genuinely single-device host)
         results.extend(serve_bench.main(["--tp"]))
+        # long-context gate: sp=1 vs sp=2/4 sequence-parallel A/B at a
+        # fixed per-chip KV footprint — max servable context must scale
+        # exactly ~N x, short streams token-exact vs sp=1, and the
+        # long-prompt row must serve at sp>1 / fail cleanly at sp=1
+        # (returns no rows — with a printed note — on one device)
+        results.extend(serve_bench.main(["--longctx"]))
         # elastic-fleet gate: trickle-then-burst A/B, autoscaler off vs on
         # — the on row must strictly beat the off twin's goodput-at-SLO
         # and the host-tier probe must beat the no-tier baseline

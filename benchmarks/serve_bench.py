@@ -68,6 +68,22 @@ max_concurrent_at_slo (requests fitting a fixed PER-CHIP HBM budget) rises
 with it. Needs >=2 JAX devices; rows persist as
 benchmarks/results/tp_ab_smoke.json.
 
+--longctx runs a sequence-parallel long-context A/B (bench_longctx): the
+SAME per-chip KV footprint (blocks_per_chip pool blocks per device) at
+sp=1 vs sp=2 vs sp=4 over the context mesh. Every gate is deterministic:
+max_context_blocks scales EXACTLY ~N x (sp * (blocks_per_chip - 1), one
+scratch block per shard) while per-chip residency stays flat, the short
+decode batch is token-identical to the sp=1 reference, and the
+long-prompt row — a prompt whose KV exceeds ONE chip's pool — serves
+token-exact against the teacher-forced greedy reference at sp>1 and is
+rejected with a pointed admission error (not an OOM) at sp=1. Prefill
+wall-clock for the long prompt rides the artifact's info section: on a
+real mesh each shard sweeps 1/sp of the pages per layer, but the virtual
+CPU mesh timeshares one core, so the deterministic stand-in — per-shard
+table span exactly assembly_width/sp — is gated instead. Needs >=2 JAX
+devices (the sp=4 row needs 4); rows persist as
+benchmarks/results/longctx_ab_smoke.json.
+
 --spike runs an elastic-fleet A/B (bench_spike): the same two-phase
 arrival trace (gentle trickle, then a Poisson burst) through a Router of
 host-tier-enabled replicas, once pinned at 1 replica (autoscaler off) and
@@ -847,6 +863,162 @@ def bench_tp(model, params, *, num_requests: int, prompt_len: int,
                            meta={"devices": jax.device_count(),
                                  "kv_budget_mb": kv_budget_mb},
                            label="tp A/B")
+            row["artifact_path"] = artifact
+    return row
+
+
+def bench_longctx(model, params, *, sp: int, sp_max: int,
+                  blocks_per_chip: int = 4, block_size: int = 4,
+                  max_new: int = 4, label: str = "serve_longctx",
+                  seed: int = 0, shared: dict = None, artifact: str = None):
+    """Sequence-parallel long-context A/B row: the SAME per-chip KV
+    footprint (``blocks_per_chip`` pool blocks per device) at sp=1
+    (baseline) and sp>1 (each request's blocks round-robined over a
+    context mesh, every shard sweeping its own pages, one online-softmax
+    merge per layer).
+
+    All gates are deterministic, per the artifact convention that
+    wall-clock columns are informational:
+
+    - capacity arithmetic: ``max_context_blocks == sp *
+      (blocks_per_chip - 1)`` EXACTLY (one reserved scratch block per
+      shard) — aggregate context scales ~N x while per-chip residency
+      (``pool_blocks_per_shard``) stays flat;
+    - ``exact_vs_sp1``: the short decode batch (fits even the sp=1 pool)
+      is token-identical to the sp=1 reference streams;
+    - the long-prompt row — KV exceeding ONE chip's pool — serves
+      token-exact against the teacher-forced greedy reference at sp>1
+      (``gate_long_prompt_exact``) and is REJECTED with a pointed
+      admission error, not an OOM or a hang, at sp=1
+      (``gate_long_prompt_rejected``);
+    - ``gate_shard_span``: each shard's per-layer sweep covers exactly
+      ``blocks_per_seq / sp`` table positions — the mechanism behind the
+      prefill speedup on a real mesh.
+
+    ``long_prefill_ms`` (the long prompt's TTFT) is reported per sp>1
+    row but NOT gated: each shard sweeps 1/sp of the pages per layer, so
+    on real multi-chip hardware it drops ~sp x, but this smoke runs on a
+    virtual CPU mesh whose shards timeshare one core. ``shared`` carries
+    the sp=1 short-batch reference between rows; ``artifact`` persists
+    all rows once the sp_max row lands.
+    """
+    from tnn_tpu.models.gpt2 import generate
+    from tnn_tpu.serving import InferenceEngine
+
+    num_blocks = blocks_per_chip * sp
+    print(f"{label}: per-chip pool {blocks_per_chip} x {block_size}-token "
+          f"blocks, sp={sp} ({jax.device_count()} devices) -> "
+          f"{num_blocks} blocks aggregate")
+
+    def mk_engine():
+        return InferenceEngine(
+            model, params, num_blocks=num_blocks, block_size=block_size,
+            max_batch_size=2, max_seq_len=model.max_len, seed=seed,
+            decode_path="paged", sp=sp)
+
+    # short batch: fits even the sp=1 pool (3 usable blocks = 12 tokens
+    # at the defaults), so every row decodes the SAME streams — the
+    # token-exactness gate of the sequence-parallel transform
+    rng = np.random.default_rng(seed)
+    cap1 = (blocks_per_chip - 1) * block_size
+    shorts = [rng.integers(0, model.vocab_size, int(l)).astype(np.int32)
+              for l in rng.integers(5, cap1 - max_new + 1, 3)]
+    engine = mk_engine()
+    t0 = time.perf_counter()
+    rids = [engine.submit(p, max_new) for p in shorts]
+    out = engine.run_until_complete()
+    wall = time.perf_counter() - t0
+    outs = [out[r] for r in rids]
+    assert engine.pool.num_allocated == 0, "leaked KV blocks (short batch)"
+    engine.check_invariants()
+
+    shared = shared if shared is not None else {}
+    if sp == 1:
+        shared["ref_outs"] = outs
+    ref_outs = shared.get("ref_outs")
+    exact = ref_outs is not None and len(outs) == len(ref_outs) and \
+        all(np.array_equal(a, b) for a, b in zip(outs, ref_outs))
+    assert exact, "sequence-parallel decode diverged from the sp=1 streams"
+
+    st = engine.stats()
+    assert st["sp_degree"] == sp
+    assert st["pool_blocks_per_shard"] == blocks_per_chip, \
+        "per-chip residency moved — the capacity headline is flat HBM"
+    max_ctx_blocks = engine.pool.capacity
+    assert max_ctx_blocks == sp * (blocks_per_chip - 1), \
+        "aggregate context capacity is not exactly ~N x per chip"
+    assert engine.blocks_per_seq % sp == 0
+    span = engine.blocks_per_seq // sp
+
+    # long-prompt row: KV needs more blocks than ONE chip's pool holds.
+    # Sized to the row's own aggregate capacity, so the sp=4 row serves a
+    # prompt more than 3 x what any single chip could. rng(100) is a
+    # checked tie-free seed: the merge is exact to float tolerance, but
+    # XLA fusion drift inside shard_map can flip greedy argmax near-ties
+    # on this tiny random model (same convention as the tp/sp tests).
+    long_len = max_ctx_blocks * block_size - max_new
+    long_p = np.random.default_rng(100).integers(
+        0, model.vocab_size, long_len).astype(np.int32)
+    long_exact = 0
+    long_rejected = 0
+    long_ttft_ms = 0.0
+    if sp == 1:
+        try:
+            # the NEXT row's long prompt (same per-chip footprint, sp x
+            # the aggregate) must fail cleanly here at admission
+            probe = np.random.default_rng(100).integers(
+                0, model.vocab_size,
+                2 * (blocks_per_chip - 1) * block_size - max_new
+            ).astype(np.int32)
+            engine.submit(probe, max_new)
+        except ValueError:
+            long_rejected = 1
+        assert long_rejected, \
+            "a prompt exceeding one chip's pool was admitted at sp=1"
+    else:
+        eng2 = mk_engine()
+        r = eng2.submit(long_p, max_new)
+        t0 = time.perf_counter()
+        lout = eng2.run_until_complete()
+        long_prefill_s = time.perf_counter() - t0
+        s2 = eng2.metrics.summary()
+        long_ttft_ms = s2["ttft_ms_p50"] or long_prefill_s * 1e3
+        ref = np.asarray(generate(model, params, long_p[None], max_new,
+                                  max_len=eng2.assembly_len))[0].tolist()
+        long_exact = int(lout[r] == ref)
+        assert long_exact, \
+            "long-prompt stream diverged from the greedy reference"
+        assert eng2.pool.num_allocated == 0, "leaked KV blocks (long row)"
+        eng2.check_invariants()
+
+    s = engine.metrics.summary()
+    row = report(
+        label, wall, items=s["decode_tokens"], item_name="tok",
+        extra={"sp": sp,
+               "num_blocks": num_blocks,
+               # "blocks_per_chip", not "...per_shard": the _per_s info
+               # marker would misfile this structural field as a rate
+               "blocks_per_chip": blocks_per_chip,
+               "max_context_blocks": max_ctx_blocks,
+               "max_context_tokens": max_ctx_blocks * block_size,
+               "shard_table_span": span,
+               "gate_shard_span": int(span * sp == engine.blocks_per_seq),
+               "exact_vs_sp1": int(exact),
+               "long_prompt_len": long_len if sp > 1 else 0,
+               "gate_long_prompt_exact": long_exact,
+               "gate_long_prompt_rejected": long_rejected,
+               "long_prefill_ms": round(long_ttft_ms, 3),
+               "ttft_ms_p50": s["ttft_ms_p50"],
+               "ttft_ms_p99": s["ttft_ms_p99"],
+               "requests": s["requests_finished"]})
+    if shared is not None:
+        shared.setdefault("rows", []).append(row)
+        if artifact and sp == sp_max:
+            write_artifact(artifact, shared["rows"],
+                           meta={"devices": jax.device_count(),
+                                 "blocks_per_chip": blocks_per_chip,
+                                 "block_size": block_size},
+                           label="longctx A/B")
             row["artifact_path"] = artifact
     return row
 
@@ -2088,6 +2260,16 @@ def main(argv=None):
                          "tp, max_concurrent_at_slo from a per-chip HBM "
                          "budget); needs >=2 JAX devices (CPU: "
                          "--xla_force_host_platform_device_count)")
+    ap.add_argument("--longctx", action="store_true",
+                    help="tiny model, sp=1 vs sp=2 (vs sp=4 given 4 "
+                         "devices) sequence-parallel long-context A/B: "
+                         "same per-chip KV footprint per row, asserting "
+                         "max_context_blocks scales exactly ~N x, short "
+                         "decode streams token-exact vs sp=1, and the "
+                         "long-prompt row (KV > one chip's pool) serves "
+                         "token-exact at sp>1 / fails cleanly at sp=1; "
+                         "needs >=2 JAX devices (CPU: "
+                         "--xla_force_host_platform_device_count)")
     ap.add_argument("--trace", action="store_true",
                     help="tiny model through a traced 2-replica Router: "
                          "persists the merged Perfetto trace, per-replica "
@@ -2122,6 +2304,30 @@ def main(argv=None):
                 num_blocks=32, block_size=4, max_batch_size=4, tp=d,
                 label=f"serve_tp{d}", shared=tshared, artifact=art),
                 label=f"bench_tp_{deg}")
+        return rr.results
+    if args.longctx:
+        # sequence-parallel long-context A/B: fixed per-chip pool, the
+        # context mesh makes the AGGREGATE pool sp x deeper — the sp rows
+        # self-assert token-exact short streams vs sp=1 and the headline
+        # long-prompt gate (serves at sp>1, clean admission error at
+        # sp=1). Skips (no rows) on a genuinely single-device host; the
+        # sp=4 row needs 4 devices.
+        if jax.device_count() < 2:
+            print("serve_bench --longctx: needs >=2 JAX devices (set "
+                  "XLA_FLAGS=--xla_force_host_platform_device_count=8 "
+                  "before jax imports for a virtual CPU mesh); skipping")
+            return rr.results
+        model, params = _smoke_model()
+        lshared = {}
+        import os
+        art = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "results", "longctx_ab_smoke.json")
+        degrees = (1, 2, 4) if jax.device_count() >= 4 else (1, 2)
+        for deg in degrees:
+            rr.add(lambda d=deg: bench_longctx(
+                model, params, sp=d, sp_max=degrees[-1],
+                label=f"serve_longctx_sp{d}", shared=lshared, artifact=art),
+                label=f"bench_longctx_{deg}")
         return rr.results
     if args.disagg:
         # disaggregated-serving A/B: the same long+chat mix all-mixed, with

@@ -60,6 +60,15 @@ def tp():
     return 2
 
 
+@pytest.fixture
+def sp():
+    """Sequence-parallel (context mesh) degree for @pytest.mark.sp tests;
+    same virtual-platform contract as ``tp``."""
+    if jax.device_count() < 2:
+        pytest.skip("sequence-parallel tests need >=2 devices")
+    return 2
+
+
 # -- test tiers ---------------------------------------------------------------
 # Measured-slow tests (>15s on a 1-CPU host, mostly multi-minute mesh/pipeline
 # XLA compiles) are auto-marked so `pytest -m "not slow"` is a fast dev tier;

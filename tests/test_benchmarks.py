@@ -298,6 +298,66 @@ def test_serve_bench_tp(tp):
                    for row in payload["gated"]["rows"] for k in row)
 
 
+def test_serve_bench_longctx(sp):
+    """The --longctx A/B is the benchmark-shaped sequence-parallel gate:
+    the same per-chip KV footprint at sp=1 vs sp=2 vs sp=4 over the
+    context mesh. bench_longctx self-asserts the exactness contract
+    (short streams token-identical to sp=1, the long-prompt stream
+    matching the teacher-forced greedy reference, zero leaked blocks);
+    here we gate the capacity arithmetic — max servable context scales
+    EXACTLY ~N x while per-chip residency stays flat, and the headline
+    long-prompt row serves at sp>1 but is rejected at sp=1 — and that
+    the persisted artifact re-parses. Tier-1 so long-context serving
+    regressions fail fast."""
+    import json
+    import os
+
+    import jax
+
+    from benchmarks import serve_bench
+
+    results = [r for r in serve_bench.main(["--longctx"]) if r]
+    degrees = [1, 2, 4] if jax.device_count() >= 4 else [1, 2]
+    assert [r["bench"] for r in results] == \
+        [f"serve_longctx_sp{d}" for d in degrees]
+    sp1 = results[0]
+    for r, d in zip(results, degrees):
+        assert r["ms"] > 0 and r["requests"] == 3
+        assert r["sp"] == d
+        assert r["exact_vs_sp1"] == 1
+        # the capacity contract is exact arithmetic, not a measurement:
+        # per-chip pool depth is CONSTANT across rows while the aggregate
+        # (minus one scratch block per shard) scales with the mesh
+        assert r["blocks_per_chip"] == sp1["blocks_per_chip"]
+        assert r["num_blocks"] == d * r["blocks_per_chip"]
+        assert r["max_context_blocks"] == d * (r["blocks_per_chip"] - 1)
+        assert r["max_context_tokens"] == \
+            d * sp1["max_context_tokens"]
+        # each shard sweeps an equal 1/sp span of the assembly width —
+        # the per-layer page-sweep parallelism behind the prefill win
+        assert r["gate_shard_span"] == 1
+    # the headline: a prompt whose KV exceeds one chip's pool serves
+    # token-exact on the context mesh and fails CLEANLY on one chip
+    assert sp1["gate_long_prompt_rejected"] == 1
+    for r in results[1:]:
+        assert r["gate_long_prompt_exact"] == 1
+        assert r["long_prompt_len"] + 4 > sp1["max_context_tokens"]
+    # the smoke artifact persisted and re-parses with every row gated
+    art = results[-1]["artifact_path"]
+    assert os.path.exists(art)
+    with open(art) as f:
+        payload = json.load(f)
+    assert [row["bench"] for row in payload["gated"]["rows"]] == \
+        [f"serve_longctx_sp{d}" for d in degrees]
+    assert payload["gated"]["devices"] >= 2
+    # timing (incl. the long prompt's prefill wall-clock — informational
+    # on the one-core virtual mesh) lives in the info section so re-runs
+    # don't churn the committed artifact
+    assert "generated" in payload["info"]
+    assert not any(k.endswith("_ms") or k == "ms"
+                   for row in payload["gated"]["rows"] for k in row)
+
+
 def test_serve_bench_chaos():
     """The --chaos row is the benchmark-shaped fault-tolerance gate: seeded
     pool-alloc failures + NaN logits, asserting every request terminal and

@@ -174,6 +174,41 @@ class E:
 '''
         assert _rules(src, "use-after-donate") == []
 
+    # sequence-parallel builders route through SPContext.jit_step — same
+    # wrapper name, extra routing kwargs (tables_argnum tells the context
+    # mesh which argument is the per-shard table stack). Donation happens
+    # on every context-mesh shard; the kwargs must not confuse the rule's
+    # donated-position extraction.
+    SP_BUILDER = '''
+class E:
+    def _step_fn(self):
+        def fn(params, pages_k, pages_v, toks, offsets, tables):
+            return pages_k, pages_v
+        return self._sp.jit_step(fn, donate_argnums=(1, 2), n_outs=2,
+                                 tables_argnum=5)
+
+    def step(self):
+        fn = self._jit.get(key)
+        if fn is None:
+            fn = self._jit[key] = self._step_fn()
+        pk, pv = fn(self.params, self.pool.pages_k, self.pool.pages_v,
+                    toks, offsets, tables)
+'''
+
+    def test_sp_builder_read_after_donation_flags(self):
+        src = self.SP_BUILDER + '''
+        shape = self.pool.pages_k.shape
+        self.pool.update_pages(pk, pv)
+'''
+        assert _rules(src, "use-after-donate") == ["use-after-donate"]
+
+    def test_sp_builder_readoption_clean(self):
+        src = self.SP_BUILDER + '''
+        self.pool.update_pages(pk, pv)
+        shape = self.pool.pages_k.shape
+'''
+        assert _rules(src, "use-after-donate") == []
+
 
 class TestHostSyncInStepPath:
     def test_int_on_device_value_flags(self):
@@ -278,6 +313,39 @@ class TPContext:
             return jitted(*args)
         return dispatch
 ''', select=["fetch-outside-commit"], options=self.TP_OPTS)
+        assert vios == []
+
+    # same contract for the sequence-parallel dispatcher: the closure
+    # SPContext.jit_step returns stages per-shard tables and launches the
+    # context-mesh step — a device_get hidden there (say, peeking at the
+    # per-shard merge stats) would barrier all sp shards every step
+    SP_OPTS = {"fetch-outside-commit":
+               {"step_roots": ["SPContext.jit_step"],
+                "commit_helpers": ["InferenceEngine._fetch_bundle"]}}
+
+    def test_fetch_in_sp_dispatch_closure_flags(self):
+        vios = lint_source('''
+import jax
+class SPContext:
+    def jit_step(self, fn):
+        jitted = self._compile(fn)
+        def dispatch(*args):
+            out = jitted(*args)
+            stats = jax.device_get(out[-1])
+            return out
+        return dispatch
+''', select=["fetch-outside-commit"], options=self.SP_OPTS)
+        assert [v.rule for v in vios] == ["fetch-outside-commit"]
+
+    def test_sp_dispatch_returning_device_refs_clean(self):
+        vios = lint_source('''
+class SPContext:
+    def jit_step(self, fn):
+        jitted = self._compile(fn)
+        def dispatch(*args):
+            return jitted(*args)
+        return dispatch
+''', select=["fetch-outside-commit"], options=self.SP_OPTS)
         assert vios == []
 
 

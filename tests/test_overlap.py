@@ -234,8 +234,9 @@ class TestDeferredPhase:
         assert g == {
             "queue_depth": 0, "num_running": 0, "kv_dtype": "f32",
             "kv_bytes_per_token": eng.pool.kv_bytes_per_token,
-            "quant_weights": 0, "tp_degree": 1,
+            "quant_weights": 0, "tp_degree": 1, "sp_degree": 1,
             "kv_bytes_per_token_per_shard": eng.pool.kv_bytes_per_token,
+            "pool_blocks_per_shard": eng.pool.num_blocks,
             "host_tier_max_bytes": 0, "tier_blocks": 0}
 
 

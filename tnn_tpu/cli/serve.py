@@ -126,6 +126,23 @@ def main(argv=None):
                          "(one all-reduce per layer for attention out + MLP; "
                          "requires num_kv_heads %% tp == 0 and tp <= "
                          "device count; token-exact vs tp=1)")
+    ap.add_argument("--sp", type=int, default=1,
+                    help="sequence-parallel degree: shard each request's KV "
+                         "blocks position-wise over a context mesh of this "
+                         "many chips, so one prompt's cache can exceed a "
+                         "single chip's pool (aggregate capacity ~ N x). "
+                         "Every shard sweeps its own pages with the ragged "
+                         "paged kernel and partial attention merges via one "
+                         "online-softmax psum per layer; token-exact vs "
+                         "sp=1. Requires sp <= device count; pick ONE of "
+                         "--sp / --tp per replica")
+    ap.add_argument("--compile-cache", default="",
+                    help="persistent XLA compilation cache directory: step "
+                         "programs compiled on a previous run (or by a "
+                         "sibling replica on shared storage) are reloaded "
+                         "instead of recompiled, cutting restart and "
+                         "scale-up cold time; content-addressed, so a "
+                         "changed jaxlib or flag set misses cleanly")
     ap.add_argument("--host-tier-bytes", type=int, default=0,
                     help="host-RAM KV tier capacity in bytes (0 = off): "
                          "prefix-cache blocks the pool would reclaim are "
@@ -306,6 +323,73 @@ def main(argv=None):
         params = variables["params"]
     else:
         model = models.create(args.model)
+        # params init deferred until the mesh pre-flights below pass:
+        # building random gpt2_small weights takes seconds, and a config
+        # error should die before that, not after
+        params = None
+
+    # fail fast on an impossible TP config BEFORE touching model weights:
+    # the engine would reject it anyway, but a clear one-line error beats
+    # a traceback out of shard placement
+    if args.tp > 1:
+        n_dev = jax.device_count()
+        if args.tp > n_dev:
+            ap.error(f"--tp {args.tp} exceeds the {n_dev} visible "
+                     "device(s); off-TPU, raise the host device count with "
+                     "--xla_force_host_platform_device_count in XLA_FLAGS")
+        h_kv = getattr(model, "num_kv_heads", model.num_heads)
+        if h_kv % args.tp:
+            ap.error(f"--tp {args.tp} does not divide the model's "
+                     f"{h_kv} KV head(s); head-sharded TP needs "
+                     "num_kv_heads % tp == 0")
+        if args.quant_weights:
+            ap.error("--quant-weights is incompatible with --tp > 1 "
+                     "(int8 weight leaves don't column-shard)")
+        if args.decode_path == "fused":
+            ap.error("--decode-path fused is incompatible with --tp > 1 "
+                     "(the fused kernel stacks whole-model weights; use "
+                     "auto, paged, or standard)")
+
+    # same fail-fast treatment for an impossible SP (context mesh) config
+    if args.sp > 1:
+        n_dev = jax.device_count()
+        if args.sp > n_dev:
+            ap.error(f"--sp {args.sp} exceeds the {n_dev} visible "
+                     "device(s); off-TPU, raise the host device count with "
+                     "--xla_force_host_platform_device_count in XLA_FLAGS")
+        if args.tp > 1:
+            ap.error(f"--sp {args.sp} with --tp {args.tp} is unsupported "
+                     "this engine — the context mesh and the head mesh "
+                     "would need a 2-D shard_map; pick ONE of --sp / --tp "
+                     "per replica")
+        if args.host_tier_bytes:
+            ap.error("--host-tier-bytes is incompatible with --sp > 1 "
+                     "(a demoted block's pages live on one context-mesh "
+                     "shard; run the host tier on single-chip replicas)")
+        if args.num_blocks % args.sp:
+            ap.error(f"--num-blocks {args.num_blocks} does not divide "
+                     f"evenly over --sp {args.sp} shards")
+        if args.quant_weights:
+            ap.error("--quant-weights is incompatible with --sp > 1 "
+                     "(int8 weight leaves re-materialize off-mesh)")
+        if args.decode_path == "fused":
+            ap.error("--decode-path fused is incompatible with --sp > 1 "
+                     "(the fused kernel assembles one chip's contiguous "
+                     "cache; use auto, paged, or standard)")
+        # mirror the engine's assembly-width computation so a bad
+        # max_seq_len dies here as a one-liner, not a ctor traceback
+        cap = min(model.max_len, (args.num_blocks - args.sp)
+                  * args.block_size)
+        msl = min(args.max_seq_len or cap, cap)
+        nb = -(-msl // args.block_size)
+        if nb % args.sp:
+            ap.error(f"--sp {args.sp} does not divide the assembly width "
+                     f"({nb} blocks/seq from max_seq_len {msl}, block "
+                     f"size {args.block_size}); pick --max-seq-len (or "
+                     "--num-blocks/--block-size) so ceil(max_seq_len / "
+                     "block_size) is a multiple of sp")
+
+    if params is None:
         print(f"no --model-file: random-weight {args.model} "
               "(smoke/benchmark mode)", file=sys.stderr)
         params = model.init(jax.random.PRNGKey(args.seed), (1, 8))["params"]
@@ -346,7 +430,7 @@ def main(argv=None):
             profiler=prof, trace=bool(args.trace),
             overlap=not args.no_overlap,
             kv_dtype=args.kv_dtype, quant_weights=args.quant_weights,
-            tp=args.tp, host_tier_bytes=args.host_tier_bytes,
+            tp=args.tp, sp=args.sp, host_tier_bytes=args.host_tier_bytes,
             seed=args.seed)
 
     def build_supervisor(eng, idx=0):
@@ -360,27 +444,14 @@ def main(argv=None):
             drain_deadline_s=args.drain_deadline_s or None,
             flight_dir=flight_dir)
 
-    # fail fast on an impossible TP config BEFORE touching model weights:
-    # the engine would reject it anyway, but a clear one-line error beats
-    # a traceback out of shard placement
-    if args.tp > 1:
-        n_dev = jax.device_count()
-        if args.tp > n_dev:
-            ap.error(f"--tp {args.tp} exceeds the {n_dev} visible "
-                     "device(s); off-TPU, raise the host device count with "
-                     "--xla_force_host_platform_device_count in XLA_FLAGS")
-        h_kv = getattr(model, "num_kv_heads", model.num_heads)
-        if h_kv % args.tp:
-            ap.error(f"--tp {args.tp} does not divide the model's "
-                     f"{h_kv} KV head(s); head-sharded TP needs "
-                     "num_kv_heads % tp == 0")
-        if args.quant_weights:
-            ap.error("--quant-weights is incompatible with --tp > 1 "
-                     "(int8 weight leaves don't column-shard)")
-        if args.decode_path == "fused":
-            ap.error("--decode-path fused is incompatible with --tp > 1 "
-                     "(the fused kernel stacks whole-model weights; use "
-                     "auto, paged, or standard)")
+    if args.compile_cache:
+        from tnn_tpu.serving import compile_cache
+
+        cache_dir = compile_cache.enable(args.compile_cache)
+        warm = compile_cache.entry_count(cache_dir)
+        print(f"compile cache: {cache_dir} "
+              f"({'warm, %d entries' % warm if warm else 'cold'})",
+              file=sys.stderr)
 
     engine = build_engine()
     if args.host_tier_bytes:
@@ -391,6 +462,11 @@ def main(argv=None):
         print(f"tensor parallel: tp={args.tp}, "
               f"{model.num_heads // args.tp} head(s)/shard, per-shard KV "
               f"{engine.stats()['kv_bytes_per_token_per_shard']} B/token",
+              file=sys.stderr)
+    if args.sp > 1:
+        print(f"sequence parallel: sp={args.sp}, "
+              f"{engine.pool.blocks_per_shard} block(s)/shard, max context "
+              f"{engine.max_seq_len} tokens over the context mesh",
               file=sys.stderr)
     if not engine._paged and engine.paged_fallback_reason:
         print(f"paged decode unavailable: {engine.paged_fallback_reason}",

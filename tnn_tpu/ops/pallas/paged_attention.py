@@ -101,21 +101,21 @@ def quantize_kv_rows(x):
 
 
 def _attn_kernel(tables_ref, lens_ref, qlens_ref, layer_ref, q_ref, k_ref,
-                 v_ref, o_ref, m_scr, l_scr, acc_scr, *, scale: float,
-                 bs: int, g: int, qw: int):
-    del tables_ref, layer_ref  # consumed by the index maps, not the body
+                 v_ref, *refs, scale: float, bs: int, g: int, qw: int,
+                 stats: bool = False):
+    del layer_ref  # consumed by the index maps, not the body
 
     def load_kv():
         return k_ref[0, 0, 0], v_ref[0, 0, 0]    # (bs, Dh) — one page
 
-    _attn_step(lens_ref, qlens_ref, q_ref, load_kv, o_ref, m_scr, l_scr,
-               acc_scr, scale=scale, bs=bs, g=g, qw=qw)
+    _attn_step(tables_ref, lens_ref, qlens_ref, q_ref, load_kv, refs,
+               scale=scale, bs=bs, g=g, qw=qw, stats=stats)
 
 
 def _attn_kernel_int8(tables_ref, lens_ref, qlens_ref, layer_ref, q_ref,
-                      k_ref, v_ref, ks_ref, vs_ref, o_ref, m_scr, l_scr,
-                      acc_scr, *, scale: float, bs: int, g: int, qw: int):
-    del tables_ref, layer_ref
+                      k_ref, v_ref, ks_ref, vs_ref, *refs, scale: float,
+                      bs: int, g: int, qw: int, stats: bool = False):
+    del layer_ref
 
     def load_kv():
         # in-VMEM dequant inside the online-softmax sweep: the page arrives
@@ -128,15 +128,25 @@ def _attn_kernel_int8(tables_ref, lens_ref, qlens_ref, layer_ref, q_ref,
         v = v_ref[0, 0, 0].astype(jnp.float32) * vs_ref[0, 0, 0]
         return k, v
 
-    _attn_step(lens_ref, qlens_ref, q_ref, load_kv, o_ref, m_scr, l_scr,
-               acc_scr, scale=scale, bs=bs, g=g, qw=qw)
+    _attn_step(tables_ref, lens_ref, qlens_ref, q_ref, load_kv, refs,
+               scale=scale, bs=bs, g=g, qw=qw, stats=stats)
 
 
-def _attn_step(lens_ref, qlens_ref, q_ref, load_kv, o_ref, m_scr, l_scr,
-               acc_scr, *, scale: float, bs: int, g: int, qw: int):
+def _attn_step(tables_ref, lens_ref, qlens_ref, q_ref, load_kv, refs, *,
+               scale: float, bs: int, g: int, qw: int, stats: bool):
     """Shared online-softmax body: the f32 and int8 kernels differ ONLY in
     how a page's K/V reaches the MXU (``load_kv``), keeping the two in
-    lockstep by construction."""
+    lockstep by construction.
+
+    ``refs`` is (o_ref, [m_ref, l_ref when stats], m_scr, l_scr, acc_scr) —
+    with ``stats`` the kernel also emits its per-row online-softmax state
+    (running max ``m``, normalizer ``l``), which is exactly the partial a
+    sequence-parallel shard needs for ``ops.softmax_merge.merge_psum``.
+    """
+    if stats:
+        o_ref, m_ref, l_ref, m_scr, l_scr, acc_scr = refs
+    else:
+        (o_ref, m_scr, l_scr, acc_scr), m_ref, l_ref = refs, None, None
     b = pl.program_id(0)
     j = pl.program_id(2)
     nj = pl.num_programs(2)
@@ -151,7 +161,10 @@ def _attn_step(lens_ref, qlens_ref, q_ref, load_kv, o_ref, m_scr, l_scr,
     kv_len = lens_ref[b]
     q_live = qlens_ref[b]
 
-    @pl.when(j * bs < kv_len)
+    # a NEGATIVE table entry is a dead hole — sequence-parallel serving
+    # stamps -1 at positions another shard owns; the fetch index map clamps
+    # it to page 0 and this predicate skips the block entirely
+    @pl.when((j * bs < kv_len) & (tables_ref[b, j] >= 0))
     def _block():
         q = q_ref[0, :, 0].reshape(qw * g, dh)   # whole ragged query chunk
         k, v = load_kv()
@@ -182,10 +195,13 @@ def _attn_step(lens_ref, qlens_ref, q_ref, load_kv, o_ref, m_scr, l_scr,
         lsafe = jnp.where(l == 0.0, 1.0, l)  # fully-masked rows -> exactly 0
         o_ref[0, :, 0] = (acc_scr[:] / lsafe).astype(o_ref.dtype) \
             .reshape(qw, g, dh)
+        if stats:
+            m_ref[0, :, 0] = m_scr[:].reshape(qw, g, 1)
+            l_ref[0, :, 0] = l[:].reshape(qw, g, 1)
 
 
 def _paged_attention_pallas(q, pages_k, pages_v, block_tables, kv_lens,
-                            q_lens, layer, scale, interpret):
+                            q_lens, layer, scale, interpret, stats=False):
     quant = isinstance(pages_k, QuantPages)
     b, qw, h, dh = q.shape
     _, _, hkv, bs, _ = (pages_k.data if quant else pages_k).shape
@@ -200,9 +216,12 @@ def _paged_attention_pallas(q, pages_k, pages_v, block_tables, kv_lens,
     def kv_index(bi, hi, j, tbl, ln, qln, ly):
         # clamp dead trailing pages to the row's last live page: the repeated
         # block index lets the pipeline elide the DMA (compute is pl.when-
-        # skipped); max(len, 1) keeps fully-dead rows fetching page 0
+        # skipped); max(len, 1) keeps fully-dead rows fetching page 0, and
+        # the outer max clamps -1 holes (pages another SP shard owns — their
+        # compute is pl.when-skipped on the table-entry sign) to page 0 too
         nlive = (jnp.maximum(ln[bi], 1) + bs - 1) // bs
-        return (ly[0], tbl[bi, jnp.minimum(j, nlive - 1)], hi, 0, 0)
+        return (ly[0], jnp.maximum(tbl[bi, jnp.minimum(j, nlive - 1)], 0),
+                hi, 0, 0)
 
     def q_index(bi, hi, j, tbl, ln, qln, ly):
         return (bi, 0, hi, 0, 0)
@@ -225,11 +244,20 @@ def _paged_attention_pallas(q, pages_k, pages_v, block_tables, kv_lens,
         operands += [pages_k, pages_v]
         kernel = _attn_kernel
 
+    out_specs = pl.BlockSpec((1, qw, 1, g, dh), q_index)
+    out_shape = jax.ShapeDtypeStruct((b, qw, hkv, g, dh), q.dtype)
+    if stats:
+        # per-row online-softmax state rides along as two extra outputs —
+        # the sequence-parallel merge's inputs (ops.softmax_merge)
+        stat_spec = pl.BlockSpec((1, qw, 1, g, 1), q_index)
+        stat_shape = jax.ShapeDtypeStruct((b, qw, hkv, g, 1), jnp.float32)
+        out_specs = (out_specs, stat_spec, stat_spec)
+        out_shape = (out_shape, stat_shape, stat_shape)
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=4,
         grid=(b, hkv, nb),
         in_specs=in_specs,
-        out_specs=pl.BlockSpec((1, qw, 1, g, dh), q_index),
+        out_specs=out_specs,
         scratch_shapes=[
             pltpu.VMEM((qw * g, 1), jnp.float32),
             pltpu.VMEM((qw * g, 1), jnp.float32),
@@ -237,14 +265,19 @@ def _paged_attention_pallas(q, pages_k, pages_v, block_tables, kv_lens,
         ],
     )
     out = pl.pallas_call(
-        functools.partial(kernel, scale=scale, bs=bs, g=g, qw=qw),
+        functools.partial(kernel, scale=scale, bs=bs, g=g, qw=qw,
+                          stats=stats),
         grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((b, qw, hkv, g, dh), q.dtype),
+        out_shape=out_shape,
         # scratch carries only along the innermost (page) sweep
         compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(tables, lens, qlens, layer_arr, *operands)
+    if stats:
+        o, m, l = out  # noqa: E741
+        return (o.reshape(b, qw, h, dh), m.reshape(b, qw, h, 1),
+                l.reshape(b, qw, h, 1))
     return out.reshape(b, qw, h, dh)
 
 
@@ -262,41 +295,63 @@ def _pages_shape(pages):
     return pages.data.shape if isinstance(pages, QuantPages) else pages.shape
 
 
+def _live_positions(block_tables, kv_lens, t, bs):
+    """(B, T) live mask: positions inside kv_lens whose table entry is a
+    real page — NEGATIVE entries are dead holes (pages another SP shard
+    owns) and mask out their whole block. Identity when no -1 is present."""
+    live = jnp.arange(t)[None, :] < kv_lens[:, None]
+    return live & jnp.repeat(block_tables >= 0, bs, axis=1)
+
+
 def _paged_attention_xla(q, pages_k, pages_v, block_tables, kv_lens, layer,
-                         scale):
-    """Single-token (decode) reference — the PR 2 math, kept verbatim so the
-    legacy decode traces stay bit-identical."""
+                         scale, stats=False):
+    """Single-token (decode) reference — the PR 2 math (dead -1 table
+    entries additionally masked, a numeric no-op when none are present)."""
     b, h, dh = q.shape
     _, _, hkv, bs, _ = _pages_shape(pages_k)
     g = h // hkv
     t = block_tables.shape[1] * bs
 
-    k = _gather_pages(pages_k, block_tables, layer, b, hkv, t, dh)
-    v = _gather_pages(pages_v, block_tables, layer, b, hkv, t, dh)
+    tbl = jnp.maximum(block_tables, 0)   # clamp -1 holes for the gather
+    k = _gather_pages(pages_k, tbl, layer, b, hkv, t, dh)
+    v = _gather_pages(pages_v, tbl, layer, b, hkv, t, dh)
     qg = q.reshape(b, hkv, g, dh)
     s = jnp.einsum("bhgd,bhtd->bhgt", qg, k,
                    preferred_element_type=jnp.float32) * scale
-    live = jnp.arange(t)[None, :] < kv_lens[:, None]      # (B, T)
+    live = _live_positions(block_tables, kv_lens, t, bs)  # (B, T)
     s = jnp.where(live[:, None, None, :], s, _NEG_INF)
+    if stats:
+        # unnormalized form, emitting the same (m, l) state as the kernel's
+        # online softmax — the SP merge's inputs
+        m = jnp.max(s, axis=-1, keepdims=True)            # (B, Hkv, G, 1)
+        p = jnp.where(live[:, None, None, :], jnp.exp(s - m), 0.0)
+        l = jnp.sum(p, axis=-1, keepdims=True)  # noqa: E741
+        out = jnp.einsum("bhgt,bhtd->bhgd", p.astype(v.dtype), v,
+                         preferred_element_type=jnp.float32)
+        out = out / jnp.where(l == 0.0, 1.0, l)
+        return (out.astype(q.dtype).reshape(b, h, dh),
+                m.reshape(b, h, 1), l.reshape(b, h, 1))
     p = jax.nn.softmax(s, axis=-1)
-    # kv_len == 0 rows attend to NOTHING (output 0), matching the kernel's
-    # l == 0 guard — softmax alone would return uniform garbage attention
-    p = jnp.where(kv_lens[:, None, None, None] > 0, p, 0.0)
+    # rows with NO live position attend to NOTHING (output 0), matching the
+    # kernel's l == 0 guard — softmax alone would return uniform garbage
+    p = jnp.where(jnp.any(live, axis=-1)[:, None, None, None], p, 0.0)
     out = jnp.einsum("bhgt,bhtd->bhgd", p.astype(v.dtype), v,
                      preferred_element_type=jnp.float32)
     return out.astype(q.dtype).reshape(b, h, dh)
 
 
 def _paged_attention_xla_mq(q, pages_k, pages_v, block_tables, kv_lens,
-                            q_lens, layer, scale):
-    """Multi-token-query reference: same ragged causal mask as the kernel."""
+                            q_lens, layer, scale, stats=False):
+    """Multi-token-query reference: same ragged causal mask as the kernel
+    (and the same dead -1 table-entry masking)."""
     b, qw, h, dh = q.shape
     _, _, hkv, bs, _ = _pages_shape(pages_k)
     g = h // hkv
     t = block_tables.shape[1] * bs
 
-    k = _gather_pages(pages_k, block_tables, layer, b, hkv, t, dh)
-    v = _gather_pages(pages_v, block_tables, layer, b, hkv, t, dh)
+    tbl = jnp.maximum(block_tables, 0)   # clamp -1 holes for the gather
+    k = _gather_pages(pages_k, tbl, layer, b, hkv, t, dh)
+    v = _gather_pages(pages_v, tbl, layer, b, hkv, t, dh)
     qg = q.reshape(b, qw, hkv, g, dh)
     s = jnp.einsum("bqhgd,bhtd->bqhgt", qg, k,
                    preferred_element_type=jnp.float32) * scale
@@ -305,11 +360,22 @@ def _paged_attention_xla_mq(q, pages_k, pages_v, block_tables, kv_lens,
     kpos = jnp.arange(t)
     live = (kpos[None, None, :] <= (start + tpos)[:, :, None]) \
         & (tpos < q_lens[:, None])[:, :, None]            # (B, Q, T)
+    live = live & jnp.repeat(block_tables >= 0, bs, axis=1)[:, None, :]
     s = jnp.where(live[:, :, None, None, :], s, _NEG_INF)
+    if stats:
+        m = jnp.max(s, axis=-1, keepdims=True)        # (B, Q, Hkv, G, 1)
+        p = jnp.where(live[:, :, None, None, :], jnp.exp(s - m), 0.0)
+        l = jnp.sum(p, axis=-1, keepdims=True)  # noqa: E741
+        out = jnp.einsum("bqhgt,bhtd->bqhgd", p.astype(v.dtype), v,
+                         preferred_element_type=jnp.float32)
+        out = out / jnp.where(l == 0.0, 1.0, l)
+        return (out.astype(q.dtype).reshape(b, qw, h, dh),
+                m.reshape(b, qw, h, 1), l.reshape(b, qw, h, 1))
     p = jax.nn.softmax(s, axis=-1)
     # fully-masked query rows (padding past q_lens, or q_lens/kv_lens == 0)
     # output exactly 0, matching the kernel's l == 0 guard
     row_live = (tpos < q_lens[:, None]) & (start + tpos >= 0)   # (B, Q)
+    row_live = row_live & jnp.any(live, axis=-1)
     p = jnp.where(row_live[:, :, None, None, None], p, 0.0)
     out = jnp.einsum("bqhgt,bhtd->bqhgd", p.astype(v.dtype), v,
                      preferred_element_type=jnp.float32)
@@ -385,7 +451,8 @@ def _check_args(q, pages_k, pages_v, block_tables, kv_lens, q_lens, scale):
 def paged_attention(q, pages_k, pages_v, block_tables, kv_lens, *,
                     q_lens=None, layer=0, scale: Optional[float] = None,
                     backend: str = "auto",
-                    interpret: Optional[bool] = None):
+                    interpret: Optional[bool] = None,
+                    return_stats: bool = False):
     """Ragged attention for the current step's query rows over paged KV.
 
     q : (B, H, Dh) — decode form, one token per sequence — or (B, Q, H, Dh)
@@ -411,6 +478,14 @@ def paged_attention(q, pages_k, pages_v, block_tables, kv_lens, *,
 
     GQA: H % H_kv == 0; each kv head's page is fetched once and attended by
     its whole query-head group. Returns q's shape.
+
+    Block-table entries may be NEGATIVE: a -1 marks a dead hole (a page
+    another sequence-parallel shard owns) whose positions are skipped as if
+    masked. With ``return_stats`` the per-row online-softmax state rides
+    along — returns ``(out, m, l)`` with m/l shaped like out with the head
+    dim collapsed to 1 — which is exactly what
+    ``ops.softmax_merge.merge_psum`` needs to combine shard partials into
+    the full-row softmax.
     """
     q, was_3d, q_lens, pages_k, pages_v, scale = _check_args(
         q, pages_k, pages_v, block_tables, kv_lens, q_lens, scale)
@@ -418,16 +493,24 @@ def paged_attention(q, pages_k, pages_v, block_tables, kv_lens, *,
         backend = "pallas" if jax.default_backend() == "tpu" else "xla"
     if backend == "xla":
         if was_3d:
-            return _paged_attention_xla(q[:, 0], pages_k, pages_v,
-                                        block_tables, kv_lens, layer, scale)
-        return _paged_attention_xla_mq(q, pages_k, pages_v, block_tables,
-                                       kv_lens, q_lens, layer, scale)
+            out = _paged_attention_xla(q[:, 0], pages_k, pages_v,
+                                       block_tables, kv_lens, layer, scale,
+                                       stats=return_stats)
+        else:
+            out = _paged_attention_xla_mq(q, pages_k, pages_v, block_tables,
+                                          kv_lens, q_lens, layer, scale,
+                                          stats=return_stats)
+        return out
     if backend != "pallas":
         raise ValueError(f"unknown paged-attention backend {backend!r}")
     if interpret is None:
         interpret = interpret_default()
     out = _paged_attention_pallas(q, pages_k, pages_v, block_tables,
-                                  kv_lens, q_lens, layer, scale, interpret)
+                                  kv_lens, q_lens, layer, scale, interpret,
+                                  stats=return_stats)
+    if return_stats:
+        o, m, l = out  # noqa: E741
+        return (o[:, 0], m[:, 0], l[:, 0]) if was_3d else (o, m, l)
     return out[:, 0] if was_3d else out
 
 
@@ -455,6 +538,9 @@ def scatter_kv_rows(pages, block_tables, offsets, rows, *, layer=None):
     bs = pages.shape[-2]
     blk = jnp.take_along_axis(block_tables, (offsets // bs)[:, None],
                               axis=1)[:, 0]
+    # -1 holes (positions another SP shard owns) divert to the scratch page
+    # instead of wrapping to the LAST page and corrupting live KV
+    blk = jnp.maximum(blk, 0)
     slot = offsets % bs
     # two advanced indices (blk, slot) around the sliced head axis put the
     # batch dim first in the update operand: rows is already (B, H, Dh)
@@ -489,7 +575,9 @@ def scatter_kv_chunk(pages, block_tables, starts, rows, q_lens, *,
     live = jnp.arange(qw)[None, :] < q_lens[:, None]      # (B, Q)
     blk = jnp.take_along_axis(block_tables,
                               jnp.clip(pos // bs, 0, nbt - 1), axis=1)
-    blk = jnp.where(live, blk, 0)   # dead tokens land in the scratch page
+    # dead tokens AND -1 table holes (positions another SP shard owns) land
+    # in the scratch page — a raw -1 would wrap to the last page
+    blk = jnp.maximum(jnp.where(live, blk, 0), 0)
     slot = pos % bs
     # advanced (blk, slot) indices around the sliced head axis broadcast to
     # (B, Q) and lead the update operand: rows is already (B, Q, H, Dh)

@@ -1,0 +1,103 @@
+"""Online-softmax merge: the shared reassociation behind every partitioned
+attention in this repo.
+
+softmax(x) @ V over a row split into partitions P_1..P_N can be computed
+per-partition and combined, because the partial state (m, l, acc) —
+
+    m   = max_j x_j                      (running row max)
+    l   = sum_j exp(x_j - m)             (normalizer at that max)
+    acc = sum_j exp(x_j - m) * v_j       (UNnormalized weighted values)
+
+— forms a commutative monoid under :func:`merge`. Ring attention
+(``parallel/ring_attention.py``) folds partitions sequentially with
+:func:`block_update`; sequence-parallel serving (``serving/sp.py``) computes
+every shard's partial at once and combines across the mesh with
+:func:`merge_psum`. Both are algebraically identical to one full-row
+softmax; the only nonassociativity is fp rounding in ``exp``/``+``.
+
+Identity element: ``(m, l, acc) = (-inf_proxy, 0, 0)`` — a partition that
+saw no keys. :func:`merge` and :func:`merge_psum` both treat it as a true
+identity, and a row whose EVERY partition is empty yields ``acc = 0``
+(matching the flash/paged kernels' ``l == 0 -> output 0`` convention rather
+than dividing by zero).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+#: finite stand-in for -inf so exp(m - m) stays well-defined on empty rows
+NEG_INF = -1e30
+
+
+def block_update(m_prev, l_prev, acc, logits, v_blk):
+    """Fold one block of logits into running (m, l, acc) state — the exact
+    recurrence ring attention's per-hop update has always used (kept
+    verbatim so extracting it here is bit-identical for existing callers).
+
+    ``logits``: (..., S_q, S_kv_blk) pre-softmax scores, already scaled and
+    masked (dead positions at <= NEG_INF); ``v_blk``: values for the block.
+    ``m_prev``/``l_prev`` are (..., S_q, 1); ``acc`` is (..., S_q, Dh).
+    Returns the updated ``(m, l, acc)``.
+    """
+    m_cur = jnp.max(logits, axis=-1, keepdims=True)
+    m_new = jnp.maximum(m_prev, m_cur)
+    p = jnp.exp(logits - m_new)
+    l_cur = jnp.sum(p, axis=-1, keepdims=True)
+    alpha = jnp.exp(m_prev - m_new)
+    l_new = alpha * l_prev + l_cur
+    acc = acc * alpha + jnp.einsum(
+        "...qk,...kd->...qd", p.astype(v_blk.dtype), v_blk,
+        preferred_element_type=jnp.float32)
+    return m_new, l_new, acc
+
+
+def finalize(m, l, acc, dtype=None):  # noqa: E741 — l is the normalizer
+    """(m, l, acc) -> attention output: acc / l with the l == 0 -> 0 guard
+    (an all-empty row attends to nothing, not to garbage)."""
+    del m
+    lsafe = jnp.where(l == 0.0, 1.0, l)
+    out = acc / lsafe
+    return out.astype(dtype) if dtype is not None else out
+
+
+def merge(a, b):
+    """Pairwise merge of two partial-softmax states ``(m, l, acc)``.
+
+    Associative and commutative up to fp rounding — merge(a, merge(b, c))
+    equals the single-pass state over the concatenated partitions. The
+    empty state ``(NEG_INF, 0, 0)`` is the identity.
+    """
+    m_a, l_a, acc_a = a
+    m_b, l_b, acc_b = b
+    m = jnp.maximum(m_a, m_b)
+    alpha_a = jnp.exp(m_a - m)
+    alpha_b = jnp.exp(m_b - m)
+    l = alpha_a * l_a + alpha_b * l_b  # noqa: E741
+    acc = alpha_a * acc_a + alpha_b * acc_b
+    return m, l, acc
+
+
+def merge_psum(out, m, l, axis_name):  # noqa: E741
+    """Cross-mesh combine of per-shard NORMALIZED attention outputs.
+
+    Each shard of a sequence-parallel sweep produces its local
+    ``out = acc / max(l, 1)`` plus the stats ``(m, l)`` the kernel already
+    tracked — re-weighting by ``l * exp(m - m_global)`` and psum-ing
+    recovers exactly the full-row softmax:
+
+        num = sum_s out_s * l_s * exp(m_s - m*)   (= sum_s acc_s * exp(m_s - m*))
+        den = sum_s l_s   * exp(m_s - m*)
+        result = num / den
+
+    ``m``/``l`` are (..., 1) per attention row, broadcast against ``out``'s
+    trailing head dim. An empty shard (m = NEG_INF, l = 0) contributes 0 to
+    both sums; a row empty on EVERY shard returns 0 (den == 0 guard),
+    matching :func:`finalize`.
+    """
+    m_max = jax.lax.pmax(m, axis_name)
+    w = l * jnp.exp(m - m_max)
+    den = jax.lax.psum(w, axis_name)
+    num = jax.lax.psum(out.astype(jnp.float32) * w, axis_name)
+    den = jnp.where(den == 0.0, 1.0, den)
+    return (num / den).astype(out.dtype)

@@ -19,6 +19,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh
 
+from ..ops import softmax_merge
 from . import mesh as mesh_lib
 
 
@@ -52,15 +53,10 @@ def _ring_attention_local(q, k, v, *, axis: str, causal: bool, scale: float):
         if causal:
             kpos = (owner * s_local + jnp.arange(s_local))[None, :]
             logits = jnp.where(qpos >= kpos, logits, -1e30)
-        m_cur = jnp.max(logits, axis=-1, keepdims=True)
-        m_new = jnp.maximum(m_prev, m_cur)
-        p = jnp.exp(logits - m_new)
-        l_cur = jnp.sum(p, axis=-1, keepdims=True)
-        alpha = jnp.exp(m_prev - m_new)
-        l_new = alpha * l_prev + l_cur
-        acc = acc * alpha + jnp.einsum("bhqk,bhkd->bhqd", p.astype(v_blk.dtype), v_blk,
-                                       preferred_element_type=jnp.float32)
-        return m_new, l_new, acc
+        # the online-softmax recurrence lives in ops.softmax_merge — the
+        # single source of the partitioned-attention math, shared with the
+        # sequence-parallel serving combine (serving/sp.py)
+        return softmax_merge.block_update(m_prev, l_prev, acc, logits, v_blk)
 
     def block(carry, r):
         # lax.scan (not a Python loop): one compiled body regardless of ring size,
@@ -76,8 +72,7 @@ def _ring_attention_local(q, k, v, *, axis: str, causal: bool, scale: float):
     (m, l, acc, k_last, v_last), _ = jax.lax.scan(
         block, (m0, l0, acc0, k, v), jnp.arange(ring - 1))
     m, l, acc = attend(m, l, acc, k_last, v_last, ring - 1)
-    l = jnp.where(l == 0.0, 1.0, l)
-    return (acc / l).astype(q.dtype)
+    return softmax_merge.finalize(m, l, acc, q.dtype)
 
 
 def ring_attention(q, k, v, mesh: Mesh, *, axis: str = "seq", causal: bool = False,

@@ -14,6 +14,7 @@ with crash recovery, a step-latency watchdog, and graceful drain;
 docs/serving.md for the architecture, request lifecycle, failure-mode
 matrix, and operations guide.
 """
+from . import compile_cache
 from .autoscaler import Autoscaler
 from .engine import InferenceEngine
 from .faults import EngineCrash, FaultInjected, FaultPlan
@@ -41,6 +42,6 @@ __all__ = [
     "Router", "CircuitBreaker", "BreakerState", "NetDrop", "HealthScore",
     "HostKVTier", "Autoscaler",
     "ServingServer", "run_server", "worker_only",
-    "Tracer", "FlightRecorder", "span_name",
+    "Tracer", "FlightRecorder", "span_name", "compile_cache",
     "render_prometheus", "label_series", "merge_series",
 ]

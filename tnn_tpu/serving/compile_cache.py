@@ -1,0 +1,92 @@
+"""Persistent XLA compilation cache for serving restarts.
+
+An engine build jit-compiles a family of step programs (prefill buckets,
+mixed buckets, decode, COW copy, adopt) and under ``sp``/``tp`` each of
+them lowers through ``shard_map`` — on the CPU CI host that is seconds,
+on a real TPU pod slice it is minutes of XLA work repeated identically on
+every process restart, rolling deploy, and autoscaler scale-up. JAX
+already knows how to persist compiled executables keyed by (HLO,
+compile options, backend fingerprint); this module is the thin serving
+switch for it: ``enable(dir)`` points the runtime at an operator-chosen
+directory (``tnn-serve --compile-cache DIR``), and ``entry_count(dir)``
+lets supervisors and tests observe warm-start behaviour without parsing
+JAX internals.
+
+The cache is content-addressed and safe to share between replicas of the
+same binary on shared storage: a mismatched jaxlib or flag set changes
+the key and misses cleanly, never serving a stale executable. Eviction
+is the operator's problem (it is a plain directory — ``find -mtime`` in
+a cron job); entries are small relative to KV pools and the miss cost is
+just the compile that would have happened anyway.
+"""
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax
+
+#: the directory most recently handed to :func:`enable` (None = disabled)
+_active_dir: Optional[str] = None
+
+
+def enable(cache_dir: str) -> str:
+    """Switch on JAX's persistent compilation cache rooted at ``cache_dir``
+    (created if missing) and return the directory.
+
+    The two threshold overrides make the cache unconditional: by default
+    JAX only persists compiles that took >1 s and produced a large
+    executable, which on the CPU CI host (and for the engine's many tiny
+    step programs) would silently cache nothing and make warm-start
+    assertions vacuous. Serving wants every step program back on restart,
+    so both floors drop to zero. Idempotent; calling with a new directory
+    repoints the runtime at it.
+    """
+    cache_dir = os.path.abspath(os.path.expanduser(cache_dir))
+    os.makedirs(cache_dir, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    # JAX initializes its cache object at most once per process, and ANY
+    # compile before this call (importing tnn_tpu compiles a few helpers)
+    # pins it to the config visible at that moment — i.e. permanently off.
+    # reset_cache() drops the memoized object so the next compile
+    # re-initializes against the directory set above.
+    from jax.experimental.compilation_cache import compilation_cache as _cc
+
+    _cc.reset_cache()
+    global _active_dir
+    _active_dir = cache_dir
+    return cache_dir
+
+
+def active_dir() -> Optional[str]:
+    """The enabled cache directory, or None when the cache is off."""
+    return _active_dir
+
+
+def disable() -> None:
+    """Switch the persistent cache back off (tests and embedders; the CLI
+    never needs this). Safe to call when already off."""
+    jax.config.update("jax_compilation_cache_dir", None)
+    from jax.experimental.compilation_cache import compilation_cache as _cc
+
+    _cc.reset_cache()
+    global _active_dir
+    _active_dir = None
+
+
+def entry_count(cache_dir: str) -> int:
+    """Number of persisted executables under ``cache_dir``.
+
+    Counts non-hidden directory entries (each cache entry is one file
+    keyed by its content hash; JAX may add dot-prefixed bookkeeping).
+    A missing or unreadable directory counts as empty rather than
+    raising — callers use this for gauges and warm/cold log lines, not
+    control flow.
+    """
+    try:
+        return sum(1 for name in os.listdir(cache_dir)
+                   if not name.startswith("."))
+    except OSError:
+        return 0

@@ -241,7 +241,7 @@ class InferenceEngine:
                  draft_model=None, draft_params=None,
                  profiler: Optional[Profiler] = None, trace: bool = False,
                  overlap: bool = False, kv_dtype: str = "f32",
-                 quant_weights: bool = False, tp: int = 1,
+                 quant_weights: bool = False, tp: int = 1, sp: int = 1,
                  host_tier_bytes: int = 0, seed: int = 0):
         if getattr(model, "kv_cache_dtype", None):
             raise ValueError(
@@ -277,6 +277,12 @@ class InferenceEngine:
             raise ValueError(
                 "host_tier_bytes with tp>1 is unsupported — demoted page "
                 "slices would need a cross-shard gather/scatter; run the "
+                "host tier on single-chip replicas")
+        if host_tier_bytes and sp > 1:
+            raise ValueError(
+                "host_tier_bytes with sp>1 is unsupported — a demoted "
+                "block's pages live on one context-mesh shard and the "
+                "re-admission write would need per-shard routing; run the "
                 "host tier on single-chip replicas")
         self.drafter: Optional[spec_decode.Drafter] = None
         self.spec_mode = spec if isinstance(spec, str) else \
@@ -337,16 +343,49 @@ class InferenceEngine:
             from . import tp as tp_lib
             self._tp = tp_lib.TPContext(model, params, self.tp)
             params = self._tp.params
+        # sequence parallelism: sp > 1 range-partitions the paged pool's
+        # BLOCK axis over a context mesh of sp devices, so the aggregate
+        # pool (and thus max servable context) is sp x one chip's. Params
+        # stay fully replicated; block tables are staged per-shard
+        # (serving/sp.py) and each shard's attention sweep merges via one
+        # online-softmax psum per layer. _sp is None at sp=1 and every SP
+        # branch below keys off it, so the single-chip configuration
+        # traces byte-identical programs to before.
+        self.sp = int(sp)
+        self._sp = None
+        if self.sp < 1:
+            raise ValueError(f"sp must be >= 1, got {sp}")
+        if self.sp > 1:
+            if self.tp > 1:
+                raise ValueError(
+                    "sp>1 with tp>1 is unsupported this engine — the "
+                    "context mesh and the head mesh would need a 2-D "
+                    "shard_map; pick ONE of sp / tp per replica")
+            if self.quant_weights:
+                raise ValueError(
+                    "quant_weights with sp>1 is unsupported — "
+                    "quantize_for_decode re-materializes leaves off the "
+                    "context mesh; serve fp weights under SP")
+            if getattr(model, "moe_experts", 0):
+                raise ValueError(
+                    "sequence-parallel serving does not support MoE models "
+                    "(expert dispatch is not sequence-sharded)")
+            from . import sp as sp_lib
+            self._sp = sp_lib.SPContext(model, params, self.sp)
+            params = self._sp.params
         # the model the compiled step bodies trace: the head-sharded
-        # adapter under TP (same interface, per-shard math), the model
-        # itself otherwise. Host-side math keeps reading self.model.
-        self._step_model = self._tp.model if self._tp else model
+        # adapter under TP (same interface, per-shard math), the
+        # block-sharded adapter under SP, the model itself otherwise.
+        # Host-side math keeps reading self.model.
+        self._step_model = (self._tp.model if self._tp
+                            else self._sp.model if self._sp else model)
         # compile-key suffix: int8 pools trace different step programs
         # (QuantPages operands), so their cache entries must never collide
-        # with f32 ones; likewise tp>1 (shard_map bodies). f32/tp=1
-        # appends () — keys stay byte-identical
+        # with f32 ones; likewise tp>1 / sp>1 (shard_map bodies). The
+        # f32/tp=1/sp=1 configuration appends () — keys stay byte-identical
         self._kv_key = (("int8",) if kv_dtype == "int8" else ()) + \
-            ((f"tp{self.tp}",) if self.tp > 1 else ())
+            ((f"tp{self.tp}",) if self.tp > 1 else ()) + \
+            ((f"sp{self.sp}",) if self.sp > 1 else ())
         if self.quant_weights:
             from ..nn import quant as _quant
             params = _quant.quantize_for_decode(params)
@@ -357,7 +396,9 @@ class InferenceEngine:
             head_dim=self.head_dim, num_blocks=num_blocks,
             block_size=block_size, dtype=model.policy.compute_dtype,
             kv_dtype=kv_dtype,
-            sharding=self._tp.page_sharding if self._tp else None)
+            sharding=(self._tp.page_sharding if self._tp
+                      else self._sp.page_sharding if self._sp else None),
+            sp=self.sp)
         self.pool.fault_plan = faults
         # static gauge extras spliced into every _health_gauges refresh:
         # lets operators spot a misconfigured replica from /healthz alone
@@ -370,6 +411,11 @@ class InferenceEngine:
             "kv_bytes_per_token_per_shard":
                 (self.pool.kv_bytes_per_token +
                  self.pool.kv_scale_bytes_per_token) // self.tp,
+            "sp_degree": self.sp,
+            # the SP headline: each chip holds 1/sp of the pool's BLOCKS
+            # (whole tokens — per-token bytes are unchanged; the pool is
+            # sp x deeper in aggregate)
+            "pool_blocks_per_shard": self.pool.blocks_per_shard,
             "host_tier_max_bytes": int(host_tier_bytes),
         }
         cap = min(model.max_len, self.pool.capacity * block_size)
@@ -377,6 +423,13 @@ class InferenceEngine:
         # fixed assembly width: every decode step gathers this many blocks per
         # row (padded with scratch), so ONE compile covers all batch states
         self.blocks_per_seq = self.pool.blocks_for(self.max_seq_len)
+        if self.sp > 1 and self.blocks_per_seq % self.sp:
+            raise ValueError(
+                f"assembly width blocks_per_seq={self.blocks_per_seq} does "
+                f"not divide over sp={self.sp} shards — the round-robin "
+                f"placement would leave shards sweeping unequal table "
+                f"spans; pick max_seq_len (or num_blocks/block_size) so "
+                f"ceil(max_seq_len / block_size) is a multiple of sp")
         self.assembly_len = self.blocks_per_seq * block_size
         self.chunk_size = int(chunk_size)
         self.chunked_prefill = bool(chunked_prefill)
@@ -416,6 +469,10 @@ class InferenceEngine:
             # every TP step dispatch records a serve.allreduce span (the
             # 2-psum/layer collective cost is the TP tax worth watching)
             self._tp.tracer = self.tracer
+        if self._sp is not None:
+            # likewise SP: a serve.spmerge span per dispatch (one
+            # online-softmax merge psum per layer is the SP tax)
+            self._sp.tracer = self.tracer
         self.step_seq = 0                   # monotonically counts step() calls
         self._step_note: Optional[Dict[str, Any]] = None
         self._finished_note: Optional[Dict[str, Any]] = None
@@ -496,6 +553,11 @@ class InferenceEngine:
                 "fused decode stacks whole-model weights into one kernel "
                 "invocation — head-sharded TP params cannot stack; tp>1 "
                 "serves the paged or standard path")
+        if self.sp > 1:
+            raise ValueError(
+                "fused decode assembles one chip's contiguous cache — a "
+                "block-sharded SP pool has no single-chip cache to "
+                "assemble; sp>1 serves the paged or standard path")
         from ..models import fused_decode
 
         chunks = fused_decode.pick_chunks(
@@ -659,6 +721,8 @@ class InferenceEngine:
             "tp_degree": self.tp,
             "kv_bytes_per_token_per_shard":
                 self._gauge_extras["kv_bytes_per_token_per_shard"],
+            "sp_degree": self.sp,
+            "pool_blocks_per_shard": self.pool.blocks_per_shard,
             "host_tier_enabled": self.kv_tier is not None,
         })
         # tier counters: live values when the tier exists, stable zeroed
@@ -911,19 +975,48 @@ class InferenceEngine:
     def _put(self, x, dtype=None):
         """Explicit host->device transfer for step inputs (guard-proof
         replacement for the implicit jnp.asarray commit at dispatch).
-        Under TP the put replicates onto the mesh — a committed
+        Under TP/SP the put replicates onto the mesh — a committed
         single-device array cannot feed a jit whose other operands live on
         the mesh."""
         if self._tp is not None:
             return self._tp.put_replicated(np.asarray(x, dtype))
+        if self._sp is not None:
+            return self._sp.put_replicated(np.asarray(x, dtype))
         return jax.device_put(np.asarray(x, dtype))
 
+    def _put_tables(self, tables):
+        """Stage a step's GLOBAL block tables: a plain replicated put at
+        sp=1 (and under TP — every shard holds every block), the stacked
+        per-shard LOCAL view (``SPContext.put_tables``) under SP."""
+        if self._sp is not None:
+            return self._sp.put_tables(np.asarray(tables, np.int32),
+                                       self.pool.blocks_per_shard)
+        return self._put(tables, jnp.int32)
+
+    def _put_block_id(self, blk, dtype=None):
+        """Stage ONE global block id for the compiled whole-block write
+        (adopt) step: a traced scalar at sp=1, a per-shard (1, 1) local
+        table under SP — only the owner shard resolves a real row; everyone
+        else sees ``-1`` and no-ops on its scratch page."""
+        if self._sp is not None:
+            return self._sp.put_tables(np.array([[blk]], np.int32),
+                                       self.pool.blocks_per_shard)
+        return self._put(blk, dtype)
+
     def _jit_step(self, fn, *, donate_argnums=(), n_outs: int = 4,
-                  pages_argnums=(1, 2), pages_out=None, params_argnum=0):
-        """Compile a step body: plain jit at tp=1 (byte-identical programs
-        to before TP existed), shard_map over the TP mesh otherwise. The
-        extra keyword arguments describe which operands/outputs are the
-        head-sharded page bundles — plain jit ignores them."""
+                  pages_argnums=(1, 2), pages_out=None, params_argnum=0,
+                  tables_argnum=None):
+        """Compile a step body: plain jit at tp=sp=1 (byte-identical
+        programs to before TP/SP existed), shard_map over the TP or SP mesh
+        otherwise. The extra keyword arguments describe which
+        operands/outputs are the sharded page bundles and (under SP) which
+        operand is the stacked per-shard block table — plain jit and TP
+        ignore ``tables_argnum`` (TP tables are replicated)."""
+        if self._sp is not None:
+            return self._sp.jit_step(
+                fn, donate_argnums=donate_argnums, n_outs=n_outs,
+                pages_argnums=pages_argnums, pages_out=pages_out,
+                params_argnum=params_argnum, tables_argnum=tables_argnum)
         if self._tp is None:
             return jax.jit(fn, donate_argnums=donate_argnums)
         return self._tp.jit_step(
@@ -998,6 +1091,7 @@ class InferenceEngine:
                                     self.pool.occupancy,
                                     self.pool.kv_bytes_per_token,
                                     tp_degree=self.tp,
+                                    sp_degree=self.sp,
                                     tier_blocks=tier_blocks,
                                     tier_bytes=(self.kv_tier.bytes_used
                                                 if self.kv_tier is not None
@@ -1120,7 +1214,7 @@ class InferenceEngine:
         try:
             for req, g in zip(live, grows):
                 if g:
-                    ext = self.pool.alloc(g)
+                    ext = self.pool.alloc(g, start=len(req.block_table))
                     rollback.append((req, len(req.block_table), ext))
                     req.block_table.extend(ext)
         except PoolExhausted:
@@ -1149,7 +1243,7 @@ class InferenceEngine:
                              self.profiler):
                 newtok, ok, pk, pv = fn(
                     self.params, self.pool.pages_k, self.pool.pages_v,
-                    prev_tok, self._put(offsets), self._put(step.tables),
+                    prev_tok, self._put(offsets), self._put_tables(step.tables),
                     self._put(step.temps), self._put(step.topks),
                     self._put(step.topps), step_key, self._put(step.poison))
         except Exception:  # noqa: BLE001 — speculation must never hurt
@@ -1319,6 +1413,8 @@ class InferenceEngine:
             # jax.random.split runs on the default device; replicate the
             # subkey onto the mesh before it feeds a sharded step
             sub = self._tp.put_replicated(sub)
+        elif self._sp is not None:
+            sub = self._sp.put_replicated(sub)
         return sub
 
     def _prefill_fn(self, padded_len: int, nb: int):
@@ -1340,7 +1436,8 @@ class InferenceEngine:
 
         # pool buffers are donated: the scatter updates pages in place
         # instead of copying the whole pool per prefill
-        return self._jit_step(fn, donate_argnums=(1, 2), n_outs=4)
+        return self._jit_step(fn, donate_argnums=(1, 2), n_outs=4,
+                              tables_argnum=5)
 
     def _prefill_build(self, req: Request, events) -> Optional[Dict[str, Any]]:
         """Legacy whole-prompt prefill, build/dispatch half: allocate the
@@ -1392,8 +1489,8 @@ class InferenceEngine:
                 tok, ok, pk, pv = fn(
                     self.params, self.pool.pages_k, self.pool.pages_v,
                     self._put(ids), self._put(len(seq), jnp.int32),
-                    self._put(self.pool.padded_table(blocks, nb_bucket),
-                              jnp.int32),
+                    self._put_tables(self.pool.padded_table(blocks,
+                                                            nb_bucket)),
                     self._put(req.temperature, jnp.float32),
                     self._put(req.top_k, jnp.int32),
                     self._put(req.top_p, jnp.float32), self._step_key(),
@@ -1467,6 +1564,21 @@ class InferenceEngine:
         return True
 
     def _cow_copy_fn(self):
+        if self._sp is not None:
+            # the clone was allocated on the SOURCE block's shard
+            # (_match_prefix), so the copy is shard-local: the owner sees
+            # (src_local, dst_local), every other shard sees (-1, -1) ->
+            # clamped to its scratch page, a harmless identity write
+            def sp_fn(pages_k, pages_v, pair):
+                src = jnp.maximum(pair[0, 0], 0)
+                dst = jnp.maximum(pair[0, 1], 0)
+                return (kv_pool_lib.copy_blocks(pages_k, src, dst),
+                        kv_pool_lib.copy_blocks(pages_v, src, dst))
+
+            return self._jit_step(sp_fn, donate_argnums=(0, 1), n_outs=2,
+                                  pages_argnums=(0, 1), pages_out=(0, 1),
+                                  params_argnum=None, tables_argnum=2)
+
         def fn(pages_k, pages_v, src, dst):
             # kv_pool.copy_blocks: under int8 the scale sidecar clones with
             # its pages, so the COW block dequantizes identically
@@ -1501,6 +1613,20 @@ class InferenceEngine:
                                     tier_bytes=self.kv_tier.bytes_used)
 
     def _tier_adopt_fn(self):
+        if self._sp is not None:
+            # handoff adopt under SP: ``blk`` arrives as the per-shard
+            # (1, 1) local view (_put_block_id) — the owner writes the
+            # replicated payload into its row, every other shard writes it
+            # into its scratch page (garbage-by-contract, never read)
+            def sp_fn(pages_k, pages_v, blk, payload_k, payload_v):
+                b = jnp.maximum(blk[0, 0], 0)
+                return (kv_pool_lib.write_block(pages_k, b, payload_k),
+                        kv_pool_lib.write_block(pages_v, b, payload_v))
+
+            return self._jit_step(sp_fn, donate_argnums=(0, 1), n_outs=2,
+                                  pages_argnums=(0, 1), pages_out=(0, 1),
+                                  params_argnum=None, tables_argnum=2)
+
         def fn(pages_k, pages_v, blk, payload_k, payload_v):
             # kv_pool.write_block: under int8 the payload is a QuantPages
             # of slices, so data and scales re-adopt together
@@ -1566,7 +1692,7 @@ class InferenceEngine:
                 break
             payload_k, payload_v = self._tier_payload(leaves)
             self.pool.adopt_blocks([(blk[0], payload_k, payload_v)],
-                                   self._get_adopt_fn(), self._put)
+                                   self._get_adopt_fn(), self._put_block_id)
             self.prefix_cache.adopt(key, blk[0])
             # release into the evictable LRU (the block is now indexed):
             # probe() sees it immediately and fork() revives it — COW and
@@ -1624,10 +1750,20 @@ class InferenceEngine:
         fetched = iter(self.pool.export_blocks(
             [b for _, b, _ in sources if b is not None]))
         exports = []
-        for key, blk, leaves in sources:
+        for i, (key, blk, leaves) in enumerate(sources):
             if blk is not None:
                 leaves = tuple(np.asarray(x) for x in next(fetched))
-            exports.append((key, leaves, tier_digest(key, leaves)))
+            if self.pool.sp > 1:
+                # sp>1 wire tuples gain a 4th element: the context-mesh
+                # shard that held this block's pages. A same-degree
+                # receiver re-allocates on the matching shard so the
+                # adopted chain keeps a balanced position->shard layout;
+                # sp=1 stays a 3-tuple, byte-compatible with PR 19 peers.
+                exports.append((key, leaves, tier_digest(key, leaves),
+                                self.pool.owner(blk) if blk is not None
+                                else i % self.pool.sp))
+            else:
+                exports.append((key, leaves, tier_digest(key, leaves)))
         if exports:
             self.metrics.observe_handoff_export(len(exports))
             if self.tracer.enabled:
@@ -1678,7 +1814,13 @@ class InferenceEngine:
         if self.prefix_cache is None or self.pool.pages_deleted():
             return 0
         adopted = resident = 0
-        for key, leaves, digest in exports:
+        for i, ex in enumerate(exports):
+            # PR 19 peers ship 3-tuples; sp>1 exporters append the owner
+            # shard. Map it onto THIS replica's mesh degree (mod sp, the
+            # degrees need not match), defaulting to chain-position
+            # round-robin for legacy tuples.
+            key, leaves, digest = ex[0], ex[1], ex[2]
+            shard = (ex[3] if len(ex) > 3 else i) % self.pool.sp
             if self.prefix_cache.contains_key(key):
                 resident += 1       # dedupe — served here, keep walking
                 continue
@@ -1699,12 +1841,12 @@ class InferenceEngine:
             if not self._wire_leaves_ok(leaves):
                 break               # geometry mismatch — recompute instead
             try:
-                blk = self.pool.alloc(1)
+                blk = self.pool.alloc(1, start=shard)
             except (PoolExhausted, FaultInjected):
                 break
             payload_k, payload_v = self._tier_payload(leaves)
             self.pool.adopt_blocks([(blk[0], payload_k, payload_v)],
-                                   self._get_adopt_fn(), self._put)
+                                   self._get_adopt_fn(), self._put_block_id)
             if not self.prefix_cache.adopt(key, blk[0]):
                 # raced a local publish of the same chain: the key is
                 # served either way; the private copy drains to free
@@ -1760,7 +1902,12 @@ class InferenceEngine:
         table = self.pool.fork(blocks[:-1] if cow else blocks)
         if cow:
             try:
-                copy = self.pool.alloc(1)
+                # under SP the clone must land on the SOURCE block's shard —
+                # the jitted copy is shard-local (alloc's start is a table
+                # position, so passing the owner index targets that shard)
+                copy = self.pool.alloc(
+                    1, start=(self.pool.owner(blocks[-1])
+                              if self.pool.sp > 1 else 0))
             except (PoolExhausted, FaultInjected):
                 if table:
                     self.pool.free(table)
@@ -1769,9 +1916,13 @@ class InferenceEngine:
             fn = self._jit.get(cow_key)
             if fn is None:
                 fn = self._jit[cow_key] = self._cow_copy_fn()
-            pk, pv = fn(self.pool.pages_k, self.pool.pages_v,
-                        self._put(blocks[-1], jnp.int32),
+            if self._sp is not None:
+                tail = (self._put_tables(
+                    np.array([[blocks[-1], copy[0]]], np.int32)),)
+            else:
+                tail = (self._put(blocks[-1], jnp.int32),
                         self._put(copy[0], jnp.int32))
+            pk, pv = fn(self.pool.pages_k, self.pool.pages_v, *tail)
             self.pool.update_pages(pk, pv)
             table = table + copy
             self.metrics.observe_prefix_cow()
@@ -1801,7 +1952,8 @@ class InferenceEngine:
         prefill fault-injection site at the boundary)."""
         needed = self.pool.blocks_for(req.cache_len + new_tokens)
         grow = max(0, needed - len(req.block_table))
-        while grow and not self.pool.can_alloc(grow):
+        while grow and not self.pool.can_alloc(
+                grow, start=len(req.block_table)):
             victim = self.scheduler.preempt_victim()
             if victim is None or (victim is req
                                   and len(self.scheduler.running) == 1):
@@ -1827,7 +1979,8 @@ class InferenceEngine:
             if chunk and self.faults is not None:
                 self.faults.on_prefill()
             if grow:
-                req.block_table.extend(self.pool.alloc(grow))
+                req.block_table.extend(
+                    self.pool.alloc(grow, start=len(req.block_table)))
         except (PoolExhausted, FaultInjected) as e:
             where = "at chunk boundary" if chunk else "mid-decode"
             self._terminate(req, RequestState.FAILED,
@@ -1879,6 +2032,9 @@ class InferenceEngine:
                 # the TP mesh so the poison shift and the splice below mix
                 # only mesh-resident arrays
                 d = spec_decode.DeviceDraft(self._tp.put_replicated(d.toks))
+            elif self._sp is not None:
+                # same single-device drafter, context mesh instead
+                d = spec_decode.DeviceDraft(self._sp.put_replicated(d.toks))
             if not len(d):
                 continue
             if self.faults is not None and self.faults.poison_draft():
@@ -1942,7 +2098,8 @@ class InferenceEngine:
                 if d:
                     grow = self.pool.blocks_for(
                         req.cache_len + 1 + len(d)) - len(req.block_table)
-                    if grow > 0 and not self.pool.can_alloc(grow):
+                    if grow > 0 and not self.pool.can_alloc(
+                            grow, start=len(req.block_table)):
                         drafts.pop(req.rid, None)
                         d = None
                 if not self._grow_blocks(req, 1 + (len(d) if d else 0),
@@ -2000,11 +2157,12 @@ class InferenceEngine:
             # splice device-resident drafts into the token matrix without
             # fetching them. The commit reads draft VALUES back from the
             # fetched token matrix, so host and device drafts commit
-            # identically. Under TP the draft tensor (produced on the
+            # identically. Under TP/SP the draft tensor (produced on the
             # drafter's single device) replicates onto the mesh first —
             # a device-to-device transfer, no host sync.
-            draft_toks = dd.toks if self._tp is None \
-                else self._tp.put_replicated(dd.toks)
+            mesh = self._tp if self._tp is not None else self._sp
+            draft_toks = dd.toks if mesh is None \
+                else mesh.put_replicated(dd.toks)
             toks_in = _splice_draft_row(toks_in, draft_toks[None, :],
                                         self._put(i, jnp.int32))
         # one key per STEP (held across the retry): a transient fault retried
@@ -2021,7 +2179,7 @@ class InferenceEngine:
                         accepts, newtok, ok, pk, pv = fn(
                             self.params, self.pool.pages_k, self.pool.pages_v,
                             toks_in, self._put(step.starts),
-                            self._put(step.q_lens), self._put(step.tables),
+                            self._put(step.q_lens), self._put_tables(step.tables),
                             self._put(step.n_draft), self._put(step.temps),
                             self._put(step.topks), self._put(step.topps),
                             step_key, self._put(poison))
@@ -2029,7 +2187,7 @@ class InferenceEngine:
                         newtok, ok, pk, pv = fn(
                             self.params, self.pool.pages_k, self.pool.pages_v,
                             toks_in, self._put(step.starts),
-                            self._put(step.q_lens), self._put(step.tables),
+                            self._put(step.q_lens), self._put_tables(step.tables),
                             self._put(step.temps), self._put(step.topks),
                             self._put(step.topps), step_key,
                             self._put(poison))
@@ -2184,16 +2342,21 @@ class InferenceEngine:
             newtok = sampling.sample_ragged(last, key, t, k, p)
             return newtok, ok, pages_k, pages_v
 
-        return self._jit_step(fn, donate_argnums=(1, 2), n_outs=4)
+        return self._jit_step(fn, donate_argnums=(1, 2), n_outs=4,
+                              tables_argnum=6)
 
     def _mixed_standard_fn(self, b: int, qw: int, nb: int):
         model = self._step_model
+        # SP assembled-cache path: each shard gathers the positions it owns
+        # and a psum over the context mesh rebuilds the full replicated
+        # cache, so the cached-attention body below runs unchanged
+        sp_axis = self._step_model.sp_axis if self._sp is not None else None
 
         def fn(params, pages_k, pages_v, toks, starts, q_lens, tables,
                t, k, p, key, poison):
             kf, vf = kv_pool_lib.gather_kv(
                 pages_k, pages_v, tables,
-                out_dtype=model.policy.compute_dtype)
+                out_dtype=model.policy.compute_dtype, axis_name=sp_axis)
             # pad the time axis by qw: apply_cached's per-row cache write
             # CLAMPS its start, so a chunk ending at the assembly edge must
             # have headroom — the padded tail is gathered back below only
@@ -2228,7 +2391,8 @@ class InferenceEngine:
                                                 rows_v, q_lens)
             return newtok, ok, pages_k, pages_v
 
-        return self._jit_step(fn, donate_argnums=(1, 2), n_outs=4)
+        return self._jit_step(fn, donate_argnums=(1, 2), n_outs=4,
+                              tables_argnum=6)
 
     # -- speculative verification ----------------------------------------------
 
@@ -2303,17 +2467,19 @@ class InferenceEngine:
                                          t, k, p, key, poison)
             return accepts, newtok, ok, pages_k, pages_v
 
-        return self._jit_step(fn, donate_argnums=(1, 2), n_outs=5)
+        return self._jit_step(fn, donate_argnums=(1, 2), n_outs=5,
+                              tables_argnum=6)
 
     def _spec_standard_fn(self, b: int, qw: int, nb: int):
         model = self._step_model
         verify = self._spec_verify
+        sp_axis = self._step_model.sp_axis if self._sp is not None else None
 
         def fn(params, pages_k, pages_v, toks, starts, q_lens, tables,
                n_draft, t, k, p, key, poison):
             kf, vf = kv_pool_lib.gather_kv(
                 pages_k, pages_v, tables,
-                out_dtype=model.policy.compute_dtype)
+                out_dtype=model.policy.compute_dtype, axis_name=sp_axis)
             # same assembly-edge headroom rationale as _mixed_standard_fn
             pad = [(0, 0), (0, 0), (0, 0), (0, qw), (0, 0)]
             kf, vf = jnp.pad(kf, pad), jnp.pad(vf, pad)
@@ -2343,7 +2509,8 @@ class InferenceEngine:
                                                 rows_v, q_lens)
             return accepts, newtok, ok, pages_k, pages_v
 
-        return self._jit_step(fn, donate_argnums=(1, 2), n_outs=5)
+        return self._jit_step(fn, donate_argnums=(1, 2), n_outs=5,
+                              tables_argnum=6)
 
     def _preempt(self, req: Request) -> None:
         self._note_leave_running(req, time.perf_counter())
@@ -2358,12 +2525,13 @@ class InferenceEngine:
 
     def _decode_fn(self, batch: int, nb: int):
         model = self._step_model
+        sp_axis = self._step_model.sp_axis if self._sp is not None else None
 
         def fn(params, pages_k, pages_v, toks, offsets, tables, t, k, p, key,
                poison):
             kf, vf = kv_pool_lib.gather_kv(
                 pages_k, pages_v, tables,
-                out_dtype=model.policy.compute_dtype)
+                out_dtype=model.policy.compute_dtype, axis_name=sp_axis)
             x, _ = model.wte.apply({"params": params["wte"], "state": {}},
                                    toks[:, None])                 # (B, 1, D)
             x, _ = model.wpe.apply({"params": params["wpe"], "state": {}},
@@ -2388,7 +2556,8 @@ class InferenceEngine:
                                                 jnp.stack(rows_v))
             return newtok, ok, pages_k, pages_v
 
-        return self._jit_step(fn, donate_argnums=(1, 2), n_outs=4)
+        return self._jit_step(fn, donate_argnums=(1, 2), n_outs=4,
+                              tables_argnum=5)
 
     def _paged_decode_fn(self, batch: int, nb: int):
         model = self._step_model
@@ -2406,7 +2575,8 @@ class InferenceEngine:
             newtok = sampling.sample_ragged(logits, key, t, k, p)
             return newtok, ok, pages_k, pages_v
 
-        return self._jit_step(fn, donate_argnums=(1, 2), n_outs=4)
+        return self._jit_step(fn, donate_argnums=(1, 2), n_outs=4,
+                              tables_argnum=5)
 
     def _fused_decode_fn(self, batch: int, nb: int):
         model = self.model
@@ -2493,14 +2663,14 @@ class InferenceEngine:
                             self.pool.pages_k, self.pool.pages_v,
                             self._put(step.toks),
                             self._put(int(step.offsets[0]), jnp.int32),
-                            self._put(step.tables), self._put(step.temps),
+                            self._put_tables(step.tables), self._put(step.temps),
                             self._put(step.topks), self._put(step.topps),
                             step_key, self._put(poison))
                     else:
                         newtok, ok, pk, pv = fn(
                             self.params, self.pool.pages_k, self.pool.pages_v,
                             self._put(step.toks), self._put(step.offsets),
-                            self._put(step.tables), self._put(step.temps),
+                            self._put_tables(step.tables), self._put(step.temps),
                             self._put(step.topks), self._put(step.topps),
                             step_key, self._put(poison))
                 break

@@ -71,7 +71,7 @@ class PagedKVPool:
 
     def __init__(self, num_layers: int, num_kv_heads: int, head_dim: int,
                  num_blocks: int, block_size: int = 16, dtype=jnp.float32,
-                 kv_dtype: str = "f32", sharding=None):
+                 kv_dtype: str = "f32", sharding=None, sp: int = 1):
         if num_blocks < 2:
             raise ValueError("need >= 2 blocks (block 0 is reserved scratch)")
         if block_size < 1:
@@ -79,6 +79,15 @@ class PagedKVPool:
         if kv_dtype not in ("f32", "int8"):
             raise ValueError(f"kv_dtype must be 'f32' or 'int8', "
                              f"got {kv_dtype!r}")
+        if sp < 1:
+            raise ValueError(f"sp must be >= 1, got {sp}")
+        if num_blocks % sp:
+            raise ValueError(f"num_blocks {num_blocks} must divide evenly "
+                             f"over sp {sp} shards")
+        if sp > 1 and num_blocks // sp < 2:
+            raise ValueError(f"num_blocks {num_blocks} leaves < 2 blocks "
+                             f"per shard at sp {sp} (each shard reserves "
+                             "one scratch block)")
         self.num_layers = int(num_layers)
         self.num_kv_heads = int(num_kv_heads)
         self.head_dim = int(head_dim)
@@ -86,16 +95,30 @@ class PagedKVPool:
         self.block_size = int(block_size)
         self.dtype = dtype
         self.kv_dtype = kv_dtype
+        # sequence-parallel serving: the block axis is range-partitioned
+        # over ``sp`` shards — shard s owns GLOBAL block ids
+        # [s * N_local, (s+1) * N_local) with N_local = num_blocks // sp,
+        # and each shard's local row 0 (global id s * N_local) is reserved
+        # as that shard's scratch page. Host bookkeeping stays GLOBAL and
+        # replicated; only alloc placement (``alloc(..., start=)`` steers a
+        # table position's block to its round-robin owner shard) and the
+        # per-shard capacity accounting below are sp-aware.
+        self.sp = int(sp)
+        self.blocks_per_shard = self.num_blocks // self.sp
+        self._scratch = frozenset(s * self.blocks_per_shard
+                                  for s in range(self.sp))
         # tensor-parallel serving: a NamedSharding splitting the head axis
-        # over the TP mesh (serving/tp.PAGE_SPEC). Bookkeeping (free list,
-        # refcounts, tables) never looks inside a bundle, so only page
-        # creation here and in reset_pages cares; one sharding covers both
-        # QuantPages leaves (the f32 scale sidecar shards with its heads).
+        # over the TP mesh (serving/tp.PAGE_SPEC) — or, under SP, the block
+        # axis over the context mesh (serving/sp.PAGE_SPEC). Bookkeeping
+        # (free list, refcounts, tables) never looks inside a bundle, so
+        # only page creation here and in reset_pages cares; one sharding
+        # covers both QuantPages leaves.
         self.sharding = sharding
         self.reset_pages()
         # LIFO free list: freshly freed blocks are reused first (their pages
-        # are warmest); block 0 never enters it
-        self._free: List[int] = list(range(self.num_blocks - 1, 0, -1))
+        # are warmest); scratch blocks never enter it
+        self._free: List[int] = [b for b in range(self.num_blocks - 1, -1, -1)
+                                 if b not in self._scratch]
         self._ref: Dict[int, int] = {}
         # evictable LRU (insertion order = eviction order, oldest first):
         # blocks whose refcount dropped to zero but whose KV content is still
@@ -126,8 +149,9 @@ class PagedKVPool:
 
     @property
     def capacity(self) -> int:
-        """Allocatable blocks (total minus the reserved scratch block)."""
-        return self.num_blocks - 1
+        """Allocatable blocks (total minus the reserved scratch block —
+        one per sequence-parallel shard, so ``num_blocks - sp``)."""
+        return self.num_blocks - self.sp
 
     @property
     def num_free(self) -> int:
@@ -140,8 +164,18 @@ class PagedKVPool:
 
     @property
     def num_allocatable(self) -> int:
-        """Blocks an alloc() can take right now: free + evictable."""
-        return len(self._free) + len(self._evictable)
+        """Blocks an alloc() can take right now: free + evictable.
+
+        Under sequence parallelism a table position's block must come from
+        its round-robin owner shard, so the BOTTLENECK shard gates
+        admission: the aggregate is ``sp * min_s(free_s + evictable_s)``
+        — exactly the largest contiguous run of table positions that is
+        guaranteed allocatable from any starting position. The scheduler
+        consults only this property, so bottleneck gating falls out with
+        no scheduler change."""
+        if self.sp == 1:
+            return len(self._free) + len(self._evictable)
+        return self.sp * min(self._shard_avail(s) for s in range(self.sp))
 
     @property
     def num_allocated(self) -> int:
@@ -191,33 +225,107 @@ class PagedKVPool:
         """Blocks needed to hold ``num_tokens`` cache positions."""
         return max(1, math.ceil(num_tokens / self.block_size))
 
-    def can_alloc(self, n: int) -> bool:
-        return n <= len(self._free) + len(self._evictable)
+    def owner(self, block: int) -> int:
+        """Sequence-parallel shard a global block id lives on."""
+        return block // self.blocks_per_shard
+
+    def _shard_avail(self, shard: int) -> int:
+        """Free + evictable blocks owned by one SP shard."""
+        return (sum(1 for b in self._free if self.owner(b) == shard)
+                + sum(1 for b in self._evictable if self.owner(b) == shard))
+
+    def _shard_need(self, n: int, start: int) -> List[int]:
+        """Per-shard block demand of ``n`` table positions from ``start``
+        (position j's block lives on shard ``j % sp``)."""
+        need = [0] * self.sp
+        for i in range(n):
+            need[(start + i) % self.sp] += 1
+        return need
+
+    def can_alloc(self, n: int, start: int = 0) -> bool:
+        if self.sp == 1:
+            return n <= len(self._free) + len(self._evictable)
+        return all(need <= self._shard_avail(s)
+                   for s, need in enumerate(self._shard_need(n, start)))
 
     def is_evictable(self, block: int) -> bool:
         return block in self._evictable
 
-    def alloc(self, n: int) -> List[int]:
+    def _pick_free(self, shard: int) -> Optional[int]:
+        """Pop the most-recently-freed block owned by ``shard`` (keeps the
+        LIFO warm-reuse property per shard)."""
+        for i in range(len(self._free) - 1, -1, -1):
+            if self.owner(self._free[i]) == shard:
+                return self._free.pop(i)  # tnnlint: disable=unpaired-pool-mutation -- the popped block is set-less only until alloc() re-homes it into _ref; alloc runs _debug_check() after its shard loop, and a mid-pick check would false-trip the strict partition
+        return None
+
+    def _reclaim_shard(self, shard: int) -> bool:
+        """Reclaim the LRU-oldest evictable block owned by ``shard`` into
+        the free list (same demote/reclaim hook contract as _reclaim)."""
+        for b in self._evictable:
+            if self.owner(b) == shard:
+                del self._evictable[b]
+                self._free.append(b)
+                if self.demote_hook is not None:
+                    self.demote_hook([b])
+                if self.reclaim_hook is not None:
+                    self.reclaim_hook([b])
+                self._debug_check()
+                return True
+        return False
+
+    def alloc(self, n: int, start: int = 0) -> List[int]:
         """Take ``n`` blocks (refcount 1 each); raises PoolExhausted.
 
         Under pressure the free list is topped up by reclaiming LRU-oldest
         evictable blocks first (``reclaim_hook`` is told so the prefix cache
         drops their index entries) — cached pages are recycled before any
-        allocation can fail."""
-        if n > len(self._free) + len(self._evictable):
+        allocation can fail.
+
+        Under sequence parallelism, ``start`` is the table POSITION the
+        first returned block will occupy: block i is drawn from the free
+        list of shard ``(start + i) % sp``, so a sequence's pages spread
+        round-robin over the context mesh and each shard's attention sweep
+        covers ~1/sp of the sequence. At sp=1 ``start`` is ignored and the
+        behavior is byte-identical to the classic single-list pool."""
+        if self.sp == 1:
+            if n > len(self._free) + len(self._evictable):
+                raise PoolExhausted(
+                    f"need {n} blocks, {len(self._free)} free + "
+                    f"{len(self._evictable)} evictable "
+                    f"(capacity {self.capacity})")
+            if self.fault_plan is not None:
+                # may raise an injected PoolExhausted; fires BEFORE any state
+                # mutation so a rejected alloc never half-takes blocks (nor
+                # evicts cache entries for an allocation that never happens)
+                self.fault_plan.on_alloc(n, self.num_allocatable)
+            if n > len(self._free):
+                self._reclaim(n - len(self._free))
+            blocks = [self._free.pop() for _ in range(n)]
+            for b in blocks:
+                self._ref[b] = 1
+            self._debug_check()
+            return blocks
+        need = self._shard_need(n, start)
+        short = [(s, nd, self._shard_avail(s))
+                 for s, nd in enumerate(need) if nd > self._shard_avail(s)]
+        if short:
+            s, nd, av = short[0]
             raise PoolExhausted(
-                f"need {n} blocks, {len(self._free)} free + "
-                f"{len(self._evictable)} evictable (capacity {self.capacity})")
+                f"need {n} blocks from table position {start}, but shard "
+                f"{s} can cover only {av} of its {nd} "
+                f"(capacity {self.capacity}, {self.sp} SP shards)")
         if self.fault_plan is not None:
-            # may raise an injected PoolExhausted; fires BEFORE any state
-            # mutation so a rejected alloc never half-takes blocks (nor
-            # evicts cache entries for an allocation that never happens)
             self.fault_plan.on_alloc(n, self.num_allocatable)
-        if n > len(self._free):
-            self._reclaim(n - len(self._free))
-        blocks = [self._free.pop() for _ in range(n)]
-        for b in blocks:
+        blocks = []
+        for i in range(n):
+            s = (start + i) % self.sp
+            b = self._pick_free(s)
+            if b is None:
+                self._reclaim_shard(s)
+                b = self._pick_free(s)
             self._ref[b] = 1
+            blocks.append(b)
         self._debug_check()
         return blocks
 
@@ -372,9 +480,11 @@ class PagedKVPool:
         if len(free_set) != len(self._free):
             raise ValueError(f"duplicate blocks in free list: {self._free}")
         evict_set = set(self._evictable)
-        if (self.SCRATCH in free_set or self.SCRATCH in self._ref
-                or self.SCRATCH in evict_set):
-            raise ValueError("scratch block 0 entered circulation")
+        leaked_scratch = self._scratch & (free_set | self._ref.keys()
+                                          | evict_set)
+        if leaked_scratch:
+            raise ValueError(f"scratch block "
+                             f"{min(leaked_scratch)} entered circulation")
         if free_set & self._ref.keys():
             raise ValueError(
                 f"blocks both free and allocated: {free_set & self._ref.keys()}")
@@ -390,7 +500,7 @@ class PagedKVPool:
                 f"free ({len(self._free)}) + allocated ({len(self._ref)}) + "
                 f"evictable ({len(evict_set)}) != capacity ({self.capacity})")
         bad = [b for b in (free_set | self._ref.keys() | evict_set)
-               if not 1 <= b < self.num_blocks]
+               if not 0 <= b < self.num_blocks or b in self._scratch]
         if bad:
             raise ValueError(f"block ids out of range: {bad}")
         if any(r < 1 for r in self._ref.values()):
@@ -417,7 +527,8 @@ class PagedKVPool:
             usage: Counter = Counter()
             for table in block_tables:
                 usage.update(table)
-            usage.pop(self.SCRATCH, None)   # padded entries are legal
+            for sc in self._scratch:        # padded entries are legal
+                usage.pop(sc, None)
             stale = set(usage) & (evict_set | free_set)
             if stale:
                 raise ValueError(
@@ -523,7 +634,8 @@ class PagedKVPool:
 # -- jit-safe assembly (trace into the engine's compiled steps) ---------------
 
 
-def gather_kv(pages_k, pages_v, block_tables, out_dtype=None):
+def gather_kv(pages_k, pages_v, block_tables, out_dtype=None,
+              axis_name=None):
     """Block tables -> contiguous ragged-batch caches.
 
     pages_*: (L, N, H, bs, Dh); block_tables: (B, nb) int32.
@@ -536,23 +648,39 @@ def gather_kv(pages_k, pages_v, block_tables, out_dtype=None):
     to it (default f32) so it matches the compute dtype the downstream
     cached-attention writes its new rows in. Plain pages ignore it — they
     already ARE the pool dtype.
+
+    ``axis_name`` (sequence-parallel path, inside shard_map over the context
+    mesh): ``block_tables`` is this shard's LOCAL table — positions owned by
+    other shards hold ``-1``. Each shard gathers the positions it owns,
+    zeros the holes, and a ``psum`` over the mesh assembles the full
+    replicated cache: every shard ends up with the complete (L, B, H, T, Dh)
+    arrays, so the standard (assembled-cache) decode path runs unchanged
+    under SP.
     """
     def g(pages):
+        tbl = jnp.maximum(block_tables, 0) if axis_name is not None \
+            else block_tables
         if isinstance(pages, QuantPages):
             # dequant at the gather: the assembled cache is compute-dtype,
             # so the cached-attention consumers downstream are untouched
             l, _, h, bs, dh = pages.data.shape
             b, nb = block_tables.shape
-            x = pages.data[:, block_tables].astype(jnp.float32) \
-                * pages.scale[:, block_tables]
+            x = pages.data[:, tbl].astype(jnp.float32) \
+                * pages.scale[:, tbl]
             x = x.astype(out_dtype or jnp.float32)
             x = x.transpose(0, 1, 3, 2, 4, 5)
-            return x.reshape(l, b, h, nb * bs, dh)
-        l, _, h, bs, dh = pages.shape
-        b, nb = block_tables.shape
-        x = pages[:, block_tables]               # (L, B, nb, H, bs, Dh)
-        x = x.transpose(0, 1, 3, 2, 4, 5)        # (L, B, H, nb, bs, Dh)
-        return x.reshape(l, b, h, nb * bs, dh)
+            x = x.reshape(l, b, h, nb * bs, dh)
+        else:
+            l, _, h, bs, dh = pages.shape
+            b, nb = block_tables.shape
+            x = pages[:, tbl]                    # (L, B, nb, H, bs, Dh)
+            x = x.transpose(0, 1, 3, 2, 4, 5)    # (L, B, H, nb, bs, Dh)
+            x = x.reshape(l, b, h, nb * bs, dh)
+        if axis_name is not None:
+            dead = jnp.repeat(block_tables < 0, bs, axis=1)  # (B, nb*bs)
+            x = jnp.where(dead[None, :, None, :, None], 0, x)
+            x = jax.lax.psum(x, axis_name)
+        return x
     return g(pages_k), g(pages_v)
 
 
@@ -561,7 +689,9 @@ def scatter_prefill(pages, blocks, kv):
 
     pages: (L, N, H, bs, Dh); blocks: (nb,) int32; kv: (L, H, nb*bs, Dh).
     Returns the updated pages. QuantPages: rows quantize at write time;
-    data and scale scatter through identical index math.
+    data and scale scatter through identical index math. Under SP the
+    per-shard ``blocks`` carries ``-1`` for positions another shard owns;
+    those chunks are redirected to the shard's scratch page (local row 0).
     """
     if isinstance(pages, QuantPages):
         qkv, skv = quantize_kv_rows(kv)
@@ -569,6 +699,7 @@ def scatter_prefill(pages, blocks, kv):
                           scatter_prefill(pages.scale, blocks, skv))
     l, _, h, bs, dh = pages.shape
     nb = blocks.shape[0]
+    blocks = jnp.maximum(blocks, 0)
     x = kv.transpose(0, 2, 1, 3)                 # (L, P, H, Dh)
     x = x.reshape(l, nb, bs, h, dh)              # (L, nb, bs, H, Dh)
     x = x.transpose(0, 1, 3, 2, 4)               # (L, nb, H, bs, Dh)
@@ -592,6 +723,9 @@ def scatter_token(pages, block_tables, offsets, rows):
     bs = pages.shape[3]
     blk = jnp.take_along_axis(block_tables, (offsets // bs)[:, None],
                               axis=1)[:, 0]
+    # SP: a -1 hole (position owned by another shard) lands in this shard's
+    # scratch page instead of wrapping to the pool's last block
+    blk = jnp.maximum(blk, 0)
     slot = offsets % bs
     # the two advanced indices (blk, slot) around sliced axes put the batch
     # dim first in the update operand: (B, L, H, Dh)
@@ -622,7 +756,9 @@ def scatter_chunk(pages, block_tables, starts, rows, q_lens):
     live = jnp.arange(qw)[None, :] < q_lens[:, None]      # (B, Q)
     blk = jnp.take_along_axis(block_tables,
                               jnp.clip(pos // bs, 0, nbt - 1), axis=1)
-    blk = jnp.where(live, blk, PagedKVPool.SCRATCH)
+    # dead tokens AND -1 holes (SP positions owned by another shard) are
+    # both redirected to the scratch page
+    blk = jnp.maximum(jnp.where(live, blk, PagedKVPool.SCRATCH), 0)
     slot = pos % bs
     # advanced (blk, slot) indices broadcast to (B, Q) and lead the update
     # operand: (B, Q, L, H, Dh)

@@ -168,6 +168,11 @@ EXPOSITION: Dict[str, Tuple[str, str, str, str]] = {
         "tnn_serve_tp_degree", "gauge",
         "Tensor-parallel degree of this engine (attention heads and KV "
         "pool head-sharded over tp chips; 1 = single-chip)", "tp_degree"),
+    "serve.sp_degree": (
+        "tnn_serve_sp_degree", "gauge",
+        "Sequence-parallel degree of this engine (KV blocks sharded "
+        "position-wise over a context mesh of sp chips; 1 = single-chip)",
+        "sp_degree"),
     "serve.tier_hits": (
         "tnn_serve_tier_hits_total", "counter",
         "KV blocks re-admitted from the host-RAM tier (digest-verified "
@@ -614,6 +619,7 @@ class ServingMetrics:
     def observe_gauges(self, queue_depth: int, pool_occupancy: float,
                        kv_bytes_per_token: float = 0.0,
                        tp_degree: float = 1.0,
+                       sp_degree: float = 1.0,
                        tier_blocks: int = 0,
                        tier_bytes: float = 0.0) -> None:
         self.queue_depth.append(queue_depth)
@@ -622,6 +628,7 @@ class ServingMetrics:
         self._last_pool_occupancy = pool_occupancy
         self._last_kv_bytes_per_token = kv_bytes_per_token
         self._last_tp_degree = tp_degree
+        self._last_sp_degree = sp_degree
         self._last_tier_blocks = tier_blocks
         self._last_tier_bytes = tier_bytes
 
@@ -907,6 +914,7 @@ class ServingMetrics:
             "kv_bytes_per_token": getattr(self, "_last_kv_bytes_per_token",
                                           0.0),
             "tp_degree": getattr(self, "_last_tp_degree", 1.0),
+            "sp_degree": getattr(self, "_last_sp_degree", 1.0),
             "tier_hits": self.tier_hits,
             "tier_corrupt": self.tier_corrupt,
             "handoff_exported_blocks": self.handoff_exported_blocks,

@@ -46,6 +46,15 @@ chain's tail is reclaimed before its parents. A reclaimed parent would
 orphan its children's index entries (unreachable — ``probe`` walks from
 block 0 — but still occupying evictable pages until their own reclaim);
 tail-first reclaim avoids creating orphans in the common case.
+
+Sequence parallelism (sp>1) needs NO changes here: chain keys are
+sequence-positional, so block ``i`` of a cached chain was allocated for
+table position ``i`` and already lives on that position's round-robin
+owner shard — a ``probe`` hit forks blocks that are on the right shards
+by construction, and a published block parked evictable keeps its pages
+shard-local. The one SP-aware caller is ``engine._match_prefix``'s COW
+path, which allocates the clone on the SOURCE block's shard (the jitted
+copy is shard-local).
 """
 from __future__ import annotations
 

@@ -16,6 +16,12 @@ token-for-token, so preemption is invisible in the output stream.
 
 The scheduler is pure host-side policy — it never touches device arrays. The
 engine executes its plans and reports back via admit/finish/requeue.
+
+Pool gating is mesh-agnostic by construction: every admission/chunk decision
+consults ``pool.num_allocatable``, which under sequence parallelism (sp>1)
+already reports ``sp * min(free blocks per shard)`` — the BOTTLENECK shard
+gates admission, since a request's next block must come from the round-robin
+owner of its table position. No scheduler code branches on sp.
 """
 from __future__ import annotations
 

@@ -71,6 +71,32 @@ class DecodeStep(PackedStep):
     lockstep: bool = False          # uniform offsets (fused-kernel eligible)
 
 
+def shard_tables(tables: np.ndarray, sp: int,
+                 blocks_per_shard: int) -> np.ndarray:
+    """GLOBAL block tables -> stacked per-shard LOCAL tables for sequence
+    parallelism. Pure host math — the dispatch side stages the result over
+    the context mesh with ``P("seq", None, None)``.
+
+    ``tables``: global ids of any rank — (B, nb) step tables, the (nb,)
+    legacy-prefill table, the (1, k) block-id pairs of the COW/adopt steps.
+    Position j's block was allocated from shard ``j % sp``
+    (``PagedKVPool.alloc(..., start=)``) but this function derives
+    ownership from the ID RANGE, ``g // blocks_per_shard``, so COW-forked
+    and handoff-adopted blocks land on whichever shard actually holds
+    their pages. Returns (sp, *tables.shape) int32 where shard s's entry
+    is the LOCAL row ``g % blocks_per_shard`` if shard s owns ``g``, else
+    ``-1``: the paged kernel skips -1 blocks, the scatters redirect them to
+    the shard's scratch page, and ``gather_kv``'s psum reassembles the full
+    cache from the ownership partition.
+    """
+    owner = tables // blocks_per_shard
+    local = (tables % blocks_per_shard).astype(np.int32)
+    shards = np.arange(sp, dtype=np.int32).reshape(
+        (sp,) + (1,) * tables.ndim)
+    return np.where(owner[None] == shards, local[None],
+                    np.int32(-1))
+
+
 def _fill_row(step: PackedStep, i: int, req) -> None:
     step.tables[i, :len(req.block_table)] = req.block_table
     step.temps[i] = req.temperature
